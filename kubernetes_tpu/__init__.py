@@ -1,0 +1,45 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A from-scratch re-design of the reference Kubernetes scheduler stack
+(plugin/pkg/scheduler in the reference tree) around a pure, batched
+(pending_pods x nodes) tensor program executed by XLA on TPU:
+
+- predicates  -> boolean mask kernels over a struct-of-arrays ClusterSnapshot
+- priorities  -> integer score matrices (0..10 per priority, reference math)
+- selection   -> deterministic argmax replicating generic_scheduler.selectHost
+                 (score desc, host-name desc, round-robin among ties)
+- the backlog -> a lax.scan that threads resource commitments through the
+                 batch so results are bit-identical to the serial Go loop
+
+The event-driven shell around the tensor core (list/watch caches, optimistic
+assume with TTL expiry, binding, backoff, events, metrics, leader election)
+lives in host-side modules under `cache/`, `client/`, `utils/`.
+
+Layout:
+  api/       core object schema: Quantity, labels/selectors, Pod/Node types
+             (reference: pkg/api/types.go, pkg/api/resource, pkg/labels)
+  snapshot/  columnar ClusterSnapshot + host-side dictionary encoders
+             (reference: plugin/pkg/scheduler/schedulercache/node_info.go)
+  ops/       predicate masks and priority score kernels
+             (reference: plugin/pkg/scheduler/algorithm/{predicates,priorities})
+  models/    scheduling algorithms: batched generic scheduler, providers
+             (reference: plugin/pkg/scheduler/generic_scheduler.go,
+              plugin/pkg/scheduler/algorithmprovider)
+  parallel/  device-mesh sharding of the (pods x nodes) program (pjit/shard_map)
+  cache/     scheduler cache state machine (assume/add/expire)
+  client/    FIFO/watch/reflector-style feeds and fake control planes
+  oracle/    pure-Python sequential reference oracle (Go semantics) used as
+             the conformance corpus generator/checker
+  utils/     workqueue, backoff, trace, metrics, events
+
+Integer semantics note: the reference computes scores with int64 arithmetic
+(e.g. `((capacity-requested)*10)/capacity` in priorities.go:33); memory is
+int64 bytes. We therefore enable jax x64 so device arithmetic matches
+bit-for-bit. The heavy mask work stays int32/uint32.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,524 @@
+"""Scheduling-relevant object schema.
+
+Reference surface: pkg/api/types.go (Pod :1527, PodSpec :1391, Node :2043,
+NodeStatus :1930, ResourceRequirements :922, Binding :2115), plus the
+v1.3-era alpha annotations through which affinity/taints/tolerations were
+expressed (pkg/api/helpers.go: GetAffinityFromPodAnnotations,
+GetTolerationsFromPodAnnotations, GetTaintsFromNodeAnnotations).
+
+Dataclasses only — no behavior beyond light helpers. The tensor program
+consumes the columnar encodings in `kubernetes_tpu.snapshot`, never these.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.resource import (
+    resource_list_cpu_milli,
+    resource_list_gpu,
+    resource_list_memory,
+)
+
+# Alpha annotation keys (pkg/api/types.go / plugin factory.go:51).
+AFFINITY_ANNOTATION = "scheduler.alpha.kubernetes.io/affinity"
+TOLERATIONS_ANNOTATION = "scheduler.alpha.kubernetes.io/tolerations"
+TAINTS_ANNOTATION = "scheduler.alpha.kubernetes.io/taints"
+SCHEDULER_NAME_ANNOTATION = "scheduler.alpha.kubernetes.io/name"
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    # requests maps resource name -> quantity string/int ("cpu": "100m").
+    requests: Dict[str, object] = field(default_factory=dict)
+    limits: Dict[str, object] = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+# --- volume sources relevant to scheduling predicates -----------------------
+
+
+@dataclass
+class GCEPersistentDisk:
+    pd_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class AWSElasticBlockStore:
+    volume_id: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class RBDVolume:
+    monitors: Tuple[str, ...] = ()
+    image: str = ""
+    pool: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class PersistentVolumeClaimSource:
+    claim_name: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    gce_persistent_disk: Optional[GCEPersistentDisk] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStore] = None
+    rbd: Optional[RBDVolume] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimSource] = None
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    gce_persistent_disk: Optional[GCEPersistentDisk] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStore] = None
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_name: str = ""  # bound PV name
+
+
+# --- affinity ---------------------------------------------------------------
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In NotIn Exists DoesNotExist Gt Lt
+    values: Tuple[str, ...] = ()
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: Tuple[NodeSelectorRequirement, ...] = ()
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: Tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[NodeSelector] = None
+    preferred_during_scheduling_ignored_during_execution: Tuple[
+        PreferredSchedulingTerm, ...
+    ] = ()
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In NotIn Exists DoesNotExist
+    values: Tuple[str, ...] = ()
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: Tuple[LabelSelectorRequirement, ...] = ()
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    # None (nil) == the pod's own namespace; () (empty list) == ALL
+    # namespaces (util/non_zero.go:96 GetNamespacesFromPodAffinityTerm).
+    namespaces: Optional[Tuple[str, ...]] = None
+    topology_key: str = ""
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required_during_scheduling_ignored_during_execution: Tuple[PodAffinityTerm, ...] = ()
+    preferred_during_scheduling_ignored_during_execution: Tuple[
+        WeightedPodAffinityTerm, ...
+    ] = ()
+
+
+@dataclass
+class PodAntiAffinity:
+    required_during_scheduling_ignored_during_execution: Tuple[PodAffinityTerm, ...] = ()
+    preferred_during_scheduling_ignored_during_execution: Tuple[
+        WeightedPodAffinityTerm, ...
+    ] = ()
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "", NoSchedule, PreferNoSchedule
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule
+
+
+# --- pod / node -------------------------------------------------------------
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    volumes: List[Volume] = field(default_factory=list)
+    # Direct fields are preferred; the annotation forms (v1.3 alpha) are
+    # parsed by get_affinity/get_tolerations when the field is None.
+    affinity: Optional[Affinity] = None
+    tolerations: Optional[List[Toleration]] = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class NodeCondition:
+    type: str = "Ready"  # Ready | OutOfDisk | MemoryPressure | ...
+    status: str = "True"  # True | False | Unknown
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, object] = field(default_factory=dict)
+    allocatable: Dict[str, object] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    images: List["ContainerImage"] = field(default_factory=list)
+
+
+@dataclass
+class ContainerImage:
+    names: Tuple[str, ...] = ()
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: Optional[List[Taint]] = None  # direct form; else annotation
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class ReplicationControllerSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    replicas: int = 1
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
+
+
+@dataclass
+class ReplicaSetSpec:
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+
+
+@dataclass
+class Binding:
+    """The object POSTed to pods/<name>/binding (pkg/api/types.go:2115)."""
+
+    pod_namespace: str
+    pod_name: str
+    target_node: str
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def pod_resource_request(pod: Pod) -> Tuple[int, int, int]:
+    """(milliCPU, memoryBytes, gpu) for fit checks.
+
+    predicates.go:355-374 getResourceRequest: sum over containers, then take
+    elementwise max with each init container (cpu/mem only for the max rule).
+    """
+    mcpu = sum(resource_list_cpu_milli(c.requests) for c in pod.spec.containers)
+    mem = sum(resource_list_memory(c.requests) for c in pod.spec.containers)
+    gpu = sum(resource_list_gpu(c.requests) for c in pod.spec.containers)
+    for c in pod.spec.init_containers:
+        mcpu = max(mcpu, resource_list_cpu_milli(c.requests))
+        mem = max(mem, resource_list_memory(c.requests))
+    return mcpu, mem, gpu
+
+
+def pod_nonzero_request(pod: Pod) -> Tuple[int, int]:
+    """(milliCPU, memoryBytes) with per-container defaults for priorities.
+
+    priorities/util/non_zero.go:34-56 — a container that does not mention a
+    resource key at all is charged 100m / 200Mi; an explicit zero stays zero.
+    Init containers are NOT included (NodeInfo sums only spec.Containers).
+    """
+    mcpu = 0
+    mem = 0
+    for c in pod.spec.containers:
+        if "cpu" in c.requests:
+            mcpu += resource_list_cpu_milli(c.requests)
+        else:
+            mcpu += 100
+        if "memory" in c.requests:
+            mem += resource_list_memory(c.requests)
+        else:
+            mem += 200 * 1024 * 1024
+    return mcpu, mem
+
+
+def _node_selector_requirement_from_json(d: dict) -> NodeSelectorRequirement:
+    return NodeSelectorRequirement(
+        key=d.get("key", ""),
+        operator=d.get("operator", "In"),
+        values=tuple(d.get("values") or ()),
+    )
+
+
+def _node_selector_from_json(d: dict) -> NodeSelector:
+    terms = []
+    for t in d.get("nodeSelectorTerms") or ():
+        terms.append(
+            NodeSelectorTerm(
+                match_expressions=tuple(
+                    _node_selector_requirement_from_json(e)
+                    for e in t.get("matchExpressions") or ()
+                )
+            )
+        )
+    return NodeSelector(node_selector_terms=tuple(terms))
+
+
+def _label_selector_from_json(d: Optional[dict]) -> Optional[LabelSelector]:
+    if d is None:
+        return None
+    return LabelSelector(
+        match_labels=dict(d.get("matchLabels") or {}),
+        match_expressions=tuple(
+            LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=tuple(e.get("values") or ()),
+            )
+            for e in d.get("matchExpressions") or ()
+        ),
+    )
+
+
+def _pod_affinity_term_from_json(d: dict) -> PodAffinityTerm:
+    ns = d.get("namespaces")
+    return PodAffinityTerm(
+        label_selector=_label_selector_from_json(d.get("labelSelector")),
+        namespaces=None if ns is None else tuple(ns),
+        topology_key=d.get("topologyKey", ""),
+    )
+
+
+def get_affinity(pod: Pod) -> Optional[Affinity]:
+    """Affinity from the spec field, else the v1.3 alpha annotation
+    (pkg/api/helpers.go GetAffinityFromPodAnnotations)."""
+    if pod.spec.affinity is not None:
+        return pod.spec.affinity
+    raw = pod.metadata.annotations.get(AFFINITY_ANNOTATION)
+    if not raw:
+        return None
+    d = json.loads(raw)
+    aff = Affinity()
+    na = d.get("nodeAffinity")
+    if na:
+        req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+        pref = na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+        aff.node_affinity = NodeAffinity(
+            required_during_scheduling_ignored_during_execution=(
+                _node_selector_from_json(req) if req else None
+            ),
+            preferred_during_scheduling_ignored_during_execution=tuple(
+                PreferredSchedulingTerm(
+                    weight=p.get("weight", 1),
+                    preference=NodeSelectorTerm(
+                        match_expressions=tuple(
+                            _node_selector_requirement_from_json(e)
+                            for e in (p.get("preference") or {}).get(
+                                "matchExpressions"
+                            )
+                            or ()
+                        )
+                    ),
+                )
+                for p in pref
+            ),
+        )
+    pa = d.get("podAffinity")
+    if pa:
+        aff.pod_affinity = PodAffinity(
+            required_during_scheduling_ignored_during_execution=tuple(
+                _pod_affinity_term_from_json(t)
+                for t in pa.get("requiredDuringSchedulingIgnoredDuringExecution") or ()
+            ),
+            preferred_during_scheduling_ignored_during_execution=tuple(
+                WeightedPodAffinityTerm(
+                    weight=t.get("weight", 1),
+                    pod_affinity_term=_pod_affinity_term_from_json(
+                        t.get("podAffinityTerm") or {}
+                    ),
+                )
+                for t in pa.get("preferredDuringSchedulingIgnoredDuringExecution")
+                or ()
+            ),
+        )
+    paa = d.get("podAntiAffinity")
+    if paa:
+        aff.pod_anti_affinity = PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=tuple(
+                _pod_affinity_term_from_json(t)
+                for t in paa.get("requiredDuringSchedulingIgnoredDuringExecution")
+                or ()
+            ),
+            preferred_during_scheduling_ignored_during_execution=tuple(
+                WeightedPodAffinityTerm(
+                    weight=t.get("weight", 1),
+                    pod_affinity_term=_pod_affinity_term_from_json(
+                        t.get("podAffinityTerm") or {}
+                    ),
+                )
+                for t in paa.get("preferredDuringSchedulingIgnoredDuringExecution")
+                or ()
+            ),
+        )
+    return aff
+
+
+def get_tolerations(pod: Pod) -> List[Toleration]:
+    """Tolerations from the spec field, else the alpha annotation."""
+    if pod.spec.tolerations is not None:
+        return pod.spec.tolerations
+    raw = pod.metadata.annotations.get(TOLERATIONS_ANNOTATION)
+    if not raw:
+        return []
+    return [
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "") or "Equal",
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in json.loads(raw)
+    ]
+
+
+def get_taints(node: Node) -> List[Taint]:
+    """Taints from the spec field, else the alpha annotation."""
+    if node.spec.taints is not None:
+        return node.spec.taints
+    raw = node.metadata.annotations.get(TAINTS_ANNOTATION)
+    if not raw:
+        return []
+    return [
+        Taint(
+            key=t.get("key", ""),
+            value=t.get("value", ""),
+            effect=t.get("effect", "NoSchedule"),
+        )
+        for t in json.loads(raw)
+    ]

@@ -1,0 +1,112 @@
+"""Unit tests for the core API layer (quantities, selectors, helpers).
+
+Scenario tables are re-derived from the reference's test intent
+(pkg/api/resource/quantity_test.go, pkg/labels/selector_test.go idioms) —
+tables, not code.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import labels as lab
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import (
+    Container,
+    Pod,
+    PodSpec,
+    ObjectMeta,
+    pod_nonzero_request,
+    pod_resource_request,
+)
+
+
+@pytest.mark.parametrize(
+    "s,value,milli",
+    [
+        ("100m", 1, 100),
+        ("1", 1, 1000),
+        ("0", 0, 0),
+        ("500Mi", 500 * 1024 * 1024, 500 * 1024 * 1024 * 1000),
+        ("1Gi", 1024**3, 1024**3 * 1000),
+        ("4", 4, 4000),
+        ("2.5", 3, 2500),  # Value() rounds up
+        ("1e3", 1000, 10**6),
+        ("5G", 5 * 10**9, 5 * 10**12),
+        ("110", 110, 110000),
+        ("250m", 1, 250),
+        ("32Gi", 32 * 1024**3, 32 * 1024**3 * 1000),
+    ],
+)
+def test_quantity_parse(s, value, milli):
+    q = parse_quantity(s)
+    assert q.value() == value
+    assert q.milli_value() == milli
+
+
+def test_quantity_negative_rounds_away_from_zero():
+    assert parse_quantity("-2.5").value() == -3
+    assert parse_quantity("-100m").milli_value() == -100
+
+
+def test_selector_ops():
+    labels = {"env": "prod", "tier": "web", "num": "3"}
+    assert lab.new_requirement("env", lab.IN, ["prod", "dev"]).matches(labels)
+    assert not lab.new_requirement("env", lab.IN, ["dev"]).matches(labels)
+    assert lab.new_requirement("missing", lab.NOT_IN, ["x"]).matches(labels)
+    assert lab.new_requirement("env", lab.NOT_IN, ["dev"]).matches(labels)
+    assert not lab.new_requirement("env", lab.NOT_IN, ["prod"]).matches(labels)
+    assert lab.new_requirement("tier", lab.EXISTS, []).matches(labels)
+    assert not lab.new_requirement("zzz", lab.EXISTS, []).matches(labels)
+    assert lab.new_requirement("zzz", lab.DOES_NOT_EXIST, []).matches(labels)
+    assert lab.new_requirement("num", lab.GT, ["2"]).matches(labels)
+    assert not lab.new_requirement("num", lab.GT, ["3"]).matches(labels)
+    assert lab.new_requirement("num", lab.LT, ["4"]).matches(labels)
+    # Gt with non-numeric label value -> no match
+    assert not lab.new_requirement("env", lab.GT, ["2"]).matches(labels)
+    # Gt with |values| != 1 -> no match
+    assert not lab.Requirement("num", lab.GT, frozenset(["1", "2"])).matches(labels)
+
+
+def test_selector_from_set_and_everything():
+    assert lab.selector_from_set({}).matches({"a": "b"})
+    assert lab.selector_from_set(None).matches({})
+    s = lab.selector_from_set({"a": "b", "c": "d"})
+    assert s.matches({"a": "b", "c": "d", "e": "f"})
+    assert not s.matches({"a": "b"})
+    assert not lab.nothing().matches({})
+
+
+def _pod(requests_list, init_requests=()):
+    return Pod(
+        metadata=ObjectMeta(name="p"),
+        spec=PodSpec(
+            containers=[Container(requests=r) for r in requests_list],
+            init_containers=[Container(requests=r) for r in init_requests],
+        ),
+    )
+
+
+def test_pod_resource_request_sums_and_init_max():
+    # predicates.go:355-374: sum of containers, max with init containers
+    pod = _pod([{"cpu": "100m", "memory": "500Mi"}, {"cpu": "200m"}])
+    assert pod_resource_request(pod) == (300, 500 * 1024**2, 0)
+    pod = _pod(
+        [{"cpu": "100m", "memory": "100Mi"}],
+        init_requests=[{"cpu": "1", "memory": "50Mi"}, {"cpu": "50m", "memory": "900Mi"}],
+    )
+    mcpu, mem, gpu = pod_resource_request(pod)
+    assert mcpu == 1000  # init container max beats sum
+    assert mem == 900 * 1024**2
+    assert gpu == 0
+
+
+def test_pod_nonzero_request_defaults():
+    # non_zero.go: absent key -> 100m/200Mi; explicit zero stays zero
+    pod = _pod([{}])
+    assert pod_nonzero_request(pod) == (100, 200 * 1024**2)
+    pod = _pod([{"cpu": "0", "memory": "0"}])
+    assert pod_nonzero_request(pod) == (0, 0)
+    pod = _pod([{"cpu": "250m"}])
+    assert pod_nonzero_request(pod) == (250, 200 * 1024**2)
+    # init containers do not contribute (node_info.go calculateResource)
+    pod = _pod([{}], init_requests=[{"cpu": "4"}])
+    assert pod_nonzero_request(pod) == (100, 200 * 1024**2)
