@@ -38,6 +38,9 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     uid: str = ""
+    # RFC3339 string when the object is pending deletion (selector-spread
+    # skips such pods, selector_spreading.go:146).
+    deletion_timestamp: Optional[str] = None
 
     @property
     def full_name(self) -> str:

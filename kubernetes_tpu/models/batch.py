@@ -1,0 +1,330 @@
+"""Batched generic scheduler: the whole backlog as one device program.
+
+The reference schedules 50k pods as 50k serial scheduleOne cycles
+(scheduler.go:93), each a fresh O(nodes x predicates) CPU scan. Here the
+backlog is a single jitted lax.scan whose carry is the mutable slice of
+the cluster state (requested/nonzero resources, pod counts, port masks,
+per-class pod counts, lastNodeIndex) and whose per-step body is:
+
+    fit[N]    = AND of predicate masks          (ops.predicates)
+    score[N]  = sum_i weight_i * priority_i[N]  (ops.priorities)
+    chosen    = deterministic argmax w/ name-desc round-robin (ops.select)
+    carry'    = carry + commit(pod, chosen)     (AssumePod analogue)
+
+which is bit-identical to the serial loop because the commit threading
+reproduces scheduler.go:122 AssumePod between cycles and the selection
+reproduces selectHost exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.ops import predicates as P
+from kubernetes_tpu.ops import priorities as R
+from kubernetes_tpu.ops import select as S
+from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
+
+# predicate keys (factory/plugins.go registry names)
+GENERAL_PREDICATES = "GeneralPredicates"
+POD_TOLERATES_NODE_TAINTS = "PodToleratesNodeTaints"
+CHECK_NODE_MEMORY_PRESSURE = "CheckNodeMemoryPressure"
+
+LEAST_REQUESTED = "LeastRequestedPriority"
+BALANCED_ALLOCATION = "BalancedResourceAllocation"
+SELECTOR_SPREAD = "SelectorSpreadPriority"
+NODE_AFFINITY = "NodeAffinityPriority"
+TAINT_TOLERATION = "TaintTolerationPriority"
+EQUAL = "EqualPriority"
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Static (compile-time) algorithm configuration — the analogue of a
+    resolved algorithm provider (defaults.go:55 init)."""
+
+    predicates: Tuple[str, ...] = (
+        GENERAL_PREDICATES,
+        POD_TOLERATES_NODE_TAINTS,
+        CHECK_NODE_MEMORY_PRESSURE,
+    )
+    priorities: Tuple[Tuple[str, int], ...] = (
+        (LEAST_REQUESTED, 1),
+        (BALANCED_ALLOCATION, 1),
+        (SELECTOR_SPREAD, 1),
+        (NODE_AFFINITY, 1),
+        (TAINT_TOLERATION, 1),
+    )
+
+
+def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
+    (
+        req_mcpu,
+        req_mem,
+        req_gpu,
+        nz_mcpu,
+        nz_mem,
+        pod_count,
+        port_mask,
+        class_count,
+        last_idx,
+    ) = carry
+
+    fit = ~pod["unschedulable"]
+    if GENERAL_PREDICATES in config.predicates:
+        fit = fit & P.pod_fits_resources(
+            pod["req_mcpu"],
+            pod["req_mem"],
+            pod["req_gpu"],
+            pod["zero_req"],
+            static["alloc_mcpu"],
+            static["alloc_mem"],
+            static["alloc_gpu"],
+            static["alloc_pods"],
+            req_mcpu,
+            req_mem,
+            req_gpu,
+            pod_count,
+        )
+        fit = fit & P.pod_fits_host(pod["host_req"], static["alloc_mcpu"].shape[0])
+        fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
+        fit = fit & P.match_node_selector(
+            pod["ns_ops"],
+            pod["ns_key"],
+            pod["ns_set"],
+            pod["ns_numkey"],
+            pod["ns_num"],
+            pod["aff_has_req"],
+            pod["aff_term_valid"],
+            pod["aff_ops"],
+            pod["aff_key"],
+            pod["aff_set"],
+            pod["aff_numkey"],
+            pod["aff_num"],
+            static["label_kv"],
+            static["label_key"],
+            static["numval"],
+            static["set_table"],
+        )
+    if POD_TOLERATES_NODE_TAINTS in config.predicates:
+        fit = fit & P.pod_tolerates_node_taints(
+            pod["tol_mask"],
+            pod["has_tolerations"],
+            static["taint_mask"],
+            static["has_taints"],
+            static["taint_bad"],
+            static["noschedule_taints"],
+        )
+    if CHECK_NODE_MEMORY_PRESSURE in config.predicates:
+        fit = fit & P.check_node_memory_pressure(
+            pod["best_effort"], static["mem_pressure"]
+        )
+
+    score = jnp.zeros(req_mcpu.shape, jnp.int64)
+    for name, weight in config.priorities:
+        if name == LEAST_REQUESTED:
+            s = R.least_requested(
+                pod["nz_mcpu"],
+                pod["nz_mem"],
+                nz_mcpu,
+                nz_mem,
+                static["alloc_mcpu"],
+                static["alloc_mem"],
+            )
+        elif name == BALANCED_ALLOCATION:
+            s = R.balanced_resource_allocation(
+                pod["nz_mcpu"],
+                pod["nz_mem"],
+                nz_mcpu,
+                nz_mem,
+                static["alloc_mcpu"],
+                static["alloc_mem"],
+            )
+        elif name == SELECTOR_SPREAD:
+            s = R.selector_spread(
+                pod["has_selectors"],
+                pod["spread_match"],
+                class_count,
+                static["zone_id"],
+                num_zones,
+                fit,
+            )
+        elif name == NODE_AFFINITY:
+            s = R.node_affinity_preferred(
+                pod["pref_valid"],
+                pod["pref_weight"],
+                pod["pref_ops"],
+                pod["pref_key"],
+                pod["pref_set"],
+                pod["pref_numkey"],
+                pod["pref_num"],
+                static["label_kv"],
+                static["label_key"],
+                static["numval"],
+                static["set_table"],
+                fit,
+            )
+        elif name == TAINT_TOLERATION:
+            s = R.taint_toleration(
+                pod["intolerable_prefer"],
+                static["taint_count"],
+                fit,
+            )
+        elif name == EQUAL:
+            s = R.equal(req_mcpu.shape[0])
+        else:
+            raise ValueError(f"unknown priority {name!r}")
+        score = score + jnp.int64(weight) * s
+
+    chosen, scheduled = S.select_host(score, fit, last_idx, static["name_desc_order"])
+
+    # commit (AssumePod): fold the pod into the carry where scheduled.
+    # NodeInfo accounting uses container sums WITHOUT the init-container
+    # max rule (node_info.go:158), hence commit_* not req_*.
+    safe = jnp.maximum(chosen, 0)
+    inc = scheduled.astype(jnp.int64)
+    req_mcpu = req_mcpu.at[safe].add(pod["commit_mcpu"] * inc)
+    req_mem = req_mem.at[safe].add(pod["commit_mem"] * inc)
+    req_gpu = req_gpu.at[safe].add(pod["commit_gpu"] * inc)
+    nz_mcpu = nz_mcpu.at[safe].add(pod["nz_mcpu"] * inc)
+    nz_mem = nz_mem.at[safe].add(pod["nz_mem"] * inc)
+    pod_count = pod_count.at[safe].add(inc)
+    port_mask = port_mask.at[safe].set(
+        jnp.where(scheduled, port_mask[safe] | pod["port_mask"], port_mask[safe])
+    )
+    class_count = class_count.at[safe, pod["class_id"]].add(inc)
+    last_idx = last_idx + inc
+
+    carry = (
+        req_mcpu,
+        req_mem,
+        req_gpu,
+        nz_mcpu,
+        nz_mem,
+        pod_count,
+        port_mask,
+        class_count,
+        last_idx,
+    )
+    return carry, chosen
+
+
+class BatchScheduler:
+    """Schedule a pending-pod backlog against a snapshot, bit-identically
+    to the serial reference loop. One compile per (N, P, widths) shape."""
+
+    POD_FIELDS = [
+        "req_mcpu",
+        "req_mem",
+        "req_gpu",
+        "zero_req",
+        "commit_mcpu",
+        "commit_mem",
+        "commit_gpu",
+        "nz_mcpu",
+        "nz_mem",
+        "host_req",
+        "port_mask",
+        "ns_ops",
+        "ns_key",
+        "ns_set",
+        "ns_numkey",
+        "ns_num",
+        "aff_has_req",
+        "aff_term_valid",
+        "aff_ops",
+        "aff_key",
+        "aff_set",
+        "aff_numkey",
+        "aff_num",
+        "pref_valid",
+        "pref_weight",
+        "pref_ops",
+        "pref_key",
+        "pref_set",
+        "pref_numkey",
+        "pref_num",
+        "tol_mask",
+        "intolerable_prefer",
+        "has_tolerations",
+        "best_effort",
+        "has_selectors",
+        "spread_match",
+        "class_id",
+        "unschedulable",
+    ]
+    STATIC_FIELDS = [
+        "alloc_mcpu",
+        "alloc_mem",
+        "alloc_gpu",
+        "alloc_pods",
+        "label_kv",
+        "label_key",
+        "numval",
+        "taint_mask",
+        "taint_count",
+        "has_taints",
+        "taint_bad",
+        "mem_pressure",
+        "zone_id",
+        "name_desc_order",
+        "set_table",
+        "noschedule_taints",
+        "prefer_taints",
+    ]
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        self._jitted = {}
+
+    def _compiled(self, num_zones: int):
+        key = num_zones
+        fn = self._jitted.get(key)
+        if fn is None:
+            scan_body = functools.partial(_scan_fn, self.config, num_zones)
+
+            @jax.jit
+            def run(static, carry, pods):
+                final, chosen = jax.lax.scan(
+                    functools.partial(scan_body, static), carry, pods
+                )
+                return final, chosen
+
+            fn = run
+            self._jitted[key] = fn
+        return fn
+
+    def initial_carry(self, snap: ClusterSnapshot):
+        return (
+            jnp.asarray(snap.req_mcpu),
+            jnp.asarray(snap.req_mem),
+            jnp.asarray(snap.req_gpu),
+            jnp.asarray(snap.nz_mcpu),
+            jnp.asarray(snap.nz_mem),
+            jnp.asarray(snap.pod_count),
+            jnp.asarray(snap.port_mask),
+            jnp.asarray(snap.class_count),
+            jnp.int64(0),
+        )
+
+    def schedule(self, snap: ClusterSnapshot, batch: PodBatch):
+        """Returns (chosen_node_index[P] int32 with -1 == unschedulable,
+        final_carry)."""
+        static = {f: jnp.asarray(getattr(snap, f)) for f in self.STATIC_FIELDS}
+        pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
+        num_zones = int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1
+        # num_zones must cover the vocab; zone ids are dense from encoding
+        run = self._compiled(max(num_zones, 1))
+        final, chosen = run(static, self.initial_carry(snap), pods)
+        return np.asarray(chosen), final
+
+    def schedule_names(self, snap: ClusterSnapshot, batch: PodBatch):
+        """Like schedule() but returns node names (None == unschedulable)."""
+        chosen, _ = self.schedule(snap, batch)
+        return [snap.node_names[i] if i >= 0 else None for i in chosen]

@@ -1,0 +1,11 @@
+"""Device kernels: predicate masks, priority scores, host selection.
+
+Everything here is pure jnp on the columnar snapshot — no Python objects,
+no strings, no data-dependent Python control flow. These are the tensor
+re-statements of plugin/pkg/scheduler/algorithm/{predicates,priorities}
+(reference file:line cites on each kernel).
+"""
+
+from kubernetes_tpu.ops import bitset, predicates, priorities, select
+
+__all__ = ["bitset", "predicates", "priorities", "select"]

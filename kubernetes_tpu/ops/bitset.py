@@ -1,0 +1,34 @@
+"""uint32 bitset primitives used by every mask kernel."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def test_bit(mask: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """mask[..., W] u32, idx[...] i32 -> bool: bit `idx` set? Negative idx
+    (unknown vocab id) tests as False."""
+    safe = jnp.maximum(idx, 0)
+    word = jnp.take_along_axis(
+        mask, (safe // 32)[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    bit = (word >> (safe % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return (bit != 0) & (idx >= 0)
+
+
+def intersects(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """any common bit along the last (word) axis."""
+    return jnp.any((a & b) != 0, axis=-1)
+
+
+def popcount(mask: jnp.ndarray) -> jnp.ndarray:
+    """number of set bits, summed over the word axis -> int64."""
+    # binary popcount on u32 words
+    x = mask
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    x = (x * jnp.uint32(0x01010101)) >> 24
+    return x.astype(jnp.int64).sum(axis=-1)
+
+

@@ -1,0 +1,169 @@
+"""Predicate mask kernels.
+
+Each kernel maps one pending pod (scalar fields + small compiled programs)
+against all N nodes at once, returning a bool[N] fit mask — the tensor
+re-statement of the reference's per-node serial loop
+(generic_scheduler.go:182 podFitsOnNode). Dynamic state (requested
+resources, pod counts, port masks, class counts) is threaded through the
+scan by models/batch; static node data comes from the snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import bitset
+from kubernetes_tpu.snapshot.encode import (
+    OP_EXISTS,
+    OP_FAIL,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_EXISTS,
+    OP_NOT_IN,
+    OP_PAD,
+)
+
+
+def pod_fits_resources(
+    pod_req_mcpu,
+    pod_req_mem,
+    pod_req_gpu,
+    pod_zero_req,
+    alloc_mcpu,
+    alloc_mem,
+    alloc_gpu,
+    alloc_pods,
+    req_mcpu,
+    req_mem,
+    req_gpu,
+    pod_count,
+):
+    """predicates.go:416 PodFitsResources as a mask.
+
+    Order quirks preserved: the pod-count check applies even to
+    zero-request pods; a zero-request pod then skips cpu/mem/gpu entirely
+    (predicates.go:423-431)."""
+    count_ok = pod_count + 1 <= alloc_pods
+    cpu_ok = alloc_mcpu >= pod_req_mcpu + req_mcpu
+    mem_ok = alloc_mem >= pod_req_mem + req_mem
+    gpu_ok = alloc_gpu >= pod_req_gpu + req_gpu
+    resources_ok = jnp.where(pod_zero_req, True, cpu_ok & mem_ok & gpu_ok)
+    return count_ok & resources_ok
+
+
+def pod_fits_host(pod_host_req, num_nodes):
+    """predicates.go:533 PodFitsHost: -1 == unconstrained; -2 == a node
+    name not in the snapshot (matches nothing)."""
+    node_ids = jnp.arange(num_nodes, dtype=jnp.int32)
+    return jnp.where(pod_host_req < 0, pod_host_req == -1, node_ids == pod_host_req)
+
+
+def pod_fits_host_ports(pod_port_mask, node_port_mask):
+    """predicates.go:687 PodFitsHostPorts: no wanted port already in use.
+    An empty want-set intersects nothing, reproducing the early true."""
+    return ~bitset.intersects(node_port_mask, pod_port_mask[None, :])
+
+
+def _requirement_matrix(
+    ops, key, set_idx, numkey, num, label_kv, label_key, numval, set_table
+):
+    """Evaluate an AND-program of R requirements against N nodes.
+
+    ops/key/set_idx/numkey: [R]; num: [R] f64
+    label_kv: [N, LW] u32; label_key: [N, KW] u32; numval: [N, KG] f64
+    Returns match[N] = AND over requirements (exact selector.go:163-203
+    semantics per op)."""
+    R = ops.shape[0]
+    has_key = bitset.test_bit(label_key[:, None, :], key[None, :])  # [N, R]
+    set_masks = set_table[jnp.maximum(set_idx, 0)]  # [R, LW]
+    in_set = bitset.intersects(label_kv[:, None, :], set_masks[None, :, :])  # [N, R]
+    nk = jnp.maximum(numkey, 0)
+    node_num = numval[:, nk]  # [N, R]
+    num_valid = ~jnp.isnan(node_num)
+    gt = has_key & num_valid & (node_num > num[None, :])
+    lt = has_key & num_valid & (node_num < num[None, :])
+
+    match = jnp.ones_like(has_key)
+    match = jnp.where(ops[None, :] == OP_IN, has_key & in_set, match)
+    match = jnp.where(ops[None, :] == OP_NOT_IN, (~has_key) | (~in_set), match)
+    match = jnp.where(ops[None, :] == OP_EXISTS, has_key, match)
+    match = jnp.where(ops[None, :] == OP_NOT_EXISTS, ~has_key, match)
+    match = jnp.where(ops[None, :] == OP_GT, gt, match)
+    match = jnp.where(ops[None, :] == OP_LT, lt, match)
+    match = jnp.where(ops[None, :] == OP_FAIL, False, match)
+    return jnp.all(match, axis=1)  # [N]
+
+
+def match_node_selector(
+    ns_ops,
+    ns_key,
+    ns_set,
+    ns_numkey,
+    ns_num,
+    aff_has_req,
+    aff_term_valid,
+    aff_ops,
+    aff_key,
+    aff_set,
+    aff_numkey,
+    aff_num,
+    label_kv,
+    label_key,
+    numval,
+    set_table,
+):
+    """predicates.go:470 PodMatchesNodeLabels: nodeSelector (AND program)
+    AND required NodeAffinity (OR over terms, each an AND program; a pod
+    with required affinity but zero valid terms matches nothing)."""
+    ns_match = _requirement_matrix(
+        ns_ops, ns_key, ns_set, ns_numkey, ns_num, label_kv, label_key, numval, set_table
+    )
+    T = aff_term_valid.shape[0]
+    term_matches = []
+    for t in range(T):  # T is a small static bound; unrolled at trace time
+        m = _requirement_matrix(
+            aff_ops[t],
+            aff_key[t],
+            aff_set[t],
+            aff_numkey[t],
+            aff_num[t],
+            label_kv,
+            label_key,
+            numval,
+            set_table,
+        )
+        term_matches.append(m & aff_term_valid[t])
+    any_term = jnp.stack(term_matches, axis=0).any(axis=0)
+    aff_ok = jnp.where(aff_has_req, any_term, True)
+    return ns_match & aff_ok
+
+
+def pod_tolerates_node_taints(
+    pod_tol_mask,
+    pod_has_tolerations,
+    node_taint_mask,
+    node_has_taints,
+    node_taint_bad,
+    noschedule_taints,
+):
+    """predicates.go:960-1002 PodToleratesNodeTaints. Quirks preserved:
+    empty taints -> fit; non-empty taints + empty tolerations -> unfit
+    (even all-PreferNoSchedule); otherwise every NoSchedule taint must be
+    tolerated (PreferNoSchedule skipped). A node with a malformed taints
+    annotation errors for every pod -> unfit."""
+    untolerated = node_taint_mask & noschedule_taints[None, :] & ~pod_tol_mask[None, :]
+    all_tolerated = ~jnp.any(untolerated != 0, axis=-1)
+    fit = jnp.where(
+        ~node_has_taints,
+        True,
+        jnp.where(~pod_has_tolerations, False, all_tolerated),
+    )
+    return fit & ~node_taint_bad
+
+
+def check_node_memory_pressure(pod_best_effort, node_mem_pressure):
+    """predicates.go:1011 CheckNodeMemoryPressurePredicate."""
+    return jnp.where(pod_best_effort, ~node_mem_pressure, True)

@@ -1,0 +1,176 @@
+"""Priority score kernels — integer/float arithmetic matched to the
+reference operation-for-operation so int truncations agree.
+
+Every kernel returns an int64[N] score vector in 0..10 for one pending
+pod. Normalizing kernels (spread, node-affinity, taint-toleration) take
+the fit mask because the reference normalizes over FILTERED nodes only
+(PrioritizeNodes receives FakeNodeLister(filteredNodes),
+generic_scheduler.go:109)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import bitset
+from kubernetes_tpu.ops.predicates import _requirement_matrix
+
+MAX_PRIORITY = 10
+
+
+def _calculate_score(requested, capacity):
+    """priorities.go:33 calculateScore — int64, truncating division;
+    0 when capacity == 0 or requested > capacity."""
+    safe_cap = jnp.where(capacity == 0, 1, capacity)
+    score = ((capacity - requested) * 10) // safe_cap
+    return jnp.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+def least_requested(pod_nz_mcpu, pod_nz_mem, nz_mcpu, nz_mem, alloc_mcpu, alloc_mem):
+    """priorities.go:81 LeastRequestedPriority: avg of cpu+mem scores,
+    over NonZeroRequest + the pod's own nonzero request."""
+    total_cpu = nz_mcpu + pod_nz_mcpu
+    total_mem = nz_mem + pod_nz_mem
+    cpu_score = _calculate_score(total_cpu, alloc_mcpu)
+    mem_score = _calculate_score(total_mem, alloc_mem)
+    return (cpu_score + mem_score) // 2
+
+
+def balanced_resource_allocation(
+    pod_nz_mcpu, pod_nz_mem, nz_mcpu, nz_mem, alloc_mcpu, alloc_mem
+):
+    """priorities.go:215 BalancedResourceAllocation: float64 fractions,
+    10 - |cpuFrac - memFrac| * 10, truncated; 0 if either frac >= 1
+    (fractionOfCapacity returns 1 for capacity==0)."""
+    total_cpu = (nz_mcpu + pod_nz_mcpu).astype(jnp.float64)
+    total_mem = (nz_mem + pod_nz_mem).astype(jnp.float64)
+    cpu_frac = jnp.where(
+        alloc_mcpu == 0, 1.0, total_cpu / alloc_mcpu.astype(jnp.float64)
+    )
+    mem_frac = jnp.where(
+        alloc_mem == 0, 1.0, total_mem / alloc_mem.astype(jnp.float64)
+    )
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = (10.0 - diff * 10.0).astype(jnp.int64)
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0, score)
+
+
+def equal(num_nodes):
+    """generic_scheduler.go:310 EqualPriority."""
+    return jnp.ones((num_nodes,), jnp.int64)
+
+
+def selector_spread(
+    pod_has_selectors,
+    pod_spread_match,  # i64[C] 0/1
+    class_count,  # i64[N, C]
+    zone_id,  # i32[N]
+    num_zones,  # static int (vocab size incl. 0 == none)
+    fit_mask,  # bool[N]
+):
+    """selector_spreading.go:84 CalculateSpreadPriority.
+
+    count_n = number of same-namespace, non-deleted pods on node n
+    matching ANY selector of the pod = class_count @ spread_match.
+    maxCount and the zone aggregation run over FILTERED nodes only
+    (nodes.Items is the filtered list). float32 math as in Go."""
+    # contraction in int32: per-node pod counts are far below 2^31, and
+    # XLA's x64 rewriter has no TPU lowering for s64 dot_general
+    counts = (
+        class_count.astype(jnp.int32) @ pod_spread_match.astype(jnp.int32)
+    ).astype(jnp.int64)
+    counts = jnp.where(fit_mask, counts, 0)
+    max_count = counts.max(where=fit_mask, initial=0)
+
+    # zone aggregation: zone 0 == "no zone" and never participates.
+    # countsByZone exists for every zone seen among filtered nodes
+    # (including zero counts), so haveZones == any filtered node is zoned.
+    zcounts = jnp.zeros((num_zones,), jnp.int64).at[zone_id].add(
+        jnp.where(fit_mask, counts, 0)
+    )
+    zone_seen = jnp.zeros((num_zones,), jnp.int32).at[zone_id].add(
+        (fit_mask & (zone_id > 0)).astype(jnp.int32)
+    )
+    have_zones = jnp.any(zone_seen > 0)
+    max_zone = jnp.where(jnp.arange(num_zones) > 0, zcounts, 0).max(initial=0)
+
+    f = jnp.full(counts.shape, jnp.float32(MAX_PRIORITY))
+    f = jnp.where(
+        max_count > 0,
+        jnp.float32(MAX_PRIORITY)
+        * ((max_count - counts).astype(jnp.float32) / max_count.astype(jnp.float32)),
+        f,
+    )
+    node_zcount = zcounts[zone_id]
+    # NO maxCountByZone>0 guard in the reference (selector_spreading.go:224):
+    # 0/0 in float32 is NaN; Go's int(NaN) on amd64 is minInt64. We keep the
+    # IEEE NaN through the blend and map it at the final conversion.
+    zone_score = jnp.float32(MAX_PRIORITY) * (
+        (max_zone - node_zcount).astype(jnp.float32) / max_zone.astype(jnp.float32)
+    )
+    zone_weighting = jnp.float32(2.0 / 3.0)
+    blended = f * (jnp.float32(1.0) - zone_weighting) + zone_weighting * zone_score
+    f = jnp.where(have_zones & (zone_id > 0), blended, f)
+    # no selectors -> counts map empty -> maxCount 0 and zones skipped -> 10
+    f = jnp.where(pod_has_selectors, f, jnp.float32(MAX_PRIORITY))
+    return jnp.where(jnp.isnan(f), jnp.int64(-(2**63)), f.astype(jnp.int64))
+
+
+def node_affinity_preferred(
+    pref_valid,  # bool[TP]
+    pref_weight,  # i64[TP]
+    pref_ops,
+    pref_key,
+    pref_set,
+    pref_numkey,
+    pref_num,  # [TP, R] programs
+    label_kv,
+    label_key,
+    numval,
+    set_table,
+    fit_mask,
+):
+    """node_affinity.go:44 CalculateNodeAffinityPriority: sum weights of
+    matching preferred terms; normalize by max over filtered nodes;
+    10 * count/max in float64, truncated."""
+    TP = pref_valid.shape[0]
+    counts = jnp.zeros(fit_mask.shape, jnp.int64)
+    for t in range(TP):
+        m = _requirement_matrix(
+            pref_ops[t],
+            pref_key[t],
+            pref_set[t],
+            pref_numkey[t],
+            pref_num[t],
+            label_kv,
+            label_key,
+            numval,
+            set_table,
+        )
+        counts = counts + jnp.where(m & pref_valid[t], pref_weight[t], 0)
+    max_count = counts.max(where=fit_mask, initial=0)
+    f = jnp.where(
+        max_count > 0,
+        10.0 * (counts.astype(jnp.float64) / jnp.maximum(max_count, 1).astype(jnp.float64)),
+        0.0,
+    )
+    return f.astype(jnp.int64)
+
+
+def taint_toleration(
+    pod_intolerable_prefer,  # i32[TV] 0/1
+    node_taint_count,  # i32[N, TV] multiplicities
+    fit_mask,
+):
+    """taint_toleration.go:94: count PreferNoSchedule taints intolerable by
+    the pod's PreferNoSchedule-filtered tolerations (per-LIST count — a
+    node carrying duplicate taints counts each occurrence); normalize over
+    filtered nodes; (1 - count/max) * 10 float64, truncated."""
+    counts = (node_taint_count @ pod_intolerable_prefer).astype(jnp.int64)
+    max_count = counts.max(where=fit_mask, initial=0)
+    f = jnp.where(
+        max_count > 0,
+        (1.0 - counts.astype(jnp.float64) / jnp.maximum(max_count, 1).astype(jnp.float64))
+        * 10.0,
+        jnp.float64(MAX_PRIORITY),
+    )
+    return f.astype(jnp.int64)

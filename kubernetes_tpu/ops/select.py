@@ -1,0 +1,35 @@
+"""Deterministic host selection.
+
+generic_scheduler.go:119 selectHost: sort by (score desc, host-name desc)
+— a strict total order since names are unique — then pick index
+lastNodeIndex % numTies among the max-score prefix. Here: no sort; we use
+the precomputed name-descending permutation and a masked cumulative count
+to find the (r+1)-th tied node in name-desc order. O(N)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def select_host(scores, fit_mask, last_node_index, name_desc_order):
+    """Returns (chosen_node_index or -1, scheduled: bool).
+
+    scores: i64[N] combined weighted score
+    fit_mask: bool[N]
+    last_node_index: i64 scalar (increments only on success, host-side
+                     threading handled by the caller)
+    name_desc_order: i32[N] node indices sorted by name descending
+    """
+    min_int = jnp.int64(-(2**63))
+    max_score = jnp.where(fit_mask, scores, min_int).max()
+    any_fit = fit_mask.any()
+    # `fit &` keeps a real minInt64 score (the spread-NaN case) selectable
+    # while still excluding filtered-out nodes.
+    ties = fit_mask & (scores == max_score)
+    num_ties = ties.sum().astype(jnp.int64)
+    r = last_node_index % jnp.maximum(num_ties, 1)
+    ties_by_name = ties[name_desc_order]  # name-desc positions
+    cum = jnp.cumsum(ties_by_name.astype(jnp.int64))
+    pick_pos = jnp.argmax(ties_by_name & (cum == r + 1)).astype(jnp.int32)
+    chosen = name_desc_order[pick_pos]
+    return jnp.where(any_fit, chosen, -1), any_fit

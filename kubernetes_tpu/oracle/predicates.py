@@ -71,23 +71,36 @@ def insufficient_resource_error(resource: str, requested: int, used: int, capaci
 
 
 def node_selector_requirements_as_selector(reqs) -> Optional[labelpkg.Selector]:
-    """pkg/api/helpers.go:373 — empty list => Nothing; bad operator => None
-    (treated as parse error => no match)."""
+    """pkg/api/helpers.go:373 — empty list => Nothing; any requirement that
+    labels.NewRequirement (selector.go:116-144) would reject => None
+    (parse error => caller regards the whole term list as no-match)."""
     if not reqs:
         return labelpkg.nothing()
     out = []
     for r in reqs:
-        if r.operator not in (
-            labelpkg.IN,
-            labelpkg.NOT_IN,
-            labelpkg.EXISTS,
-            labelpkg.DOES_NOT_EXIST,
-            labelpkg.GT,
-            labelpkg.LT,
-        ):
+        if not _requirement_valid(r):
             return None
         out.append(labelpkg.new_requirement(r.key, r.operator, r.values))
     return labelpkg.Selector(tuple(out))
+
+
+def _requirement_valid(r) -> bool:
+    """labels.NewRequirement validation (selector.go:116-144)."""
+    if not r.key:
+        return False
+    if r.operator in (labelpkg.IN, labelpkg.NOT_IN):
+        return len(r.values) > 0
+    if r.operator in (labelpkg.EXISTS, labelpkg.DOES_NOT_EXIST):
+        return len(r.values) == 0
+    if r.operator in (labelpkg.GT, labelpkg.LT):
+        if len(r.values) != 1:
+            return False
+        try:
+            float(next(iter(r.values)))
+            return True
+        except (TypeError, ValueError):
+            return False
+    return False  # unrecognized operator
 
 
 def label_selector_as_selector(sel: Optional[LabelSelector]) -> labelpkg.Selector:
@@ -376,9 +389,13 @@ def taint_tolerated_by_tolerations(taint, tolerations) -> bool:
 def pod_tolerates_node_taints(pod: Pod, info: NodeInfo, state: ClusterState):
     """predicates.go:960 PodToleratesNodeTaints + :979
     tolerationsToleratesTaints — note: a non-empty taint list with an empty
-    toleration list is rejected even if all taints are PreferNoSchedule."""
-    taints = get_taints(info.node)
-    tolerations = get_tolerations(pod)
+    toleration list is rejected even if all taints are PreferNoSchedule.
+    A malformed taints/tolerations annotation is an error => unfit."""
+    try:
+        taints = get_taints(info.node)
+        tolerations = get_tolerations(pod)
+    except Exception:
+        return False, ERR_TAINTS_TOLERATIONS_NOT_MATCH
     if not taints:
         return True, None
     if not tolerations:

@@ -37,6 +37,11 @@ from kubernetes_tpu.oracle.state import ClusterState
 MAX_PRIORITY = 10
 ZONE_WEIGHTING = 2.0 / 3.0  # selector_spreading.go:38
 
+
+class PriorityError(Exception):
+    """A priority function returned an error (aborts the scheduling cycle
+    without a FitError, generic_scheduler.go:109-112)."""
+
 MB = 1024 * 1024
 MIN_IMG_SIZE = 23 * MB  # priorities.go:138-142
 MAX_IMG_SIZE = 1000 * MB
@@ -177,6 +182,10 @@ def selector_spread_priority(pod: Pod, state: ClusterState) -> Dict[str, int]:
             for np_ in info.pods:
                 if pod.namespace != np_.namespace:
                     continue
+                if np_.metadata.deletion_timestamp is not None:
+                    # pending-deleted pods are ignored for spreading
+                    # (selector_spreading.go:141-148)
+                    continue
                 if any(s.matches(np_.metadata.labels) for s in selectors):
                     count += 1
             counts[name] = count
@@ -203,14 +212,18 @@ def selector_spread_priority(pod: Pod, state: ClusterState) -> Dict[str, int]:
         if have_zones:
             zone_id = get_zone_key(info.node)
             if zone_id != "":
-                zone_score = np.float32(MAX_PRIORITY) * (
-                    np.float32(max_count_by_zone - counts_by_zone.get(zone_id, 0))
-                    / np.float32(max_count_by_zone)
-                )
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    # selector_spreading.go:224 has NO maxCountByZone>0
+                    # guard: 0/0 is float32 NaN and Go's int(NaN) on amd64
+                    # is minInt64 — reproduced below.
+                    zone_score = np.float32(MAX_PRIORITY) * (
+                        np.float32(max_count_by_zone - counts_by_zone.get(zone_id, 0))
+                        / np.float32(max_count_by_zone)
+                    )
                 f_score = np.float32(f_score * np.float32(1.0 - ZONE_WEIGHTING)) + (
                     np.float32(ZONE_WEIGHTING) * zone_score
                 )
-        out[name] = int(f_score)
+        out[name] = -(2**63) if np.isnan(f_score) else int(f_score)
     return out
 
 
@@ -276,8 +289,9 @@ def node_affinity_priority(pod: Pod, state: ClusterState) -> Dict[str, int]:
                 term.preference.match_expressions
             )
             if sel is None:
-                # reference returns an error -> priority aborts; model as all-0
-                return {name: 0 for name in state.node_infos}
+                # node_affinity.go:68 returns the parse error -> the whole
+                # scheduling cycle errors out and the pod is not scheduled
+                raise PriorityError("invalid preferred scheduling term")
             for name, info in state.node_infos.items():
                 if sel.matches(info.node.metadata.labels):
                     counts[name] = counts.get(name, 0) + term.weight

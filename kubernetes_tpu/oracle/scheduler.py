@@ -188,11 +188,13 @@ class GenericScheduler:
         """Serial scheduleOne over a backlog: schedule, assume, repeat —
         exactly what scheduler_perf drives (scheduler.go:93 + AssumePod).
         Returns the chosen node per pod (None where nothing fit)."""
+        from kubernetes_tpu.oracle.priorities import PriorityError
+
         results: List[Optional[str]] = []
         for pod in pods:
             try:
                 host = self.schedule(pod, state)
-            except FitError:
+            except (FitError, PriorityError):
                 results.append(None)
                 continue
             results.append(host)

@@ -1,0 +1,677 @@
+"""Host-side snapshot encoder: objects -> columnar device arrays.
+
+This is the analogue of the reference's snapshot step
+(schedulercache/cache.go:77 GetNodeNameToInfoMap) plus a compilation pass
+that turns every string-typed construct (labels, selectors, taints, host
+ports, node names) into dictionary ids and uint32 bitsets, so the entire
+predicate/priority computation can run as masked integer tensor ops.
+
+Selector compilation (SURVEY.md §7 hard-part 3): a label requirement
+(key, op, values) becomes (op_code, key_id, value_set_id, numeric operand);
+the node side carries `label_kv` / `label_key` bitsets and a float64
+sidecar for Gt/Lt keys. Matching a requirement is then 2-4 bitwise ops per
+(pod, node) pair, with k8s's exact key-absence semantics preserved
+(pkg/labels/selector.go:163-203).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as labelpkg
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Node,
+    NodeSelectorRequirement,
+    Pod,
+    get_affinity,
+    get_taints,
+    get_tolerations,
+    pod_nonzero_request,
+    pod_resource_request,
+)
+from kubernetes_tpu.api.resource import parse_quantity, resource_list_cpu_milli, resource_list_memory
+from kubernetes_tpu.api.types import Taint
+from kubernetes_tpu.oracle.predicates import (
+    _requirement_valid,
+    get_pod_controllers,
+    get_pod_replica_sets,
+    get_pod_services,
+    is_pod_best_effort,
+    label_selector_as_selector,
+    taint_tolerated_by_tolerations,
+)
+from kubernetes_tpu.oracle.priorities import get_zone_key
+from kubernetes_tpu.oracle.state import ClusterState, _calculate_resource
+
+# requirement op codes (device-side)
+OP_PAD = 0  # always passes (padding inside a term)
+OP_IN = 1
+OP_NOT_IN = 2
+OP_EXISTS = 3
+OP_NOT_EXISTS = 4
+OP_GT = 5
+OP_LT = 6
+OP_FAIL = 7  # always fails (parse error / empty term)
+
+_OP_BY_NAME = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_NOT_EXISTS,
+    "Gt": OP_GT,
+    "Lt": OP_LT,
+}
+
+
+def _pack_bits(ids: Sequence[int], words: int) -> np.ndarray:
+    out = np.zeros((words,), dtype=np.uint32)
+    for i in ids:
+        out[i // 32] |= np.uint32(1) << np.uint32(i % 32)
+    return out
+
+
+def _words(n: int) -> int:
+    return max(1, (n + 31) // 32)
+
+
+class _Dict:
+    """Monotone string->id dictionary."""
+
+    def __init__(self):
+        self.ids: Dict[object, int] = {}
+
+    def get(self, key, add=True) -> int:
+        i = self.ids.get(key)
+        if i is None:
+            if not add:
+                return -1
+            i = len(self.ids)
+            self.ids[key] = i
+        return i
+
+    def __len__(self):
+        return len(self.ids)
+
+
+@dataclass
+class ClusterSnapshot:
+    """Node-axis arrays + vocabulary tables (numpy, host-resident; the
+    batch scheduler ships them to device once per wave)."""
+
+    node_names: List[str]
+    # resources
+    alloc_mcpu: np.ndarray  # i64[N]
+    alloc_mem: np.ndarray  # i64[N]
+    alloc_gpu: np.ndarray  # i64[N]
+    alloc_pods: np.ndarray  # i64[N]
+    req_mcpu: np.ndarray  # i64[N]
+    req_mem: np.ndarray
+    req_gpu: np.ndarray
+    nz_mcpu: np.ndarray
+    nz_mem: np.ndarray
+    pod_count: np.ndarray  # i64[N]
+    # ports / labels / taints
+    port_mask: np.ndarray  # u32[N, PW]
+    label_kv: np.ndarray  # u32[N, LW]
+    label_key: np.ndarray  # u32[N, KW]
+    numval: np.ndarray  # f64[N, KG]
+    taint_mask: np.ndarray  # u32[N, TW]
+    # per-(node, taint-id) multiplicity: nodes can carry duplicate taints
+    # and the taint-toleration priority counts per-list, not per-set
+    taint_count: np.ndarray  # i32[N, TV]
+    has_taints: np.ndarray  # bool[N]
+    taint_bad: np.ndarray  # bool[N]: malformed taints annotation => unfit
+    mem_pressure: np.ndarray  # bool[N]
+    zone_id: np.ndarray  # i32[N], 0 == no zone
+    # per-(node, pod-class) counts
+    class_count: np.ndarray  # i64[N, C]
+    # tie-break order: node indices sorted by name DESCENDING
+    name_desc_order: np.ndarray  # i32[N]
+    # vocab tables
+    set_table: np.ndarray  # u32[S, LW]
+    noschedule_taints: np.ndarray  # u32[TW]
+    prefer_taints: np.ndarray  # u32[TW]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+
+@dataclass
+class PodBatch:
+    """Pending-pod-axis arrays."""
+
+    pod_keys: List[Tuple[str, str]]  # (namespace, name)
+    # fit-check request: container sums maxed with init containers
+    # (predicates.go:355-374)
+    req_mcpu: np.ndarray  # i64[P]
+    req_mem: np.ndarray
+    req_gpu: np.ndarray
+    zero_req: np.ndarray  # bool[P]
+    # commit request: container sums ONLY — NodeInfo.addPod accounting
+    # (node_info.go:158 calculateResource has no init-container rule)
+    commit_mcpu: np.ndarray  # i64[P]
+    commit_mem: np.ndarray
+    commit_gpu: np.ndarray
+    nz_mcpu: np.ndarray
+    nz_mem: np.ndarray
+    host_req: np.ndarray  # i32[P], -1 == unconstrained
+    port_mask: np.ndarray  # u32[P, PW]
+    # nodeSelector program: single AND term
+    ns_ops: np.ndarray  # i8[P, R1]
+    ns_key: np.ndarray  # i32[P, R1]
+    ns_set: np.ndarray  # i32[P, R1]
+    ns_numkey: np.ndarray  # i32[P, R1]
+    ns_num: np.ndarray  # f64[P, R1]
+    # required node affinity: ORed terms, each an AND program
+    aff_has_req: np.ndarray  # bool[P]
+    aff_term_valid: np.ndarray  # bool[P, T]
+    aff_ops: np.ndarray  # i8[P, T, R]
+    aff_key: np.ndarray  # i32[P, T, R]
+    aff_set: np.ndarray  # i32[P, T, R]
+    aff_numkey: np.ndarray  # i32[P, T, R]
+    aff_num: np.ndarray  # f64[P, T, R]
+    # preferred node affinity terms (priority)
+    pref_valid: np.ndarray  # bool[P, TP]
+    pref_weight: np.ndarray  # i64[P, TP]
+    pref_ops: np.ndarray  # i8[P, TP, R]
+    pref_key: np.ndarray  # i32[P, TP, R]
+    pref_set: np.ndarray  # i32[P, TP, R]
+    pref_numkey: np.ndarray  # i32[P, TP, R]
+    pref_num: np.ndarray  # f64[P, TP, R]
+    # taints / tolerations
+    tol_mask: np.ndarray  # u32[P, TW]
+    # 0/1 per taint id: PreferNoSchedule AND not tolerated by the pod's
+    # PreferNoSchedule-filtered tolerations (taint_toleration.go:39-47)
+    intolerable_prefer: np.ndarray  # i32[P, TV]
+    has_tolerations: np.ndarray  # bool[P]
+    best_effort: np.ndarray  # bool[P]
+    # spread
+    has_selectors: np.ndarray  # bool[P]
+    spread_match: np.ndarray  # i64[P, C] 0/1
+    class_id: np.ndarray  # i32[P]
+    unschedulable: np.ndarray  # bool[P]
+
+    @property
+    def num_pods(self) -> int:
+        return len(self.pod_keys)
+
+
+class SnapshotEncoder:
+    """Builds all vocabularies over (cluster state, pending pods) and emits
+    the columnar snapshot + pod batch. Vocabularies are derived jointly so
+    pod-side and node-side ids agree."""
+
+    def __init__(self, state: ClusterState, pods: Sequence[Pod]):
+        self.state = state
+        self.pods = list(pods)
+        self.node_names = [
+            name for name, info in state.node_infos.items() if info.node is not None
+        ]
+        self.node_id = {n: i for i, n in enumerate(self.node_names)}
+        # --- vocabularies
+        self.ports = _Dict()
+        self.kv = _Dict()  # (key, value) pairs
+        self.keys = _Dict()  # label keys
+        self.numkeys = _Dict()  # keys used by Gt/Lt
+        self.taints = _Dict()  # (key, value, effect)
+        self.zones = _Dict()
+        self.zones.get("")  # id 0 == no zone
+        self.classes = _Dict()  # (ns, frozenset(labels.items()), deleted)
+        self.sets: Dict[frozenset, int] = {}
+        self.set_members: List[frozenset] = []
+        self._build_vocabs()
+
+    # -- vocab construction --------------------------------------------------
+
+    def _class_key(self, pod: Pod):
+        deleted = pod.metadata.deletion_timestamp is not None
+        return (
+            pod.namespace,
+            frozenset(pod.metadata.labels.items()),
+            deleted,
+        )
+
+    def _intern_set(self, key: str, values) -> int:
+        """Intern a requirement value set as a bitmask over kv ids."""
+        fs = frozenset((key, v) for v in values)
+        idx = self.sets.get(fs)
+        if idx is None:
+            idx = len(self.set_members)
+            self.sets[fs] = idx
+            self.set_members.append(fs)
+        for kv in fs:
+            self.kv.get(kv)
+        return idx
+
+    def _visit_requirement(self, r: NodeSelectorRequirement):
+        self.keys.get(r.key)
+        if r.operator in ("In", "NotIn"):
+            self._intern_set(r.key, r.values)
+        elif r.operator in ("Gt", "Lt"):
+            self.numkeys.get(r.key)
+
+    def _visit_pod_vocab(self, pod: Pod):
+        for c in pod.spec.containers:
+            for p in c.ports:
+                if p.host_port != 0:
+                    self.ports.get(p.host_port)
+        for k, v in pod.spec.node_selector.items():
+            self.keys.get(k)
+            self._intern_set(k, [v])
+        aff = self._affinity_or_none(pod)
+        if aff is not None and aff.node_affinity is not None:
+            na = aff.node_affinity
+            if na.required_during_scheduling_ignored_during_execution is not None:
+                for t in na.required_during_scheduling_ignored_during_execution.node_selector_terms:
+                    for r in t.match_expressions:
+                        self._visit_requirement(r)
+            for wt in na.preferred_during_scheduling_ignored_during_execution:
+                for r in wt.preference.match_expressions:
+                    self._visit_requirement(r)
+        self.classes.get(self._class_key(pod))
+
+    def _affinity_or_none(self, pod: Pod) -> Optional[Affinity]:
+        try:
+            return get_affinity(pod)
+        except Exception:
+            return None
+
+    def _build_vocabs(self):
+        for name in self.node_names:
+            node = self.state.node_infos[name].node
+            for k, v in node.metadata.labels.items():
+                self.keys.get(k)
+                self.kv.get((k, v))
+            try:
+                for t in get_taints(node):
+                    self.taints.get((t.key, t.value, t.effect))
+            except Exception:
+                pass  # malformed annotation; encode_nodes marks taint_bad
+            zone = get_zone_key(node)
+            if zone:
+                self.zones.get(zone)
+        for info in self.state.node_infos.values():
+            for pod in info.pods:
+                self._visit_pod_vocab(pod)
+        for pod in self.pods:
+            self._visit_pod_vocab(pod)
+
+    # -- emission ------------------------------------------------------------
+
+    @property
+    def widths(self):
+        return dict(
+            PW=_words(len(self.ports)),
+            LW=_words(len(self.kv)),
+            KW=_words(len(self.keys)),
+            TW=_words(len(self.taints)),
+            TV=max(1, len(self.taints)),
+            KG=max(1, len(self.numkeys)),
+            C=max(1, len(self.classes)),
+        )
+
+    def encode_nodes(self) -> ClusterSnapshot:
+        w = self.widths
+        N = len(self.node_names)
+        C = w["C"]
+        snap = ClusterSnapshot(
+            node_names=list(self.node_names),
+            alloc_mcpu=np.zeros(N, np.int64),
+            alloc_mem=np.zeros(N, np.int64),
+            alloc_gpu=np.zeros(N, np.int64),
+            alloc_pods=np.zeros(N, np.int64),
+            req_mcpu=np.zeros(N, np.int64),
+            req_mem=np.zeros(N, np.int64),
+            req_gpu=np.zeros(N, np.int64),
+            nz_mcpu=np.zeros(N, np.int64),
+            nz_mem=np.zeros(N, np.int64),
+            pod_count=np.zeros(N, np.int64),
+            port_mask=np.zeros((N, w["PW"]), np.uint32),
+            label_kv=np.zeros((N, w["LW"]), np.uint32),
+            label_key=np.zeros((N, w["KW"]), np.uint32),
+            numval=np.full((N, w["KG"]), np.nan, np.float64),
+            taint_mask=np.zeros((N, w["TW"]), np.uint32),
+            taint_count=np.zeros((N, w["TV"]), np.int32),
+            has_taints=np.zeros(N, bool),
+            taint_bad=np.zeros(N, bool),
+            mem_pressure=np.zeros(N, bool),
+            zone_id=np.zeros(N, np.int32),
+            class_count=np.zeros((N, C), np.int64),
+            name_desc_order=np.argsort(
+                np.array(self.node_names, dtype=object), kind="stable"
+            )[::-1].astype(np.int32),
+            set_table=self._set_table(),
+            noschedule_taints=self._taint_effect_mask("NoSchedule"),
+            prefer_taints=self._taint_effect_mask("PreferNoSchedule"),
+        )
+        for i, name in enumerate(self.node_names):
+            info = self.state.node_infos[name]
+            node = info.node
+            alloc = node.status.allocatable
+            snap.alloc_mcpu[i] = resource_list_cpu_milli(alloc)
+            snap.alloc_mem[i] = resource_list_memory(alloc)
+            snap.alloc_gpu[i] = parse_quantity(
+                alloc.get("alpha.kubernetes.io/nvidia-gpu", 0)
+            ).value()
+            snap.alloc_pods[i] = parse_quantity(alloc.get("pods", 0)).value()
+            snap.req_mcpu[i] = info.requested_milli_cpu
+            snap.req_mem[i] = info.requested_memory
+            snap.req_gpu[i] = info.requested_gpu
+            snap.nz_mcpu[i] = info.nonzero_milli_cpu
+            snap.nz_mem[i] = info.nonzero_memory
+            snap.pod_count[i] = len(info.pods)
+            # ports in use on this node
+            port_ids = [
+                self.ports.get(p.host_port, add=False)
+                for pod in info.pods
+                for c in pod.spec.containers
+                for p in c.ports
+                if p.host_port != 0
+            ]
+            snap.port_mask[i] = _pack_bits([x for x in port_ids if x >= 0], w["PW"])
+            # labels
+            kv_ids = [
+                self.kv.get((k, v), add=False)
+                for k, v in node.metadata.labels.items()
+            ]
+            snap.label_kv[i] = _pack_bits([x for x in kv_ids if x >= 0], w["LW"])
+            key_ids = [
+                self.keys.get(k, add=False) for k in node.metadata.labels
+            ]
+            snap.label_key[i] = _pack_bits([x for x in key_ids if x >= 0], w["KW"])
+            for k, col in self.numkeys.ids.items():
+                v = node.metadata.labels.get(k)
+                if v is not None:
+                    try:
+                        snap.numval[i, col] = float(v)
+                    except ValueError:
+                        pass  # stays NaN -> Gt/Lt never match
+            # taints
+            try:
+                taints = get_taints(node)
+            except Exception:
+                snap.taint_bad[i] = True
+                taints = []
+            snap.taint_mask[i] = _pack_bits(
+                [self.taints.get((t.key, t.value, t.effect)) for t in taints],
+                w["TW"],
+            )
+            for t in taints:
+                snap.taint_count[i, self.taints.get((t.key, t.value, t.effect))] += 1
+            snap.has_taints[i] = bool(taints)
+            for cond in node.status.conditions:
+                if cond.type == "MemoryPressure" and cond.status == "True":
+                    snap.mem_pressure[i] = True
+            zone = get_zone_key(node)
+            snap.zone_id[i] = self.zones.get(zone) if zone else 0
+            # classes
+            for pod in info.pods:
+                snap.class_count[i, self.classes.get(self._class_key(pod))] += 1
+        return snap
+
+    def _set_table(self) -> np.ndarray:
+        w = self.widths
+        table = np.zeros((max(1, len(self.set_members)), w["LW"]), np.uint32)
+        for idx, fs in enumerate(self.set_members):
+            table[idx] = _pack_bits(
+                [self.kv.get(kv, add=False) for kv in fs], w["LW"]
+            )
+        return table
+
+    def _taint_effect_mask(self, effect: str) -> np.ndarray:
+        w = self.widths
+        ids = [i for (k, v, e), i in self.taints.ids.items() if e == effect]
+        return _pack_bits(ids, w["TW"])
+
+    # -- pod batch -----------------------------------------------------------
+
+    def _compile_requirements(self, reqs, ops, key, set_, numkey, num, row):
+        """Fill one AND-program row from a requirement list. Returns False
+        (with the whole row forced to OP_FAIL) when labels.NewRequirement
+        would reject any requirement — the caller must then treat the term
+        list exactly as the reference does on parse error."""
+        for j, r in enumerate(reqs):
+            if not _requirement_valid(r):
+                ops[row][:] = OP_PAD
+                ops[row][0] = OP_FAIL
+                return False
+            code = _OP_BY_NAME[r.operator]
+            ops[row][j] = code
+            key[row][j] = self.keys.get(r.key, add=False)
+            if code in (OP_IN, OP_NOT_IN):
+                set_[row][j] = self._intern_set_ro(r.key, r.values)
+            elif code in (OP_GT, OP_LT):
+                numkey[row][j] = self.numkeys.get(r.key, add=False)
+                num[row][j] = float(next(iter(r.values)))
+        return True
+
+    def _intern_set_ro(self, key, values) -> int:
+        fs = frozenset((key, v) for v in values)
+        idx = self.sets.get(fs)
+        if idx is None:
+            raise KeyError(
+                f"value set for key {key!r} was not interned during vocab "
+                "construction — encoder bug"
+            )
+        return idx
+
+    def encode_pods(self, max_terms=None, max_reqs=None) -> PodBatch:
+        w = self.widths
+        P = len(self.pods)
+        affs = [self._affinity_or_none(p) for p in self.pods]
+        parse_failed = [
+            get_affinity_raises(p) for p in self.pods
+        ]
+
+        def na(a):
+            return a.node_affinity if a is not None else None
+
+        R1 = max(
+            [1] + [len(p.spec.node_selector) for p in self.pods]
+        )
+        req_terms = []
+        pref_terms = []
+        for a in affs:
+            n = na(a)
+            if n is not None and n.required_during_scheduling_ignored_during_execution is not None:
+                req_terms.append(
+                    list(n.required_during_scheduling_ignored_during_execution.node_selector_terms)
+                )
+            else:
+                req_terms.append(None)
+            pref_terms.append(
+                list(n.preferred_during_scheduling_ignored_during_execution)
+                if n is not None
+                else []
+            )
+        T = max_terms or max([1] + [len(t) for t in req_terms if t is not None])
+        TP = max([1] + [len(t) for t in pref_terms])
+        R = max_reqs or max(
+            [1]
+            + [
+                len(term.match_expressions)
+                for terms in req_terms
+                if terms
+                for term in terms
+            ]
+            + [
+                len(wt.preference.match_expressions)
+                for terms in pref_terms
+                for wt in terms
+            ]
+        )
+
+        b = PodBatch(
+            pod_keys=[(p.namespace, p.name) for p in self.pods],
+            req_mcpu=np.zeros(P, np.int64),
+            req_mem=np.zeros(P, np.int64),
+            req_gpu=np.zeros(P, np.int64),
+            zero_req=np.zeros(P, bool),
+            commit_mcpu=np.zeros(P, np.int64),
+            commit_mem=np.zeros(P, np.int64),
+            commit_gpu=np.zeros(P, np.int64),
+            nz_mcpu=np.zeros(P, np.int64),
+            nz_mem=np.zeros(P, np.int64),
+            host_req=np.full(P, -1, np.int32),
+            port_mask=np.zeros((P, w["PW"]), np.uint32),
+            ns_ops=np.zeros((P, R1), np.int8),
+            ns_key=np.zeros((P, R1), np.int32),
+            ns_set=np.zeros((P, R1), np.int32),
+            ns_numkey=np.zeros((P, R1), np.int32),
+            ns_num=np.zeros((P, R1), np.float64),
+            aff_has_req=np.zeros(P, bool),
+            aff_term_valid=np.zeros((P, T), bool),
+            aff_ops=np.zeros((P, T, R), np.int8),
+            aff_key=np.zeros((P, T, R), np.int32),
+            aff_set=np.zeros((P, T, R), np.int32),
+            aff_numkey=np.zeros((P, T, R), np.int32),
+            aff_num=np.zeros((P, T, R), np.float64),
+            pref_valid=np.zeros((P, TP), bool),
+            pref_weight=np.zeros((P, TP), np.int64),
+            pref_ops=np.zeros((P, TP, R), np.int8),
+            pref_key=np.zeros((P, TP, R), np.int32),
+            pref_set=np.zeros((P, TP, R), np.int32),
+            pref_numkey=np.zeros((P, TP, R), np.int32),
+            pref_num=np.zeros((P, TP, R), np.float64),
+            tol_mask=np.zeros((P, w["TW"]), np.uint32),
+            intolerable_prefer=np.zeros((P, w["TV"]), np.int32),
+            has_tolerations=np.zeros(P, bool),
+            best_effort=np.zeros(P, bool),
+            has_selectors=np.zeros(P, bool),
+            spread_match=np.zeros((P, w["C"]), np.int64),
+            class_id=np.zeros(P, np.int32),
+            unschedulable=np.zeros(P, bool),
+        )
+        class_list = list(self.classes.ids.keys())
+        for i, pod in enumerate(self.pods):
+            cpu, mem, gpu = pod_resource_request(pod)
+            b.req_mcpu[i], b.req_mem[i], b.req_gpu[i] = cpu, mem, gpu
+            b.zero_req[i] = cpu == 0 and mem == 0 and gpu == 0
+            b.commit_mcpu[i], b.commit_mem[i], b.commit_gpu[i] = _calculate_resource(pod)
+            b.nz_mcpu[i], b.nz_mem[i] = pod_nonzero_request(pod)
+            if pod.spec.node_name:
+                b.host_req[i] = self.node_id.get(pod.spec.node_name, -2)
+            b.port_mask[i] = _pack_bits(
+                [
+                    self.ports.get(p.host_port, add=False)
+                    for c in pod.spec.containers
+                    for p in c.ports
+                    if p.host_port != 0
+                ],
+                w["PW"],
+            )
+            # nodeSelector -> equality (In) requirements
+            for j, (k, v) in enumerate(sorted(pod.spec.node_selector.items())):
+                b.ns_ops[i, j] = OP_IN
+                b.ns_key[i, j] = self.keys.get(k, add=False)
+                b.ns_set[i, j] = self._intern_set_ro(k, [v])
+            if parse_failed[i]:
+                b.unschedulable[i] = True
+                continue
+            aff = affs[i]
+            n = na(aff)
+            if n is not None and n.required_during_scheduling_ignored_during_execution is not None:
+                b.aff_has_req[i] = True
+                terms = n.required_during_scheduling_ignored_during_execution.node_selector_terms
+                for t_idx, term in enumerate(terms):
+                    b.aff_term_valid[i, t_idx] = True
+                    if not term.match_expressions:
+                        # empty req list == labels.Nothing (helpers.go:374),
+                        # no error — later terms still evaluated
+                        b.aff_ops[i, t_idx, 0] = OP_FAIL
+                        continue
+                    ok = self._compile_requirements(
+                        term.match_expressions,
+                        b.aff_ops[i],
+                        b.aff_key[i],
+                        b.aff_set[i],
+                        b.aff_numkey[i],
+                        b.aff_num[i],
+                        t_idx,
+                    )
+                    if not ok:
+                        # parse error: predicates.go:457-459 returns false
+                        # for the WHOLE term list the moment the bad term is
+                        # reached — terms before it were already tried, so
+                        # "any earlier term matched" wins; later terms never
+                        # run. Leaving them term_valid=False models that.
+                        break
+            for t_idx, wt in enumerate(pref_terms[i]):
+                if wt.weight == 0:
+                    continue
+                b.pref_valid[i, t_idx] = True
+                b.pref_weight[i, t_idx] = wt.weight
+                if not wt.preference.match_expressions:
+                    b.pref_ops[i, t_idx, 0] = OP_FAIL
+                    continue
+                ok = self._compile_requirements(
+                    wt.preference.match_expressions,
+                    b.pref_ops[i],
+                    b.pref_key[i],
+                    b.pref_set[i],
+                    b.pref_numkey[i],
+                    b.pref_num[i],
+                    t_idx,
+                )
+                if not ok:
+                    # node_affinity.go:68: a bad preferred term errors the
+                    # whole scheduling cycle — the pod is not scheduled.
+                    b.unschedulable[i] = True
+                    break
+            if b.unschedulable[i]:
+                continue
+            # tolerations
+            try:
+                tols = get_tolerations(pod)
+            except Exception:
+                # malformed annotation => every node's taint predicate errors
+                b.unschedulable[i] = True
+                continue
+            b.has_tolerations[i] = bool(tols)
+            prefer_tols = [
+                t for t in tols if not t.effect or t.effect == "PreferNoSchedule"
+            ]
+            for (tk, tv, te), tid in self.taints.ids.items():
+                taint = Taint(key=tk, value=tv, effect=te)
+                if taint_tolerated_by_tolerations(taint, tols):
+                    b.tol_mask[i, tid // 32] |= np.uint32(1) << np.uint32(tid % 32)
+                if te == "PreferNoSchedule" and not taint_tolerated_by_tolerations(
+                    taint, prefer_tols
+                ):
+                    b.intolerable_prefer[i, tid] = 1
+            b.best_effort[i] = is_pod_best_effort(pod)
+            # spread selectors
+            selectors = []
+            for svc in get_pod_services(self.state, pod):
+                selectors.append(labelpkg.selector_from_set(svc.spec.selector))
+            for rc in get_pod_controllers(self.state, pod):
+                selectors.append(labelpkg.selector_from_set(rc.spec.selector))
+            for rs in get_pod_replica_sets(self.state, pod):
+                selectors.append(label_selector_as_selector(rs.spec.selector))
+            b.has_selectors[i] = bool(selectors)
+            if selectors:
+                for c_idx, (ns, labels_fs, deleted) in enumerate(class_list):
+                    if deleted or ns != pod.namespace:
+                        continue
+                    lbls = dict(labels_fs)
+                    if any(s.matches(lbls) for s in selectors):
+                        b.spread_match[i, c_idx] = 1
+            b.class_id[i] = self.classes.get(self._class_key(pod))
+        return b
+
+    def encode(self) -> Tuple[ClusterSnapshot, PodBatch]:
+        return self.encode_nodes(), self.encode_pods()
+
+
+def get_affinity_raises(pod: Pod) -> bool:
+    try:
+        get_affinity(pod)
+        return False
+    except Exception:
+        return True

@@ -1,0 +1,343 @@
+"""Bit-identical conformance: BatchScheduler (TPU tensor path) vs the
+sequential oracle on randomized scenarios.
+
+This is the core guarantee of the framework (BASELINE.json north star):
+node selection must match the serial reference loop exactly, including
+round-robin tie-breaks, integer score truncations, and commitment
+threading across the backlog.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeAffinity,
+    NodeCondition,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+    Affinity,
+    ReplicationController,
+    ReplicationControllerSpec,
+    Service,
+    ServiceSpec,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.oracle import predicates as opreds
+from kubernetes_tpu.oracle import priorities as oprios
+from kubernetes_tpu.oracle.scheduler import PriorityConfig
+from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+REGION = "failure-domain.beta.kubernetes.io/region"
+
+ORACLE_PREDICATES = (
+    ("GeneralPredicates", opreds.general_predicates),
+    ("PodToleratesNodeTaints", opreds.pod_tolerates_node_taints),
+    ("CheckNodeMemoryPressure", opreds.check_node_memory_pressure),
+)
+ORACLE_PRIORITIES = (
+    PriorityConfig(oprios.least_requested_priority, 1, "LeastRequestedPriority"),
+    PriorityConfig(oprios.balanced_resource_allocation, 1, "BalancedResourceAllocation"),
+    PriorityConfig(oprios.selector_spread_priority, 1, "SelectorSpreadPriority"),
+    PriorityConfig(oprios.node_affinity_priority, 1, "NodeAffinityPriority"),
+    PriorityConfig(oprios.taint_toleration_priority, 1, "TaintTolerationPriority"),
+)
+
+
+def random_scenario(rng: random.Random, n_nodes=12, n_existing=15, n_pending=25):
+    zones = ["a", "b", "c"]
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"kubernetes.io/hostname": f"node-{i:03d}"}
+        if rng.random() < 0.7:
+            labels[ZONE] = rng.choice(zones)
+            labels[REGION] = "r1"
+        if rng.random() < 0.5:
+            labels["disktype"] = rng.choice(["ssd", "hdd"])
+        if rng.random() < 0.3:
+            labels["gen"] = str(rng.randint(1, 5))
+        taints = None
+        if rng.random() < 0.25:
+            taints = [
+                Taint(
+                    key=rng.choice(["dedicated", "special"]),
+                    value=rng.choice(["a", "b"]),
+                    effect=rng.choice(["NoSchedule", "PreferNoSchedule"]),
+                )
+            ]
+        conds = [NodeCondition("Ready", "True")]
+        if rng.random() < 0.15:
+            conds.append(NodeCondition("MemoryPressure", "True"))
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(name=f"node-{i:03d}", labels=labels),
+                spec=NodeSpec(taints=taints),
+                status=NodeStatus(
+                    allocatable={
+                        "cpu": f"{rng.choice([1000, 2000, 4000])}m",
+                        "memory": str(rng.choice([2, 4, 8]) * 1024**3),
+                        "pods": str(rng.choice([3, 5, 110])),
+                    },
+                    conditions=conds,
+                ),
+            )
+        )
+
+    def rand_containers(allow_zero=True):
+        cs = []
+        for _ in range(rng.randint(1, 2)):
+            reqs = {}
+            if not allow_zero or rng.random() < 0.8:
+                reqs["cpu"] = f"{rng.choice([0, 100, 250, 500])}m"
+            if not allow_zero or rng.random() < 0.8:
+                reqs["memory"] = str(rng.choice([0, 128, 512, 1024]) * 1024**2)
+            ports = []
+            if rng.random() < 0.25:
+                ports.append(ContainerPort(host_port=rng.choice([8080, 9090, 9091])))
+            cs.append(Container(requests=reqs, ports=ports))
+        return cs
+
+    app_labels = [{"app": "web"}, {"app": "db"}, {"app": "cache", "tier": "be"}]
+
+    existing = []
+    for i in range(n_existing):
+        existing.append(
+            Pod(
+                metadata=ObjectMeta(
+                    name=f"existing-{i}",
+                    labels=rng.choice(app_labels),
+                    deletion_timestamp="2026-01-01T00:00:00Z" if rng.random() < 0.1 else None,
+                ),
+                spec=PodSpec(
+                    node_name=f"node-{rng.randrange(n_nodes):03d}",
+                    containers=rand_containers(),
+                ),
+            )
+        )
+
+    services = [
+        Service(metadata=ObjectMeta(name="web"), spec=ServiceSpec(selector={"app": "web"})),
+        Service(metadata=ObjectMeta(name="db"), spec=ServiceSpec(selector={"app": "db"})),
+    ]
+    controllers = [
+        ReplicationController(
+            metadata=ObjectMeta(name="cache-rc"),
+            spec=ReplicationControllerSpec(selector={"app": "cache"}),
+        )
+    ]
+
+    pending = []
+    for i in range(n_pending):
+        spec_kw = {}
+        if rng.random() < 0.3:
+            spec_kw["node_selector"] = rng.choice(
+                [{"disktype": "ssd"}, {ZONE: "a"}, {"disktype": "hdd"}]
+            )
+        if rng.random() < 0.2:
+            spec_kw["tolerations"] = [
+                Toleration(
+                    key=rng.choice(["dedicated", "special"]),
+                    operator=rng.choice(["Exists", "Equal"]),
+                    value="a",
+                    effect=rng.choice(["", "NoSchedule"]),
+                )
+            ]
+        affinity = None
+        if rng.random() < 0.3:
+            terms = []
+            for _ in range(rng.randint(1, 2)):
+                reqs = [
+                    NodeSelectorRequirement(
+                        key=rng.choice(["disktype", "gen", ZONE]),
+                        operator=rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]),
+                        values=(rng.choice(["ssd", "2", "a", "x"]),),
+                    )
+                ]
+                terms.append(NodeSelectorTerm(match_expressions=tuple(reqs)))
+            required = NodeSelector(node_selector_terms=tuple(terms)) if rng.random() < 0.6 else None
+            preferred = ()
+            if rng.random() < 0.5:
+                preferred = tuple(
+                    PreferredSchedulingTerm(
+                        weight=rng.randint(1, 5),
+                        preference=NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    key=rng.choice(["disktype", "gen"]),
+                                    operator=rng.choice(["In", "Exists"]),
+                                    values=("ssd",),
+                                ),
+                            )
+                        ),
+                    )
+                    for _ in range(rng.randint(1, 2))
+                )
+            affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    required_during_scheduling_ignored_during_execution=required,
+                    preferred_during_scheduling_ignored_during_execution=preferred,
+                )
+            )
+        pod = Pod(
+            metadata=ObjectMeta(name=f"pending-{i:04d}", labels=rng.choice(app_labels)),
+            spec=PodSpec(
+                containers=rand_containers(),
+                affinity=affinity,
+                **spec_kw,
+            ),
+        )
+        if rng.random() < 0.1:
+            pod.spec.init_containers = [
+                Container(requests={"cpu": "600m", "memory": str(512 * 1024**2)})
+            ]
+        pending.append(pod)
+
+    state = ClusterState.build(
+        nodes, assigned_pods=existing, services=services, controllers=controllers
+    )
+    return state, pending
+
+
+def run_both(state, pending):
+    oracle = GenericScheduler(predicates=ORACLE_PREDICATES, priorities=ORACLE_PRIORITIES)
+    oracle_result = oracle.schedule_backlog(pending, state.clone())
+
+    enc = SnapshotEncoder(state, pending)
+    snap, batch = enc.encode()
+    tpu = BatchScheduler(SchedulerConfig())
+    tpu_result = tpu.schedule_names(snap, batch)
+    return oracle_result, tpu_result
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_scenarios_bit_identical(seed):
+    rng = random.Random(seed)
+    state, pending = random_scenario(rng)
+    oracle_result, tpu_result = run_both(state, pending)
+    assert tpu_result == oracle_result, (
+        f"seed {seed}: first divergence at "
+        f"{next(i for i, (a, b) in enumerate(zip(oracle_result, tpu_result)) if a != b)}"
+    )
+
+
+def test_scheduler_perf_shape_identical():
+    # 50 identical nodes, 300 identical pause pods — the density-test shape
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name=f"node-{i:04d}"),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(50)
+    ]
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"pod-{i:05d}", labels={"app": "pause"}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "100m", "memory": "500Mi"})]
+            ),
+        )
+        for i in range(300)
+    ]
+    state = ClusterState.build(nodes)
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert None not in tpu_result
+
+
+def test_duplicate_taints_count_per_list():
+    # a node carrying duplicate PreferNoSchedule taints counts each
+    # occurrence in the taint-toleration priority (review regression)
+    n0 = Node(
+        metadata=ObjectMeta(name="node-0"),
+        spec=NodeSpec(
+            taints=[Taint("k", "v", "PreferNoSchedule"), Taint("k", "v", "PreferNoSchedule")]
+        ),
+        status=NodeStatus(
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+    n1 = Node(
+        metadata=ObjectMeta(name="node-1"),
+        spec=NodeSpec(taints=[Taint("other", "x", "PreferNoSchedule")]),
+        status=NodeStatus(
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}"),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "100m"})],
+                tolerations=[Toleration(key="zzz", operator="Exists")],
+            ),
+        )
+        for i in range(2)
+    ]
+    state = ClusterState.build([n0, n1])
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+
+
+def test_bogus_operator_in_required_term(recwarn):
+    # term order matters: a match BEFORE the bogus term wins; a bogus term
+    # reached first rejects the whole list (review regression)
+    def mk_pod(name, terms):
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "100m"})],
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required_during_scheduling_ignored_during_execution=NodeSelector(
+                            node_selector_terms=tuple(terms)
+                        )
+                    )
+                ),
+            ),
+        )
+
+    good = NodeSelectorTerm(
+        match_expressions=(
+            NodeSelectorRequirement(key="disktype", operator="In", values=("ssd",)),
+        )
+    )
+    bogus = NodeSelectorTerm(
+        match_expressions=(
+            NodeSelectorRequirement(key="x", operator="Bogus", values=("y",)),
+        )
+    )
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name=f"node-{i}", labels={"disktype": "ssd"}),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(2)
+    ]
+    state = ClusterState.build(nodes)
+    pods = [mk_pod("a", [bogus, good]), mk_pod("b", [good, bogus])]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert oracle_result[0] is None  # bogus reached first -> unschedulable
+    assert oracle_result[1] is not None  # good term matched first -> fits
