@@ -1,0 +1,106 @@
+"""Headline benchmark: the reference's scheduler_perf density test B
+(30,000 pause pods onto 1,000 identical nodes — test/component/scheduler/
+perf/scheduler_test.go:31-33) run through the TPU batch scheduler with the
+full default predicate/priority stack.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the Go reference cannot be executed in this image (no Go
+toolchain), so BASELINE.md records the published era figure of ~100
+pods/s for this config (v1.3 kube-scheduler throughput at 1k nodes);
+vs_baseline = measured / 100.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_PODS_PER_SEC = 100.0
+
+NUM_NODES = 1000
+NUM_PODS = 30000
+
+
+def main():
+    from kubernetes_tpu.api.types import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        Service,
+        ServiceSpec,
+    )
+    from kubernetes_tpu.models.batch import BatchScheduler
+    from kubernetes_tpu.oracle import ClusterState
+    from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name=f"node-{i:05d}"),
+            status=NodeStatus(
+                # perf/util.go:88-118 node shape: 4 CPU / 32Gi / 110 pods
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(NUM_NODES)
+    ]
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"pod-{i:06d}", labels={"name": "sched-perf"}),
+            spec=PodSpec(
+                # perf/util.go:120-141 pod shape: pause, 100m / 500Mi
+                containers=[Container(requests={"cpu": "100m", "memory": "500Mi"})]
+            ),
+        )
+        for i in range(NUM_PODS)
+    ]
+    state = ClusterState.build(
+        nodes,
+        services=[
+            Service(
+                metadata=ObjectMeta(name="sched-perf"),
+                spec=ServiceSpec(selector={"name": "sched-perf"}),
+            )
+        ],
+    )
+
+    sched = BatchScheduler()
+    t0 = time.time()
+    snap, batch = SnapshotEncoder(state, pods).encode()
+    encode_s = time.time() - t0
+
+    # warm-up compile (excluded, like the harness's ramp-up second)
+    chosen, _ = sched.schedule(snap, batch)
+    n_sched = int((chosen >= 0).sum())
+    assert n_sched == NUM_PODS, f"only {n_sched}/{NUM_PODS} scheduled"
+
+    t1 = time.time()
+    chosen, final = sched.schedule(snap, batch)
+    chosen[0].item() if hasattr(chosen, "item") else None
+    device_s = time.time() - t1
+
+    total_s = encode_s + device_s
+    pods_per_sec = NUM_PODS / total_s
+    print(
+        json.dumps(
+            {
+                "metric": "scheduler_perf_1000n_30kp_pods_per_sec",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+            }
+        )
+    )
+    print(
+        f"# encode {encode_s:.2f}s + device {device_s:.2f}s = {total_s:.2f}s "
+        f"for {NUM_PODS} pods on {NUM_NODES} nodes",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

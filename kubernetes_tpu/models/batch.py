@@ -316,6 +316,9 @@ class BatchScheduler:
     def schedule(self, snap: ClusterSnapshot, batch: PodBatch):
         """Returns (chosen_node_index[P] int32 with -1 == unschedulable,
         final_carry)."""
+        if snap.num_nodes == 0:
+            # empty cluster: every pod fails with FitError in the reference
+            return np.full(batch.num_pods, -1, np.int32), self.initial_carry(snap)
         static = {f: jnp.asarray(getattr(snap, f)) for f in self.STATIC_FIELDS}
         pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
         num_zones = int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1

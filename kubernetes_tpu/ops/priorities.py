@@ -84,9 +84,7 @@ def selector_spread(
     # zone aggregation: zone 0 == "no zone" and never participates.
     # countsByZone exists for every zone seen among filtered nodes
     # (including zero counts), so haveZones == any filtered node is zoned.
-    zcounts = jnp.zeros((num_zones,), jnp.int64).at[zone_id].add(
-        jnp.where(fit_mask, counts, 0)
-    )
+    zcounts = jnp.zeros((num_zones,), jnp.int64).at[zone_id].add(counts)
     zone_seen = jnp.zeros((num_zones,), jnp.int32).at[zone_id].add(
         (fit_mask & (zone_id > 0)).astype(jnp.int32)
     )
@@ -115,7 +113,7 @@ def selector_spread(
     return jnp.where(jnp.isnan(f), jnp.int64(-(2**63)), f.astype(jnp.int64))
 
 
-def node_affinity_preferred(
+def node_affinity_counts(
     pref_valid,  # bool[TP]
     pref_weight,  # i64[TP]
     pref_ops,
@@ -127,13 +125,11 @@ def node_affinity_preferred(
     label_key,
     numval,
     set_table,
-    fit_mask,
 ):
-    """node_affinity.go:44 CalculateNodeAffinityPriority: sum weights of
-    matching preferred terms; normalize by max over filtered nodes;
-    10 * count/max in float64, truncated."""
+    """node_affinity.go:44-62: per-node sum of weights of matching
+    preferred terms (the un-normalized counts)."""
     TP = pref_valid.shape[0]
-    counts = jnp.zeros(fit_mask.shape, jnp.int64)
+    counts = jnp.zeros(label_kv.shape[:1], jnp.int64)
     for t in range(TP):
         m = _requirement_matrix(
             pref_ops[t],
@@ -147,13 +143,68 @@ def node_affinity_preferred(
             set_table,
         )
         counts = counts + jnp.where(m & pref_valid[t], pref_weight[t], 0)
-    max_count = counts.max(where=fit_mask, initial=0)
+    return counts
+
+
+def normalize_counts_up(counts, max_count):
+    """10 * count/max (float64, truncated); all-0 when max == 0
+    (node_affinity.go:85-90)."""
     f = jnp.where(
         max_count > 0,
-        10.0 * (counts.astype(jnp.float64) / jnp.maximum(max_count, 1).astype(jnp.float64)),
+        10.0
+        * (counts.astype(jnp.float64) / jnp.maximum(max_count, 1).astype(jnp.float64)),
         0.0,
     )
     return f.astype(jnp.int64)
+
+
+def normalize_counts_down(counts, max_count):
+    """(1 - count/max) * 10 (float64, truncated); all-10 when max == 0
+    (taint_toleration.go:100-106)."""
+    f = jnp.where(
+        max_count > 0,
+        (
+            1.0
+            - counts.astype(jnp.float64)
+            / jnp.maximum(max_count, 1).astype(jnp.float64)
+        )
+        * 10.0,
+        jnp.float64(MAX_PRIORITY),
+    )
+    return f.astype(jnp.int64)
+
+
+def node_affinity_preferred(
+    pref_valid,
+    pref_weight,
+    pref_ops,
+    pref_key,
+    pref_set,
+    pref_numkey,
+    pref_num,
+    label_kv,
+    label_key,
+    numval,
+    set_table,
+    fit_mask,
+):
+    """node_affinity.go:44 CalculateNodeAffinityPriority: counts normalized
+    by the max over FILTERED nodes."""
+    counts = node_affinity_counts(
+        pref_valid,
+        pref_weight,
+        pref_ops,
+        pref_key,
+        pref_set,
+        pref_numkey,
+        pref_num,
+        label_kv,
+        label_key,
+        numval,
+        set_table,
+    )
+    max_count = counts.max(where=fit_mask, initial=0)
+    return normalize_counts_up(counts, max_count)
 
 
 def taint_toleration(
@@ -167,10 +218,4 @@ def taint_toleration(
     filtered nodes; (1 - count/max) * 10 float64, truncated."""
     counts = (node_taint_count @ pod_intolerable_prefer).astype(jnp.int64)
     max_count = counts.max(where=fit_mask, initial=0)
-    f = jnp.where(
-        max_count > 0,
-        (1.0 - counts.astype(jnp.float64) / jnp.maximum(max_count, 1).astype(jnp.float64))
-        * 10.0,
-        jnp.float64(MAX_PRIORITY),
-    )
-    return f.astype(jnp.int64)
+    return normalize_counts_down(counts, max_count)

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism for the scheduling program.
+
+The reference scales the node axis with a 16-worker CPU pool
+(pkg/util/workqueue/parallelizer.go via generic_scheduler.go:161); here
+the node axis is sharded over a jax.sharding.Mesh and the per-step
+reductions ride ICI collectives:
+
+- masks/scores: computed shard-locally, O(N/devices) each step
+- filtered-set normalizations (spread/affinity/taint): pmax/psum scalars
+- host selection: all_gather of the int64 score vector (~N bytes) then a
+  replicated deterministic selectHost — every chip picks the same node
+- commit: the owning shard folds the pod into its slice of the carry
+"""
+
+from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
+
+__all__ = ["MeshBatchScheduler"]

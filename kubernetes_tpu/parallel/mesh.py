@@ -1,0 +1,334 @@
+"""shard_map SPMD implementation of the batch scheduler.
+
+Node-axis arrays are sharded P("nodes"); pod-batch arrays are replicated.
+The scan runs inside shard_map so per-step collectives (pmax/psum for the
+filtered-normalization maxes, all_gather for selection) ride ICI. Results
+are bit-identical to the single-chip BatchScheduler: every reduction here
+computes exactly the same integers, just distributed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.ops import predicates as P
+from kubernetes_tpu.ops import select as S
+from kubernetes_tpu.ops import priorities as R
+from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
+
+AXIS = "nodes"
+
+
+def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
+    """Pad the node axis with never-fit dummy nodes (alloc all zero ->
+    pod-count check fails) so N divides the mesh size. Dummy nodes never
+    win selection because they are never in the fit mask."""
+    n = len(snap.node_names)
+    pad = (-n) % multiple
+    if pad == 0:
+        return snap
+    import dataclasses
+
+    def pad_arr(a: np.ndarray, fill=0):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    fields = {}
+    for f in dataclasses.fields(snap):
+        v = getattr(snap, f.name)
+        if f.name == "node_names":
+            fields[f.name] = list(v) + [f"\x00pad-{i}" for i in range(pad)]
+        elif f.name == "name_desc_order":
+            # dummy names are never selected; order them after real nodes
+            fields[f.name] = np.concatenate(
+                [v, np.arange(n, n + pad, dtype=np.int32)]
+            )
+        elif f.name == "numval":
+            fields[f.name] = np.pad(
+                v, [(0, pad), (0, 0)], constant_values=np.nan
+            )
+        elif f.name in ("set_table", "noschedule_taints", "prefer_taints"):
+            fields[f.name] = v  # vocab tables: not node-axis
+        elif isinstance(v, np.ndarray):
+            fields[f.name] = pad_arr(v)
+        else:
+            fields[f.name] = v
+    return dataclasses.replace(snap, **fields)
+
+
+def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
+    """Per-shard scan body. `static`/`carry` node arrays hold this shard's
+    slice; `pod` is replicated. Mirrors models.batch._scan_fn with the
+    normalization maxes and selection made global via collectives."""
+    (
+        req_mcpu,
+        req_mem,
+        req_gpu,
+        nz_mcpu,
+        nz_mem,
+        pod_count,
+        port_mask,
+        class_count,
+        last_idx,
+    ) = carry
+
+    shard = jax.lax.axis_index(AXIS)
+    offset = shard.astype(jnp.int32) * n_per_shard
+
+    fit = ~pod["unschedulable"]
+    fit = fit & P.pod_fits_resources(
+        pod["req_mcpu"],
+        pod["req_mem"],
+        pod["req_gpu"],
+        pod["zero_req"],
+        static["alloc_mcpu"],
+        static["alloc_mem"],
+        static["alloc_gpu"],
+        static["alloc_pods"],
+        req_mcpu,
+        req_mem,
+        req_gpu,
+        pod_count,
+    )
+    # host check against GLOBAL node ids
+    local_ids = offset + jnp.arange(n_per_shard, dtype=jnp.int32)
+    fit = fit & jnp.where(
+        pod["host_req"] < 0, pod["host_req"] == -1, local_ids == pod["host_req"]
+    )
+    fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
+    fit = fit & P.match_node_selector(
+        pod["ns_ops"],
+        pod["ns_key"],
+        pod["ns_set"],
+        pod["ns_numkey"],
+        pod["ns_num"],
+        pod["aff_has_req"],
+        pod["aff_term_valid"],
+        pod["aff_ops"],
+        pod["aff_key"],
+        pod["aff_set"],
+        pod["aff_numkey"],
+        pod["aff_num"],
+        static["label_kv"],
+        static["label_key"],
+        static["numval"],
+        static["set_table"],
+    )
+    fit = fit & P.pod_tolerates_node_taints(
+        pod["tol_mask"],
+        pod["has_tolerations"],
+        static["taint_mask"],
+        static["has_taints"],
+        static["taint_bad"],
+        static["noschedule_taints"],
+    )
+    fit = fit & P.check_node_memory_pressure(pod["best_effort"], static["mem_pressure"])
+
+    score = jnp.zeros(req_mcpu.shape, jnp.int64)
+    for name, weight in config.priorities:
+        if name == "LeastRequestedPriority":
+            s = R.least_requested(
+                pod["nz_mcpu"], pod["nz_mem"], nz_mcpu, nz_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == "BalancedResourceAllocation":
+            s = R.balanced_resource_allocation(
+                pod["nz_mcpu"], pod["nz_mem"], nz_mcpu, nz_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == "SelectorSpreadPriority":
+            s = _spread_sharded(
+                pod["has_selectors"], pod["spread_match"], class_count,
+                static["zone_id"], num_zones, fit,
+            )
+        elif name == "NodeAffinityPriority":
+            counts = R.node_affinity_counts(
+                pod["pref_valid"], pod["pref_weight"], pod["pref_ops"],
+                pod["pref_key"], pod["pref_set"], pod["pref_numkey"],
+                pod["pref_num"], static["label_kv"], static["label_key"],
+                static["numval"], static["set_table"],
+            )
+            # int32 for the collective: s64 all-reduce max has no TPU lowering
+            local_max = counts.max(where=fit, initial=0).astype(jnp.int32)
+            max_count = jax.lax.pmax(local_max, AXIS).astype(jnp.int64)
+            s = R.normalize_counts_up(counts, max_count)
+        elif name == "TaintTolerationPriority":
+            counts = (static["taint_count"] @ pod["intolerable_prefer"]).astype(
+                jnp.int64
+            )
+            local_max = counts.max(where=fit, initial=0).astype(jnp.int32)
+            max_count = jax.lax.pmax(local_max, AXIS).astype(jnp.int64)
+            s = R.normalize_counts_down(counts, max_count)
+        elif name == "EqualPriority":
+            s = jnp.ones(req_mcpu.shape, jnp.int64)
+        else:
+            raise ValueError(name)
+        score = score + jnp.int64(weight) * s
+
+    # --- global selection: gather the small per-node vectors, pick once
+    score_g = jax.lax.all_gather(score, AXIS, tiled=True)  # i64[N]
+    fit_g = jax.lax.all_gather(fit, AXIS, tiled=True)  # bool[N]
+    chosen, scheduled = S.select_host(
+        score_g, fit_g, last_idx, static["name_desc_order_global"]
+    )
+
+    # --- commit locally if the chosen node lives on this shard
+    local = chosen - offset
+    mine = scheduled & (local >= 0) & (local < n_per_shard)
+    safe = jnp.clip(local, 0, n_per_shard - 1)
+    inc = mine.astype(jnp.int64)
+    req_mcpu = req_mcpu.at[safe].add(pod["commit_mcpu"] * inc)
+    req_mem = req_mem.at[safe].add(pod["commit_mem"] * inc)
+    req_gpu = req_gpu.at[safe].add(pod["commit_gpu"] * inc)
+    nz_mcpu = nz_mcpu.at[safe].add(pod["nz_mcpu"] * inc)
+    nz_mem = nz_mem.at[safe].add(pod["nz_mem"] * inc)
+    pod_count = pod_count.at[safe].add(inc)
+    port_mask = port_mask.at[safe].set(
+        jnp.where(mine, port_mask[safe] | pod["port_mask"], port_mask[safe])
+    )
+    class_count = class_count.at[safe, pod["class_id"]].add(inc)
+    last_idx = last_idx + scheduled.astype(jnp.int64)  # global counter
+
+    carry = (
+        req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
+        pod_count, port_mask, class_count, last_idx,
+    )
+    return carry, chosen
+
+
+def _spread_sharded(
+    pod_has_selectors, pod_spread_match, class_count, zone_id, num_zones, fit_mask
+):
+    """selector_spread with the max/zone reductions made mesh-global."""
+    counts = (
+        class_count.astype(jnp.int32) @ pod_spread_match.astype(jnp.int32)
+    ).astype(jnp.int64)
+    counts = jnp.where(fit_mask, counts, 0)
+    max_count = jax.lax.pmax(
+        counts.max(where=fit_mask, initial=0).astype(jnp.int32), AXIS
+    ).astype(jnp.int64)
+
+    zcounts_local = jnp.zeros((num_zones,), jnp.int32).at[zone_id].add(
+        jnp.where(fit_mask, counts, 0).astype(jnp.int32)
+    )
+    zcounts = jax.lax.psum(zcounts_local, AXIS).astype(jnp.int64)
+    zone_seen_local = jnp.zeros((num_zones,), jnp.int32).at[zone_id].add(
+        (fit_mask & (zone_id > 0)).astype(jnp.int32)
+    )
+    zone_seen = jax.lax.psum(zone_seen_local, AXIS)
+    have_zones = jnp.any(zone_seen > 0)
+    max_zone = jnp.where(jnp.arange(num_zones) > 0, zcounts, 0).max(initial=0)
+
+    f = jnp.full(counts.shape, jnp.float32(R.MAX_PRIORITY))
+    f = jnp.where(
+        max_count > 0,
+        jnp.float32(R.MAX_PRIORITY)
+        * ((max_count - counts).astype(jnp.float32) / max_count.astype(jnp.float32)),
+        f,
+    )
+    node_zcount = zcounts[zone_id]
+    zone_score = jnp.float32(R.MAX_PRIORITY) * (
+        (max_zone - node_zcount).astype(jnp.float32) / max_zone.astype(jnp.float32)
+    )
+    zone_weighting = jnp.float32(2.0 / 3.0)
+    blended = f * (jnp.float32(1.0) - zone_weighting) + zone_weighting * zone_score
+    f = jnp.where(have_zones & (zone_id > 0), blended, f)
+    f = jnp.where(pod_has_selectors, f, jnp.float32(R.MAX_PRIORITY))
+    return jnp.where(jnp.isnan(f), jnp.int64(-(2**63)), f.astype(jnp.int64))
+
+
+class MeshBatchScheduler:
+    """BatchScheduler over a jax.sharding.Mesh: node axis sharded, pods
+    replicated. Intended shape: one shard per chip on a v5e slice, DCN
+    untouched (the pod scan is sequential by construction)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, config: Optional[SchedulerConfig] = None):
+        if mesh is None:
+            mesh = Mesh(np.array(jax.devices()), (AXIS,))
+        self.mesh = mesh
+        self.config = config or SchedulerConfig()
+        self._jitted = {}
+
+    def schedule(self, snap: ClusterSnapshot, batch: PodBatch):
+        n_dev = self.mesh.devices.size
+        if len(snap.node_names) == 0:
+            sched = BatchScheduler(self.config)
+            return (
+                np.full(batch.num_pods, -1, np.int32),
+                sched.initial_carry(snap),
+            )
+        snap = _pad_snapshot(snap, n_dev)
+        n = len(snap.node_names)
+        n_per_shard = n // n_dev
+
+        static = {
+            f: jnp.asarray(getattr(snap, f)) for f in BatchScheduler.STATIC_FIELDS
+        }
+        static["name_desc_order_global"] = static.pop("name_desc_order")
+        pods = {f: jnp.asarray(getattr(batch, f)) for f in BatchScheduler.POD_FIELDS}
+        num_zones = max(int(snap.zone_id.max()) + 1, 1)
+
+        sharded_static = {
+            k: (
+                PSpec(AXIS)
+                if k
+                in (
+                    "alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods",
+                    "has_taints", "taint_bad", "mem_pressure", "zone_id",
+                )
+                else PSpec(AXIS, None)
+                if k
+                in ("label_kv", "label_key", "numval", "taint_mask", "taint_count")
+                else PSpec()  # replicated vocab tables + global order
+            )
+            for k in static
+        }
+        carry_specs = (
+            PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS),
+            PSpec(AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
+        )
+        pod_specs = {k: PSpec() for k in pods}
+
+        key = (n, n_per_shard, batch.num_pods, num_zones)
+        run = self._jitted.get(key)
+        if run is None:
+            body = functools.partial(
+                _mesh_scan_fn, self.config, num_zones, n_per_shard
+            )
+
+            def spmd(static_, carry_, pods_):
+                final, chosen = jax.lax.scan(
+                    functools.partial(body, static_), carry_, pods_
+                )
+                return final, chosen
+
+            from jax import shard_map
+
+            sharded = shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(sharded_static, carry_specs, pod_specs),
+                out_specs=(carry_specs, PSpec()),
+                check_vma=False,
+            )
+            run = jax.jit(sharded)
+            self._jitted[key] = run
+
+        sched = BatchScheduler(self.config)
+        carry = sched.initial_carry(snap)
+        with self.mesh:
+            final, chosen = run(static, carry, pods)
+        chosen = np.asarray(chosen)
+        return chosen, final
+
+    def schedule_names(self, snap: ClusterSnapshot, batch: PodBatch):
+        names = list(snap.node_names)
+        chosen, _ = self.schedule(snap, batch)
+        return [names[i] if i >= 0 else None for i in chosen]

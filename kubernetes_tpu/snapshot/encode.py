@@ -464,10 +464,16 @@ class SnapshotEncoder:
     def encode_pods(self, max_terms=None, max_reqs=None) -> PodBatch:
         w = self.widths
         P = len(self.pods)
-        affs = [self._affinity_or_none(p) for p in self.pods]
-        parse_failed = [
-            get_affinity_raises(p) for p in self.pods
-        ]
+        # one annotation parse per pod: failures become (None, True)
+        affs = []
+        parse_failed = []
+        for p in self.pods:
+            try:
+                affs.append(get_affinity(p))
+                parse_failed.append(False)
+            except Exception:
+                affs.append(None)
+                parse_failed.append(True)
 
         def na(a):
             return a.node_affinity if a is not None else None
@@ -637,14 +643,16 @@ class SnapshotEncoder:
             prefer_tols = [
                 t for t in tols if not t.effect or t.effect == "PreferNoSchedule"
             ]
+            tolerated_ids = []
             for (tk, tv, te), tid in self.taints.ids.items():
                 taint = Taint(key=tk, value=tv, effect=te)
                 if taint_tolerated_by_tolerations(taint, tols):
-                    b.tol_mask[i, tid // 32] |= np.uint32(1) << np.uint32(tid % 32)
+                    tolerated_ids.append(tid)
                 if te == "PreferNoSchedule" and not taint_tolerated_by_tolerations(
                     taint, prefer_tols
                 ):
                     b.intolerable_prefer[i, tid] = 1
+            b.tol_mask[i] = _pack_bits(tolerated_ids, w["TW"])
             b.best_effort[i] = is_pod_best_effort(pod)
             # spread selectors
             selectors = []
@@ -669,9 +677,3 @@ class SnapshotEncoder:
         return self.encode_nodes(), self.encode_pods()
 
 
-def get_affinity_raises(pod: Pod) -> bool:
-    try:
-        get_affinity(pod)
-        return False
-    except Exception:
-        return True

@@ -4,13 +4,20 @@ Tests run on CPU with 8 virtual devices so multi-chip sharding
 (kubernetes_tpu.parallel) is exercised without TPU hardware, per the
 kubemark idea in the reference (hollow nodes: real scheduler, fake
 everything else — SURVEY.md §4).
+
+NOTE: the jaxtyping pytest plugin imports jax before this conftest runs,
+so env vars alone are too late — jax.config.update still works as long as
+no backend has been initialized yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -341,3 +341,14 @@ def test_bogus_operator_in_required_term(recwarn):
     assert tpu_result == oracle_result
     assert oracle_result[0] is None  # bogus reached first -> unschedulable
     assert oracle_result[1] is not None  # good term matched first -> fits
+
+
+def test_empty_cluster_all_unscheduled():
+    # review regression: zero-node snapshot must return all -1, not crash
+    state = ClusterState.build([])
+    pods = [
+        Pod(metadata=ObjectMeta(name="p"), spec=PodSpec(containers=[Container()]))
+    ]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert oracle_result == [None]
+    assert tpu_result == [None]

@@ -1,0 +1,43 @@
+"""Mesh-sharded scheduler vs single-device scheduler: identical results
+on an 8-virtual-device CPU mesh (the kubemark idea: real program, fake
+chips — SURVEY.md §4)."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
+from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+from tests.test_conformance import random_scenario, run_both
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must force 8 CPU devices"
+    return Mesh(np.array(devices), ("nodes",))
+
+
+@pytest.mark.parametrize("seed", [1, 4])
+def test_mesh_matches_single_device(mesh, seed):
+    rng = random.Random(seed)
+    # 13 nodes: NOT divisible by 8 -> exercises dummy-node padding
+    state, pending = random_scenario(rng, n_nodes=13, n_existing=10, n_pending=18)
+    snap, batch = SnapshotEncoder(state, pending).encode()
+
+    single = BatchScheduler(SchedulerConfig()).schedule_names(snap, batch)
+    sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
+    assert sharded == single
+
+
+def test_mesh_matches_oracle(mesh):
+    rng = random.Random(7)
+    state, pending = random_scenario(rng, n_nodes=16, n_existing=8, n_pending=12)
+    oracle_result, _ = run_both(state, pending)
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
+    assert sharded == oracle_result
