@@ -114,3 +114,76 @@ def new_requirement(key: str, operator: str, values: Iterable[str]) -> Requireme
 
 def selector(*reqs: Requirement) -> Selector:
     return Selector(tuple(reqs))
+
+
+def parse(text: str) -> Selector:
+    """Parse the query-string selector syntax (pkg/labels/selector.go
+    Parse): comma-joined requirements of the forms `k=v`, `k==v`, `k!=v`,
+    `k in (a,b)`, `k notin (a,b)`, `k` (Exists), `!k` (DoesNotExist)."""
+    text = (text or "").strip()
+    if not text:
+        return everything()
+    reqs: List[Requirement] = []
+    # Split on commas that are not inside parentheses.
+    parts: List[str] = []
+    depth = 0
+    cur = []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        low = part.lower()
+        if " notin " in low:
+            idx = low.index(" notin ")
+            key, vals = part[:idx].strip(), part[idx + 7 :].strip()
+            reqs.append(
+                Requirement(key, NOT_IN, frozenset(_parse_value_list(vals)))
+            )
+        elif " in " in low:
+            idx = low.index(" in ")
+            key, vals = part[:idx].strip(), part[idx + 4 :].strip()
+            reqs.append(Requirement(key, IN, frozenset(_parse_value_list(vals))))
+        elif "!=" in part:
+            key, val = part.split("!=", 1)
+            reqs.append(
+                Requirement(key.strip(), NOT_IN, frozenset([val.strip()]))
+            )
+        elif "==" in part:
+            key, val = part.split("==", 1)
+            reqs.append(Requirement(key.strip(), IN, frozenset([val.strip()])))
+        elif "=" in part:
+            key, val = part.split("=", 1)
+            reqs.append(Requirement(key.strip(), IN, frozenset([val.strip()])))
+        elif part.startswith("!"):
+            reqs.append(Requirement(part[1:].strip(), DOES_NOT_EXIST))
+        else:
+            reqs.append(Requirement(part, EXISTS))
+    for r in reqs:
+        _validate_parsed_key(r.key)
+    return Selector(tuple(reqs))
+
+
+def _validate_parsed_key(key: str) -> None:
+    """Reject malformed clauses instead of silently producing a wrong
+    selector (selector.go Parse returns an error; the apiserver maps the
+    raised ValueError to a 400)."""
+    if not key or any(ch in key for ch in "=!<>() "):
+        raise ValueError(f"invalid label selector key {key!r}")
+
+
+def _parse_value_list(text: str) -> List[str]:
+    text = text.strip()
+    if text.startswith("(") and text.endswith(")"):
+        text = text[1:-1]
+    return [v.strip() for v in text.split(",") if v.strip()]

@@ -41,6 +41,12 @@ class ObjectMeta:
     # RFC3339 string when the object is pending deletion (selector-spread
     # skips such pods, selector_spreading.go:146).
     deletion_timestamp: Optional[str] = None
+    # Storage bookkeeping (pkg/api/types.go ObjectMeta): optimistic
+    # concurrency token assigned by the store on every write, and the
+    # creation instant. generate_name seeds server-side name generation.
+    resource_version: str = ""
+    creation_timestamp: Optional[str] = None
+    generate_name: str = ""
 
     @property
     def full_name(self) -> str:
@@ -232,8 +238,31 @@ class PodSpec:
 
 
 @dataclass
+class PodCondition:
+    type: str = "Ready"  # Ready | PodScheduled | Initialized
+    status: str = "True"  # True | False | Unknown
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    ready: bool = False
+    restart_count: int = 0
+    state: str = "waiting"  # waiting | running | terminated
+
+
+@dataclass
 class PodStatus:
-    phase: str = "Pending"
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed | Unknown
+    conditions: List["PodCondition"] = field(default_factory=list)
+    host_ip: str = ""
+    pod_ip: str = ""
+    start_time: Optional[str] = None
+    reason: str = ""
+    message: str = ""
+    container_statuses: List["ContainerStatus"] = field(default_factory=list)
 
 
 @dataclass
@@ -255,6 +284,16 @@ class Pod:
 class NodeCondition:
     type: str = "Ready"  # Ready | OutOfDisk | MemoryPressure | ...
     status: str = "True"  # True | False | Unknown
+    last_heartbeat_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class NodeAddress:
+    type: str = "InternalIP"  # InternalIP | ExternalIP | Hostname
+    address: str = ""
 
 
 @dataclass
@@ -263,6 +302,8 @@ class NodeStatus:
     allocatable: Dict[str, object] = field(default_factory=dict)
     conditions: List[NodeCondition] = field(default_factory=list)
     images: List["ContainerImage"] = field(default_factory=list)
+    addresses: List["NodeAddress"] = field(default_factory=list)
+    phase: str = ""
 
 
 @dataclass
@@ -300,27 +341,52 @@ class Service:
 
 
 @dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
 class ReplicationControllerSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    fully_labeled_replicas: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class ReplicationController:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
+    status: ReplicationControllerStatus = field(
+        default_factory=ReplicationControllerStatus
+    )
 
 
 @dataclass
 class ReplicaSetSpec:
     selector: Optional[LabelSelector] = None
     replicas: int = 1
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    observed_generation: int = 0
 
 
 @dataclass
 class ReplicaSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
 
 
 @dataclass
@@ -330,6 +396,144 @@ class Binding:
     pod_namespace: str
     pod_name: str
     target_node: str
+
+
+# --- control-plane kinds beyond the scheduler's own needs -------------------
+
+
+@dataclass
+class NamespaceSpec:
+    finalizers: List[str] = field(default_factory=lambda: ["kubernetes"])
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"  # Active | Terminating
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    target_ref: str = ""  # "namespace/pod-name"
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    """An observability record (pkg/api/types.go Event); produced by the
+    recorder/broadcaster pipeline in kubernetes_tpu.client.record."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    source_component: str = ""
+    first_timestamp: Optional[str] = None
+    last_timestamp: Optional[str] = None
+    count: int = 1
+    type: str = "Normal"  # Normal | Warning
+
+
+@dataclass
+class JobSpec:
+    parallelism: int = 1
+    completions: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    conditions: List[str] = field(default_factory=list)  # e.g. ["Complete"]
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    strategy: str = "RollingUpdate"  # RollingUpdate | Recreate
+    max_unavailable: int = 1
+    max_surge: int = 1
+
+
+@dataclass
+class DeploymentStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    updated_replicas: int = 0
+    available_replicas: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    desired_number_scheduled: int = 0
+    number_misscheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
 
 
 # --- helpers ----------------------------------------------------------------

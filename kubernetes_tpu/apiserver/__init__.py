@@ -1,0 +1,16 @@
+"""API surface layer (pkg/apiserver + pkg/registry + pkg/master).
+
+APIServer is the hub of the hub-and-spoke design: the only writer to
+the store, serving REST verbs + resumable watches for every registered
+resource. It is transport-agnostic — `handle()` takes (method, path,
+query, body) and returns a status + JSON payload or a WatchResponse —
+with two frontends:
+
+- serve_http(): a real HTTP server (the production shape), and
+- the client layer's LocalTransport, which calls handle() in-process
+  (the httptest in-process master idiom, master_utils.go:320).
+"""
+
+from kubernetes_tpu.apiserver.server import APIServer, APIError, WatchResponse
+
+__all__ = ["APIServer", "APIError", "WatchResponse"]

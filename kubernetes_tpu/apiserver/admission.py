@@ -1,0 +1,60 @@
+"""Admission control (pkg/admission + plugin/pkg/admission).
+
+A chain of plugins runs on every write before storage (chain.go). Each
+plugin sees (operation, resource, namespace, object) and may mutate the
+object or reject the request by raising AdmissionDenied.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+CREATE = "CREATE"
+UPDATE = "UPDATE"
+DELETE = "DELETE"
+
+
+class AdmissionDenied(Exception):
+    pass
+
+
+class AdmissionPlugin:
+    def admit(
+        self, operation: str, resource: str, namespace: str, obj: Optional[Any]
+    ) -> None:
+        raise NotImplementedError
+
+
+class AdmissionChain(AdmissionPlugin):
+    """chain.go: run plugins in order; first rejection wins."""
+
+    def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
+        self.plugins = plugins or []
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        for p in self.plugins:
+            p.admit(operation, resource, namespace, obj)
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """plugin/pkg/admission/namespace/lifecycle: reject writes into a
+    terminating namespace. (Missing namespaces are auto-provisioned by
+    the server itself, the test-master convenience.)"""
+
+    def __init__(self, server):
+        self._server = server
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if operation != CREATE or not namespace or resource == "namespaces":
+            return
+        ns = self._server.get_namespace(namespace)
+        if ns is not None and ns.status.phase == "Terminating":
+            raise AdmissionDenied(
+                f"unable to create new content in namespace {namespace} "
+                "because it is being terminated"
+            )
+
+
+class AlwaysAdmit(AdmissionPlugin):
+    def admit(self, operation, resource, namespace, obj) -> None:
+        return

@@ -1,0 +1,65 @@
+"""Field selectors (pkg/fields).
+
+The scheduler's reflectors watch with field selectors like
+`spec.nodeName==""` (unassigned pods) and `spec.unschedulable==false`
+(factory.go:431-448). Fields are resolved against the wire (camelCase)
+encoding of the object, so any field the codec emits is selectable;
+absent paths resolve to "".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from kubernetes_tpu.runtime.scheme import encode_value
+
+
+def parse_field_selector(text: str) -> List[Tuple[str, str, str]]:
+    """-> [(path, op, value)] with op in {'=', '!='}. Empty text -> []."""
+    out: List[Tuple[str, str, str]] = []
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            out.append((k.strip(), "!=", v.strip()))
+        elif "==" in part:
+            k, v = part.split("==", 1)
+            out.append((k.strip(), "=", v.strip()))
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            out.append((k.strip(), "=", v.strip()))
+        else:
+            raise ValueError(f"invalid field selector clause {part!r}")
+    return out
+
+
+def _lookup(wire: Dict[str, Any], path: str) -> str:
+    cur: Any = wire
+    for seg in path.split("."):
+        if not isinstance(cur, dict) or seg not in cur:
+            return ""
+        cur = cur[seg]
+    if cur is None:
+        return ""
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    return str(cur)
+
+
+def matches_fields(obj: Any, clauses: List[Tuple[str, str, str]]) -> bool:
+    if not clauses:
+        return True
+    wire = encode_value(obj)
+    for path, op, want in clauses:
+        got = _lookup(wire, path)
+        # strip optional quoting: spec.nodeName=="" arrives as value '""'
+        if len(want) >= 2 and want[0] == want[-1] == '"':
+            want = want[1:-1]
+        ok = got == want
+        if op == "!=":
+            ok = not ok
+        if not ok:
+            return False
+    return True

@@ -1,0 +1,103 @@
+"""HTTP frontend for APIServer (pkg/genericapiserver serve path).
+
+Threaded HTTP server translating requests to APIServer.handle(). Watches
+stream as newline-delimited JSON frames over a chunked response, exactly
+the reference's watch wire shape (pkg/apiserver/watch.go WatchServer):
+
+    {"type": "ADDED", "object": {...}}\n
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
+
+
+def start_http_server(api: APIServer, host: str, port: int):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet; pkg/httplog is V-gated
+            pass
+
+        def _dispatch(self, method: str):
+            parsed = urlparse(self.path)
+            query = {
+                k: v[0] for k, v in parse_qs(parsed.query).items() if v
+            }
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                raw = self.rfile.read(length)
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    self._send_json(400, {"message": "invalid JSON body"})
+                    return
+            code, payload = api.handle(method, parsed.path, query, body)
+            if isinstance(payload, WatchResponse):
+                self._stream_watch(payload)
+                return
+            if parsed.path == "/metrics" and code == 200:
+                text = payload.get("text", "").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+                return
+            self._send_json(code, payload)
+
+        def _send_json(self, code: int, payload) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _stream_watch(self, watch: WatchResponse) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for event in watch.events():
+                    frame = json.dumps(event).encode() + b"\n"
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                watch.stop()
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_PUT(self):
+            self._dispatch("PUT")
+
+        def do_PATCH(self):
+            self._dispatch("PATCH")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    server = Server((host, port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="apiserver-http", daemon=True
+    )
+    thread.start()
+    return server, server.server_address[1]

@@ -1,0 +1,133 @@
+"""Per-resource REST semantics (pkg/registry analogue).
+
+One ResourceInfo per resource: kind, store key prefix (the reference's
+etcd layout — pods under /pods/<ns>/<name>, nodes under /minions/<name>,
+registry/pod/etcd, registry/node/etcd), namespacing, and the
+prepare/validate strategy hooks (strategy.go idiom).
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, Optional
+
+from kubernetes_tpu.api import types as t
+
+
+def now_rfc3339() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class ValidationError(Exception):
+    pass
+
+
+_name_seq = itertools.count()
+
+
+def prepare_meta(obj: Any) -> None:
+    """Common create-time defaulting (strategy PrepareForCreate +
+    BeforeCreate in pkg/api/rest): uid, creationTimestamp, generateName."""
+    meta = obj.metadata
+    if not meta.name and meta.generate_name:
+        # pkg/api/rest/create.go uses a 5-char random suffix; a counter
+        # keeps tests deterministic while preserving uniqueness.
+        meta.name = f"{meta.generate_name}{uuid.uuid4().hex[:5]}"
+    if not meta.uid:
+        meta.uid = str(uuid.uuid4())
+    if not meta.creation_timestamp:
+        meta.creation_timestamp = now_rfc3339()
+
+
+def validate_meta(obj: Any, namespaced: bool) -> None:
+    meta = obj.metadata
+    if not meta.name:
+        raise ValidationError("metadata.name: required value")
+    if namespaced and not meta.namespace:
+        raise ValidationError("metadata.namespace: required value")
+
+
+def prepare_pod(pod: t.Pod) -> None:
+    if not pod.status.phase:
+        pod.status.phase = "Pending"
+
+
+def validate_pod(pod: t.Pod) -> None:
+    if not pod.spec.containers:
+        raise ValidationError("spec.containers: required value")
+
+
+@dataclass
+class ResourceInfo:
+    resource: str  # plural REST name, e.g. "pods"
+    kind: str
+    cls: type
+    prefix: str  # store key prefix
+    namespaced: bool = True
+    group: str = ""  # "" == core /api/v1; else /apis/<group>/v1
+    prepare: Optional[Callable[[Any], None]] = None
+    validate: Optional[Callable[[Any], None]] = None
+    has_status: bool = False
+
+    def key(self, namespace: str, name: str) -> str:
+        if self.namespaced:
+            return f"{self.prefix}/{namespace}/{name}"
+        return f"{self.prefix}/{name}"
+
+    def list_prefix(self, namespace: str = "") -> str:
+        if self.namespaced and namespace:
+            return f"{self.prefix}/{namespace}/"
+        return f"{self.prefix}/"
+
+
+def default_resources() -> Dict[str, ResourceInfo]:
+    """The resource table the master installs (master.go:419
+    initV1ResourcesStorage + extensions in master.go InstallAPIs)."""
+    infos = [
+        ResourceInfo(
+            "pods", "Pod", t.Pod, "/pods",
+            prepare=prepare_pod, validate=validate_pod, has_status=True,
+        ),
+        # nodes live under /minions in the reference's etcd layout
+        ResourceInfo(
+            "nodes", "Node", t.Node, "/minions", namespaced=False, has_status=True
+        ),
+        ResourceInfo("services", "Service", t.Service, "/services/specs"),
+        ResourceInfo("endpoints", "Endpoints", t.Endpoints, "/services/endpoints"),
+        ResourceInfo("events", "Event", t.Event, "/events"),
+        ResourceInfo(
+            "namespaces", "Namespace", t.Namespace, "/namespaces",
+            namespaced=False, has_status=True,
+        ),
+        ResourceInfo(
+            "replicationcontrollers", "ReplicationController",
+            t.ReplicationController, "/controllers", has_status=True,
+        ),
+        ResourceInfo(
+            "persistentvolumes", "PersistentVolume", t.PersistentVolume,
+            "/persistentvolumes", namespaced=False,
+        ),
+        ResourceInfo(
+            "persistentvolumeclaims", "PersistentVolumeClaim",
+            t.PersistentVolumeClaim, "/persistentvolumeclaims",
+        ),
+        ResourceInfo(
+            "replicasets", "ReplicaSet", t.ReplicaSet, "/replicasets",
+            group="extensions", has_status=True,
+        ),
+        ResourceInfo(
+            "deployments", "Deployment", t.Deployment, "/deployments",
+            group="extensions", has_status=True,
+        ),
+        ResourceInfo(
+            "daemonsets", "DaemonSet", t.DaemonSet, "/daemonsets",
+            group="extensions", has_status=True,
+        ),
+        ResourceInfo(
+            "jobs", "Job", t.Job, "/jobs", group="batch", has_status=True
+        ),
+    ]
+    return {info.resource: info for info in infos}

@@ -1,0 +1,460 @@
+"""The API server core (pkg/apiserver resthandler.go + pkg/master).
+
+Transport-agnostic request handling: handle(method, path, query, body)
+implements GET/LIST/POST/PUT/PATCH/DELETE plus resumable filtered
+watches and the pods/binding + <resource>/status subresources. Paths
+follow the reference's URL space:
+
+    /api/v1/namespaces/{ns}/pods[/{name}[/binding|/status]]
+    /api/v1/nodes[/{name}[/status]]
+    /apis/extensions/v1beta1/namespaces/{ns}/replicasets/...
+    /healthz, /metrics
+
+serve_http() puts a real threaded HTTP frontend on top (chunked watch
+streaming); the client layer's LocalTransport skips the socket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import labels as labelpkg
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.apiserver import admission as adm
+from kubernetes_tpu.apiserver.fields import matches_fields, parse_field_selector
+from kubernetes_tpu.apiserver.registry import (
+    ResourceInfo,
+    ValidationError,
+    default_resources,
+    prepare_meta,
+    validate_meta,
+)
+from kubernetes_tpu.runtime import scheme as default_scheme
+from kubernetes_tpu.storage import (
+    Compacted,
+    Conflict,
+    KeyExists,
+    KeyNotFound,
+    MemoryStore,
+    WatchStream,
+)
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str, reason: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.reason = reason or {
+            400: "BadRequest",
+            404: "NotFound",
+            409: "Conflict",
+            410: "Gone",
+            422: "Invalid",
+            403: "Forbidden",
+        }.get(code, "InternalError")
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "message": str(self),
+            "reason": self.reason,
+            "code": self.code,
+        }
+
+
+@dataclass
+class WatchResponse:
+    """A filtered, translated watch the frontends stream to the client."""
+
+    stream: WatchStream
+    label_selector: labelpkg.Selector
+    field_clauses: List[Tuple[str, str, str]]
+    scheme: Any
+
+    def events(self):
+        """Yield wire-format {"type", "object"} dicts, applying the
+        selector-transition translation (etcd_watcher.go sendModify/
+        sendDelete): MODIFIED entering the filter becomes ADDED, leaving
+        it becomes DELETED."""
+        for ev in self.stream:
+            if ev.type == "ERROR":
+                yield {
+                    "type": "ERROR",
+                    "object": {
+                        "kind": "Status",
+                        "status": "Failure",
+                        "message": "watch window overflowed; relist required",
+                        "reason": "Expired",
+                        "code": 410,
+                    },
+                }
+                return
+            cur_match = ev.object is not None and self._match(ev.object)
+            if ev.type == "ADDED":
+                if not cur_match:
+                    continue
+                out_type = "ADDED"
+            elif ev.type == "MODIFIED":
+                prev_match = ev.prev_object is not None and self._match(
+                    ev.prev_object
+                )
+                if cur_match and prev_match:
+                    out_type = "MODIFIED"
+                elif cur_match:
+                    out_type = "ADDED"
+                elif prev_match:
+                    out_type = "DELETED"
+                else:
+                    continue
+            elif ev.type == "DELETED":
+                ref = ev.prev_object if ev.prev_object is not None else ev.object
+                if ref is None or not self._match(ref):
+                    continue
+                out_type = "DELETED"
+            else:
+                continue
+            yield {"type": out_type, "object": self.scheme.encode(ev.object)}
+
+    def _match(self, obj: Any) -> bool:
+        if not self.label_selector.matches(obj.metadata.labels):
+            return False
+        return matches_fields(obj, self.field_clauses)
+
+    def stop(self) -> None:
+        self.stream.stop()
+
+
+class APIServer:
+    def __init__(
+        self,
+        store: Optional[MemoryStore] = None,
+        scheme=None,
+        auto_provision_namespaces: bool = True,
+    ):
+        self.store = store or MemoryStore()
+        self.scheme = scheme or default_scheme
+        self.resources = default_resources()
+        self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
+        self._auto_ns = auto_provision_namespaces
+        self._http_server = None
+
+    # -- namespace helpers ---------------------------------------------------
+
+    def get_namespace(self, name: str) -> Optional[t.Namespace]:
+        try:
+            obj, _ = self.store.get(f"/namespaces/{name}")
+            return obj
+        except KeyNotFound:
+            return None
+
+    def _ensure_namespace(self, name: str) -> None:
+        if not self._auto_ns or not name:
+            return
+        if self.get_namespace(name) is None:
+            ns = t.Namespace(metadata=t.ObjectMeta(name=name, namespace=""))
+            prepare_meta(ns)
+            try:
+                self.store.create(f"/namespaces/{name}", ns)
+            except KeyExists:
+                pass
+
+    # -- request routing -----------------------------------------------------
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+    ):
+        """Returns (status_code, payload_dict) or (200, WatchResponse)."""
+        query = query or {}
+        try:
+            return self._handle(method.upper(), path, query, body)
+        except ValueError as e:
+            return 400, APIError(400, str(e)).status()
+        except APIError as e:
+            return e.code, e.status()
+        except ValidationError as e:
+            return 422, APIError(422, str(e)).status()
+        except adm.AdmissionDenied as e:
+            return 403, APIError(403, str(e)).status()
+        except KeyNotFound as e:
+            return 404, APIError(404, f"not found: {e}").status()
+        except KeyExists as e:
+            return 409, APIError(409, f"already exists: {e}").status()
+        except Conflict as e:
+            return 409, APIError(409, str(e)).status()
+        except Compacted as e:
+            return 410, APIError(410, str(e), reason="Expired").status()
+
+    def _handle(self, method, path, query, body):
+        if path == "/healthz":
+            return 200, {"ok": True}
+        if path == "/metrics":
+            from kubernetes_tpu.metrics import registry as metrics_registry
+
+            return 200, {"text": metrics_registry.render()}
+        if path in ("/api", "/api/v1", "/apis"):
+            return 200, {"resources": sorted(self.resources)}
+
+        # POST /api/v1/namespaces/{ns}/bindings — the collection form the
+        # reference's binder uses (factory.go:537-543)
+        if method == "POST" and path.rstrip("/").endswith("/bindings"):
+            parts = [p for p in path.split("/") if p]
+            ns = parts[parts.index("namespaces") + 1] if "namespaces" in parts else ""
+            return self._bind(ns, "", body)
+
+        ns, info, name, subresource = self._route(path)
+        if info is None:
+            raise APIError(404, f"unknown path {path!r}")
+
+        if method == "GET":
+            if query.get("watch") in ("true", "1") or subresource == "watch":
+                return 200, self._watch(info, ns, query)
+            if name:
+                return 200, self._get(info, ns, name)
+            return 200, self._list(info, ns, query)
+        if method == "POST":
+            if subresource == "binding" or (not name and info.resource == "bindings"):
+                return self._bind(ns, name, body)
+            if name:
+                raise APIError(400, "POST to a named resource")
+            return self._create(info, ns, body)
+        if method == "PUT":
+            if not name:
+                raise APIError(400, "PUT requires a resource name")
+            return self._update(info, ns, name, body, subresource)
+        if method == "PATCH":
+            if not name:
+                raise APIError(400, "PATCH requires a resource name")
+            return self._patch(info, ns, name, body, subresource)
+        if method == "DELETE":
+            if not name:
+                raise APIError(400, "DELETE requires a resource name")
+            return self._delete(info, ns, name)
+        raise APIError(400, f"unsupported method {method}")
+
+    def _route(
+        self, path: str
+    ) -> Tuple[str, Optional[ResourceInfo], str, str]:
+        """-> (namespace, resource info, name, subresource)."""
+        parts = [p for p in path.split("/") if p]
+        # strip the API group prefix: api/v1 | apis/<group>/<version>
+        if parts[:1] == ["api"]:
+            parts = parts[2:]
+        elif parts[:1] == ["apis"]:
+            parts = parts[3:]
+        else:
+            return "", None, "", ""
+        # optional 1.2-style watch prefix: /api/v1/watch/...
+        watch_prefix = False
+        if parts[:1] == ["watch"]:
+            watch_prefix = True
+            parts = parts[1:]
+        ns = ""
+        if (
+            parts[:1] == ["namespaces"]
+            and len(parts) >= 3
+            and parts[2] in self.resources
+        ):
+            # /namespaces/{ns}/{resource}/... — a namespaced resource
+            ns = parts[1]
+            parts = parts[2:]
+        # else /namespaces[/{name}[/status]] — the namespaces resource
+        # itself (parts[2], if present, is its subresource)
+        if not parts:
+            return ns, None, "", ""
+        resource, rest = parts[0], parts[1:]
+        info = self.resources.get(resource)
+        if info is None:
+            return ns, None, "", ""
+        name = rest[0] if rest else ""
+        sub = rest[1] if len(rest) > 1 else ""
+        if watch_prefix:
+            sub = "watch"
+        return ns, info, name, sub
+
+    # -- verbs ---------------------------------------------------------------
+
+    def _get(self, info: ResourceInfo, ns: str, name: str):
+        obj, _ = self.store.get(info.key(ns, name))
+        return self.scheme.encode(obj)
+
+    def _list(self, info: ResourceInfo, ns: str, query):
+        sel = labelpkg.parse(query.get("labelSelector", ""))
+        clauses = parse_field_selector(query.get("fieldSelector", ""))
+        objs, rv = self.store.list(info.list_prefix(ns))
+        items = [
+            self.scheme.encode(o)
+            for o in objs
+            if sel.matches(o.metadata.labels) and matches_fields(o, clauses)
+        ]
+        return {
+            "kind": f"{info.kind}List",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": items,
+        }
+
+    def _watch(self, info: ResourceInfo, ns: str, query) -> WatchResponse:
+        sel = labelpkg.parse(query.get("labelSelector", ""))
+        clauses = parse_field_selector(query.get("fieldSelector", ""))
+        from_rv = int(query.get("resourceVersion", "0") or "0")
+        stream = self.store.watch(info.list_prefix(ns), from_rv=from_rv)
+        return WatchResponse(stream, sel, clauses, self.scheme)
+
+    def _decode_body(self, info: ResourceInfo, body) -> Any:
+        if body is None:
+            raise APIError(400, "request body required")
+        try:
+            return self.scheme.decode(body, info.cls)
+        except Exception as e:
+            raise APIError(400, f"decode error: {e}")
+
+    def _create(self, info: ResourceInfo, ns: str, body):
+        obj = self._decode_body(info, body)
+        if info.namespaced:
+            if obj.metadata.namespace and ns and obj.metadata.namespace != ns:
+                raise APIError(
+                    400,
+                    f"namespace mismatch: body {obj.metadata.namespace!r}, "
+                    f"url {ns!r}",
+                )
+            obj.metadata.namespace = ns or obj.metadata.namespace or "default"
+        else:
+            obj.metadata.namespace = ""
+        prepare_meta(obj)
+        if info.prepare:
+            info.prepare(obj)
+        validate_meta(obj, info.namespaced)
+        if info.validate:
+            info.validate(obj)
+        if info.namespaced:
+            self._ensure_namespace(obj.metadata.namespace)
+        self.admission.admit(
+            adm.CREATE, info.resource, obj.metadata.namespace, obj
+        )
+        self.store.create(info.key(obj.metadata.namespace, obj.metadata.name), obj)
+        return 201, self.scheme.encode(self.store.get(
+            info.key(obj.metadata.namespace, obj.metadata.name)
+        )[0])
+
+    def _update(self, info: ResourceInfo, ns: str, name: str, body, subresource):
+        new = self._decode_body(info, body)
+        key = info.key(ns, name)
+        cur, cur_rv = self.store.get(key)
+        if new.metadata.resource_version:
+            if new.metadata.resource_version != str(cur_rv):
+                raise Conflict(
+                    f"{info.resource} {name!r}: the object has been modified"
+                )
+        if subresource == "status":
+            # status subresource: only .status moves (registry strategy
+            # PrepareForStatusUpdate idiom)
+            cur.status = new.status
+            new = cur
+        else:
+            # preserve immutable meta
+            new.metadata.uid = cur.metadata.uid
+            new.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            new.metadata.namespace = cur.metadata.namespace
+            new.metadata.name = cur.metadata.name
+            if info.has_status:
+                # status never moves through the main resource (pod
+                # strategy PrepareForUpdate copies old status forward)
+                new.status = cur.status
+        self.admission.admit(adm.UPDATE, info.resource, ns, new)
+        self.store.update(key, new, expect_rv=cur_rv if
+                          new.metadata.resource_version else None)
+        return 200, self.scheme.encode(self.store.get(key)[0])
+
+    def _patch(self, info: ResourceInfo, ns: str, name: str, body, subresource):
+        """Strategic-merge-lite: JSON merge patch over the wire form
+        (resthandler.go:445 PatchResource)."""
+        if body is None:
+            raise APIError(400, "patch body required")
+        # the status/main separation holds for PATCH too
+        if subresource == "status":
+            body = {"status": body.get("status", {})}
+        elif info.has_status:
+            body = {k: v for k, v in body.items() if k != "status"}
+        key = info.key(ns, name)
+        cur, cur_rv = self.store.get(key)
+        wire = self.scheme.encode(cur)
+
+        def merge(dst, patch):
+            for k, v in patch.items():
+                if v is None:
+                    dst.pop(k, None)
+                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merge(wire, body)
+        new = self.scheme.decode(wire, info.cls)
+        new.metadata.namespace = cur.metadata.namespace
+        new.metadata.name = cur.metadata.name
+        new.metadata.uid = cur.metadata.uid
+        self.admission.admit(adm.UPDATE, info.resource, ns, new)
+        self.store.update(key, new, expect_rv=cur_rv)
+        return 200, self.scheme.encode(self.store.get(key)[0])
+
+    def _delete(self, info: ResourceInfo, ns: str, name: str):
+        self.admission.admit(adm.DELETE, info.resource, ns, None)
+        obj = self.store.delete(info.key(ns, name))
+        return 200, self.scheme.encode(obj)
+
+    def _bind(self, ns: str, pod_name: str, body):
+        """POST pods/{name}/binding: assign spec.nodeName under CAS
+        (registry/pod/rest.go assignPod; the scheduler's Bind target,
+        factory.go:537-543)."""
+        if body is None:
+            raise APIError(400, "binding body required")
+        target = (body.get("target") or {}).get("name") or body.get(
+            "targetNode"
+        )
+        name = (body.get("metadata") or {}).get("name") or body.get(
+            "podName"
+        ) or pod_name
+        if not target or not name:
+            raise APIError(400, "binding requires pod name and target node")
+        key = f"/pods/{ns}/{name}"
+
+        def assign(pod):
+            if pod.spec.node_name:
+                raise Conflict(
+                    f"pod {name!r} is already assigned to node "
+                    f"{pod.spec.node_name!r}"
+                )
+            pod.spec.node_name = target
+            for c in pod.status.conditions:
+                if c.type == "PodScheduled":
+                    c.status = "True"
+                    break
+            else:
+                pod.status.conditions.append(
+                    t.PodCondition(type="PodScheduled", status="True")
+                )
+            return pod
+
+        self.store.guaranteed_update(key, assign)
+        return 201, {"kind": "Status", "status": "Success"}
+
+    # -- HTTP frontend -------------------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start a threaded HTTP frontend; returns (host, actual_port)."""
+        from kubernetes_tpu.apiserver.http_frontend import start_http_server
+
+        self._http_server, actual_port = start_http_server(self, host, port)
+        return host, actual_port
+
+    def shutdown_http(self) -> None:
+        if self._http_server is not None:
+            self._http_server.shutdown()
+            self._http_server = None

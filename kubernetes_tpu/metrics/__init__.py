@@ -1,0 +1,28 @@
+"""Prometheus-style metrics (plugin/pkg/scheduler/metrics + pkg/apiserver/metrics).
+
+A minimal counter/gauge/histogram registry rendered in the Prometheus
+text exposition format at /metrics. Histogram bucket layout matches the
+scheduler's exponential 1ms -> ~16s buckets (metrics.go:31-54).
+"""
+
+from kubernetes_tpu.metrics.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    registry,
+    scheduler_binding_latency,
+    scheduler_algorithm_latency,
+    scheduler_e2e_latency,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "registry",
+    "scheduler_e2e_latency",
+    "scheduler_algorithm_latency",
+    "scheduler_binding_latency",
+]

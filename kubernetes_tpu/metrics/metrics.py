@@ -1,0 +1,170 @@
+"""Counters, gauges, and histograms with Prometheus text rendering."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    """prometheus.ExponentialBuckets — the scheduler uses
+    (1000, 2, 15) microseconds: 1ms .. ~16s (metrics.go:36)."""
+    out = []
+    v = start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+    def render(self) -> str:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                suffix = f"{{{lbl}}}" if lbl else ""
+                lines.append(f"{self.name}{suffix} {v}")
+        return "\n".join(lines)
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self._value}"
+        )
+
+
+class Histogram(_Metric):
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        super().__init__(name, help_)
+        self.buckets = list(buckets or exponential_buckets(1000, 2, 15))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile from bucket upper bounds (the way the
+        e2e metrics scraper reads histograms, metrics_util.go)."""
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            target = q * self._count
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                if cum >= target:
+                    return b
+            return float("inf")
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            cum += self._counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, m: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        with self._lock:
+            return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+#: process-global registry (prometheus.DefaultRegisterer analogue)
+registry = Registry()
+
+# The scheduler's three histograms (metrics.go:31-54), microsecond units.
+scheduler_e2e_latency = registry.register(
+    Histogram(
+        "scheduler_e2e_scheduling_latency_microseconds",
+        "E2e scheduling latency (scheduling algorithm + binding)",
+    )
+)
+scheduler_algorithm_latency = registry.register(
+    Histogram(
+        "scheduler_scheduling_algorithm_latency_microseconds",
+        "Scheduling algorithm latency",
+    )
+)
+scheduler_binding_latency = registry.register(
+    Histogram(
+        "scheduler_binding_latency_microseconds",
+        "Binding latency",
+    )
+)

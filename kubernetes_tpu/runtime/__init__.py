@@ -1,0 +1,12 @@
+"""Serialization / schema layer (pkg/runtime analogue).
+
+One Scheme maps kind names <-> dataclasses and round-trips every API
+object through camelCase JSON — the equivalent of the reference's
+Scheme + codec factory (pkg/runtime/scheme.go, serializer/json). The
+wire format is JSON only; the columnar device encodings live in
+kubernetes_tpu.snapshot and never pass through here.
+"""
+
+from kubernetes_tpu.runtime.scheme import Scheme, scheme
+
+__all__ = ["Scheme", "scheme"]

@@ -1,0 +1,168 @@
+"""Generic dataclass <-> JSON codec with a kind registry.
+
+The reference generates thousands of lines of conversion/deepcopy/codec
+code per type (pkg/api/ vN/ zz_generated*); here the schema IS the
+dataclass, and one reflective codec covers every kind. Field names are
+converted snake_case <-> camelCase at the wire boundary so payloads look
+like the reference's JSON (e.g. "nodeName", "resourceVersion").
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type
+
+__all__ = ["Scheme", "scheme", "to_camel", "to_snake"]
+
+
+def to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def to_snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _is_dataclass_type(t: Any) -> bool:
+    return isinstance(t, type) and dataclasses.is_dataclass(t)
+
+
+def encode_value(v: Any) -> Any:
+    """Recursively encode a value into JSON-compatible data."""
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(v):
+            fv = getattr(v, f.name)
+            if fv is None:
+                continue
+            # omitempty: skip empty containers and default-empty strings
+            if fv == {} or fv == [] or fv == () or fv == "":
+                continue
+            out[to_camel(f.name)] = encode_value(fv)
+        return out
+    if isinstance(v, dict):
+        return {k: encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [encode_value(x) for x in v]
+    return v
+
+
+def _strip_optional(t: Any) -> Any:
+    if typing.get_origin(t) is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return t
+
+
+def decode_value(t: Any, v: Any) -> Any:
+    """Recursively decode JSON data into the typed form `t`."""
+    t = _strip_optional(t)
+    if v is None:
+        return None
+    origin = typing.get_origin(t)
+    if _is_dataclass_type(t):
+        if not isinstance(v, dict):
+            raise ValueError(f"expected object for {t.__name__}, got {type(v)}")
+        hints = typing.get_type_hints(t)
+        kwargs = {}
+        by_camel = {to_camel(f.name): f.name for f in dataclasses.fields(t)}
+        for k, fv in v.items():
+            fname = by_camel.get(k)
+            if fname is None:
+                continue  # unknown fields are dropped, like strict-less json
+            kwargs[fname] = decode_value(hints[fname], fv)
+        return t(**kwargs)
+    if origin in (list, typing.List):
+        (elem,) = typing.get_args(t) or (Any,)
+        return [decode_value(elem, x) for x in v]
+    if origin in (tuple, typing.Tuple):
+        args = typing.get_args(t)
+        elem = args[0] if args else Any
+        return tuple(decode_value(elem, x) for x in v)
+    if origin in (dict, typing.Dict):
+        args = typing.get_args(t)
+        vt = args[1] if len(args) == 2 else Any
+        if vt is object or vt is Any:
+            return dict(v)
+        return {k: decode_value(vt, x) for k, x in v.items()}
+    return v
+
+
+class Scheme:
+    """Kind registry + codec (pkg/runtime/scheme.go analogue)."""
+
+    def __init__(self, api_version: str = "v1"):
+        self.api_version = api_version
+        self._kind_to_type: Dict[str, type] = {}
+        self._type_to_kind: Dict[type, str] = {}
+
+    def register(self, kind: str, cls: type) -> None:
+        self._kind_to_type[kind] = cls
+        self._type_to_kind[cls] = kind
+
+    def kind_for(self, obj: Any) -> Optional[str]:
+        return self._type_to_kind.get(type(obj))
+
+    def type_for(self, kind: str) -> Optional[type]:
+        return self._kind_to_type.get(kind)
+
+    def encode(self, obj: Any) -> Dict[str, Any]:
+        """Object -> JSON dict with kind/apiVersion tags."""
+        d = encode_value(obj)
+        kind = self.kind_for(obj)
+        if kind:
+            d["kind"] = kind
+            d["apiVersion"] = self.api_version
+        return d
+
+    def decode(self, data: Dict[str, Any], cls: Optional[type] = None) -> Any:
+        """JSON dict -> object. Type comes from `cls` or the kind tag."""
+        if cls is None:
+            kind = data.get("kind")
+            cls = self._kind_to_type.get(kind or "")
+            if cls is None:
+                raise ValueError(f"no kind registered for {kind!r}")
+        data = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
+        return decode_value(cls, data)
+
+    def deep_copy(self, obj: Any) -> Any:
+        return copy.deepcopy(obj)
+
+
+def _default_scheme() -> Scheme:
+    from kubernetes_tpu.api import types as t
+
+    s = Scheme()
+    for kind, cls in [
+        ("Pod", t.Pod),
+        ("Node", t.Node),
+        ("Service", t.Service),
+        ("ReplicationController", t.ReplicationController),
+        ("ReplicaSet", t.ReplicaSet),
+        ("PersistentVolume", t.PersistentVolume),
+        ("PersistentVolumeClaim", t.PersistentVolumeClaim),
+        ("Namespace", t.Namespace),
+        ("Endpoints", t.Endpoints),
+        ("Event", t.Event),
+        ("Job", t.Job),
+        ("Deployment", t.Deployment),
+        ("DaemonSet", t.DaemonSet),
+        ("Binding", t.Binding),
+    ]:
+        s.register(kind, cls)
+    return s
+
+
+#: The framework-wide scheme (api.Scheme analogue).
+scheme = _default_scheme()

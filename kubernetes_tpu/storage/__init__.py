@@ -1,0 +1,34 @@
+"""Storage / consensus layer (pkg/storage analogue).
+
+The reference persists everything in etcd behind storage.Interface
+(pkg/storage/interfaces.go:82-142) and multiplexes watches through an
+in-memory watch cache (cacher.go). Here the store itself is in-memory
+and thread-safe — the control plane is a single process in this
+framework, so raft consensus is out of scope — but the *contract* is
+preserved exactly: monotonic resourceVersions, optimistic-concurrency
+GuaranteedUpdate, watch streams resumable from a resourceVersion, and
+"too old" errors past the compaction horizon that force clients to
+relist (reflector.go:281 semantics depend on all of these).
+"""
+
+from kubernetes_tpu.storage.store import (
+    Compacted,
+    Conflict,
+    KeyExists,
+    KeyNotFound,
+    MemoryStore,
+    StorageError,
+    WatchEvent,
+    WatchStream,
+)
+
+__all__ = [
+    "MemoryStore",
+    "WatchEvent",
+    "WatchStream",
+    "StorageError",
+    "KeyNotFound",
+    "KeyExists",
+    "Conflict",
+    "Compacted",
+]

@@ -1,0 +1,282 @@
+"""Versioned key-value store with watch (storage.Interface equivalent).
+
+Contract surface mirrored from pkg/storage/interfaces.go:82-142:
+Create / Get / List / GuaranteedUpdate / Delete / Watch(+prefix), all
+keyed on resourceVersion. Event history is a bounded ring (the etcd3
+compaction + cacher.go watch-window analogue): watching from a version
+older than the horizon raises Compacted, which the reflector answers
+with a fresh list (reflector.go ListAndWatch).
+
+Objects are stored as deep copies and handed out as deep copies —
+callers can mutate freely, like decoding fresh bytes from etcd.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+ERROR = "ERROR"
+
+
+class StorageError(Exception):
+    pass
+
+
+class KeyNotFound(StorageError):
+    pass
+
+
+class KeyExists(StorageError):
+    pass
+
+
+class Conflict(StorageError):
+    """resourceVersion precondition failed (optimistic concurrency)."""
+
+
+class Compacted(StorageError):
+    """Requested watch window is older than the retained history."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED | ERROR
+    object: Any
+    resource_version: int
+    # Previous value on MODIFIED/DELETED (the etcd prevNode): selector-
+    # filtered watches need it to translate transitions in/out of the
+    # filter into ADDED/DELETED (pkg/storage/etcd/etcd_watcher.go
+    # sendModify).
+    prev_object: Any = None
+
+
+class WatchStream:
+    """One watcher's event channel. Iterate to receive events; stop() to
+    cancel. The store never blocks on a slow watcher: a full channel
+    terminates the watch with ERROR, and the client relists — exactly the
+    cacher.go "terminate blocked watchers" strategy (cacher.go:terminate)."""
+
+    def __init__(self, store: "MemoryStore", capacity: int = 4096):
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=capacity)
+        self._store = store
+        self._stopped = threading.Event()
+
+    def _deliver(self, ev: WatchEvent) -> None:
+        if self._stopped.is_set():
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            # The watcher fell behind: drop its backlog and terminate the
+            # stream with ERROR so it relists (cacher.go blocked-watcher
+            # termination). Undelivered events are unrecoverable anyway —
+            # the client must resync from a fresh List.
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._q.put_nowait(WatchEvent(ERROR, None, ev.resource_version))
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._store._remove_watcher(self)
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Blocking single-event read; None on stop or timeout."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+
+class MemoryStore:
+    """The single source of truth (the framework's "etcd")."""
+
+    def __init__(self, history_size: int = 8192):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[Any, int]] = {}  # key -> (object, mod_rv)
+        self._rv = 0
+        self._history: List[Tuple[str, WatchEvent]] = []  # (key, event)
+        self._history_size = history_size
+        self._compacted_rv = 0  # events <= this are gone
+        self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def get(self, key: str) -> Tuple[Any, int]:
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            obj, rv = self._data[key]
+            return copy.deepcopy(obj), rv
+
+    def list(self, prefix: str) -> Tuple[List[Any], int]:
+        """All objects under prefix plus the store's current version (the
+        List + resourceVersion pair the reflector records)."""
+        with self._lock:
+            out = [
+                copy.deepcopy(obj)
+                for key, (obj, _) in sorted(self._data.items())
+                if key.startswith(prefix)
+            ]
+            return out, self._rv
+
+    # -- writes --------------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _record(self, key: str, ev: WatchEvent) -> None:
+        self._history.append((key, ev))
+        if len(self._history) > self._history_size:
+            drop = len(self._history) - self._history_size
+            self._compacted_rv = self._history[drop - 1][1].resource_version
+            del self._history[:drop]
+        for prefix, stream in list(self._watchers):
+            if key.startswith(prefix):
+                stream._deliver(
+                    WatchEvent(
+                        ev.type,
+                        copy.deepcopy(ev.object),
+                        ev.resource_version,
+                        copy.deepcopy(ev.prev_object),
+                    )
+                )
+
+    def create(self, key: str, obj: Any) -> int:
+        with self._lock:
+            if key in self._data:
+                raise KeyExists(key)
+            rv = self._next_rv()
+            stored = copy.deepcopy(obj)
+            self._set_rv(stored, rv)
+            self._data[key] = (stored, rv)
+            self._record(key, WatchEvent(ADDED, stored, rv))
+            return rv
+
+    def update(self, key: str, obj: Any, expect_rv: Optional[int] = None) -> int:
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            prev, cur = self._data[key]
+            if expect_rv is not None and expect_rv != cur:
+                raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
+            rv = self._next_rv()
+            stored = copy.deepcopy(obj)
+            self._set_rv(stored, rv)
+            self._data[key] = (stored, rv)
+            self._record(key, WatchEvent(MODIFIED, stored, rv, prev))
+            return rv
+
+    def guaranteed_update(
+        self,
+        key: str,
+        fn: Callable[[Any], Any],
+        ignore_not_found: bool = False,
+    ) -> int:
+        """Retry loop applying fn to the latest value (interfaces.go:126
+        GuaranteedUpdate). Under one process-wide lock the first attempt
+        always wins, but the shape is kept so callers are written
+        correctly. fn returning None aborts without writing."""
+        with self._lock:
+            if key not in self._data:
+                if not ignore_not_found:
+                    raise KeyNotFound(key)
+                cur = None
+            else:
+                cur = copy.deepcopy(self._data[key][0])
+            new = fn(cur)
+            if new is None:
+                return self._rv
+            if key in self._data:
+                return self.update(key, new)
+            return self.create(key, new)
+
+    def delete(self, key: str, expect_rv: Optional[int] = None) -> Any:
+        with self._lock:
+            if key not in self._data:
+                raise KeyNotFound(key)
+            obj, cur = self._data[key]
+            if expect_rv is not None and expect_rv != cur:
+                raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
+            del self._data[key]
+            rv = self._next_rv()
+            self._record(key, WatchEvent(DELETED, obj, rv, obj))
+            return copy.deepcopy(obj)
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, prefix: str, from_rv: int = 0) -> WatchStream:
+        """Events for keys under prefix with resource_version > from_rv.
+        from_rv==0 means "from now". Raises Compacted when the window is
+        gone — the caller must relist."""
+        with self._lock:
+            if from_rv and from_rv < self._compacted_rv:
+                raise Compacted(
+                    f"requested {from_rv}, horizon {self._compacted_rv}"
+                )
+            stream = WatchStream(self)
+            if from_rv:
+                for key, ev in self._history:
+                    if ev.resource_version > from_rv and key.startswith(prefix):
+                        stream._deliver(
+                            WatchEvent(
+                                ev.type,
+                                copy.deepcopy(ev.object),
+                                ev.resource_version,
+                                copy.deepcopy(ev.prev_object),
+                            )
+                        )
+            self._watchers.append((prefix, stream))
+            return stream
+
+    def _remove_watcher(self, stream: WatchStream) -> None:
+        with self._lock:
+            self._watchers = [(p, s) for p, s in self._watchers if s is not stream]
+
+    def compact(self, keep_last: int = 0) -> None:
+        """Force-drop history (etcd3 compact.go analogue); mainly a test
+        hook for the relist path."""
+        with self._lock:
+            if keep_last >= len(self._history):
+                return
+            drop = len(self._history) - keep_last
+            if drop > 0 and self._history:
+                self._compacted_rv = self._history[drop - 1][1].resource_version
+                del self._history[:drop]
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _set_rv(obj: Any, rv: int) -> None:
+        meta = getattr(obj, "metadata", None)
+        if meta is not None and hasattr(meta, "resource_version"):
+            meta.resource_version = str(rv)

@@ -1,0 +1,173 @@
+"""Work queues (pkg/util/workqueue) and bounded fan-out.
+
+WorkQueue: deduplicating queue with the dirty/processing discipline —
+an item re-added while being processed is requeued when done, never
+processed concurrently with itself. DelayingQueue adds add_after;
+RateLimitingQueue adds per-item exponential requeue backoff. These are
+what every controller loop drains.
+
+parallelize() is workqueue.Parallelize (parallelizer.go:29-48), kept for
+host-side fan-outs that have no tensor form; the scheduler's node scan
+(its 16-worker user, generic_scheduler.go:161) is replaced by the device
+program and does NOT use this.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Hashable, List, Optional, Tuple
+
+from kubernetes_tpu.utils.clock import Clock, DEFAULT_CLOCK
+from kubernetes_tpu.utils.flowcontrol import Backoff
+
+
+class ShutDown(Exception):
+    pass
+
+
+class WorkQueue:
+    """FIFO of unique items with in-flight tracking."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Hashable] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutting_down = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Hashable:
+        """Block until an item is available; raises ShutDown when the
+        queue is drained and shutting down."""
+        with self._cond:
+            while not self._queue:
+                if self._shutting_down:
+                    raise ShutDown
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+
+class DelayingQueue(WorkQueue):
+    """WorkQueue + add_after(item, delay). A waiter thread moves items
+    from a heap into the queue when their time comes."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        super().__init__()
+        self._clock = clock or DEFAULT_CLOCK
+        self._heap: List[Tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._heap_cond = threading.Condition()
+        self._waiter = threading.Thread(target=self._wait_loop, daemon=True)
+        self._waiter.start()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._heap_cond:
+            heapq.heappush(self._heap, (self._clock.now() + delay, self._seq, item))
+            self._seq += 1
+            self._heap_cond.notify()
+
+    def _wait_loop(self) -> None:
+        while True:
+            with self._heap_cond:
+                if self._shutting_down:
+                    return
+                if not self._heap:
+                    self._heap_cond.wait(timeout=0.5)
+                    continue
+                ready_at = self._heap[0][0]
+                now = self._clock.now()
+                if ready_at > now:
+                    self._heap_cond.wait(timeout=min(ready_at - now, 0.5))
+                    continue
+                _, _, item = heapq.heappop(self._heap)
+            self.add(item)
+
+    def shut_down(self) -> None:
+        super().shut_down()
+        with self._heap_cond:
+            self._heap_cond.notify_all()
+
+
+class RateLimitingQueue(DelayingQueue):
+    """DelayingQueue + per-item exponential backoff requeues
+    (workqueue/rate_limitting_queue.go)."""
+
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 1000.0,
+        clock: Optional[Clock] = None,
+    ):
+        super().__init__(clock=clock)
+        self._backoff = Backoff(base_delay, max_delay, clock=clock)
+        self._requeues: dict = {}
+        self._requeue_lock = threading.Lock()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        with self._requeue_lock:
+            self._requeues[item] = self._requeues.get(item, 0) + 1
+        self.add_after(item, self._backoff.next_(str(item)))
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._requeue_lock:
+            return self._requeues.get(item, 0)
+
+    def forget(self, item: Hashable) -> None:
+        with self._requeue_lock:
+            self._requeues.pop(item, None)
+        self._backoff.reset(str(item))
+
+
+def parallelize(workers: int, pieces: int, do_work_piece: Callable[[int], Any]) -> None:
+    """Bounded fan-out over indices with a completion barrier
+    (parallelizer.go:29-48). Exceptions are contained per piece the way
+    HandleCrash is (parallelizer.go:40)."""
+    if pieces <= 0:
+        return
+
+    def safe(i: int) -> None:
+        try:
+            do_work_piece(i)
+        except Exception as exc:
+            import logging
+
+            logging.getLogger("kubernetes_tpu.workqueue").exception(
+                "worker panic on piece %d: %s", i, exc
+            )
+
+    with ThreadPoolExecutor(max_workers=max(1, workers)) as pool:
+        list(pool.map(safe, range(pieces)))

@@ -1,0 +1,338 @@
+"""APIServer tests (pkg/apiserver resthandler + registry semantics)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver import APIServer, WatchResponse
+from kubernetes_tpu.runtime import scheme
+
+
+def pod_body(name, ns="default", node="", labels=None):
+    return scheme.encode(
+        Pod(
+            metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "100m"})], node_name=node
+            ),
+        )
+    )
+
+
+def node_body(name):
+    return scheme.encode(Node(metadata=ObjectMeta(name=name)))
+
+
+@pytest.fixture()
+def api():
+    return APIServer()
+
+
+class TestRESTVerbs:
+    def test_create_get_pod(self, api):
+        code, out = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("p1")
+        )
+        assert code == 201
+        assert out["metadata"]["uid"]
+        assert out["metadata"]["resourceVersion"]
+        assert out["status"]["phase"] == "Pending"
+        code, out = api.handle("GET", "/api/v1/namespaces/default/pods/p1")
+        assert code == 200
+        assert out["metadata"]["name"] == "p1"
+
+    def test_create_validates(self, api):
+        bad = pod_body("p1")
+        bad["spec"].pop("containers")
+        code, out = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=bad
+        )
+        assert code == 422
+
+    def test_create_duplicate_conflict(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("p1")
+        )
+        assert code == 409
+
+    def test_namespace_mismatch(self, api):
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/other/pods", body=pod_body("p1", ns="default")
+        )
+        assert code == 400
+
+    def test_list_with_selectors(self, api):
+        api.handle(
+            "POST",
+            "/api/v1/namespaces/default/pods",
+            body=pod_body("a", labels={"app": "web"}),
+        )
+        api.handle(
+            "POST",
+            "/api/v1/namespaces/default/pods",
+            body=pod_body("b", labels={"app": "db"}, node="n1"),
+        )
+        code, out = api.handle(
+            "GET",
+            "/api/v1/namespaces/default/pods",
+            {"labelSelector": "app=web"},
+        )
+        assert [i["metadata"]["name"] for i in out["items"]] == ["a"]
+        # unassigned pods: the scheduler's field selector (factory.go:431)
+        code, out = api.handle(
+            "GET", "/api/v1/pods", {"fieldSelector": "spec.nodeName="}
+        )
+        assert [i["metadata"]["name"] for i in out["items"]] == ["a"]
+        code, out = api.handle(
+            "GET", "/api/v1/pods", {"fieldSelector": "spec.nodeName!="}
+        )
+        assert [i["metadata"]["name"] for i in out["items"]] == ["b"]
+
+    def test_nodes_not_namespaced(self, api):
+        code, out = api.handle("POST", "/api/v1/nodes", body=node_body("n1"))
+        assert code == 201
+        code, out = api.handle("GET", "/api/v1/nodes/n1")
+        assert code == 200
+        code, out = api.handle("GET", "/api/v1/nodes")
+        assert len(out["items"]) == 1
+
+    def test_update_conflict_on_stale_rv(self, api):
+        _, created = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("p1")
+        )
+        stale = dict(created)
+        # successful no-op update bumps rv
+        code, _ = api.handle(
+            "PUT", "/api/v1/namespaces/default/pods/p1", body=created
+        )
+        assert code == 200
+        code, _ = api.handle(
+            "PUT", "/api/v1/namespaces/default/pods/p1", body=stale
+        )
+        assert code == 409
+
+    def test_patch_merges(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, out = api.handle(
+            "PATCH",
+            "/api/v1/namespaces/default/pods/p1",
+            body={"metadata": {"labels": {"extra": "yes"}}},
+        )
+        assert code == 200
+        assert out["metadata"]["labels"]["extra"] == "yes"
+
+    def test_status_subresource_only_moves_status(self, api):
+        _, created = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("p1")
+        )
+        update = dict(created)
+        update["status"] = {"phase": "Running"}
+        update["metadata"] = dict(created["metadata"], labels={"hacked": "yes"})
+        code, out = api.handle(
+            "PUT", "/api/v1/namespaces/default/pods/p1/status", body=update
+        )
+        assert code == 200
+        assert out["status"]["phase"] == "Running"
+        assert "hacked" not in out["metadata"].get("labels", {})
+
+    def test_delete(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, _ = api.handle("DELETE", "/api/v1/namespaces/default/pods/p1")
+        assert code == 200
+        code, _ = api.handle("GET", "/api/v1/namespaces/default/pods/p1")
+        assert code == 404
+
+    def test_extensions_group_path(self, api):
+        from kubernetes_tpu.api.types import ReplicaSet
+
+        rs = scheme.encode(ReplicaSet(metadata=ObjectMeta(name="rs1")))
+        code, _ = api.handle(
+            "POST",
+            "/apis/extensions/v1beta1/namespaces/default/replicasets",
+            body=rs,
+        )
+        assert code == 201
+        code, out = api.handle(
+            "GET", "/apis/extensions/v1beta1/namespaces/default/replicasets/rs1"
+        )
+        assert code == 200
+
+
+class TestBinding:
+    def test_bind_sets_node_name(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, _ = api.handle(
+            "POST",
+            "/api/v1/namespaces/default/pods/p1/binding",
+            body={"metadata": {"name": "p1"}, "target": {"name": "n1"}},
+        )
+        assert code == 201
+        _, out = api.handle("GET", "/api/v1/namespaces/default/pods/p1")
+        assert out["spec"]["nodeName"] == "n1"
+        conds = {c["type"]: c["status"] for c in out["status"]["conditions"]}
+        assert conds["PodScheduled"] == "True"
+
+    def test_double_bind_conflict(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        body = {"metadata": {"name": "p1"}, "target": {"name": "n1"}}
+        api.handle("POST", "/api/v1/namespaces/default/pods/p1/binding", body=body)
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/default/pods/p1/binding", body=body
+        )
+        assert code == 409
+
+    def test_bindings_collection_form(self, api):
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, _ = api.handle(
+            "POST",
+            "/api/v1/namespaces/default/bindings",
+            body={"metadata": {"name": "p1"}, "target": {"name": "n2"}},
+        )
+        assert code == 201
+        _, out = api.handle("GET", "/api/v1/namespaces/default/pods/p1")
+        assert out["spec"]["nodeName"] == "n2"
+
+
+class TestNamespaces:
+    def test_auto_provision(self, api):
+        api.handle("POST", "/api/v1/namespaces/myns/pods", body=pod_body("p", ns="myns"))
+        code, out = api.handle("GET", "/api/v1/namespaces/myns")
+        assert code == 200
+        assert out["status"]["phase"] == "Active"
+
+    def test_terminating_namespace_rejects_creates(self, api):
+        api.handle("POST", "/api/v1/namespaces/doomed/pods", body=pod_body("p", ns="doomed"))
+        _, ns = api.handle("GET", "/api/v1/namespaces/doomed")
+        ns["status"]["phase"] = "Terminating"
+        api.handle("PUT", "/api/v1/namespaces/doomed/status", body=ns)
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/doomed/pods", body=pod_body("q", ns="doomed")
+        )
+        assert code == 403
+
+
+class TestWatch:
+    def test_watch_stream_basic(self, api):
+        code, watch = api.handle(
+            "GET", "/api/v1/pods", {"watch": "true"}
+        )
+        assert code == 200
+        assert isinstance(watch, WatchResponse)
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        gen = watch.events()
+        ev = next(gen)
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["metadata"]["name"] == "p1"
+        watch.stop()
+
+    def test_watch_field_transition_translates(self, api):
+        """A pod leaving the unassigned-pod filter must surface as
+        DELETED (etcd_watcher.go sendModify) — the scheduler's FIFO
+        depends on this to drop bound pods."""
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p1"))
+        code, watch = api.handle(
+            "GET",
+            "/api/v1/pods",
+            {"watch": "true", "fieldSelector": "spec.nodeName="},
+        )
+        api.handle(
+            "POST",
+            "/api/v1/namespaces/default/pods/p1/binding",
+            body={"metadata": {"name": "p1"}, "target": {"name": "n1"}},
+        )
+        gen = watch.events()
+        ev = next(gen)
+        assert ev["type"] == "DELETED"
+        assert ev["object"]["metadata"]["name"] == "p1"
+        watch.stop()
+
+    def test_watch_from_resource_version(self, api):
+        _, out = api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("p1")
+        )
+        rv = out["metadata"]["resourceVersion"]
+        api.handle("POST", "/api/v1/namespaces/default/pods", body=pod_body("p2"))
+        code, watch = api.handle(
+            "GET", "/api/v1/pods", {"watch": "true", "resourceVersion": rv}
+        )
+        ev = next(watch.events())
+        assert ev["object"]["metadata"]["name"] == "p2"
+        watch.stop()
+
+    def test_watch_gone_after_compaction(self, api):
+        for i in range(5):
+            api.handle(
+                "POST", "/api/v1/namespaces/default/pods", body=pod_body(f"p{i}")
+            )
+        api.store.compact()
+        code, out = api.handle(
+            "GET", "/api/v1/pods", {"watch": "true", "resourceVersion": "1"}
+        )
+        assert code == 410
+
+
+class TestHTTPFrontend:
+    def test_end_to_end(self, api):
+        host, port = api.serve_http()
+        base = f"http://{host}:{port}"
+        try:
+            req = urllib.request.Request(
+                f"{base}/api/v1/namespaces/default/pods",
+                data=json.dumps(pod_body("web")).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 201
+            with urllib.request.urlopen(f"{base}/api/v1/pods") as resp:
+                out = json.loads(resp.read())
+            assert out["kind"] == "PodList"
+            assert len(out["items"]) == 1
+            with urllib.request.urlopen(f"{base}/healthz") as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(f"{base}/metrics") as resp:
+                assert b"scheduler_e2e_scheduling_latency" in resp.read()
+        finally:
+            api.shutdown_http()
+
+    def test_http_watch_streams(self, api):
+        host, port = api.serve_http()
+        base = f"http://{host}:{port}"
+        events = []
+        ready = threading.Event()
+
+        def watch():
+            resp = urllib.request.urlopen(f"{base}/api/v1/pods?watch=true")
+            ready.set()
+            while len(events) < 2:
+                line = resp.readline()
+                if not line.strip():
+                    continue
+                events.append(json.loads(line))
+
+        thr = threading.Thread(target=watch, daemon=True)
+        thr.start()
+        ready.wait(2)
+        try:
+            for name in ("a", "b"):
+                req = urllib.request.Request(
+                    f"{base}/api/v1/namespaces/default/pods",
+                    data=json.dumps(pod_body(name)).encode(),
+                    method="POST",
+                )
+                urllib.request.urlopen(req)
+            thr.join(timeout=5)
+            assert [e["type"] for e in events] == ["ADDED", "ADDED"]
+            assert [e["object"]["metadata"]["name"] for e in events] == ["a", "b"]
+        finally:
+            api.shutdown_http()

@@ -1,0 +1,254 @@
+"""Storage + codec tests (pkg/storage etcd_helper_test / cacher_test
+idioms; pkg/api serialization round-trip idiom)."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    Node,
+    NodeAffinity,
+    NodeCondition,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Service,
+    ServiceSpec,
+    Toleration,
+)
+from kubernetes_tpu.runtime import scheme
+from kubernetes_tpu.storage import (
+    Compacted,
+    Conflict,
+    KeyExists,
+    KeyNotFound,
+    MemoryStore,
+)
+
+
+def make_pod(name="p1", ns="default", node=""):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels={"app": "x"}),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": "100m", "memory": "500Mi"})],
+            node_name=node,
+        ),
+    )
+
+
+class TestScheme:
+    def test_round_trip_pod(self):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name="web", namespace="prod", labels={"app": "web"},
+                resource_version="42",
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        name="c",
+                        requests={"cpu": "250m", "memory": "64Mi"},
+                    )
+                ],
+                node_selector={"disk": "ssd"},
+                tolerations=[Toleration(key="k", operator="Exists")],
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required_during_scheduling_ignored_during_execution=NodeSelector(
+                            node_selector_terms=(
+                                NodeSelectorTerm(
+                                    match_expressions=(
+                                        NodeSelectorRequirement(
+                                            key="zone", operator="In", values=("a",)
+                                        ),
+                                    )
+                                ),
+                            )
+                        )
+                    )
+                ),
+            ),
+            status=PodStatus(phase="Running"),
+        )
+        wire = scheme.encode(pod)
+        assert wire["kind"] == "Pod"
+        assert wire["apiVersion"] == "v1"
+        assert wire["metadata"]["resourceVersion"] == "42"
+        assert wire["spec"]["nodeSelector"] == {"disk": "ssd"}
+        back = scheme.decode(wire)
+        assert back == pod
+
+    def test_round_trip_node(self):
+        node = Node(
+            metadata=ObjectMeta(name="n1", labels={"zone": "a"}),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        assert scheme.decode(scheme.encode(node)) == node
+
+    def test_decode_by_explicit_type(self):
+        svc = Service(
+            metadata=ObjectMeta(name="svc"), spec=ServiceSpec(selector={"a": "b"})
+        )
+        wire = scheme.encode(svc)
+        del wire["kind"]
+        del wire["apiVersion"]
+        assert scheme.decode(wire, Service) == svc
+
+    def test_unknown_fields_dropped(self):
+        wire = scheme.encode(make_pod())
+        wire["spec"]["bogusField"] = 1
+        pod = scheme.decode(wire)
+        assert pod.metadata.name == "p1"
+
+
+class TestMemoryStore:
+    def test_create_get_sets_rv(self):
+        s = MemoryStore()
+        pod = make_pod()
+        rv = s.create("/pods/default/p1", pod)
+        got, got_rv = s.get("/pods/default/p1")
+        assert got_rv == rv
+        assert got.metadata.resource_version == str(rv)
+        # original object untouched; stored copy isolated
+        pod.metadata.labels["mutated"] = "yes"
+        got2, _ = s.get("/pods/default/p1")
+        assert "mutated" not in got2.metadata.labels
+
+    def test_create_duplicate(self):
+        s = MemoryStore()
+        s.create("/pods/default/p1", make_pod())
+        with pytest.raises(KeyExists):
+            s.create("/pods/default/p1", make_pod())
+
+    def test_update_conflict(self):
+        s = MemoryStore()
+        rv = s.create("/pods/default/p1", make_pod())
+        s.update("/pods/default/p1", make_pod(node="n1"), expect_rv=rv)
+        with pytest.raises(Conflict):
+            s.update("/pods/default/p1", make_pod(), expect_rv=rv)
+
+    def test_guaranteed_update_applies_latest(self):
+        s = MemoryStore()
+        s.create("/pods/default/p1", make_pod())
+
+        def set_node(cur):
+            cur.spec.node_name = "n9"
+            return cur
+
+        s.guaranteed_update("/pods/default/p1", set_node)
+        got, _ = s.get("/pods/default/p1")
+        assert got.spec.node_name == "n9"
+
+    def test_guaranteed_update_abort(self):
+        s = MemoryStore()
+        rv = s.create("/pods/default/p1", make_pod())
+        s.guaranteed_update("/pods/default/p1", lambda cur: None)
+        _, got_rv = s.get("/pods/default/p1")
+        assert got_rv == rv
+
+    def test_delete_and_not_found(self):
+        s = MemoryStore()
+        s.create("/pods/default/p1", make_pod())
+        s.delete("/pods/default/p1")
+        with pytest.raises(KeyNotFound):
+            s.get("/pods/default/p1")
+
+    def test_list_prefix(self):
+        s = MemoryStore()
+        s.create("/pods/default/a", make_pod("a"))
+        s.create("/pods/default/b", make_pod("b"))
+        s.create("/pods/kube-system/c", make_pod("c", ns="kube-system"))
+        s.create("/minions/n1", Node(metadata=ObjectMeta(name="n1")))
+        objs, rv = s.list("/pods/")
+        assert sorted(o.metadata.name for o in objs) == ["a", "b", "c"]
+        objs, _ = s.list("/pods/default/")
+        assert sorted(o.metadata.name for o in objs) == ["a", "b"]
+        assert rv == s.current_rv
+
+    def test_watch_live_events(self):
+        s = MemoryStore()
+        w = s.watch("/pods/")
+        s.create("/pods/default/a", make_pod("a"))
+        s.guaranteed_update(
+            "/pods/default/a", lambda c: (setattr(c.spec, "node_name", "n1"), c)[1]
+        )
+        s.delete("/pods/default/a")
+        evs = [w.next(timeout=1) for _ in range(3)]
+        assert [e.type for e in evs] == ["ADDED", "MODIFIED", "DELETED"]
+        assert evs[1].object.spec.node_name == "n1"
+        w.stop()
+
+    def test_watch_from_rv_replays_history(self):
+        s = MemoryStore()
+        s.create("/pods/default/a", make_pod("a"))
+        _, rv = s.get("/pods/default/a")
+        s.create("/pods/default/b", make_pod("b"))
+        s.create("/minions/n1", Node(metadata=ObjectMeta(name="n1")))
+        w = s.watch("/pods/", from_rv=rv)
+        ev = w.next(timeout=1)
+        assert ev.type == "ADDED"
+        assert ev.object.metadata.name == "b"
+        w.stop()
+
+    def test_watch_prefix_filters(self):
+        s = MemoryStore()
+        w = s.watch("/minions/")
+        s.create("/pods/default/a", make_pod("a"))
+        s.create("/minions/n1", Node(metadata=ObjectMeta(name="n1")))
+        ev = w.next(timeout=1)
+        assert ev.object.metadata.name == "n1"
+        w.stop()
+
+    def test_compaction_forces_relist(self):
+        s = MemoryStore(history_size=4)
+        for i in range(10):
+            s.create(f"/pods/default/p{i}", make_pod(f"p{i}"))
+        with pytest.raises(Compacted):
+            s.watch("/pods/", from_rv=1)
+
+    def test_slow_watcher_gets_error(self):
+        s = MemoryStore()
+        w = s.watch("/pods/")
+        w._q.maxsize = 2
+        for i in range(5):
+            s.create(f"/pods/default/p{i}", make_pod(f"p{i}"))
+        types = []
+        while True:
+            ev = w.next(timeout=0.2)
+            if ev is None:
+                break
+            types.append(ev.type)
+        assert "ERROR" in types
+
+    def test_concurrent_writers_unique_rvs(self):
+        s = MemoryStore()
+        errs = []
+
+        def writer(i):
+            try:
+                for j in range(50):
+                    s.create(f"/pods/default/p{i}-{j}", make_pod(f"p{i}-{j}"))
+            except Exception as exc:
+                errs.append(exc)
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        objs, rv = s.list("/pods/")
+        assert len(objs) == 200
+        assert rv == 200
+        rvs = {int(o.metadata.resource_version) for o in objs}
+        assert len(rvs) == 200

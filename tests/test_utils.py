@@ -1,0 +1,212 @@
+"""Util layer tests (workqueue/flowcontrol/wait/trace idioms from
+pkg/util/*_test.go)."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.utils import (
+    Backoff,
+    DelayingQueue,
+    FakeClock,
+    RateLimitingQueue,
+    TokenBucketRateLimiter,
+    Trace,
+    WorkQueue,
+    parallelize,
+)
+from kubernetes_tpu.utils.wait import poll_until, until
+from kubernetes_tpu.utils.workqueue import ShutDown
+
+
+class TestWorkQueue:
+    def test_fifo_order(self):
+        q = WorkQueue()
+        for i in range(5):
+            q.add(i)
+        assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+
+    def test_dedup_while_queued(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        assert len(q) == 1
+
+    def test_readd_while_processing_requeues_on_done(self):
+        q = WorkQueue()
+        q.add("a")
+        item = q.get()
+        q.add("a")  # while processing: goes dirty, not queued
+        assert len(q) == 0
+        q.done(item)
+        assert len(q) == 1
+        assert q.get() == "a"
+
+    def test_shutdown_raises(self):
+        q = WorkQueue()
+        q.shut_down()
+        with pytest.raises(ShutDown):
+            q.get()
+
+    def test_concurrent_producers_consumers(self):
+        q = WorkQueue()
+        seen = set()
+        lock = threading.Lock()
+
+        def consume():
+            while True:
+                try:
+                    item = q.get(timeout=2)
+                except (ShutDown, TimeoutError):
+                    return
+                with lock:
+                    seen.add(item)
+                q.done(item)
+
+        threads = [threading.Thread(target=consume) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(200):
+            q.add(i)
+        for t in threads:
+            t.join(timeout=5)
+        q.shut_down()
+        assert seen == set(range(200))
+
+
+class TestDelayingQueue:
+    def test_add_after_zero_is_immediate(self):
+        q = DelayingQueue()
+        q.add_after("x", 0)
+        assert q.get(timeout=1) == "x"
+
+    def test_add_after_delivers(self):
+        q = DelayingQueue()
+        q.add_after("x", 0.05)
+        assert q.get(timeout=2) == "x"
+
+
+class TestRateLimitingQueue:
+    def test_backoff_growth_and_forget(self):
+        clock = FakeClock()
+        q = RateLimitingQueue(base_delay=1.0, max_delay=8.0, clock=clock)
+        b = q._backoff
+        assert b.next_("k") == 1.0
+        assert b.next_("k") == 2.0
+        assert b.next_("k") == 4.0
+        assert b.next_("k") == 8.0
+        assert b.next_("k") == 8.0  # capped
+        q.forget("k")
+        assert b.next_("k") == 1.0
+
+
+class TestFlowControl:
+    def test_token_bucket_burst(self):
+        clock = FakeClock()
+        rl = TokenBucketRateLimiter(qps=1, burst=3, clock=clock)
+        assert rl.try_accept()
+        assert rl.try_accept()
+        assert rl.try_accept()
+        assert not rl.try_accept()
+        clock.step(1.0)
+        assert rl.try_accept()
+
+    def test_backoff_period_check(self):
+        clock = FakeClock()
+        b = Backoff(1.0, 60.0, clock=clock)
+        b.next_("pod")
+        assert b.is_in_backoff_period("pod")
+        clock.step(1.5)
+        assert not b.is_in_backoff_period("pod")
+
+    def test_backoff_gc(self):
+        clock = FakeClock()
+        b = Backoff(1.0, 2.0, clock=clock)
+        b.next_("pod")
+        clock.step(10.0)
+        b.gc()
+        assert b.get("pod") == 0.0
+
+    def test_backoff_resets_after_idle(self):
+        # backoff.go: an entry idle for > 2*max restarts at initial
+        clock = FakeClock()
+        b = Backoff(1.0, 4.0, clock=clock)
+        b.next_("pod")
+        b.next_("pod")
+        clock.step(100.0)
+        assert b.next_("pod") == 1.0
+
+
+class TestWait:
+    def test_until_runs_and_stops(self):
+        stop = threading.Event()
+        count = []
+
+        def body():
+            count.append(1)
+            if len(count) >= 3:
+                stop.set()
+
+        until(body, 0.001, stop)
+        assert len(count) >= 3
+
+    def test_until_contains_crash(self):
+        stop = threading.Event()
+        count = []
+
+        def body():
+            count.append(1)
+            if len(count) >= 2:
+                stop.set()
+            raise RuntimeError("boom")
+
+        until(body, 0.001, stop)  # must not raise
+        assert len(count) >= 2
+
+    def test_poll_until(self):
+        clock = FakeClock()
+        state = {"n": 0}
+
+        def cond():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        assert poll_until(cond, 1.0, 10.0, clock=clock)
+        assert not poll_until(lambda: False, 1.0, 3.0, clock=clock)
+
+
+class TestParallelize:
+    def test_all_pieces_run(self):
+        seen = []
+        lock = threading.Lock()
+
+        def work(i):
+            with lock:
+                seen.append(i)
+
+        parallelize(16, 100, work)
+        assert sorted(seen) == list(range(100))
+
+    def test_contains_panics(self):
+        seen = []
+        lock = threading.Lock()
+
+        def work(i):
+            if i % 2:
+                raise RuntimeError("boom")
+            with lock:
+                seen.append(i)
+
+        parallelize(4, 10, work)
+        assert sorted(seen) == [0, 2, 4, 6, 8]
+
+
+class TestTrace:
+    def test_steps_recorded(self):
+        clock = FakeClock()
+        tr = Trace("scheduling pod", clock=clock)
+        clock.step(0.01)
+        tr.step("computing predicates")
+        clock.step(0.02)
+        assert tr.total_time() == pytest.approx(0.03)
+        tr.log_if_long(0.02)  # must not raise
