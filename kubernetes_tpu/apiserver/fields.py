@@ -51,7 +51,14 @@ def _lookup(wire: Dict[str, Any], path: str) -> str:
 def matches_fields(obj: Any, clauses: List[Tuple[str, str, str]]) -> bool:
     if not clauses:
         return True
-    wire = encode_value(obj)
+    return matches_fields_wire(encode_value(obj), clauses)
+
+
+def matches_fields_wire(
+    wire: Dict[str, Any], clauses: List[Tuple[str, str, str]]
+) -> bool:
+    """Evaluate clauses against an already-encoded wire dict (lets LIST
+    encode each object exactly once)."""
     for path, op, want in clauses:
         got = _lookup(wire, path)
         # strip optional quoting: spec.nodeName=="" arrives as value '""'

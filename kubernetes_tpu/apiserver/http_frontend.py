@@ -66,8 +66,13 @@ def start_http_server(api: APIServer, host: str, port: int):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             try:
-                for event in watch.events():
-                    frame = json.dumps(event).encode() + b"\n"
+                # idle probes every few seconds detect departed clients so
+                # quiet watches don't pin a thread + store watcher forever
+                for event in watch.events(idle_timeout=3.0):
+                    if event is None:
+                        frame = b"\n"  # keepalive; clients skip blank lines
+                    else:
+                        frame = json.dumps(event).encode() + b"\n"
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
                     self.wfile.flush()
                 self.wfile.write(b"0\r\n\r\n")

@@ -8,7 +8,6 @@ prepare/validate strategy hooks (strategy.go idiom).
 
 from __future__ import annotations
 
-import itertools
 import uuid
 from dataclasses import dataclass
 from datetime import datetime, timezone
@@ -25,16 +24,12 @@ class ValidationError(Exception):
     pass
 
 
-_name_seq = itertools.count()
-
-
 def prepare_meta(obj: Any) -> None:
     """Common create-time defaulting (strategy PrepareForCreate +
     BeforeCreate in pkg/api/rest): uid, creationTimestamp, generateName."""
     meta = obj.metadata
     if not meta.name and meta.generate_name:
-        # pkg/api/rest/create.go uses a 5-char random suffix; a counter
-        # keeps tests deterministic while preserving uniqueness.
+        # pkg/api/rest/create.go: 5-char random suffix
         meta.name = f"{meta.generate_name}{uuid.uuid4().hex[:5]}"
     if not meta.uid:
         meta.uid = str(uuid.uuid4())

@@ -22,7 +22,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from kubernetes_tpu.api import labels as labelpkg
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.apiserver import admission as adm
-from kubernetes_tpu.apiserver.fields import matches_fields, parse_field_selector
+from kubernetes_tpu.apiserver.fields import (
+    matches_fields,
+    matches_fields_wire,
+    parse_field_selector,
+)
 from kubernetes_tpu.apiserver.registry import (
     ResourceInfo,
     ValidationError,
@@ -74,12 +78,16 @@ class WatchResponse:
     field_clauses: List[Tuple[str, str, str]]
     scheme: Any
 
-    def events(self):
+    def events(self, idle_timeout: Optional[float] = None):
         """Yield wire-format {"type", "object"} dicts, applying the
         selector-transition translation (etcd_watcher.go sendModify/
         sendDelete): MODIFIED entering the filter becomes ADDED, leaving
-        it becomes DELETED."""
-        for ev in self.stream:
+        it becomes DELETED. With idle_timeout set, yields None after that
+        many idle seconds so streaming frontends can probe liveness."""
+        for ev in self._pull(idle_timeout):
+            if ev is None:
+                yield None
+                continue
             if ev.type == "ERROR":
                 yield {
                     "type": "ERROR",
@@ -117,6 +125,20 @@ class WatchResponse:
             else:
                 continue
             yield {"type": out_type, "object": self.scheme.encode(ev.object)}
+
+    def _pull(self, idle_timeout: Optional[float]):
+        if idle_timeout is None:
+            yield from self.stream
+            return
+        while True:
+            try:
+                ev = self.stream.next_event(timeout=idle_timeout)
+            except TimeoutError:
+                yield None  # idle probe
+                continue
+            if ev is None:
+                return  # stopped
+            yield ev
 
     def _match(self, obj: Any) -> bool:
         if not self.label_selector.matches(obj.metadata.labels):
@@ -214,7 +236,7 @@ class APIServer:
 
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
-                return 200, self._watch(info, ns, query)
+                return 200, self._watch(info, ns, query, name)
             if name:
                 return 200, self._get(info, ns, name)
             return 200, self._list(info, ns, query)
@@ -288,11 +310,13 @@ class APIServer:
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
         objs, rv = self.store.list(info.list_prefix(ns))
-        items = [
-            self.scheme.encode(o)
-            for o in objs
-            if sel.matches(o.metadata.labels) and matches_fields(o, clauses)
-        ]
+        items = []
+        for o in objs:
+            if not sel.matches(o.metadata.labels):
+                continue
+            wire = self.scheme.encode(o)
+            if matches_fields_wire(wire, clauses):
+                items.append(wire)
         return {
             "kind": f"{info.kind}List",
             "apiVersion": "v1",
@@ -300,9 +324,14 @@ class APIServer:
             "items": items,
         }
 
-    def _watch(self, info: ResourceInfo, ns: str, query) -> WatchResponse:
+    def _watch(
+        self, info: ResourceInfo, ns: str, query, name: str = ""
+    ) -> WatchResponse:
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
+        if name:
+            # watch on a named object restricts to that object
+            clauses.append(("metadata.name", "=", name))
         from_rv = int(query.get("resourceVersion", "0") or "0")
         stream = self.store.watch(info.list_prefix(ns), from_rv=from_rv)
         return WatchResponse(stream, sel, clauses, self.scheme)
@@ -318,13 +347,15 @@ class APIServer:
     def _create(self, info: ResourceInfo, ns: str, body):
         obj = self._decode_body(info, body)
         if info.namespaced:
-            if obj.metadata.namespace and ns and obj.metadata.namespace != ns:
+            # only an EXPLICIT body namespace can conflict with the URL;
+            # decode fills the dataclass default ("default") when absent
+            body_ns = (body.get("metadata") or {}).get("namespace", "")
+            if body_ns and ns and body_ns != ns:
                 raise APIError(
                     400,
-                    f"namespace mismatch: body {obj.metadata.namespace!r}, "
-                    f"url {ns!r}",
+                    f"namespace mismatch: body {body_ns!r}, url {ns!r}",
                 )
-            obj.metadata.namespace = ns or obj.metadata.namespace or "default"
+            obj.metadata.namespace = ns or body_ns or "default"
         else:
             obj.metadata.namespace = ""
         prepare_meta(obj)

@@ -1,0 +1,18 @@
+"""Client layer (reference: pkg/client).
+
+- transport: LocalTransport (in-process handle()) / HTTPTransport
+- rest: RESTClient — typed verbs + QPS/burst throttling
+  (pkg/client/restclient + util/flowcontrol)
+- cache: Reflector / FIFO / DeltaFIFO / Store / Indexer / listers
+  (pkg/client/cache)
+- informer: controller framework + SharedIndexInformer
+  (pkg/controller/framework)
+- record: event broadcaster/recorder (pkg/client/record)
+- leaderelection: lease via Endpoints annotation CAS
+  (pkg/client/leaderelection)
+"""
+
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import HTTPTransport, LocalTransport
+
+__all__ = ["RESTClient", "LocalTransport", "HTTPTransport"]

@@ -1,0 +1,22 @@
+"""List+watch cache toolkit (pkg/client/cache)."""
+
+from kubernetes_tpu.client.cache.fifo import FIFO, DeltaFIFO, Delta, ProcessError
+from kubernetes_tpu.client.cache.reflector import Reflector
+from kubernetes_tpu.client.cache.store import (
+    Indexer,
+    Store,
+    meta_namespace_index_func,
+    meta_namespace_key_func,
+)
+
+__all__ = [
+    "FIFO",
+    "DeltaFIFO",
+    "Delta",
+    "ProcessError",
+    "Reflector",
+    "Store",
+    "Indexer",
+    "meta_namespace_key_func",
+    "meta_namespace_index_func",
+]

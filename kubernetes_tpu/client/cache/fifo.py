@@ -1,0 +1,204 @@
+"""FIFO and DeltaFIFO producer/consumer queues.
+
+Reference: pkg/client/cache/{fifo.go, delta_fifo.go}. FIFO holds the
+latest version of each object (coalescing updates); the scheduler's
+PodQueue is one. DeltaFIFO preserves the per-object sequence of change
+types for consumers that need to see deletions distinctly (informers).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.client.cache.store import KeyFunc, meta_namespace_key_func
+
+
+class ProcessError(Exception):
+    """Raised by a pop processor to requeue the item (fifo.go ErrRequeue)."""
+
+
+class FIFO:
+    """Coalescing FIFO: at most one entry per key; Pop returns the
+    latest version. Blocks on empty."""
+
+    def __init__(self, key_func: KeyFunc = meta_namespace_key_func):
+        self.key_func = key_func
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: Dict[str, Any] = {}
+        self._queue: List[str] = []
+        self._closed = False
+
+    def add(self, obj: Any) -> None:
+        key = self.key_func(obj)
+        with self._cond:
+            if key not in self._items:
+                self._queue.append(key)
+            self._items[key] = obj
+            self._cond.notify()
+
+    def update(self, obj: Any) -> None:
+        self.add(obj)
+
+    def delete(self, obj: Any) -> None:
+        key = self.key_func(obj)
+        with self._cond:
+            self._items.pop(key, None)
+            # key stays in _queue; pop skips missing items (fifo.go Delete)
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._items.values())
+
+    def pop(self, timeout: Optional[float] = None) -> Any:
+        """Block until an item is available and return it."""
+        with self._cond:
+            while True:
+                while self._queue:
+                    key = self._queue.pop(0)
+                    if key in self._items:
+                        return self._items.pop(key)
+                if self._closed:
+                    raise ShutDown
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError
+
+    def replace(self, objs: Sequence[Any]) -> None:
+        with self._cond:
+            self._items = {self.key_func(o): o for o in objs}
+            self._queue = list(self._items.keys())
+            if self._items:
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class ShutDown(Exception):
+    pass
+
+
+@dataclass
+class Delta:
+    type: str  # Added | Updated | Deleted | Sync
+    object: Any
+
+
+class DeltaFIFO:
+    """Per-key list of deltas; pop returns (key, [Delta...]). known_objects
+    (the downstream store) lets Replace synthesize Deleted deltas for
+    objects that vanished between lists (delta_fifo.go:394-430)."""
+
+    def __init__(
+        self,
+        key_func: KeyFunc = meta_namespace_key_func,
+        known_objects=None,
+    ):
+        self.key_func = key_func
+        self.known_objects = known_objects
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: Dict[str, List[Delta]] = {}
+        self._queue: List[str] = []
+        self._closed = False
+
+    def _key_of(self, obj: Any) -> str:
+        if isinstance(obj, Delta):
+            obj = obj.object
+        if isinstance(obj, DeletedFinalStateUnknown):
+            return obj.key
+        return self.key_func(obj)
+
+    def _queue_delta(self, obj: Any, dtype: str) -> None:
+        key = self._key_of(obj)
+        with self._cond:
+            deltas = self._items.setdefault(key, [])
+            deltas.append(Delta(dtype, obj))
+            # collapse consecutive Deleted pairs (dedupDeltas)
+            if (
+                len(deltas) >= 2
+                and deltas[-1].type == "Deleted"
+                and deltas[-2].type == "Deleted"
+            ):
+                deltas[-2:] = [deltas[-1]]
+            if key not in self._queue:
+                self._queue.append(key)
+            self._cond.notify()
+
+    def add(self, obj: Any) -> None:
+        self._queue_delta(obj, "Added")
+
+    def update(self, obj: Any) -> None:
+        self._queue_delta(obj, "Updated")
+
+    def delete(self, obj: Any) -> None:
+        self._queue_delta(obj, "Deleted")
+
+    def pop(self, timeout: Optional[float] = None) -> Tuple[str, List[Delta]]:
+        with self._cond:
+            while True:
+                while self._queue:
+                    key = self._queue.pop(0)
+                    deltas = self._items.pop(key, None)
+                    if deltas:
+                        return key, deltas
+                if self._closed:
+                    raise ShutDown
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError
+
+    def replace(self, objs: Sequence[Any]) -> None:
+        keys = set()
+        for o in objs:
+            keys.add(self.key_func(o))
+            self._queue_delta(o, "Sync")
+        # Synthesize Deleted for objects that vanished during the watch
+        # gap — both ones the downstream store knows AND ones whose Added
+        # delta is still queued unprocessed (delta_fifo.go Replace scans
+        # f.items for exactly this ghost case).
+        stale: set = set()
+        if self.known_objects is not None:
+            stale.update(self.known_objects.list_keys())
+        with self._lock:
+            stale.update(
+                k
+                for k, deltas in self._items.items()
+                if deltas and deltas[-1].type != "Deleted"
+            )
+        for key in stale - keys:
+            old = (
+                self.known_objects.get_by_key(key)
+                if self.known_objects is not None
+                else None
+            )
+            self._queue_delta(DeletedFinalStateUnknown(key, old), "Deleted")
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+@dataclass
+class DeletedFinalStateUnknown:
+    """Placeholder for an object deleted while the watch was broken
+    (delta_fifo.go DeletedFinalStateUnknown)."""
+
+    key: str
+    object: Any

@@ -1,0 +1,160 @@
+"""Controller framework + shared informers.
+
+Reference: pkg/controller/framework/controller.go (:213 NewInformer,
+:278 NewIndexerInformer) and shared_informer.go. An informer is a
+Reflector feeding a DeltaFIFO, drained by a process loop that keeps a
+Store current and invokes ResourceEventHandler callbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.client.cache.fifo import (
+    DeletedFinalStateUnknown,
+    DeltaFIFO,
+    ShutDown,
+)
+from kubernetes_tpu.client.cache.reflector import Reflector
+from kubernetes_tpu.client.cache.store import (
+    IndexFunc,
+    Indexer,
+    Store,
+    meta_namespace_key_func,
+)
+from kubernetes_tpu.client.rest import ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ResourceEventHandler:
+    on_add: Optional[Callable] = None
+    on_update: Optional[Callable] = None  # (old, new)
+    on_delete: Optional[Callable] = None
+
+
+class Informer:
+    """NewInformer/NewIndexerInformer: list+watch a resource, keep
+    `store` synced, call handlers after the store is updated."""
+
+    def __init__(
+        self,
+        resource: ResourceClient,
+        handler: Optional[ResourceEventHandler] = None,
+        indexers: Optional[Dict[str, IndexFunc]] = None,
+        label_selector: str = "",
+        field_selector: str = "",
+        name: str = "",
+    ):
+        self.store: Store = (
+            Indexer(meta_namespace_key_func, indexers)
+            if indexers
+            else Store(meta_namespace_key_func)
+        )
+        # _handlers_lock serializes delta dispatch with add_event_handler's
+        # synthetic-add snapshot so late joiners see each object exactly once
+        self._handlers_lock = threading.Lock()
+        self._handlers: List[ResourceEventHandler] = []
+        if handler is not None:
+            self._handlers.append(handler)
+        self._initial_processed = threading.Event()
+        self._fifo = DeltaFIFO(meta_namespace_key_func, known_objects=self.store)
+        self._reflector = Reflector(
+            resource,
+            self._fifo,
+            label_selector=label_selector,
+            field_selector=field_selector,
+            name=name or f"informer-{resource.resource}",
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    # SharedIndexInformer.AddEventHandler
+    def add_event_handler(self, handler: ResourceEventHandler) -> None:
+        with self._handlers_lock:
+            # late joiners see the current world as synthetic adds; the
+            # lock keeps the snapshot atomic wrt the process loop
+            for obj in self.store.list():
+                _call(handler.on_add, obj)
+            self._handlers.append(handler)
+
+    def run(self) -> "Informer":
+        self._reflector.run()
+        self._thread = threading.Thread(
+            target=self._process_loop,
+            name=self._reflector.name,
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._reflector.stop()
+        self._fifo.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def has_synced(self) -> bool:
+        """True once the initial list has been fully applied to the store
+        (shared_informer.go HasSynced)."""
+        return self._initial_processed.is_set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._initial_processed.wait(timeout)
+
+    def _process_loop(self) -> None:
+        while True:
+            try:
+                key, deltas = self._fifo.pop(timeout=0.2)
+            except ShutDown:
+                return
+            except TimeoutError:
+                self._maybe_mark_synced()
+                continue
+            for d in deltas:
+                try:
+                    self._process_delta(d)
+                except Exception:
+                    log.exception("informer handler failed for %s", key)
+            self._maybe_mark_synced()
+
+    def _maybe_mark_synced(self) -> None:
+        # sync is declared only AFTER the popped deltas are applied, so a
+        # waiter never observes an empty fifo with an un-applied object
+        if (
+            not self._initial_processed.is_set()
+            and self._reflector.has_synced()
+            and len(self._fifo) == 0
+        ):
+            self._initial_processed.set()
+
+    def _process_delta(self, d) -> None:
+        obj = d.object
+        with self._handlers_lock:
+            if d.type in ("Added", "Updated", "Sync"):
+                old = self.store.get(obj)
+                self.store.update(obj)
+                if old is None:
+                    for h in self._handlers:
+                        _call(h.on_add, obj)
+                else:
+                    for h in self._handlers:
+                        _call(h.on_update, old, obj)
+            elif d.type == "Deleted":
+                if isinstance(obj, DeletedFinalStateUnknown):
+                    self.store.delete_by_key(obj.key)
+                    obj = obj.object
+                    if obj is None:
+                        return
+                else:
+                    self.store.delete(obj)
+                for h in self._handlers:
+                    _call(h.on_delete, obj)
+
+
+def _call(fn, *args) -> None:
+    if fn is not None:
+        fn(*args)

@@ -1,0 +1,171 @@
+"""Event recorder/broadcaster (pkg/client/record).
+
+Recorder.eventf → broadcaster fan-out → sinks. The apiserver sink
+aggregates duplicates client-side before POSTing (events_cache.go:69-92:
+same (object, reason, message) bumps count/lastTimestamp via PUT instead
+of creating a new Event).
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import logging
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+
+log = logging.getLogger(__name__)
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def object_reference(obj: Any) -> t.ObjectReference:
+    kind = type(obj).__name__
+    return t.ObjectReference(
+        kind=kind,
+        namespace=getattr(obj.metadata, "namespace", ""),
+        name=obj.metadata.name,
+        uid=getattr(obj.metadata, "uid", ""),
+    )
+
+
+class EventBroadcaster:
+    """Fan events out to registered sinks (record/event.go broadcaster)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sinks: List[Callable[[t.Event], None]] = []
+
+    def start_logging(self, logf: Callable[[str], None] = log.info) -> None:
+        self._add(
+            lambda ev: logf(
+                f"Event({ev.involved_object.namespace}/"
+                f"{ev.involved_object.name}): type: {ev.type!r} "
+                f"reason: {ev.reason!r} {ev.message}"
+            )
+        )
+
+    def start_recording_to_sink(self, sink: "EventSink") -> None:
+        self._add(sink.record)
+
+    def _add(self, fn: Callable[[t.Event], None]) -> None:
+        with self._lock:
+            self._sinks.append(fn)
+
+    def new_recorder(self, component: str) -> "EventRecorder":
+        return EventRecorder(self, component)
+
+    def _publish(self, ev: t.Event) -> None:
+        with self._lock:
+            sinks = list(self._sinks)
+        for fn in sinks:
+            try:
+                fn(ev)
+            except Exception:
+                log.exception("event sink failed")
+
+
+_event_seq = itertools.count()
+
+
+class EventRecorder:
+    def __init__(self, broadcaster: EventBroadcaster, component: str):
+        self.broadcaster = broadcaster
+        self.component = component
+
+    def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        ref = object_reference(obj)
+        now = _now_iso()
+        ev = t.Event(
+            metadata=t.ObjectMeta(
+                # the reference names events <object>.<UnixNano>; a
+                # process-wide counter keeps names unique here
+                name=f"{ref.name}.{next(_event_seq):016x}",
+                namespace=ref.namespace or "default",
+            ),
+            involved_object=ref,
+            reason=reason,
+            message=message,
+            source_component=self.component,
+            first_timestamp=now,
+            last_timestamp=now,
+            count=1,
+            type=event_type,
+        )
+        self.broadcaster._publish(ev)
+
+    def eventf(self, obj, event_type, reason, fmt, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+
+class EventSink:
+    """Aggregating apiserver sink (events_cache.go EventCorrelator-lite).
+    The dedup map is LRU-bounded like the reference's events cache."""
+
+    MAX_SEEN = 4096
+
+    def __init__(self, client: RESTClient):
+        self.client = client
+        self._lock = threading.Lock()
+        # (ns, involved name, reason, message) -> (event name, count); LRU
+        self._seen: "OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
+            OrderedDict()
+        )
+
+    def record(self, ev: t.Event) -> None:
+        key = (
+            ev.metadata.namespace,
+            ev.involved_object.name,
+            ev.reason,
+            ev.message,
+        )
+        with self._lock:
+            prior = self._seen.get(key)
+            if prior is not None:
+                self._seen.move_to_end(key)
+        events = self.client.resource("events", ev.metadata.namespace)
+        if prior is not None:
+            name, count = prior
+            try:
+                events.patch(
+                    name,
+                    {"count": count + 1, "lastTimestamp": ev.last_timestamp},
+                )
+                with self._lock:
+                    self._remember(key, (name, count + 1))
+                return
+            except APIStatusError:
+                pass  # fall through to create
+        try:
+            events.create(ev)
+            with self._lock:
+                self._remember(key, (ev.metadata.name, 1))
+        except APIStatusError:
+            log.debug("event create failed", exc_info=True)
+
+    def _remember(self, key, value) -> None:
+        self._seen[key] = value
+        self._seen.move_to_end(key)
+        while len(self._seen) > self.MAX_SEEN:
+            self._seen.popitem(last=False)
+
+
+class FakeRecorder:
+    """Test seam (record/fake.go): collects '<type> <reason> <message>'."""
+
+    def __init__(self):
+        self.events: List[str] = []
+
+    def event(self, obj, event_type, reason, message) -> None:
+        self.events.append(f"{event_type} {reason} {message}")
+
+    def eventf(self, obj, event_type, reason, fmt, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)

@@ -1,0 +1,200 @@
+"""Typed REST client (pkg/client/restclient + pkg/client/unversioned).
+
+One RESTClient per server; resource() returns a namespaceable accessor
+with the standard verbs. Client-side QPS/burst throttling mirrors
+restclient's flowcontrol token bucket (the perf harness runs QPS/Burst
+5000, perf/util.go:61-66).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from kubernetes_tpu.runtime import scheme as default_scheme
+from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+
+# resource -> API group prefix (extensions resources live under /apis)
+_GROUPS = {
+    "replicasets": "/apis/extensions/v1beta1",
+    "deployments": "/apis/extensions/v1beta1",
+    "daemonsets": "/apis/extensions/v1beta1",
+    "jobs": "/apis/extensions/v1beta1",
+    "horizontalpodautoscalers": "/apis/extensions/v1beta1",
+}
+_CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes"}
+
+
+class APIStatusError(Exception):
+    def __init__(self, code: int, status: Dict[str, Any]):
+        super().__init__(status.get("message", f"status {code}"))
+        self.code = code
+        self.reason = status.get("reason", "")
+        self.status = status
+
+
+class ResourceClient:
+    def __init__(self, client: "RESTClient", resource: str, namespace: str = ""):
+        self.client = client
+        self.resource = resource
+        self.namespace = namespace
+        self.cluster_scoped = resource in _CLUSTER_SCOPED
+
+    def in_namespace(self, namespace: str) -> "ResourceClient":
+        return ResourceClient(self.client, self.resource, namespace)
+
+    def _path(self, name: str = "", subresource: str = "") -> str:
+        prefix = _GROUPS.get(self.resource, "/api/v1")
+        path = prefix
+        if not self.cluster_scoped and self.namespace:
+            path += f"/namespaces/{self.namespace}"
+        path += f"/{self.resource}"
+        if name:
+            path += f"/{name}"
+        if subresource:
+            path += f"/{subresource}"
+        return path
+
+    # -- verbs ---------------------------------------------------------------
+
+    def get(self, name: str):
+        return self.client.do("GET", self._path(name))
+
+    def list(
+        self,
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> Tuple[list, str]:
+        """-> (items, list resourceVersion)."""
+        query = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        payload = self.client.do_raw("GET", self._path(), query=query)
+        items = [self.client.scheme.decode(i) for i in payload.get("items", [])]
+        rv = payload.get("metadata", {}).get("resourceVersion", "0")
+        return items, rv
+
+    def create(self, obj):
+        return self.client.do("POST", self._path(), body=self.client.scheme.encode(obj))
+
+    def update(self, obj, subresource: str = ""):
+        return self.client.do(
+            "PUT",
+            self._path(obj.metadata.name, subresource),
+            body=self.client.scheme.encode(obj),
+        )
+
+    def update_status(self, obj):
+        return self.update(obj, subresource="status")
+
+    def patch(self, name: str, patch: Dict[str, Any], subresource: str = ""):
+        return self.client.do("PATCH", self._path(name, subresource), body=patch)
+
+    def delete(self, name: str):
+        return self.client.do("DELETE", self._path(name))
+
+    def watch(
+        self,
+        resource_version: str = "0",
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> Iterator[Tuple[str, Any]]:
+        """Yield (event_type, decoded_object); raises WatchExpired on 410."""
+        from kubernetes_tpu.client.transport import WatchError
+
+        query = {"resourceVersion": resource_version}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        self.client.throttle()
+        try:
+            raw = self.client.transport.watch(self._path(), query)
+        except WatchError as e:
+            if e.code == 410 or (
+                isinstance(e.status, dict) and e.status.get("reason") == "Expired"
+            ):
+                raise WatchExpired(str(e))
+            raise
+        return _DecodedWatch(raw, self.client.scheme)
+
+    def bind(self, pod_name: str, node_name: str, namespace: str = ""):
+        """POST the binding subresource (the scheduler's Bind target,
+        factory.go:537-543)."""
+        ns = namespace or self.namespace or "default"
+        body = {
+            "kind": "Binding",
+            "metadata": {"name": pod_name, "namespace": ns},
+            "target": {"kind": "Node", "name": node_name},
+        }
+        path = f"/api/v1/namespaces/{ns}/pods/{pod_name}/binding"
+        return self.client.do_raw("POST", path, body=body)
+
+
+class WatchExpired(Exception):
+    """410: the requested resourceVersion is compacted; relist."""
+
+
+class _DecodedWatch:
+    def __init__(self, raw, scheme):
+        self._raw = raw
+        self._scheme = scheme
+
+    def __iter__(self):
+        for frame in self._raw:
+            if frame["type"] == "ERROR":
+                obj = frame.get("object", {})
+                if obj.get("code") == 410 or obj.get("reason") == "Expired":
+                    raise WatchExpired(obj.get("message", "watch expired"))
+                raise APIStatusError(obj.get("code", 500), obj)
+            yield frame["type"], self._scheme.decode(frame["object"])
+
+    def stop(self) -> None:
+        self._raw.stop()
+
+
+class RESTClient:
+    def __init__(
+        self,
+        transport,
+        scheme=None,
+        qps: float = 0.0,
+        burst: int = 0,
+    ):
+        self.transport = transport
+        self.scheme = scheme or default_scheme
+        self._limiter = (
+            TokenBucketRateLimiter(qps, burst) if qps > 0 and burst > 0 else None
+        )
+
+    def throttle(self) -> None:
+        if self._limiter is not None:
+            self._limiter.accept()
+
+    def resource(self, resource: str, namespace: str = "") -> ResourceClient:
+        return ResourceClient(self, resource, namespace)
+
+    # shorthands
+    def pods(self, namespace: str = "default") -> ResourceClient:
+        return self.resource("pods", namespace)
+
+    def nodes(self) -> ResourceClient:
+        return self.resource("nodes")
+
+    def events(self, namespace: str = "default") -> ResourceClient:
+        return self.resource("events", namespace)
+
+    def do(self, method: str, path: str, query=None, body=None):
+        """Request + decode into an API object."""
+        payload = self.do_raw(method, path, query=query, body=body)
+        if payload.get("kind") == "Status":
+            return payload
+        return self.scheme.decode(payload)
+
+    def do_raw(self, method: str, path: str, query=None, body=None):
+        self.throttle()
+        code, payload = self.transport.request(method, path, query, body)
+        if code >= 400:
+            raise APIStatusError(code, payload)
+        return payload

@@ -1,0 +1,137 @@
+"""Client transports.
+
+LocalTransport calls APIServer.handle() in-process — the analogue of the
+reference's integration-test pattern of wrapping the master's handler in
+an httptest server (test/integration/framework/master_utils.go:320),
+minus the socket. HTTPTransport speaks real HTTP to serve_http().
+
+Both return (status_code, payload) where payload is a JSON-like dict, or
+an event iterator for watches.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, Optional, Tuple
+from urllib import parse as urlparse
+from urllib import request as urlrequest
+
+
+class LocalTransport:
+    def __init__(self, server):
+        self.server = server
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, Any]:
+        return self.server.handle(method, path, query, body)
+
+    def watch(
+        self, path: str, query: Optional[Dict[str, str]] = None
+    ) -> Iterator[Dict[str, Any]]:
+        query = dict(query or {})
+        query["watch"] = "true"
+        code, resp = self.server.handle("GET", path, query, None)
+        if code != 200:
+            raise WatchError(code, resp)
+        return _StoppableEvents(resp)
+
+
+class WatchError(Exception):
+    def __init__(self, code: int, status: Any):
+        super().__init__(f"watch failed: {code} {status}")
+        self.code = code
+        self.status = status
+
+
+class _StoppableEvents:
+    """Adapts a WatchResponse into a stoppable {"type","object"} iterator."""
+
+    def __init__(self, watch_response):
+        self._wr = watch_response
+        self._it = watch_response.events()
+
+    def __iter__(self):
+        return self._it
+
+    def stop(self) -> None:
+        self._wr.stop()
+
+
+class HTTPTransport:
+    """Minimal stdlib HTTP transport (chunked watch streaming)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _url(self, path: str, query: Optional[Dict[str, str]]) -> str:
+        url = self.base_url + path
+        if query:
+            url += "?" + urlparse.urlencode(query)
+        return url
+
+    def request(self, method, path, query=None, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(
+            self._url(path, query), data=data, method=method.upper()
+        )
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return resp.status, json.loads(payload) if payload else {}
+        except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
+            payload = e.read()
+            try:
+                return e.code, json.loads(payload)
+            except Exception:
+                return e.code, {"message": payload.decode(errors="replace")}
+
+    def watch(self, path, query=None):
+        query = dict(query or {})
+        query["watch"] = "true"
+        req = urlrequest.Request(self._url(path, query))
+        try:
+            resp = urlrequest.urlopen(req, timeout=None)
+        except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
+            payload = e.read()
+            try:
+                status = json.loads(payload)
+            except Exception:
+                status = {"message": payload.decode(errors="replace")}
+            raise WatchError(e.code, status)
+        return _HTTPEvents(resp)
+
+
+class _HTTPEvents:
+    """Newline-delimited JSON watch frames (pkg/apiserver/watch.go)."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self._stopped = False
+
+    def __iter__(self):
+        try:
+            for line in self._resp:
+                if self._stopped:
+                    return
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        except Exception:
+            if not self._stopped:
+                raise
+        finally:
+            self._resp.close()
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._resp.close()
+        except Exception:
+            pass

@@ -110,6 +110,14 @@ class WatchStream:
             return None
         return ev
 
+    def next_event(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Like next(), but raises TimeoutError on timeout so callers can
+        distinguish an idle stream from a stopped one (None = stopped)."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError
+
 
 class MemoryStore:
     """The single source of truth (the framework's "etcd")."""

@@ -1,0 +1,244 @@
+"""Client layer: REST verbs, reflector/informer sync, FIFO semantics,
+events, leader election (reference: pkg/client/* test idioms)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client import LocalTransport, RESTClient
+from kubernetes_tpu.client.cache import DeltaFIFO, FIFO, Reflector, Store
+from kubernetes_tpu.client.cache.listers import (
+    StoreToServiceLister,
+    fake_service_lister,
+)
+from kubernetes_tpu.client.informer import Informer, ResourceEventHandler
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.client.record import EventBroadcaster, EventSink, FakeRecorder
+
+
+def make_client():
+    server = APIServer()
+    return server, RESTClient(LocalTransport(server))
+
+
+def pod(name, ns="default", labels=None, node=""):
+    return t.Pod(
+        metadata=t.ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=t.PodSpec(node_name=node, containers=[t.Container(name="c")]),
+    )
+
+
+class TestRESTClient:
+    def test_create_get_list_delete(self):
+        _, c = make_client()
+        c.pods().create(pod("a", labels={"app": "x"}))
+        c.pods().create(pod("b"))
+        got = c.pods().get("a")
+        assert got.metadata.name == "a"
+        items, rv = c.pods().list(label_selector="app=x")
+        assert [p.metadata.name for p in items] == ["a"]
+        assert int(rv) > 0
+        c.pods().delete("b")
+        items, _ = c.pods().list()
+        assert [p.metadata.name for p in items] == ["a"]
+
+    def test_field_selector_unassigned(self):
+        _, c = make_client()
+        c.pods().create(pod("u1"))
+        c.pods().create(pod("a1", node="n1"))
+        items, _ = c.pods().list(field_selector="spec.nodeName==")
+        assert [p.metadata.name for p in items] == ["u1"]
+
+    def test_bind(self):
+        _, c = make_client()
+        c.pods().create(pod("p"))
+        c.pods().bind("p", "node-1")
+        assert c.pods().get("p").spec.node_name == "node-1"
+
+    def test_status_update_isolated(self):
+        _, c = make_client()
+        c.nodes().create(t.Node(metadata=t.ObjectMeta(name="n1")))
+        n = c.nodes().get("n1")
+        n.status.allocatable = {"cpu": "4"}
+        c.nodes().update_status(n)
+        assert c.nodes().get("n1").status.allocatable["cpu"] == "4"
+
+
+class TestFIFO:
+    def test_coalesce_and_order(self):
+        q = FIFO()
+        q.add(pod("a"))
+        q.add(pod("b"))
+        q.add(pod("a", labels={"v": "2"}))  # coalesces, keeps position
+        first = q.pop()
+        assert first.metadata.name == "a"
+        assert first.metadata.labels == {"v": "2"}
+        assert q.pop().metadata.name == "b"
+
+    def test_delete_skips(self):
+        q = FIFO()
+        q.add(pod("a"))
+        q.add(pod("b"))
+        q.delete(pod("a"))
+        assert q.pop().metadata.name == "b"
+
+    def test_delta_fifo_synthesizes_deletes_on_replace(self):
+        store = Store()
+        store.add(pod("gone"))
+        q = DeltaFIFO(known_objects=store)
+        q.replace([pod("kept")])
+        seen = {}
+        for _ in range(2):
+            key, deltas = q.pop(timeout=1)
+            seen[key] = [d.type for d in deltas]
+        assert seen["default/kept"] == ["Sync"]
+        assert seen["default/gone"] == ["Deleted"]
+
+
+class TestReflectorInformer:
+    def test_reflector_mirrors_store(self):
+        server, c = make_client()
+        c.pods().create(pod("pre"))
+        store = Store()
+        r = Reflector(c.pods(), store).run()
+        assert r.wait_for_sync()
+        assert [p.metadata.name for p in store.list()] == ["pre"]
+        c.pods().create(pod("live"))
+        deadline = time.monotonic() + 5
+        while len(store) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(p.metadata.name for p in store.list()) == ["live", "pre"]
+        c.pods().delete("pre")
+        while len(store) > 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert [p.metadata.name for p in store.list()] == ["live"]
+        r.stop()
+
+    def test_informer_handlers(self):
+        server, c = make_client()
+        adds, updates, deletes = [], [], []
+        inf = Informer(
+            c.pods(),
+            ResourceEventHandler(
+                on_add=lambda o: adds.append(o.metadata.name),
+                on_update=lambda o, n: updates.append(n.metadata.name),
+                on_delete=lambda o: deletes.append(o.metadata.name),
+            ),
+        ).run()
+        assert inf.wait_for_sync()
+        c.pods().create(pod("x"))
+        p = c.pods().get("x")
+        p.metadata.labels = {"touched": "yes"}
+        c.pods().update(p)
+        c.pods().delete("x")
+        deadline = time.monotonic() + 5
+        while len(deletes) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert adds == ["x"]
+        assert updates == ["x"]
+        assert deletes == ["x"]
+        inf.stop()
+
+    def test_informer_selector_transition_becomes_delete(self):
+        # MODIFIED out of the label selector arrives as DELETED
+        # (etcd_watcher.go sendModify translation).
+        server, c = make_client()
+        deletes = []
+        inf = Informer(
+            c.pods(),
+            ResourceEventHandler(on_delete=lambda o: deletes.append(o.metadata.name)),
+            label_selector="app=y",
+        ).run()
+        assert inf.wait_for_sync()
+        c.pods().create(pod("p", labels={"app": "y"}))
+        deadline = time.monotonic() + 5
+        while len(inf.store) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        p = c.pods().get("p")
+        p.metadata.labels = {}
+        c.pods().update(p)
+        while not deletes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert deletes == ["p"]
+        assert len(inf.store) == 0
+        inf.stop()
+
+
+class TestListers:
+    def test_get_pod_services(self):
+        svc = t.Service(
+            metadata=t.ObjectMeta(name="s", namespace="default"),
+            spec=t.ServiceSpec(selector={"app": "web"}),
+        )
+        other_ns = t.Service(
+            metadata=t.ObjectMeta(name="s2", namespace="other"),
+            spec=t.ServiceSpec(selector={"app": "web"}),
+        )
+        empty_sel = t.Service(
+            metadata=t.ObjectMeta(name="s3", namespace="default"),
+            spec=t.ServiceSpec(selector={}),
+        )
+        lister = fake_service_lister([svc, other_ns, empty_sel])
+        matches = lister.get_pod_services(pod("p", labels={"app": "web"}))
+        assert [s.metadata.name for s in matches] == ["s"]
+
+
+class TestEvents:
+    def test_sink_aggregates_duplicates(self):
+        server, c = make_client()
+        bcast = EventBroadcaster()
+        bcast.start_recording_to_sink(EventSink(c))
+        rec = bcast.new_recorder("scheduler")
+        target = pod("p")
+        rec.event(target, "Normal", "Scheduled", "bound to node-1")
+        rec.event(target, "Normal", "Scheduled", "bound to node-1")
+        events, _ = c.events().list()
+        assert len(events) == 1
+        assert events[0].count == 2
+        assert events[0].reason == "Scheduled"
+
+    def test_fake_recorder(self):
+        rec = FakeRecorder()
+        rec.eventf(pod("p"), "Warning", "FailedScheduling", "no fit: %s", "cpu")
+        assert rec.events == ["Warning FailedScheduling no fit: cpu"]
+
+
+class TestLeaderElection:
+    def test_single_winner_and_failover(self):
+        server, c = make_client()
+        order = []
+        stop_a = threading.Event()
+
+        def make(identity, started):
+            return LeaderElector(
+                c,
+                "kube-system",
+                "kube-scheduler",
+                identity,
+                lease_duration=0.6,
+                renew_deadline=0.4,
+                retry_period=0.1,
+                on_started_leading=lambda: started.set(),
+            )
+
+        started_a, started_b = threading.Event(), threading.Event()
+        a = make("a", started_a)
+        b = make("b", started_b)
+        ta = threading.Thread(target=a.run, daemon=True)
+        tb = threading.Thread(target=b.run, daemon=True)
+        ta.start()
+        assert started_a.wait(3)
+        tb.start()
+        # b cannot take a fresh lease
+        assert not started_b.wait(0.5)
+        assert a.is_leader() and not b.is_leader()
+        # a dies; b takes over after the lease expires
+        a.stop()
+        ta.join(timeout=3)
+        assert started_b.wait(5)
+        assert b.is_leader()
+        b.stop()
+        tb.join(timeout=3)
