@@ -147,12 +147,23 @@ class DeltaFIFO:
         self._queue_delta(obj, "Deleted")
 
     def pop(self, timeout: Optional[float] = None) -> Tuple[str, List[Delta]]:
+        return self.pop_process(None, timeout)
+
+    def pop_process(
+        self, process, timeout: Optional[float] = None
+    ) -> Tuple[str, List[Delta]]:
+        """Pop the next key's deltas; if `process` is given, invoke it
+        UNDER the queue lock (fifo.go Pop(PopProcessFunc)) so replace()
+        can never run in the window between removing deltas from the
+        queue and applying them downstream — the ghost-object hazard."""
         with self._cond:
             while True:
                 while self._queue:
                     key = self._queue.pop(0)
                     deltas = self._items.pop(key, None)
                     if deltas:
+                        if process is not None:
+                            process(key, deltas)
                         return key, deltas
                 if self._closed:
                     raise ShutDown

@@ -108,18 +108,22 @@ class Informer:
     def _process_loop(self) -> None:
         while True:
             try:
-                key, deltas = self._fifo.pop(timeout=0.2)
+                # deltas are applied under the FIFO lock (pop_process) so
+                # a concurrent relist's replace() always sees either the
+                # queued delta or its downstream effect — never neither
+                self._fifo.pop_process(self._apply_deltas, timeout=0.2)
             except ShutDown:
                 return
             except TimeoutError:
-                self._maybe_mark_synced()
-                continue
-            for d in deltas:
-                try:
-                    self._process_delta(d)
-                except Exception:
-                    log.exception("informer handler failed for %s", key)
+                pass
             self._maybe_mark_synced()
+
+    def _apply_deltas(self, key: str, deltas) -> None:
+        for d in deltas:
+            try:
+                self._process_delta(d)
+            except Exception:
+                log.exception("informer handler failed for %s", key)
 
     def _maybe_mark_synced(self) -> None:
         # sync is declared only AFTER the popped deltas are applied, so a

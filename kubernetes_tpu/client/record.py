@@ -127,29 +127,28 @@ class EventSink:
             ev.reason,
             ev.message,
         )
+        # the whole lookup→API-call→remember sequence is one critical
+        # section so concurrent duplicate events aggregate instead of
+        # racing into two creates (event volume is low; contention isn't)
         with self._lock:
+            events = self.client.resource("events", ev.metadata.namespace)
             prior = self._seen.get(key)
             if prior is not None:
-                self._seen.move_to_end(key)
-        events = self.client.resource("events", ev.metadata.namespace)
-        if prior is not None:
-            name, count = prior
-            try:
-                events.patch(
-                    name,
-                    {"count": count + 1, "lastTimestamp": ev.last_timestamp},
-                )
-                with self._lock:
+                name, count = prior
+                try:
+                    events.patch(
+                        name,
+                        {"count": count + 1, "lastTimestamp": ev.last_timestamp},
+                    )
                     self._remember(key, (name, count + 1))
-                return
-            except APIStatusError:
-                pass  # fall through to create
-        try:
-            events.create(ev)
-            with self._lock:
+                    return
+                except APIStatusError:
+                    pass  # fall through to create
+            try:
+                events.create(ev)
                 self._remember(key, (ev.metadata.name, 1))
-        except APIStatusError:
-            log.debug("event create failed", exc_info=True)
+            except APIStatusError:
+                log.debug("event create failed", exc_info=True)
 
     def _remember(self, key, value) -> None:
         self._seen[key] = value

@@ -300,7 +300,7 @@ class BatchScheduler:
             self._jitted[key] = fn
         return fn
 
-    def initial_carry(self, snap: ClusterSnapshot):
+    def initial_carry(self, snap: ClusterSnapshot, last_node_index: int = 0):
         return (
             jnp.asarray(snap.req_mcpu),
             jnp.asarray(snap.req_mem),
@@ -310,21 +310,31 @@ class BatchScheduler:
             jnp.asarray(snap.pod_count),
             jnp.asarray(snap.port_mask),
             jnp.asarray(snap.class_count),
-            jnp.int64(0),
+            # selectHost's persistent round-robin counter
+            # (generic_scheduler.go:127 lastNodeIndex) — callers scheduling
+            # successive waves thread the final value back in
+            jnp.int64(last_node_index),
         )
 
-    def schedule(self, snap: ClusterSnapshot, batch: PodBatch):
+    def schedule(
+        self, snap: ClusterSnapshot, batch: PodBatch, last_node_index: int = 0
+    ):
         """Returns (chosen_node_index[P] int32 with -1 == unschedulable,
-        final_carry)."""
+        final_carry). final_carry[-1] is the post-wave lastNodeIndex."""
         if snap.num_nodes == 0:
             # empty cluster: every pod fails with FitError in the reference
-            return np.full(batch.num_pods, -1, np.int32), self.initial_carry(snap)
+            return (
+                np.full(batch.num_pods, -1, np.int32),
+                self.initial_carry(snap, last_node_index),
+            )
         static = {f: jnp.asarray(getattr(snap, f)) for f in self.STATIC_FIELDS}
         pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
         num_zones = int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1
         # num_zones must cover the vocab; zone ids are dense from encoding
         run = self._compiled(max(num_zones, 1))
-        final, chosen = run(static, self.initial_carry(snap), pods)
+        final, chosen = run(
+            static, self.initial_carry(snap, last_node_index), pods
+        )
         return np.asarray(chosen), final
 
     def schedule_names(self, snap: ClusterSnapshot, batch: PodBatch):

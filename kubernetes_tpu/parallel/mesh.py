@@ -256,13 +256,15 @@ class MeshBatchScheduler:
         self.config = config or SchedulerConfig()
         self._jitted = {}
 
-    def schedule(self, snap: ClusterSnapshot, batch: PodBatch):
+    def schedule(
+        self, snap: ClusterSnapshot, batch: PodBatch, last_node_index: int = 0
+    ):
         n_dev = self.mesh.devices.size
         if len(snap.node_names) == 0:
             sched = BatchScheduler(self.config)
             return (
                 np.full(batch.num_pods, -1, np.int32),
-                sched.initial_carry(snap),
+                sched.initial_carry(snap, last_node_index),
             )
         snap = _pad_snapshot(snap, n_dev)
         n = len(snap.node_names)
@@ -322,7 +324,7 @@ class MeshBatchScheduler:
             self._jitted[key] = run
 
         sched = BatchScheduler(self.config)
-        carry = sched.initial_carry(snap)
+        carry = sched.initial_carry(snap, last_node_index)
         with self.mesh:
             final, chosen = run(static, carry, pods)
         chosen = np.asarray(chosen)

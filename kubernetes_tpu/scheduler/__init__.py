@@ -1,0 +1,18 @@
+"""The scheduler component (plugin/pkg/scheduler).
+
+- cache: assumed-pod state machine (schedulercache)
+- plugins: predicate/priority/provider registries (factory/plugins.go)
+- algorithmprovider: DefaultProvider + the "tpu" provider
+- policy: Policy JSON config (api/types.go) + validation
+- extender: HTTP scheduler extender client (extender.go)
+- factory: watch wiring — informers -> cache, unassigned-pod FIFO,
+  backoff, binder (factory/factory.go)
+- core: Config + the scheduleOne control loop (scheduler.go)
+- server: daemon assembly — options, healthz/metrics, leader election
+  (plugin/cmd/kube-scheduler/app)
+"""
+
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.core import Scheduler, SchedulerConfig
+
+__all__ = ["SchedulerCache", "Scheduler", "SchedulerConfig"]

@@ -1,0 +1,267 @@
+"""The scheduler control loop.
+
+Reference: plugin/pkg/scheduler/scheduler.go (Config:50, Run:89 =
+wait.Until(scheduleOne, 0), scheduleOne:93: pop -> Schedule -> AssumePod
+-> async bind) and generic_scheduler.go:72 Schedule with the extender
+chain (:166-177, :276-298).
+
+TPU-first deviation (by design, not accident): when the algorithm
+supports backlog scheduling (the TPU batch program), scheduleOne drains
+every pod already waiting in the FIFO and schedules the whole wave in
+one device program — sequential-equivalent by construction (the scan
+threads resource commitments), so the decisions match the reference's
+one-at-a-time loop while amortizing snapshot + dispatch cost.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.metrics import (
+    scheduler_algorithm_latency,
+    scheduler_binding_latency,
+    scheduler_e2e_latency,
+)
+from kubernetes_tpu.oracle.scheduler import (
+    FitError,
+    GenericScheduler,
+    prioritize_nodes,
+    select_host,
+)
+from kubernetes_tpu.oracle.state import ClusterState
+from kubernetes_tpu.utils.clock import DEFAULT_CLOCK
+from kubernetes_tpu.utils.trace import Trace
+
+log = logging.getLogger(__name__)
+
+
+class ExtendedGenericScheduler(GenericScheduler):
+    """GenericScheduler + the HTTP extender chain."""
+
+    def __init__(self, predicates, priorities, extenders=()):
+        super().__init__(predicates=predicates, priorities=priorities)
+        self.extenders = list(extenders)
+
+    def schedule(self, pod: Pod, state: ClusterState) -> str:
+        trace = Trace(f"Scheduling {pod.metadata.namespace}/{pod.metadata.name}")
+        if not state.node_infos:
+            raise FitError(pod, {})
+        trace.step("Computing predicates")
+        fits, failed = self.find_nodes_that_fit(pod, state)
+        # extender Filter chain (generic_scheduler.go:166-177)
+        for ext in self.extenders:
+            if not fits:
+                break
+            nodes = [state.node_infos[n].node for n in fits]
+            kept, ext_failed = ext.filter(pod, nodes)
+            fits = [n.metadata.name for n in kept]
+            failed.update(ext_failed)
+        if not fits:
+            raise FitError(pod, failed)
+        trace.step("Prioritizing")
+        priority_list = prioritize_nodes(pod, state, self.priorities, fits)
+        # extender Prioritize fan-in (generic_scheduler.go:276-298)
+        if self.extenders:
+            combined = dict(priority_list)
+            for ext in self.extenders:
+                nodes = [state.node_infos[n].node for n in fits]
+                for host, score in ext.prioritize(pod, nodes):
+                    if host in combined:
+                        combined[host] += score * ext.weight
+            priority_list = [(n, combined[n]) for n in fits]
+        trace.step("Selecting host")
+        host = select_host(priority_list, self.last_node_index)
+        self.last_node_index += 1
+        # the reference logs cycles >20ms (generic_scheduler.go:79)
+        trace.log_if_long(0.02)
+        return host
+
+
+@dataclass
+class SchedulerConfig:
+    """scheduler.go:50 Config — the dependency set scheduleOne needs."""
+
+    scheduler_cache: object = None  # SchedulerCache
+    algorithm: object = None  # .schedule(pod, state) / .schedule_backlog
+    binder: Callable[[Pod, str], None] = None
+    pod_condition_updater: Callable[[Pod, str, str], None] = None
+    next_pod: Callable[[], Pod] = None
+    # pop up to this many additional waiting pods per cycle (0 = strictly
+    # serial, reference-identical pacing)
+    drain_waiting: Callable[[int], List[Pod]] = None
+    max_batch: int = 4096
+    # schedulable-node filter (factory.go:412 getNodeConditionPredicate
+    # applied through the NodeLister, generic_scheduler.go:81)
+    node_lister: object = None
+    error: Callable[[Pod, Exception], None] = None
+    recorder: object = None  # EventRecorder
+    snapshot_extras: Callable[[], dict] = None  # listers for ClusterState
+    stop_everything: threading.Event = field(default_factory=threading.Event)
+
+
+class Scheduler:
+    """scheduler.go Scheduler."""
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+
+    def run(self) -> threading.Thread:
+        """scheduler.go:89 Run — the loop in a daemon thread."""
+        thread = threading.Thread(
+            target=self._loop, name="scheduler", daemon=True
+        )
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self.config.stop_everything.set()
+
+    def _loop(self) -> None:
+        while not self.config.stop_everything.is_set():
+            try:
+                self.schedule_one()
+            except StopIteration:
+                return
+            except Exception:
+                log.exception("scheduleOne failed")
+
+    # -- one cycle -----------------------------------------------------------
+
+    def _snapshot(self) -> ClusterState:
+        extras = self.config.snapshot_extras() if self.config.snapshot_extras else {}
+        state = self.config.scheduler_cache.snapshot(**extras)
+        if self.config.node_lister is None:
+            return state
+        # restrict candidate nodes to the lister's schedulable set; the
+        # full state stays reachable for assigned-pod topology lookups
+        # (oracle._restrict_state semantics)
+        allowed = {
+            n.metadata.name for n in self.config.node_lister.list()
+        }
+        sub = ClusterState(
+            services=state.services,
+            controllers=state.controllers,
+            replica_sets=state.replica_sets,
+            pvs=state.pvs,
+            pvcs=state.pvcs,
+        )
+        sub.node_infos = {
+            name: info
+            for name, info in state.node_infos.items()
+            if name in allowed and info.node is not None
+        }
+        sub.full = state
+        return sub
+
+    def schedule_one(self) -> None:
+        """scheduler.go:93 scheduleOne (+ the TPU wave extension)."""
+        cfg = self.config
+        pod = cfg.next_pod()
+        if pod is None:
+            raise StopIteration
+        wave: List[Pod] = [pod]
+        if cfg.drain_waiting is not None and hasattr(
+            cfg.algorithm, "schedule_backlog"
+        ):
+            wave += cfg.drain_waiting(cfg.max_batch - 1)
+        start = DEFAULT_CLOCK.now()
+        state = self._snapshot()
+        try:
+            if len(wave) == 1:
+                hosts: List[Optional[str]] = [
+                    cfg.algorithm.schedule(wave[0], state)
+                ]
+                errors: Dict[int, Exception] = {}
+            else:
+                hosts, errors = self._schedule_wave(wave, state)
+        except Exception as e:
+            scheduler_algorithm_latency.observe(DEFAULT_CLOCK.now() - start)
+            self._handle_failure(pod, e)
+            return
+        scheduler_algorithm_latency.observe(DEFAULT_CLOCK.now() - start)
+
+        for i, (p, host) in enumerate(zip(wave, hosts)):
+            if host is None:
+                self._handle_failure(p, errors.get(i) or FitError(p, {}))
+                continue
+            self._assume_and_bind(p, host, start)
+
+    def _schedule_wave(
+        self, wave: Sequence[Pod], state: ClusterState
+    ) -> Tuple[List[Optional[str]], Dict[int, Exception]]:
+        hosts = self.config.algorithm.schedule_backlog(wave, state)
+        errors: Dict[int, Exception] = {}
+        for i, (p, h) in enumerate(zip(wave, hosts)):
+            if h is None:
+                errors[i] = self._explain_failure(p, state)
+        return list(hosts), errors
+
+    def _explain_failure(self, pod: Pod, state: ClusterState) -> Exception:
+        """Recover per-node failure reasons for an unschedulable pod by
+        running the host predicates once (rare path; the device program
+        reports fit/no-fit only)."""
+        try:
+            oracle = GenericScheduler()
+            _, failed = oracle.find_nodes_that_fit(pod, state)
+            return FitError(pod, failed)
+        except Exception as e:  # pragma: no cover
+            return e
+
+    def _assume_and_bind(self, pod: Pod, host: str, cycle_start: float) -> None:
+        cfg = self.config
+        # optimistic local commit (scheduler.go:122 AssumePod)
+        import copy
+
+        assumed = copy.copy(pod)
+        assumed.spec = copy.copy(pod.spec)
+        assumed.spec.node_name = host
+        try:
+            cfg.scheduler_cache.assume_pod(assumed)
+        except Exception:
+            log.exception("assume failed for %s", pod.metadata.name)
+
+        def bind() -> None:
+            bind_start = DEFAULT_CLOCK.now()
+            try:
+                cfg.binder(pod, host)
+            except Exception as e:
+                # bind failed: undo the assume (scheduler.go:148-151)
+                try:
+                    cfg.scheduler_cache.forget_pod(assumed)
+                except Exception:
+                    pass
+                self._handle_failure(pod, e, reason="FailedBinding")
+                return
+            scheduler_binding_latency.observe(DEFAULT_CLOCK.now() - bind_start)
+            scheduler_e2e_latency.observe(DEFAULT_CLOCK.now() - cycle_start)
+            if cfg.recorder is not None:
+                cfg.recorder.eventf(
+                    pod,
+                    "Normal",
+                    "Scheduled",
+                    "Successfully assigned %s to %s",
+                    pod.metadata.name,
+                    host,
+                )
+
+        # async bind goroutine (scheduler.go:124-152)
+        threading.Thread(target=bind, daemon=True, name="bind").start()
+
+    def _handle_failure(
+        self, pod: Pod, err: Exception, reason: str = "FailedScheduling"
+    ) -> None:
+        cfg = self.config
+        log.debug("failed to schedule %s: %s", pod.metadata.name, err)
+        if cfg.recorder is not None:
+            cfg.recorder.eventf(pod, "Warning", reason, "%s", err)
+        if cfg.pod_condition_updater is not None:
+            try:
+                cfg.pod_condition_updater(pod, "False", "Unschedulable")
+            except Exception:
+                log.debug("condition update failed", exc_info=True)
+        if cfg.error is not None:
+            cfg.error(pod, err)

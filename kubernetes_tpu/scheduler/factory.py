@@ -1,0 +1,359 @@
+"""ConfigFactory: watch wiring for the scheduler.
+
+Reference: plugin/pkg/scheduler/factory/factory.go. Informers feed the
+SchedulerCache (assigned pods :127-137, nodes :139-148); a reflector
+feeds unassigned pods into the FIFO (:339 with the field selectors of
+:431-448); auxiliary informers back the service/RC/RS/PV/PVC listers;
+failed pods re-queue through exponential backoff (:371-377, :600-613);
+the binder POSTs /bindings (:537-543); multi-scheduler dispatch honors
+the scheduler.alpha.kubernetes.io/name annotation (:404).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.client.cache.fifo import FIFO
+from kubernetes_tpu.client.cache.listers import (
+    StoreToControllerLister,
+    StoreToNodeLister,
+    StoreToPodLister,
+    StoreToReplicaSetLister,
+    StoreToServiceLister,
+)
+from kubernetes_tpu.client.cache.reflector import Reflector
+from kubernetes_tpu.client.informer import Informer, ResourceEventHandler
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.scheduler import plugins
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.core import (
+    ExtendedGenericScheduler,
+    Scheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.scheduler.extender import HTTPExtender
+from kubernetes_tpu.scheduler.policy import Policy, resolve_policy
+from kubernetes_tpu.utils.flowcontrol import Backoff
+
+log = logging.getLogger(__name__)
+
+SCHEDULER_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/name"
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+class ConfigFactory:
+    """factory.go:55 ConfigFactory."""
+
+    def __init__(
+        self,
+        client: RESTClient,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        hard_pod_affinity_weight: int = 1,
+        failure_domains: Optional[List[str]] = None,
+        cache_ttl: float = 30.0,
+    ):
+        self.client = client
+        self.scheduler_name = scheduler_name
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.failure_domains = failure_domains or []
+        self.scheduler_cache = SchedulerCache(ttl=cache_ttl).run()
+        self.pod_queue = FIFO()
+        self.pod_backoff = Backoff(initial=1.0, max_duration=60.0)
+        self._stopped = False
+        self._components: list = []
+
+        # assigned (non-terminal) pods -> cache (factory.go:127-137)
+        self.assigned_informer = Informer(
+            client.resource("pods", namespace=""),
+            ResourceEventHandler(
+                on_add=self._cache_add_pod,
+                on_update=self._cache_update_pod,
+                on_delete=self._cache_delete_pod,
+            ),
+            field_selector="spec.nodeName!=",
+            name="assigned-pods",
+        )
+        # nodes -> cache (factory.go:139-148)
+        self.node_informer = Informer(
+            client.nodes(),
+            ResourceEventHandler(
+                on_add=self.scheduler_cache.add_node,
+                on_update=self.scheduler_cache.update_node,
+                on_delete=self.scheduler_cache.remove_node,
+            ),
+            name="nodes",
+        )
+        # unassigned pods -> FIFO (factory.go:339, selector :431-440)
+        self.unassigned_reflector = Reflector(
+            client.resource("pods", namespace=""),
+            _ResponsibleFIFO(self.pod_queue, scheduler_name),
+            field_selector="spec.nodeName==",
+            name="unassigned-pods",
+        )
+        # auxiliary listers (factory.go:349-365)
+        self.service_informer = Informer(client.resource("services", ""), name="services")
+        self.controller_informer = Informer(
+            client.resource("replicationcontrollers", ""), name="rcs"
+        )
+        self.replica_set_informer = Informer(
+            client.resource("replicasets", ""), name="rss"
+        )
+        self.pv_informer = Informer(client.resource("persistentvolumes"), name="pvs")
+        self.pvc_informer = Informer(
+            client.resource("persistentvolumeclaims", ""), name="pvcs"
+        )
+        self._components = [
+            self.assigned_informer,
+            self.node_informer,
+            self.service_informer,
+            self.controller_informer,
+            self.replica_set_informer,
+            self.pv_informer,
+            self.pvc_informer,
+        ]
+
+        self.node_lister = StoreToNodeLister(
+            self.node_informer.store, predicate=node_schedulable
+        )
+        self.pod_lister = StoreToPodLister(self.assigned_informer.store)
+        self.service_lister = StoreToServiceLister(self.service_informer.store)
+        self.controller_lister = StoreToControllerLister(
+            self.controller_informer.store
+        )
+        self.replica_set_lister = StoreToReplicaSetLister(
+            self.replica_set_informer.store
+        )
+
+    # -- cache handlers (only pods of schedulable interest) ------------------
+
+    def _cache_add_pod(self, pod: Pod) -> None:
+        try:
+            self.scheduler_cache.add_pod(pod)
+        except Exception:
+            log.debug("cache add_pod", exc_info=True)
+
+    def _cache_update_pod(self, old: Pod, new: Pod) -> None:
+        try:
+            self.scheduler_cache.update_pod(old, new)
+        except Exception:
+            log.debug("cache update_pod", exc_info=True)
+
+    def _cache_delete_pod(self, pod: Pod) -> None:
+        try:
+            self.scheduler_cache.remove_pod(pod)
+        except Exception:
+            log.debug("cache remove_pod", exc_info=True)
+
+    # -- assembly ------------------------------------------------------------
+
+    def run_components(self) -> None:
+        for c in self._components:
+            c.run()
+        self.unassigned_reflector.run()
+        for c in self._components:
+            c.wait_for_sync()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.pod_queue.close()
+        for c in self._components:
+            c.stop()
+        self.unassigned_reflector.stop()
+        self.scheduler_cache.stop()
+
+    def plugin_args(self) -> plugins.PluginFactoryArgs:
+        return plugins.PluginFactoryArgs(
+            pod_lister=self.pod_lister,
+            service_lister=self.service_lister,
+            controller_lister=self.controller_lister,
+            replica_set_lister=self.replica_set_lister,
+            node_lister=self.node_lister,
+            hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+            failure_domains=self.failure_domains,
+        )
+
+    def create_from_provider(self, provider_name: str) -> SchedulerConfig:
+        """factory.go:255 CreateFromProvider."""
+        provider = plugins.get_algorithm_provider(provider_name)
+        return self.create_from_keys(
+            provider.fit_predicate_keys,
+            provider.priority_keys,
+            algorithm_factory=provider.algorithm_factory,
+        )
+
+    def create_from_config(self, policy: Policy) -> SchedulerConfig:
+        """factory.go:266 CreateFromConfig (Policy JSON)."""
+        if policy.provider and not (policy.predicates or policy.priorities):
+            return self.create_from_provider(policy.provider)
+        args = self.plugin_args()
+        predicates, priorities = resolve_policy(policy, args)
+        extenders = [HTTPExtender(e) for e in policy.extenders]
+        algorithm = ExtendedGenericScheduler(
+            list(predicates.items()), priorities, extenders
+        )
+        return self._make_config(algorithm)
+
+    def create_from_keys(
+        self, predicate_keys, priority_keys, algorithm_factory=None
+    ) -> SchedulerConfig:
+        """factory.go:301 CreateFromKeys."""
+        args = self.plugin_args()
+        if algorithm_factory is not None:
+            algorithm = algorithm_factory(args)
+        else:
+            predicates = plugins.get_fit_predicate_functions(
+                list(predicate_keys), args
+            )
+            priorities = plugins.get_priority_function_configs(
+                list(priority_keys), args
+            )
+            algorithm = ExtendedGenericScheduler(
+                list(predicates.items()), priorities
+            )
+        return self._make_config(algorithm)
+
+    def _make_config(self, algorithm) -> SchedulerConfig:
+        return SchedulerConfig(
+            scheduler_cache=self.scheduler_cache,
+            algorithm=algorithm,
+            binder=self._bind,
+            pod_condition_updater=self._update_pod_condition,
+            next_pod=self._next_pod,
+            drain_waiting=self._drain_waiting,
+            error=self._make_error_handler(),
+            snapshot_extras=self._snapshot_extras,
+            node_lister=self.node_lister,
+        )
+
+    def create_scheduler(self, config: SchedulerConfig) -> Scheduler:
+        return Scheduler(config)
+
+    # -- config closures -----------------------------------------------------
+
+    def _snapshot_extras(self) -> dict:
+        return {
+            "services": self.service_lister.list(),
+            "controllers": self.controller_lister.list(),
+            "replica_sets": self.replica_set_lister.list(),
+            "pvs": self.pv_informer.store.list(),
+            "pvcs": self.pvc_informer.store.list(),
+        }
+
+    def _next_pod(self) -> Optional[Pod]:
+        """factory.go:394 getNextPod: blocking FIFO pop."""
+        from kubernetes_tpu.client.cache.fifo import ShutDown
+
+        while True:
+            try:
+                pod = self.pod_queue.pop()
+            except ShutDown:
+                return None
+            return pod
+
+    def _drain_waiting(self, limit: int) -> List[Pod]:
+        """Non-blocking drain for TPU wave scheduling."""
+        out: List[Pod] = []
+        while len(out) < limit:
+            try:
+                out.append(self.pod_queue.pop(timeout=0))
+            except Exception:
+                break
+        return out
+
+    def _bind(self, pod: Pod, host: str) -> None:
+        """factory.go:532 binder — POST pods/<name>/binding."""
+        self.client.pods(pod.metadata.namespace).bind(
+            pod.metadata.name, host, pod.metadata.namespace
+        )
+
+    def _update_pod_condition(self, pod: Pod, status: str, reason: str) -> None:
+        """factory.go:545 podConditionUpdater — PodScheduled condition."""
+        self.client.pods(pod.metadata.namespace).patch(
+            pod.metadata.name,
+            {
+                "status": {
+                    "conditions": [
+                        {
+                            "type": "PodScheduled",
+                            "status": status,
+                            "reason": reason,
+                        }
+                    ]
+                }
+            },
+            subresource="status",
+        )
+
+    def _make_error_handler(self):
+        """factory.go:476-512: async re-queue with per-pod backoff."""
+
+        def handle(pod: Pod, err: Exception) -> None:
+            if self._stopped:
+                return
+
+            def requeue() -> None:
+                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                delay = self.pod_backoff.next_(key)
+                threading.Event().wait(delay)
+                if self._stopped:
+                    return
+                try:
+                    fresh = self.client.pods(pod.metadata.namespace).get(
+                        pod.metadata.name
+                    )
+                    if not fresh.spec.node_name:
+                        self.pod_queue.add(fresh)
+                except Exception:
+                    pass  # deleted; drop
+
+            threading.Thread(target=requeue, daemon=True).start()
+
+        return handle
+
+
+class _ResponsibleFIFO:
+    """Store adapter filtering FIFO adds by the multi-scheduler
+    annotation (factory.go:404 responsibleForPod)."""
+
+    def __init__(self, fifo: FIFO, scheduler_name: str):
+        self.fifo = fifo
+        self.scheduler_name = scheduler_name
+
+    def _responsible(self, pod: Pod) -> bool:
+        want = pod.metadata.annotations.get(SCHEDULER_ANNOTATION_KEY, "")
+        if self.scheduler_name == DEFAULT_SCHEDULER_NAME:
+            return want in ("", DEFAULT_SCHEDULER_NAME)
+        return want == self.scheduler_name
+
+    def add(self, pod: Pod) -> None:
+        if self._responsible(pod):
+            self.fifo.add(pod)
+
+    def update(self, pod: Pod) -> None:
+        if self._responsible(pod):
+            self.fifo.update(pod)
+
+    def delete(self, pod: Pod) -> None:
+        self.fifo.delete(pod)
+
+    def replace(self, pods) -> None:
+        self.fifo.replace([p for p in pods if self._responsible(p)])
+
+    def list(self):
+        return self.fifo.list()
+
+
+def node_schedulable(node) -> bool:
+    """factory.go:412 getNodeConditionPredicate: Ready and not OutOfDisk
+    and not spec.unschedulable."""
+    if node.spec and getattr(node.spec, "unschedulable", False):
+        return False
+    for cond in node.status.conditions:
+        if cond.type == "Ready" and cond.status != "True":
+            return False
+        if cond.type == "OutOfDisk" and cond.status == "True":
+            return False
+    return True

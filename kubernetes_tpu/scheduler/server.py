@@ -1,0 +1,123 @@
+"""Scheduler daemon assembly.
+
+Reference: plugin/cmd/kube-scheduler/app/{server.go,options/options.go}.
+Run() wires: client, factory + informers, event broadcaster, config from
+provider or policy file, optional leader election, then the scheduling
+loop. Healthz/metrics ride the shared apiserver mux in this framework
+(the reference runs its own :10251 mux, server.go:92-108).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.client.record import EventBroadcaster, EventSink
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.scheduler import algorithmprovider  # registers providers
+from kubernetes_tpu.scheduler.core import Scheduler
+from kubernetes_tpu.scheduler.factory import (
+    DEFAULT_SCHEDULER_NAME,
+    ConfigFactory,
+)
+from kubernetes_tpu.scheduler.policy import load_policy
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerServerOptions:
+    """options.go:31 SchedulerServer (KubeSchedulerConfiguration knobs)."""
+
+    algorithm_provider: str = algorithmprovider.DEFAULT_PROVIDER_NAME
+    policy_config_file: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    hard_pod_affinity_symmetric_weight: int = 1
+    failure_domains: List[str] = field(
+        default_factory=lambda: [
+            "kubernetes.io/hostname",
+            "failure-domain.beta.kubernetes.io/zone",
+            "failure-domain.beta.kubernetes.io/region",
+        ]
+    )
+    kube_api_qps: float = 50.0
+    kube_api_burst: int = 100
+    leader_elect: bool = False
+    leader_elect_identity: str = ""
+    lock_object_namespace: str = "kube-system"
+    lock_object_name: str = "kube-scheduler"
+
+
+class SchedulerServer:
+    """app.Run (server.go:71)."""
+
+    def __init__(self, client: RESTClient, options: Optional[SchedulerServerOptions] = None):
+        self.options = options or SchedulerServerOptions()
+        self.client = client
+        self.factory: Optional[ConfigFactory] = None
+        self.scheduler: Optional[Scheduler] = None
+        self._elector: Optional[LeaderElector] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SchedulerServer":
+        opts = self.options
+        self.factory = ConfigFactory(
+            self.client,
+            scheduler_name=opts.scheduler_name,
+            hard_pod_affinity_weight=opts.hard_pod_affinity_symmetric_weight,
+            failure_domains=opts.failure_domains,
+        )
+        self.factory.run_components()
+
+        # createConfig (server.go:163): policy file wins over provider
+        if opts.policy_config_file:
+            config = self.factory.create_from_config(
+                load_policy(opts.policy_config_file)
+            )
+        else:
+            config = self.factory.create_from_provider(opts.algorithm_provider)
+
+        # event broadcaster -> apiserver (server.go:117-120)
+        broadcaster = EventBroadcaster()
+        broadcaster.start_recording_to_sink(EventSink(self.client))
+        config.recorder = broadcaster.new_recorder("scheduler")
+
+        self.scheduler = Scheduler(config)
+        if not opts.leader_elect:
+            self._thread = self.scheduler.run()
+            return self
+
+        # leader election (server.go:140-157): run() schedules only while
+        # holding the lease; losing it stops the world (crash-restart)
+        identity = opts.leader_elect_identity or f"scheduler-{id(self):x}"
+        self._elector = LeaderElector(
+            self.client,
+            opts.lock_object_namespace,
+            opts.lock_object_name,
+            identity,
+            on_started_leading=lambda: setattr(
+                self, "_thread", self.scheduler.run()
+            ),
+            on_stopped_leading=self._lost_lease,
+        )
+        threading.Thread(target=self._elector.run, daemon=True).start()
+        return self
+
+    def _lost_lease(self) -> None:
+        log.error("lost leader lease; stopping scheduler (restart to rejoin)")
+        if self.scheduler is not None:
+            self.scheduler.stop()
+
+    def is_leader(self) -> bool:
+        return self._elector is None or self._elector.is_leader()
+
+    def stop(self) -> None:
+        if self._elector is not None:
+            self._elector.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()
+        if self.factory is not None:
+            self.factory.stop()

@@ -1,0 +1,57 @@
+"""The TPU ScheduleAlgorithm: ClusterState -> device program -> hosts.
+
+Bridges the event-driven shell (SchedulerCache snapshots) to the batched
+tensor program (models/batch.BatchScheduler): encode the snapshot
+columnar (snapshot/encode.py), run the scan program, map chosen node
+ids back to names. Decisions are bit-identical to the serial oracle
+(tests/test_conformance.py), so the shell can treat this exactly like
+the host GenericScheduler — schedule() for one pod, schedule_backlog()
+for a whole FIFO wave in one dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.oracle.scheduler import FitError
+from kubernetes_tpu.oracle.state import ClusterState
+
+
+class TPUScheduleAlgorithm:
+    def __init__(self, mesh=None):
+        if mesh is not None:
+            from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
+
+            self._sched = MeshBatchScheduler(mesh)
+        else:
+            from kubernetes_tpu.models.batch import BatchScheduler
+
+            self._sched = BatchScheduler()
+        # selectHost's round-robin counter persists across waves, like the
+        # reference's genericScheduler.lastNodeIndex persists across pods
+        self._last_node_index = 0
+
+    def schedule_backlog(
+        self, pods: Sequence[Pod], state: ClusterState
+    ) -> List[Optional[str]]:
+        from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+        if not pods:
+            return []
+        snap, batch = SnapshotEncoder(state, list(pods)).encode()
+        chosen, final = self._sched.schedule(
+            snap, batch, last_node_index=self._last_node_index
+        )
+        self._last_node_index = int(final[-1])
+        out: List[Optional[str]] = []
+        for c in chosen:
+            i = int(c)
+            out.append(snap.node_names[i] if i >= 0 else None)
+        return out
+
+    def schedule(self, pod: Pod, state: ClusterState) -> str:
+        host = self.schedule_backlog([pod], state)[0]
+        if host is None:
+            raise FitError(pod, {})
+        return host

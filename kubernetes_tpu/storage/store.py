@@ -102,17 +102,9 @@ class WatchStream:
                 return
             yield ev
 
-    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
-        """Blocking single-event read; None on stop or timeout."""
-        try:
-            ev = self._q.get(timeout=timeout)
-        except queue.Empty:
-            return None
-        return ev
-
     def next_event(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
-        """Like next(), but raises TimeoutError on timeout so callers can
-        distinguish an idle stream from a stopped one (None = stopped)."""
+        """Blocking single-event read. None = the stream stopped; raises
+        TimeoutError on timeout (distinguishing idle from stopped)."""
         try:
             return self._q.get(timeout=timeout)
         except queue.Empty:

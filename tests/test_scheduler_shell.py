@@ -1,0 +1,437 @@
+"""Scheduler shell: cache state machine, plugins/policy, factory wiring,
+end-to-end scheduling against the in-process apiserver (reference:
+schedulercache/cache_test.go, factory_test.go, integration
+scheduler_test.go)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client import LocalTransport, RESTClient
+from kubernetes_tpu.client.record import FakeRecorder
+from kubernetes_tpu.scheduler import algorithmprovider, plugins
+from kubernetes_tpu.scheduler.cache import CacheError, SchedulerCache
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+from kubernetes_tpu.scheduler.policy import (
+    PolicyValidationError,
+    load_policy,
+)
+from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def pod(name, ns="default", node="", cpu="100m", mem="500Mi", annotations=None):
+    return t.Pod(
+        metadata=t.ObjectMeta(
+            name=name, namespace=ns, annotations=annotations or {}
+        ),
+        spec=t.PodSpec(
+            node_name=node,
+            containers=[t.Container(name="c", requests={"cpu": cpu, "memory": mem})],
+        ),
+    )
+
+
+def node(name, cpu="4", mem="32Gi", pods="110"):
+    return t.Node(
+        metadata=t.ObjectMeta(name=name, labels={"kubernetes.io/hostname": name}),
+        status=t.NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[t.NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+class TestSchedulerCache:
+    def test_assume_confirm_update_remove(self):
+        cache = SchedulerCache(ttl=30)
+        cache.add_node(node("n1"))
+        p = pod("p1", node="n1")
+        cache.assume_pod(p, now=0)
+        assert cache.is_assumed_pod(p)
+        snap = cache.snapshot()
+        assert snap.node_infos["n1"].requested_milli_cpu == 100
+        # watch confirm
+        cache.add_pod(p)
+        assert not cache.is_assumed_pod(p)
+        assert cache.snapshot().node_infos["n1"].requested_milli_cpu == 100
+        # update moves resources
+        p2 = pod("p1", node="n1", cpu="200m")
+        cache.update_pod(p, p2)
+        assert cache.snapshot().node_infos["n1"].requested_milli_cpu == 200
+        cache.remove_pod(p2)
+        assert cache.snapshot().node_infos["n1"].requested_milli_cpu == 0
+
+    def test_assume_expires(self):
+        clock = FakeClock(start=100.0)
+        cache = SchedulerCache(ttl=30, clock=clock)
+        cache.add_node(node("n1"))
+        p = pod("p1", node="n1")
+        cache.assume_pod(p, now=100.0)
+        cache.cleanup_expired(now=120.0)
+        assert cache.is_assumed_pod(p)  # not yet
+        cache.cleanup_expired(now=131.0)
+        assert not cache.is_assumed_pod(p)
+        assert cache.snapshot().node_infos["n1"].requested_milli_cpu == 0
+
+    def test_forget_undoes_assume(self):
+        cache = SchedulerCache()
+        cache.add_node(node("n1"))
+        p = pod("p1", node="n1")
+        cache.assume_pod(p)
+        cache.forget_pod(p)
+        assert cache.snapshot().node_infos["n1"].requested_milli_cpu == 0
+        with pytest.raises(CacheError):
+            cache.forget_pod(p)
+
+    def test_double_assume_rejected(self):
+        cache = SchedulerCache()
+        p = pod("p1", node="n1")
+        cache.assume_pod(p)
+        with pytest.raises(CacheError):
+            cache.assume_pod(p)
+
+    def test_remove_node_keeps_pod_aggregates(self):
+        cache = SchedulerCache()
+        cache.add_node(node("n1"))
+        p = pod("p1", node="n1")
+        cache.add_pod(p)
+        cache.remove_node(node("n1"))
+        snap = cache.snapshot()
+        assert snap.node_infos["n1"].node is None
+        assert snap.node_infos["n1"].requested_milli_cpu == 100
+        cache.remove_pod(p)
+        assert "n1" not in cache.snapshot().node_infos
+
+
+class TestPlugins:
+    def test_default_provider_registered(self):
+        prov = plugins.get_algorithm_provider(
+            algorithmprovider.DEFAULT_PROVIDER_NAME
+        )
+        assert "GeneralPredicates" in prov.fit_predicate_keys
+        assert "LeastRequestedPriority" in prov.priority_keys
+
+    def test_tpu_provider_has_algorithm_factory(self):
+        prov = plugins.get_algorithm_provider(algorithmprovider.TPU_PROVIDER_NAME)
+        assert prov.algorithm_factory is not None
+
+    def test_unknown_provider_raises(self):
+        with pytest.raises(KeyError):
+            plugins.get_algorithm_provider("nope")
+
+    def test_predicate_resolution_order_is_canonical(self):
+        args = plugins.PluginFactoryArgs()
+        preds = plugins.get_fit_predicate_functions(
+            ["MatchInterPodAffinity", "NoDiskConflict", "GeneralPredicates"], args
+        )
+        assert list(preds) == [
+            "NoDiskConflict",
+            "GeneralPredicates",
+            "MatchInterPodAffinity",
+        ]
+
+
+class TestPolicy:
+    def test_load_policy_json(self):
+        text = json.dumps(
+            {
+                "kind": "Policy",
+                "apiVersion": "v1",
+                "predicates": [
+                    {"name": "PodFitsPorts"},
+                    {
+                        "name": "TestServiceAffinity",
+                        "argument": {"serviceAffinity": {"labels": ["region"]}},
+                    },
+                    {
+                        "name": "TestLabelsPresence",
+                        "argument": {
+                            "labelsPresence": {
+                                "labels": ["retired"],
+                                "presence": False,
+                            }
+                        },
+                    },
+                ],
+                "priorities": [
+                    {"name": "LeastRequestedPriority", "weight": 2},
+                    {
+                        "name": "ZonePreferred",
+                        "weight": 3,
+                        "argument": {
+                            "labelPreference": {"label": "zone", "presence": True}
+                        },
+                    },
+                ],
+                "extenders": [
+                    {
+                        "urlPrefix": "http://x/api",
+                        "filterVerb": "filter",
+                        "weight": 5,
+                    }
+                ],
+            }
+        )
+        policy = load_policy(text)
+        assert [p.name for p in policy.predicates] == [
+            "PodFitsPorts",
+            "TestServiceAffinity",
+            "TestLabelsPresence",
+        ]
+        assert policy.priorities[0].weight == 2
+        assert policy.extenders[0].filter_verb == "filter"
+
+    def test_zero_weight_rejected(self):
+        with pytest.raises(PolicyValidationError):
+            load_policy(
+                json.dumps(
+                    {"priorities": [{"name": "EqualPriority", "weight": 0}]}
+                )
+            )
+
+
+def make_control_plane():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    return server, client
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestEndToEnd:
+    def _run(self, options=None, n_nodes=3, n_pods=6):
+        server, client = make_control_plane()
+        for i in range(n_nodes):
+            client.nodes().create(node(f"n{i}"))
+        srv = SchedulerServer(client, options).start()
+        try:
+            for i in range(n_pods):
+                client.pods().create(pod(f"p{i}"))
+            assert wait_until(
+                lambda: all(
+                    p.spec.node_name for p in client.pods().list()[0]
+                )
+            ), [
+                (p.metadata.name, p.spec.node_name)
+                for p in client.pods().list()[0]
+            ]
+            return server, client, srv
+        finally:
+            srv.stop()
+
+    def test_default_provider_schedules_all(self):
+        _, client, _ = self._run()
+        pods, _ = client.pods().list()
+        hosts = sorted(p.spec.node_name for p in pods)
+        # spreading: 6 pods over 3 identical nodes -> 2 each
+        assert [hosts.count(f"n{i}") for i in range(3)] == [2, 2, 2]
+        # PodScheduled condition set by the bind subresource
+        assert all(
+            any(c.type == "PodScheduled" and c.status == "True"
+                for c in p.status.conditions)
+            for p in pods
+        )
+
+    def test_unschedulable_pod_gets_condition_and_event(self):
+        server, client = make_control_plane()
+        client.nodes().create(node("n0", cpu="1"))
+        srv = SchedulerServer(client).start()
+        try:
+            client.pods().create(pod("big", cpu="64"))
+            assert wait_until(
+                lambda: any(
+                    c.type == "PodScheduled" and c.status == "False"
+                    and c.reason == "Unschedulable"
+                    for c in client.pods().get("big").status.conditions
+                )
+            )
+            assert wait_until(
+                lambda: any(
+                    e.reason == "FailedScheduling"
+                    for e in client.events().list()[0]
+                )
+            )
+        finally:
+            srv.stop()
+
+    def test_multi_scheduler_annotation(self):
+        server, client = make_control_plane()
+        client.nodes().create(node("n0"))
+        srv = SchedulerServer(client).start()  # default-scheduler
+        try:
+            client.pods().create(
+                pod("mine", annotations={})
+            )
+            client.pods().create(
+                pod(
+                    "other",
+                    annotations={
+                        "scheduler.alpha.kubernetes.io/name": "custom-scheduler"
+                    },
+                )
+            )
+            assert wait_until(
+                lambda: client.pods().get("mine").spec.node_name == "n0"
+            )
+            time.sleep(0.3)
+            assert client.pods().get("other").spec.node_name == ""
+        finally:
+            srv.stop()
+
+    def test_tpu_provider_end_to_end(self):
+        options = SchedulerServerOptions(
+            algorithm_provider=algorithmprovider.TPU_PROVIDER_NAME
+        )
+        _, client, _ = self._run(options, n_nodes=2, n_pods=4)
+        pods, _ = client.pods().list()
+        hosts = sorted(p.spec.node_name for p in pods)
+        assert [hosts.count(f"n{i}") for i in range(2)] == [2, 2]
+
+    def test_leader_election_gates_scheduling(self):
+        server, client = make_control_plane()
+        client.nodes().create(node("n0"))
+        opts = SchedulerServerOptions(
+            leader_elect=True, leader_elect_identity="s1"
+        )
+        srv = SchedulerServer(client, opts).start()
+        try:
+            assert wait_until(srv.is_leader)
+            client.pods().create(pod("p"))
+            assert wait_until(
+                lambda: client.pods().get("p").spec.node_name == "n0"
+            )
+        finally:
+            srv.stop()
+
+
+class TestSchedulerExtender:
+    """test/integration/extender_test.go:187 TestSchedulerExtender: fake
+    HTTP extenders participate in filtering and prioritization."""
+
+    def test_extender_filter_and_prioritize(self):
+        import http.server
+        import json as jsonlib
+
+        calls = {"filter": 0, "prioritize": 0}
+
+        class Ext(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = jsonlib.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                if self.path.endswith("/filter"):
+                    calls["filter"] += 1
+                    items = [
+                        n
+                        for n in body["nodes"]["items"]
+                        # the extender rejects n0
+                        if n["metadata"]["name"] != "n0"
+                    ]
+                    resp = {
+                        "nodes": {"kind": "NodeList", "items": items},
+                        "failedNodes": {"n0": "extender says no"},
+                    }
+                else:
+                    calls["prioritize"] += 1
+                    # strongly prefer n2
+                    resp = [
+                        {
+                            "host": n["metadata"]["name"],
+                            "score": 100
+                            if n["metadata"]["name"] == "n2"
+                            else 0,
+                        }
+                        for n in body["nodes"]["items"]
+                    ]
+                data = jsonlib.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Ext)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            server, client = make_control_plane()
+            for i in range(3):
+                client.nodes().create(node(f"n{i}"))
+            import os
+            import tempfile
+
+            policy = {
+                "kind": "Policy",
+                "predicates": [{"name": "GeneralPredicates"}],
+                "priorities": [{"name": "EqualPriority", "weight": 1}],
+                "extenders": [
+                    {
+                        "urlPrefix": f"http://127.0.0.1:{httpd.server_port}/api",
+                        "apiVersion": "v1beta1",
+                        "filterVerb": "filter",
+                        "prioritizeVerb": "prioritize",
+                        "weight": 10,
+                    }
+                ],
+            }
+            with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False
+            ) as f:
+                json.dump(policy, f)
+                path = f.name
+            try:
+                srv = SchedulerServer(
+                    client, SchedulerServerOptions(policy_config_file=path)
+                ).start()
+                try:
+                    client.pods().create(pod("p"))
+                    assert wait_until(
+                        lambda: client.pods().get("p").spec.node_name == "n2"
+                    )
+                    assert calls["filter"] >= 1
+                    assert calls["prioritize"] >= 1
+                finally:
+                    srv.stop()
+            finally:
+                os.unlink(path)
+        finally:
+            httpd.shutdown()
+
+
+class TestUnschedulableNodesIntegration:
+    """test/integration/scheduler_test.go:54 TestUnschedulableNodes: the
+    scheduler reacts to node schedulability transitions."""
+
+    def test_unschedulable_spec_flag(self):
+        server, client = make_control_plane()
+        n = node("n0")
+        n.spec = t.NodeSpec(unschedulable=True)
+        client.nodes().create(n)
+        srv = SchedulerServer(client).start()
+        try:
+            client.pods().create(pod("p"))
+            time.sleep(0.4)
+            assert client.pods().get("p").spec.node_name == ""
+            # flip to schedulable; the failed pod re-queues via backoff
+            fresh = client.nodes().get("n0")
+            fresh.spec.unschedulable = False
+            client.nodes().update(fresh)
+            assert wait_until(
+                lambda: client.pods().get("p").spec.node_name == "n0",
+                timeout=15,
+            )
+        finally:
+            srv.stop()

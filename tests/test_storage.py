@@ -183,7 +183,7 @@ class TestMemoryStore:
             "/pods/default/a", lambda c: (setattr(c.spec, "node_name", "n1"), c)[1]
         )
         s.delete("/pods/default/a")
-        evs = [w.next(timeout=1) for _ in range(3)]
+        evs = [w.next_event(timeout=1) for _ in range(3)]
         assert [e.type for e in evs] == ["ADDED", "MODIFIED", "DELETED"]
         assert evs[1].object.spec.node_name == "n1"
         w.stop()
@@ -195,7 +195,7 @@ class TestMemoryStore:
         s.create("/pods/default/b", make_pod("b"))
         s.create("/minions/n1", Node(metadata=ObjectMeta(name="n1")))
         w = s.watch("/pods/", from_rv=rv)
-        ev = w.next(timeout=1)
+        ev = w.next_event(timeout=1)
         assert ev.type == "ADDED"
         assert ev.object.metadata.name == "b"
         w.stop()
@@ -205,7 +205,7 @@ class TestMemoryStore:
         w = s.watch("/minions/")
         s.create("/pods/default/a", make_pod("a"))
         s.create("/minions/n1", Node(metadata=ObjectMeta(name="n1")))
-        ev = w.next(timeout=1)
+        ev = w.next_event(timeout=1)
         assert ev.object.metadata.name == "n1"
         w.stop()
 
@@ -224,7 +224,10 @@ class TestMemoryStore:
             s.create(f"/pods/default/p{i}", make_pod(f"p{i}"))
         types = []
         while True:
-            ev = w.next(timeout=0.2)
+            try:
+                ev = w.next_event(timeout=0.2)
+            except TimeoutError:
+                break
             if ev is None:
                 break
             types.append(ev.type)
