@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import priorities as R
 from kubernetes_tpu.ops import select as S
@@ -35,12 +36,14 @@ from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 GENERAL_PREDICATES = "GeneralPredicates"
 POD_TOLERATES_NODE_TAINTS = "PodToleratesNodeTaints"
 CHECK_NODE_MEMORY_PRESSURE = "CheckNodeMemoryPressure"
+MATCH_INTER_POD_AFFINITY = "MatchInterPodAffinity"
 
 LEAST_REQUESTED = "LeastRequestedPriority"
 BALANCED_ALLOCATION = "BalancedResourceAllocation"
 SELECTOR_SPREAD = "SelectorSpreadPriority"
 NODE_AFFINITY = "NodeAffinityPriority"
 TAINT_TOLERATION = "TaintTolerationPriority"
+INTER_POD_AFFINITY = "InterPodAffinityPriority"
 EQUAL = "EqualPriority"
 
 
@@ -53,6 +56,7 @@ class SchedulerConfig:
         GENERAL_PREDICATES,
         POD_TOLERATES_NODE_TAINTS,
         CHECK_NODE_MEMORY_PRESSURE,
+        MATCH_INTER_POD_AFFINITY,
     )
     priorities: Tuple[Tuple[str, int], ...] = (
         (LEAST_REQUESTED, 1),
@@ -60,7 +64,10 @@ class SchedulerConfig:
         (SELECTOR_SPREAD, 1),
         (NODE_AFFINITY, 1),
         (TAINT_TOLERATION, 1),
+        (INTER_POD_AFFINITY, 1),
     )
+    # --hard-pod-affinity-symmetric-weight (options.go:52)
+    hard_pod_affinity_weight: int = 1
 
 
 def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
@@ -74,9 +81,28 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         port_mask,
         class_count,
         last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
     ) = carry
+    num_nodes = req_mcpu.shape[0]
 
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    if want_ip_pred or want_ip_prio:
+        cnt_u = IP.gather_counts(
+            ip_term_count, static["ip_u_topo"], static["ip_topo_dom"]
+        )
+        cnt_lt = IP.expand_lt(
+            cnt_u, static["ip_lt_u"], static["ip_lt_sign"], num_nodes
+        )
     fit = ~pod["unschedulable"]
+    if want_ip_prio:
+        # a bad assigned-pod annotation errors the priority for every pod
+        fit = fit & ~pod["ip_poison"]
     if GENERAL_PREDICATES in config.predicates:
         fit = fit & P.pod_fits_resources(
             pod["req_mcpu"],
@@ -124,6 +150,28 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
     if CHECK_NODE_MEMORY_PRESSURE in config.predicates:
         fit = fit & P.check_node_memory_pressure(
             pod["best_effort"], static["mem_pressure"]
+        )
+    if want_ip_pred:
+        own_lt = IP.gather_lt(
+            ip_own_anti,
+            static["ip_u_topo"],
+            static["ip_topo_dom"],
+            static["ip_lt_u"],
+            static["ip_lt_sign"],
+        )
+        fit = fit & IP.match_interpod(
+            cnt_lt,
+            own_lt,
+            ip_spec_total,
+            static["ip_lt_spec"],
+            pod["ip_match_spec"],
+            pod["ip_ha_lt"],
+            pod["ip_ha_self"],
+            pod["ip_hq_lt"],
+            pod["ip_has_affinity"],
+            pod["ip_has_anti"],
+            pod["ip_sym_reject"],
+            num_nodes,
         )
 
     score = jnp.zeros(req_mcpu.shape, jnp.int64)
@@ -176,6 +224,29 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
                 static["taint_count"],
                 fit,
             )
+        elif name == INTER_POD_AFFINITY:
+            s = IP.interpod_priority(
+                cnt_lt,
+                IP.gather_lt(
+                    ip_rev_hard, static["ip_u_topo"], static["ip_topo_dom"],
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                IP.gather_lt(
+                    ip_rev_pref, static["ip_u_topo"], static["ip_topo_dom"],
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                IP.gather_lt(
+                    ip_rev_anti, static["ip_u_topo"], static["ip_topo_dom"],
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                static["ip_lt_spec"],
+                pod["ip_match_spec"],
+                pod["ip_fwd_lt"],
+                pod["ip_fwd_w"],
+                config.hard_pod_affinity_weight,
+                fit,
+                num_nodes,
+            )
         elif name == EQUAL:
             s = R.equal(req_mcpu.shape[0])
         else:
@@ -200,6 +271,33 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
     )
     class_count = class_count.at[safe, pod["class_id"]].add(inc)
     last_idx = last_idx + inc
+    if want_ip_pred or want_ip_prio:
+        (
+            ip_term_count,
+            ip_own_anti,
+            ip_rev_hard,
+            ip_rev_pref,
+            ip_rev_anti,
+            ip_spec_total,
+        ) = IP.interpod_commit(
+            ip_term_count,
+            ip_own_anti,
+            ip_rev_hard,
+            ip_rev_pref,
+            ip_rev_anti,
+            ip_spec_total,
+            static["ip_topo_dom"],
+            static["ip_u_topo"],
+            static["ip_u_spec"],
+            static["ip_lt_u"],
+            pod["ip_match_spec"],
+            pod["ip_own_hard"],
+            pod["ip_own_pref"],
+            pod["ip_own_anti_hard"],
+            pod["ip_own_anti_pref"],
+            chosen,
+            scheduled,
+        )
 
     carry = (
         req_mcpu,
@@ -211,6 +309,12 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         port_mask,
         class_count,
         last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
     )
     return carry, chosen
 
@@ -218,6 +322,9 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
 class BatchScheduler:
     """Schedule a pending-pod backlog against a snapshot, bit-identically
     to the serial reference loop. One compile per (N, P, widths) shape."""
+
+    # carry tuple index of selectHost's round-robin counter
+    LAST_IDX = 8
 
     POD_FIELDS = [
         "req_mcpu",
@@ -258,6 +365,20 @@ class BatchScheduler:
         "spread_match",
         "class_id",
         "unschedulable",
+        "ip_match_spec",
+        "ip_ha_lt",
+        "ip_ha_self",
+        "ip_hq_lt",
+        "ip_fwd_lt",
+        "ip_fwd_w",
+        "ip_own_hard",
+        "ip_own_pref",
+        "ip_own_anti_hard",
+        "ip_own_anti_pref",
+        "ip_has_affinity",
+        "ip_has_anti",
+        "ip_sym_reject",
+        "ip_poison",
     ]
     STATIC_FIELDS = [
         "alloc_mcpu",
@@ -277,6 +398,12 @@ class BatchScheduler:
         "set_table",
         "noschedule_taints",
         "prefer_taints",
+        "ip_topo_dom",
+        "ip_u_topo",
+        "ip_u_spec",
+        "ip_lt_spec",
+        "ip_lt_u",
+        "ip_lt_sign",
     ]
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
@@ -314,13 +441,19 @@ class BatchScheduler:
             # (generic_scheduler.go:127 lastNodeIndex) — callers scheduling
             # successive waves thread the final value back in
             jnp.int64(last_node_index),
+            jnp.asarray(snap.ip_term_count),
+            jnp.asarray(snap.ip_own_anti),
+            jnp.asarray(snap.ip_rev_hard),
+            jnp.asarray(snap.ip_rev_pref),
+            jnp.asarray(snap.ip_rev_anti),
+            jnp.asarray(snap.ip_spec_total),
         )
 
     def schedule(
         self, snap: ClusterSnapshot, batch: PodBatch, last_node_index: int = 0
     ):
         """Returns (chosen_node_index[P] int32 with -1 == unschedulable,
-        final_carry). final_carry[-1] is the post-wave lastNodeIndex."""
+        final_carry). final_carry[LAST_IDX] is the post-wave lastNodeIndex."""
         if snap.num_nodes == 0:
             # empty cluster: every pod fails with FitError in the reference
             return (

@@ -614,7 +614,12 @@ def _node_matches_hard_pod_anti_affinity(pod, all_pods, node, pod_anti_affinity,
         if any_pod_matches_term(pod, all_pods, node, term, state):
             return False
     for ep in all_pods:
-        ep_aff = get_affinity(ep)
+        try:
+            ep_aff = get_affinity(ep)
+        except Exception:
+            # predicates.go:902: annotation parse error => (false, err) —
+            # the node fails for every pod running the symmetric check
+            return False
         if ep_aff is None or ep_aff.pod_anti_affinity is None:
             continue
         for term in ep_aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution:
@@ -639,7 +644,11 @@ def inter_pod_affinity_matches(pod: Pod, info: NodeInfo, state: ClusterState):
     if node is None:
         return False, "node not found"
     all_pods = state.all_assigned_pods()
-    affinity = get_affinity(pod)
+    try:
+        affinity = get_affinity(pod)
+    except Exception:
+        # predicates.go:775: parse error => (false, err) for every node
+        return False, ERR_POD_AFFINITY_NOT_MATCH
     if affinity is not None:
         if affinity.pod_affinity is not None:
             if not _node_matches_hard_pod_affinity(

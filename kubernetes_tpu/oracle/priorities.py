@@ -341,7 +341,11 @@ def inter_pod_affinity_priority(
 ) -> Dict[str, int]:
     """interpod_affinity.go:86 CalculateInterPodAffinityPriority."""
     all_pods = state.all_assigned_pods()
-    affinity = get_affinity(pod)
+    try:
+        affinity = get_affinity(pod)
+    except Exception:
+        # interpod_affinity.go:89: parse error aborts the whole cycle
+        raise PriorityError("invalid affinity annotation on pod")
     counts: Dict[str, int] = {}
     max_count = 0
     min_count = 0
@@ -380,7 +384,12 @@ def inter_pod_affinity_priority(
         # reverse direction: terms indicated by existing pods, matched
         # against the pending pod placed hypothetically on `node`.
         for ep in all_pods:
-            ep_aff = get_affinity(ep)
+            try:
+                ep_aff = get_affinity(ep)
+            except Exception:
+                # interpod_affinity.go:128: any assigned pod with a bad
+                # annotation errors the priority => cycle aborts
+                raise PriorityError("invalid affinity annotation on assigned pod")
             if ep_aff is None:
                 continue
             if ep_aff.pod_affinity is not None:

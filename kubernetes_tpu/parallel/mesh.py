@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
-from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.models.batch import (
+    INTER_POD_AFFINITY,
+    MATCH_INTER_POD_AFFINITY,
+    BatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import select as S
 from kubernetes_tpu.ops import priorities as R
@@ -54,8 +60,15 @@ def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
             fields[f.name] = np.pad(
                 v, [(0, pad), (0, 0)], constant_values=np.nan
             )
-        elif f.name in ("set_table", "noschedule_taints", "prefer_taints"):
-            fields[f.name] = v  # vocab tables: not node-axis
+        elif f.name == "ip_topo_dom":
+            # node axis is axis 1; dummy nodes have no topology domains
+            fields[f.name] = np.pad(
+                v, [(0, 0), (0, pad)], constant_values=-1
+            )
+        elif f.name in ("set_table", "noschedule_taints", "prefer_taints") or (
+            f.name.startswith("ip_")
+        ):
+            fields[f.name] = v  # vocab/count tables: not node-axis
         elif isinstance(v, np.ndarray):
             fields[f.name] = pad_arr(v)
         else:
@@ -77,12 +90,35 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         port_mask,
         class_count,
         last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
     ) = carry
 
     shard = jax.lax.axis_index(AXIS)
     offset = shard.astype(jnp.int32) * n_per_shard
 
+    # interpod count tables are replicated (small); queries use this
+    # shard's node columns of the (replicated) topology-domain table
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    if want_ip_pred or want_ip_prio:
+        topo_local = jax.lax.dynamic_slice_in_dim(
+            static["ip_topo_dom"], offset, n_per_shard, axis=1
+        )
+        cnt_lt = IP.expand_lt(
+            IP.gather_counts(ip_term_count, static["ip_u_topo"], topo_local),
+            static["ip_lt_u"],
+            static["ip_lt_sign"],
+            n_per_shard,
+        )
+
     fit = ~pod["unschedulable"]
+    if want_ip_prio:
+        fit = fit & ~pod["ip_poison"]
     fit = fit & P.pod_fits_resources(
         pod["req_mcpu"],
         pod["req_mem"],
@@ -130,6 +166,25 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         static["noschedule_taints"],
     )
     fit = fit & P.check_node_memory_pressure(pod["best_effort"], static["mem_pressure"])
+    if want_ip_pred:
+        own_lt = IP.gather_lt(
+            ip_own_anti, static["ip_u_topo"], topo_local,
+            static["ip_lt_u"], static["ip_lt_sign"],
+        )
+        fit = fit & IP.match_interpod(
+            cnt_lt,
+            own_lt,
+            ip_spec_total,
+            static["ip_lt_spec"],
+            pod["ip_match_spec"],
+            pod["ip_ha_lt"],
+            pod["ip_ha_self"],
+            pod["ip_hq_lt"],
+            pod["ip_has_affinity"],
+            pod["ip_has_anti"],
+            pod["ip_sym_reject"],
+            n_per_shard,
+        )
 
     score = jnp.zeros(req_mcpu.shape, jnp.int64)
     for name, weight in config.priorities:
@@ -166,6 +221,35 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
             local_max = counts.max(where=fit, initial=0).astype(jnp.int32)
             max_count = jax.lax.pmax(local_max, AXIS).astype(jnp.int64)
             s = R.normalize_counts_down(counts, max_count)
+        elif name == INTER_POD_AFFINITY:
+            totals = IP.interpod_totals(
+                cnt_lt,
+                IP.gather_lt(
+                    ip_rev_hard, static["ip_u_topo"], topo_local,
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                IP.gather_lt(
+                    ip_rev_pref, static["ip_u_topo"], topo_local,
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                IP.gather_lt(
+                    ip_rev_anti, static["ip_u_topo"], topo_local,
+                    static["ip_lt_u"], static["ip_lt_sign"],
+                ),
+                static["ip_lt_spec"],
+                pod["ip_match_spec"],
+                pod["ip_fwd_lt"],
+                pod["ip_fwd_w"],
+                config.hard_pod_affinity_weight,
+                n_per_shard,
+            )
+            # global min/max over fit nodes: gather the small vectors
+            # (s64 all-reduce min/max has no TPU lowering; gather+reduce
+            # computes the identical integers)
+            totals_g = jax.lax.all_gather(totals, AXIS, tiled=True)
+            fitp_g = jax.lax.all_gather(fit, AXIS, tiled=True)
+            mx, mn = IP.interpod_minmax(totals_g, fitp_g)
+            s = IP.interpod_normalize(totals, fit, mx, mn)
         elif name == "EqualPriority":
             s = jnp.ones(req_mcpu.shape, jnp.int64)
         else:
@@ -196,9 +280,33 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
     class_count = class_count.at[safe, pod["class_id"]].add(inc)
     last_idx = last_idx + scheduled.astype(jnp.int64)  # global counter
 
+    # interpod tables are replicated: every shard applies the identical
+    # update using the GLOBAL chosen index and the global domain table
+    if want_ip_pred or want_ip_prio:
+        (
+            ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
+            ip_spec_total,
+        ) = IP.interpod_commit(
+            ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
+            ip_spec_total,
+            static["ip_topo_dom"],
+            static["ip_u_topo"],
+            static["ip_u_spec"],
+            static["ip_lt_u"],
+            pod["ip_match_spec"],
+            pod["ip_own_hard"],
+            pod["ip_own_pref"],
+            pod["ip_own_anti_hard"],
+            pod["ip_own_anti_pref"],
+            chosen,
+            scheduled,
+        )
+
     carry = (
         req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
         pod_count, port_mask, class_count, last_idx,
+        ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
+        ip_spec_total,
     )
     return carry, chosen
 
@@ -295,6 +403,8 @@ class MeshBatchScheduler:
         carry_specs = (
             PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS),
             PSpec(AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
+            # interpod count tables: replicated (domain-indexed, not node)
+            PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
         )
         pod_specs = {k: PSpec() for k in pods}
 

@@ -43,7 +43,9 @@ class TPUScheduleAlgorithm:
         chosen, final = self._sched.schedule(
             snap, batch, last_node_index=self._last_node_index
         )
-        self._last_node_index = int(final[-1])
+        from kubernetes_tpu.models.batch import BatchScheduler
+
+        self._last_node_index = int(final[BatchScheduler.LAST_IDX])
         out: List[Optional[str]] = []
         for c in chosen:
             i = int(c)

@@ -136,6 +136,20 @@ class ClusterSnapshot:
     set_table: np.ndarray  # u32[S, LW]
     noschedule_taints: np.ndarray  # u32[TW]
     prefer_taints: np.ndarray  # u32[TW]
+    # inter-pod affinity program (snapshot/interpod.py). topo_dom is
+    # node-axis; the *_count/*_w tables are the INITIAL CARRY for the scan.
+    ip_topo_dom: Optional[np.ndarray] = None  # i32[Q, N]
+    ip_u_topo: Optional[np.ndarray] = None  # i32[U]
+    ip_u_spec: Optional[np.ndarray] = None  # i32[U]
+    ip_lt_spec: Optional[np.ndarray] = None  # i32[LT]
+    ip_lt_u: Optional[np.ndarray] = None  # i32[LT, E]
+    ip_lt_sign: Optional[np.ndarray] = None  # i8[LT, E]
+    ip_term_count: Optional[np.ndarray] = None  # i32[U, D]
+    ip_own_anti: Optional[np.ndarray] = None  # i32[U, D]
+    ip_rev_hard: Optional[np.ndarray] = None  # i32[U, D]
+    ip_rev_pref: Optional[np.ndarray] = None  # i64[U, D]
+    ip_rev_anti: Optional[np.ndarray] = None  # i64[U, D]
+    ip_spec_total: Optional[np.ndarray] = None  # i32[S]
 
     @property
     def num_nodes(self) -> int:
@@ -196,6 +210,23 @@ class PodBatch:
     spread_match: np.ndarray  # i64[P, C] 0/1
     class_id: np.ndarray  # i32[P]
     unschedulable: np.ndarray  # bool[P]
+    # inter-pod affinity per-pod program (snapshot/interpod.py)
+    ip_match_spec: Optional[np.ndarray] = None  # i8[P, S]
+    ip_ha_lt: Optional[np.ndarray] = None  # i32[P, TA]
+    ip_ha_self: Optional[np.ndarray] = None  # bool[P, TA]
+    ip_hq_lt: Optional[np.ndarray] = None  # i32[P, TQ]
+    ip_fwd_lt: Optional[np.ndarray] = None  # i32[P, TF]
+    ip_fwd_w: Optional[np.ndarray] = None  # i64[P, TF]
+    ip_own_hard: Optional[np.ndarray] = None  # i32[P, LT]
+    ip_own_pref: Optional[np.ndarray] = None  # i64[P, LT]
+    ip_own_anti_hard: Optional[np.ndarray] = None  # i32[P, LT]
+    ip_own_anti_pref: Optional[np.ndarray] = None  # i64[P, LT]
+    ip_has_affinity: Optional[np.ndarray] = None  # bool[P]
+    ip_has_anti: Optional[np.ndarray] = None  # bool[P]
+    ip_sym_reject: Optional[np.ndarray] = None  # bool[P]
+    # InterPodAffinityPriority aborts the cycle for EVERY pod when any
+    # assigned pod's affinity annotation fails to parse
+    ip_poison: Optional[np.ndarray] = None  # bool[P]
 
     @property
     def num_pods(self) -> int:
@@ -225,7 +256,20 @@ class SnapshotEncoder:
         self.classes = _Dict()  # (ns, frozenset(labels.items()), deleted)
         self.sets: Dict[frozenset, int] = {}
         self.set_members: List[frozenset] = []
+        self._interpod = None
         self._build_vocabs()
+
+    @property
+    def interpod(self):
+        """Lazily compiled inter-pod affinity program (shared between
+        encode_nodes and encode_pods so ids agree)."""
+        if self._interpod is None:
+            from kubernetes_tpu.snapshot.interpod import InterPodCompiler
+
+            self._interpod = InterPodCompiler(
+                self.state, self.pods, self.node_names
+            ).compile()
+        return self._interpod
 
     # -- vocab construction --------------------------------------------------
 
@@ -349,6 +393,18 @@ class SnapshotEncoder:
             set_table=self._set_table(),
             noschedule_taints=self._taint_effect_mask("NoSchedule"),
             prefer_taints=self._taint_effect_mask("PreferNoSchedule"),
+            ip_topo_dom=self.interpod.topo_dom,
+            ip_u_topo=self.interpod.u_topo,
+            ip_u_spec=self.interpod.u_spec,
+            ip_lt_spec=self.interpod.lt_spec,
+            ip_lt_u=self.interpod.lt_u,
+            ip_lt_sign=self.interpod.lt_sign,
+            ip_term_count=self.interpod.term_count,
+            ip_own_anti=self.interpod.own_anti,
+            ip_rev_hard=self.interpod.rev_hard,
+            ip_rev_pref=self.interpod.rev_pref,
+            ip_rev_anti=self.interpod.rev_anti,
+            ip_spec_total=self.interpod.spec_total,
         )
         for i, name in enumerate(self.node_names):
             info = self.state.node_infos[name]
@@ -553,6 +609,20 @@ class SnapshotEncoder:
             spread_match=np.zeros((P, w["C"]), np.int64),
             class_id=np.zeros(P, np.int32),
             unschedulable=np.zeros(P, bool),
+            ip_match_spec=self.interpod.match_spec,
+            ip_ha_lt=self.interpod.ha_lt,
+            ip_ha_self=self.interpod.ha_self,
+            ip_hq_lt=self.interpod.hq_lt,
+            ip_fwd_lt=self.interpod.fwd_lt,
+            ip_fwd_w=self.interpod.fwd_w,
+            ip_own_hard=self.interpod.own_hard,
+            ip_own_pref=self.interpod.own_pref,
+            ip_own_anti_hard=self.interpod.own_anti_hard,
+            ip_own_anti_pref=self.interpod.own_anti_pref,
+            ip_has_affinity=self.interpod.has_affinity,
+            ip_has_anti=self.interpod.has_anti,
+            ip_sym_reject=self.interpod.sym_reject,
+            ip_poison=np.full(P, self.interpod.poison, bool),
         )
         class_list = list(self.classes.ids.keys())
         for i, pod in enumerate(self.pods):

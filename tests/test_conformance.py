@@ -48,6 +48,7 @@ ORACLE_PREDICATES = (
     ("GeneralPredicates", opreds.general_predicates),
     ("PodToleratesNodeTaints", opreds.pod_tolerates_node_taints),
     ("CheckNodeMemoryPressure", opreds.check_node_memory_pressure),
+    ("MatchInterPodAffinity", opreds.inter_pod_affinity_matches),
 )
 ORACLE_PRIORITIES = (
     PriorityConfig(oprios.least_requested_priority, 1, "LeastRequestedPriority"),
@@ -55,10 +56,76 @@ ORACLE_PRIORITIES = (
     PriorityConfig(oprios.selector_spread_priority, 1, "SelectorSpreadPriority"),
     PriorityConfig(oprios.node_affinity_priority, 1, "NodeAffinityPriority"),
     PriorityConfig(oprios.taint_toleration_priority, 1, "TaintTolerationPriority"),
+    PriorityConfig(oprios.inter_pod_affinity_priority, 1, "InterPodAffinityPriority"),
 )
 
 
-def random_scenario(rng: random.Random, n_nodes=12, n_existing=15, n_pending=25):
+def random_pod_affinity(rng: random.Random, interpod_p: float):
+    """Random PodAffinity/PodAntiAffinity over the scenario's app labels."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        LabelSelectorRequirement,
+        PodAffinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        WeightedPodAffinityTerm,
+    )
+
+    if rng.random() >= interpod_p:
+        return None
+
+    def rand_selector():
+        r = rng.random()
+        if r < 0.4:
+            return LabelSelector(match_labels={"app": rng.choice(["web", "db", "cache"])})
+        if r < 0.7:
+            return LabelSelector(
+                match_expressions=(
+                    LabelSelectorRequirement(
+                        key=rng.choice(["app", "tier"]),
+                        operator=rng.choice(["In", "NotIn", "Exists", "DoesNotExist"]),
+                        values=(rng.choice(["web", "db", "be"]),),
+                    ),
+                )
+            )
+        if r < 0.85:
+            return LabelSelector()  # empty == Everything
+        return None  # nil == Nothing
+
+    def rand_term():
+        return PodAffinityTerm(
+            label_selector=rand_selector(),
+            namespaces=rng.choice([None, (), ("default",), ("other",)]),
+            topology_key=rng.choice(
+                ["kubernetes.io/hostname", ZONE, REGION, "", "disktype"]
+            ),
+        )
+
+    def rand_side(cls):
+        req = tuple(rand_term() for _ in range(rng.randint(0, 2)))
+        pref = tuple(
+            WeightedPodAffinityTerm(
+                weight=rng.choice([0, 1, 3, 7]), pod_affinity_term=rand_term()
+            )
+            for _ in range(rng.randint(0, 2))
+        )
+        if not req and not pref and rng.random() < 0.5:
+            return None
+        return cls(
+            required_during_scheduling_ignored_during_execution=req,
+            preferred_during_scheduling_ignored_during_execution=pref,
+        )
+
+    aff = rng.random()
+    return Affinity(
+        pod_affinity=rand_side(PodAffinity) if aff < 0.7 else None,
+        pod_anti_affinity=rand_side(PodAntiAffinity) if aff > 0.3 else None,
+    )
+
+
+def random_scenario(
+    rng: random.Random, n_nodes=12, n_existing=15, n_pending=25, interpod_p=0.0
+):
     zones = ["a", "b", "c"]
     nodes = []
     for i in range(n_nodes):
@@ -125,6 +192,7 @@ def random_scenario(rng: random.Random, n_nodes=12, n_existing=15, n_pending=25)
                 spec=PodSpec(
                     node_name=f"node-{rng.randrange(n_nodes):03d}",
                     containers=rand_containers(),
+                    affinity=random_pod_affinity(rng, interpod_p),
                 ),
             )
         )
@@ -192,6 +260,13 @@ def random_scenario(rng: random.Random, n_nodes=12, n_existing=15, n_pending=25)
                     preferred_during_scheduling_ignored_during_execution=preferred,
                 )
             )
+        ip_aff = random_pod_affinity(rng, interpod_p)
+        if ip_aff is not None:
+            if affinity is None:
+                affinity = ip_aff
+            else:
+                affinity.pod_affinity = ip_aff.pod_affinity
+                affinity.pod_anti_affinity = ip_aff.pod_anti_affinity
         pod = Pod(
             metadata=ObjectMeta(name=f"pending-{i:04d}", labels=rng.choice(app_labels)),
             spec=PodSpec(
@@ -352,3 +427,214 @@ def test_empty_cluster_all_unscheduled():
     oracle_result, tpu_result = run_both(state, pods)
     assert oracle_result == [None]
     assert tpu_result == [None]
+
+
+# --- inter-pod affinity conformance -----------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_interpod_affinity_random_bit_identical(seed):
+    """Randomized pod (anti-)affinity on existing AND pending pods, all
+    topology keys incl. empty (= any default failure domain), namespaces
+    modes, weight-0 terms, commitment threading mid-backlog."""
+    rng = random.Random(1000 + seed)
+    state, pending = random_scenario(
+        rng, n_nodes=8, n_existing=10, n_pending=15, interpod_p=0.6
+    )
+    oracle_result, tpu_result = run_both(state, pending)
+    assert tpu_result == oracle_result, (
+        f"seed {seed}: first divergence at "
+        f"{next(i for i, (a, b) in enumerate(zip(oracle_result, tpu_result)) if a != b)}"
+    )
+
+
+def _affinity_nodes(n=4):
+    zones = ["a", "a", "b", "b"]
+    return [
+        Node(
+            metadata=ObjectMeta(
+                name=f"node-{i}",
+                labels={
+                    "kubernetes.io/hostname": f"node-{i}",
+                    ZONE: zones[i % len(zones)],
+                    REGION: "r1",
+                },
+            ),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _aff_pod(name, labels, affinity=None, node=None):
+    from kubernetes_tpu.api.types import PodSpec
+
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": "100m"})],
+            affinity=affinity,
+            node_name=node,
+        ),
+    )
+
+
+def test_interpod_first_pod_of_collection_escape():
+    """predicates.go:819-843: a hard-affinity term matching no pod anywhere
+    is waived iff the pod matches its own term."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+    )
+
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "solo"}),
+        topology_key=ZONE,
+    )
+    aff = Affinity(
+        pod_affinity=PodAffinity(
+            required_during_scheduling_ignored_during_execution=(term,)
+        )
+    )
+    state = ClusterState.build(_affinity_nodes())
+    # first pod self-matches -> escape applies -> schedules; second pod
+    # then finds the first co-located; a non-self-matching pod with the
+    # same term must follow the collection, and a pod whose term matches
+    # nothing and not itself is unschedulable.
+    pods = [
+        _aff_pod("first", {"app": "solo"}, aff),
+        _aff_pod("second", {"app": "solo"}, aff),
+        _aff_pod("follower", {"app": "other"}, aff),
+        _aff_pod(
+            "lost",
+            {"app": "other"},
+            Affinity(
+                pod_affinity=PodAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            label_selector=LabelSelector(
+                                match_labels={"app": "nonexistent"}
+                            ),
+                            topology_key=ZONE,
+                        ),
+                    )
+                )
+            ),
+        ),
+    ]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert oracle_result[0] is not None
+    assert oracle_result[3] is None
+    # followers landed in the first pod's zone
+    zone_of = {f"node-{i}": ["a", "a", "b", "b"][i] for i in range(4)}
+    assert zone_of[oracle_result[1]] == zone_of[oracle_result[0]]
+    assert zone_of[oracle_result[2]] == zone_of[oracle_result[0]]
+
+
+def test_interpod_symmetric_anti_affinity():
+    """predicates.go:858-921: an ASSIGNED pod's hard anti-affinity term
+    keeps matching pods out of its topology domain (symmetry) — both for
+    preexisting pods and for pods committed mid-backlog."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAntiAffinity,
+        PodAffinityTerm,
+    )
+
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                    topology_key=ZONE,
+                ),
+            )
+        )
+    )
+    state = ClusterState.build(
+        _affinity_nodes(),
+        assigned_pods=[_aff_pod("guard", {"app": "db"}, anti, node="node-0")],
+    )
+    pods = [
+        _aff_pod("web-2", {"app": "web"}, anti),  # must avoid zone a (guard)
+        _aff_pod("web-1", {"app": "web"}),  # no own anti: symmetric check is
+        # gated on the pod having anti-affinity => schedules anywhere
+    ]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    zone_of = {f"node-{i}": ["a", "a", "b", "b"][i] for i in range(4)}
+    assert zone_of[oracle_result[0]] == "b"
+    assert oracle_result[1] is not None
+
+
+def test_interpod_empty_topology_key_any_default_domain():
+    """util/non_zero.go:97-113: empty topologyKey in anti-affinity means
+    co-location under ANY default failure-domain key."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAntiAffinity,
+        PodAffinityTerm,
+    )
+
+    anti = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                    topology_key="",
+                ),
+            )
+        )
+    )
+    # node-0/1 share zone a + region; node-2/3 share zone b + region — all
+    # four share the region, so an existing web pod anywhere blocks every
+    # node for an anti(web, "") pod.
+    state = ClusterState.build(
+        _affinity_nodes(),
+        assigned_pods=[_aff_pod("w", {"app": "web"}, node="node-3")],
+    )
+    pods = [_aff_pod("p", {"app": "cache"}, anti)]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert oracle_result[0] is None  # region co-location blocks everywhere
+
+
+def test_interpod_priority_reverse_direction():
+    """interpod_affinity.go:128-191: assigned pods' preferred terms pull
+    (or push) the pending pod toward/away from their domains."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        WeightedPodAffinityTerm,
+    )
+
+    want_web_near = Affinity(
+        pod_affinity=PodAffinity(
+            preferred_during_scheduling_ignored_during_execution=(
+                WeightedPodAffinityTerm(
+                    weight=7,
+                    pod_affinity_term=PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                        topology_key=ZONE,
+                    ),
+                ),
+            )
+        )
+    )
+    state = ClusterState.build(
+        _affinity_nodes(),
+        assigned_pods=[
+            _aff_pod("attractor", {"app": "db"}, want_web_near, node="node-2")
+        ],
+    )
+    pods = [_aff_pod("web-1", {"app": "web"})]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    zone_of = {f"node-{i}": ["a", "a", "b", "b"][i] for i in range(4)}
+    assert zone_of[oracle_result[0]] == "b"  # pulled toward the attractor

@@ -41,3 +41,19 @@ def test_mesh_matches_oracle(mesh):
     snap, batch = SnapshotEncoder(state, pending).encode()
     sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
     assert sharded == oracle_result
+
+
+@pytest.mark.parametrize("seed", [2, 9])
+def test_mesh_interpod_affinity_matches_oracle(mesh, seed):
+    """The mesh interpod path (dynamic_slice domain queries, all_gather
+    min/max normalization, replicated table commits, ip_topo_dom padding
+    on a non-divisible node count) must match the serial oracle."""
+    rng = random.Random(seed)
+    state, pending = random_scenario(
+        rng, n_nodes=13, n_existing=10, n_pending=14, interpod_p=0.7
+    )
+    oracle_result, single = run_both(state, pending)
+    assert single == oracle_result  # precondition: single-chip conformance
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
+    assert sharded == oracle_result
