@@ -30,6 +30,7 @@ from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import priorities as R
 from kubernetes_tpu.ops import select as S
+from kubernetes_tpu.ops import volumes as V
 from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 
 # predicate keys (factory/plugins.go registry names)
@@ -37,6 +38,10 @@ GENERAL_PREDICATES = "GeneralPredicates"
 POD_TOLERATES_NODE_TAINTS = "PodToleratesNodeTaints"
 CHECK_NODE_MEMORY_PRESSURE = "CheckNodeMemoryPressure"
 MATCH_INTER_POD_AFFINITY = "MatchInterPodAffinity"
+NO_DISK_CONFLICT = "NoDiskConflict"
+NO_VOLUME_ZONE_CONFLICT = "NoVolumeZoneConflict"
+MAX_EBS_VOLUME_COUNT = "MaxEBSVolumeCount"
+MAX_GCE_PD_VOLUME_COUNT = "MaxGCEPDVolumeCount"
 
 LEAST_REQUESTED = "LeastRequestedPriority"
 BALANCED_ALLOCATION = "BalancedResourceAllocation"
@@ -45,6 +50,12 @@ NODE_AFFINITY = "NodeAffinityPriority"
 TAINT_TOLERATION = "TaintTolerationPriority"
 INTER_POD_AFFINITY = "InterPodAffinityPriority"
 EQUAL = "EqualPriority"
+IMAGE_LOCALITY = "ImageLocalityPriority"
+# config-parameterized entries (Policy args, api/types.go:60-94) are
+# tuples: ("CheckNodeLabelPresence", (labels...), presence) as a predicate,
+# (("NodeLabelPriority", label, presence), weight) as a priority
+NODE_LABEL_PREDICATE = "CheckNodeLabelPresence"
+NODE_LABEL_PRIORITY = "NodeLabelPriority"
 
 
 @dataclass(frozen=True)
@@ -52,7 +63,13 @@ class SchedulerConfig:
     """Static (compile-time) algorithm configuration — the analogue of a
     resolved algorithm provider (defaults.go:55 init)."""
 
+    # defaults.go:116 defaultPredicates (full set; order is irrelevant for
+    # fit/no-fit — the masks AND together)
     predicates: Tuple[str, ...] = (
+        NO_DISK_CONFLICT,
+        NO_VOLUME_ZONE_CONFLICT,
+        MAX_EBS_VOLUME_COUNT,
+        MAX_GCE_PD_VOLUME_COUNT,
         GENERAL_PREDICATES,
         POD_TOLERATES_NODE_TAINTS,
         CHECK_NODE_MEMORY_PRESSURE,
@@ -68,6 +85,9 @@ class SchedulerConfig:
     )
     # --hard-pod-affinity-symmetric-weight (options.go:52)
     hard_pod_affinity_weight: int = 1
+    # defaults.go:37-53 (KUBE_MAX_PD_VOLS overrides in the daemon shell)
+    max_ebs_volumes: int = 39
+    max_gce_pd_volumes: int = 16
 
 
 def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
@@ -87,6 +107,10 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         ip_rev_pref,
         ip_rev_anti,
         ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
     ) = carry
     num_nodes = req_mcpu.shape[0]
 
@@ -103,6 +127,37 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
     if want_ip_prio:
         # a bad assigned-pod annotation errors the priority for every pod
         fit = fit & ~pod["ip_poison"]
+    if NO_DISK_CONFLICT in config.predicates:
+        fit = fit & V.no_disk_conflict(
+            pod["vp_vol_rw"], pod["vp_vol_ro"], vol_any, vol_rw
+        )
+    if NO_VOLUME_ZONE_CONFLICT in config.predicates:
+        fit = fit & V.volume_zone(
+            pod["vp_vz_zone"],
+            pod["vp_vz_region"],
+            pod["vp_vz_fail"],
+            static["vz_zone"],
+            static["vz_region"],
+            static["vz_has"],
+        )
+    if MAX_EBS_VOLUME_COUNT in config.predicates:
+        fit = fit & V.max_pd_count(
+            pod["vp_ebs"],
+            pod["vp_ebs_bad"],
+            pod["vp_has_ebs"],
+            ebs_mask,
+            static["ebs_bad"],
+            config.max_ebs_volumes,
+        )
+    if MAX_GCE_PD_VOLUME_COUNT in config.predicates:
+        fit = fit & V.max_pd_count(
+            pod["vp_gce"],
+            pod["vp_gce_bad"],
+            pod["vp_has_gce"],
+            gce_mask,
+            static["gce_bad"],
+            config.max_gce_pd_volumes,
+        )
     if GENERAL_PREDICATES in config.predicates:
         fit = fit & P.pod_fits_resources(
             pod["req_mcpu"],
@@ -151,6 +206,12 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         fit = fit & P.check_node_memory_pressure(
             pod["best_effort"], static["mem_pressure"]
         )
+    for entry in config.predicates:
+        if isinstance(entry, tuple) and entry[0] == NODE_LABEL_PREDICATE:
+            # per-node static mask resolved host-side (predicates.go:552)
+            for lbl in entry[1]:
+                has = static[f"nl_pred_{lbl}"]
+                fit = fit & (has if entry[2] else ~has)
     if want_ip_pred:
         own_lt = IP.gather_lt(
             ip_own_anti,
@@ -249,6 +310,10 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
             )
         elif name == EQUAL:
             s = R.equal(req_mcpu.shape[0])
+        elif name == IMAGE_LOCALITY:
+            s = R.image_locality(static["img_size"], pod["img_count"])
+        elif isinstance(name, tuple) and name[0] == NODE_LABEL_PRIORITY:
+            s = R.node_label(static[f"nl_prio_{name[1]}"], name[2])
         else:
             raise ValueError(f"unknown priority {name!r}")
         score = score + jnp.int64(weight) * s
@@ -298,6 +363,19 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
             chosen,
             scheduled,
         )
+    if any(
+        k in config.predicates
+        for k in (
+            NO_DISK_CONFLICT,
+            MAX_EBS_VOLUME_COUNT,
+            MAX_GCE_PD_VOLUME_COUNT,
+        )
+    ):
+        sel = jnp.where(scheduled, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        vol_any = vol_any.at[safe].set(vol_any[safe] | ((pod["vp_vol_rw"] | pod["vp_vol_ro"]) & sel))
+        vol_rw = vol_rw.at[safe].set(vol_rw[safe] | (pod["vp_vol_rw"] & sel))
+        ebs_mask = ebs_mask.at[safe].set(ebs_mask[safe] | (pod["vp_ebs"] & sel))
+        gce_mask = gce_mask.at[safe].set(gce_mask[safe] | (pod["vp_gce"] & sel))
 
     carry = (
         req_mcpu,
@@ -315,6 +393,10 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         ip_rev_pref,
         ip_rev_anti,
         ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
     )
     return carry, chosen
 
@@ -379,6 +461,18 @@ class BatchScheduler:
         "ip_has_anti",
         "ip_sym_reject",
         "ip_poison",
+        "vp_vol_rw",
+        "vp_vol_ro",
+        "vp_ebs",
+        "vp_gce",
+        "vp_ebs_bad",
+        "vp_gce_bad",
+        "vp_has_ebs",
+        "vp_has_gce",
+        "vp_vz_zone",
+        "vp_vz_region",
+        "vp_vz_fail",
+        "img_count",
     ]
     STATIC_FIELDS = [
         "alloc_mcpu",
@@ -404,7 +498,28 @@ class BatchScheduler:
         "ip_lt_spec",
         "ip_lt_u",
         "ip_lt_sign",
+        "ebs_bad",
+        "gce_bad",
+        "vz_zone",
+        "vz_region",
+        "vz_has",
+        "img_size",
     ]
+
+    @classmethod
+    def config_static(cls, config: "SchedulerConfig", snap: ClusterSnapshot):
+        """Per-node static arrays for config-parameterized entries
+        (NodeLabel predicates/priorities), resolved from the snapshot's
+        host-side key vocab."""
+        out = {}
+        for entry in config.predicates:
+            if isinstance(entry, tuple) and entry[0] == NODE_LABEL_PREDICATE:
+                for lbl in entry[1]:
+                    out[f"nl_pred_{lbl}"] = jnp.asarray(snap.node_has_key(lbl))
+        for name, _w in config.priorities:
+            if isinstance(name, tuple) and name[0] == NODE_LABEL_PRIORITY:
+                out[f"nl_prio_{name[1]}"] = jnp.asarray(snap.node_has_key(name[1]))
+        return out
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
         self.config = config or SchedulerConfig()
@@ -447,6 +562,10 @@ class BatchScheduler:
             jnp.asarray(snap.ip_rev_pref),
             jnp.asarray(snap.ip_rev_anti),
             jnp.asarray(snap.ip_spec_total),
+            jnp.asarray(snap.vol_any),
+            jnp.asarray(snap.vol_rw),
+            jnp.asarray(snap.ebs_mask),
+            jnp.asarray(snap.gce_mask),
         )
 
     def schedule(
@@ -461,6 +580,7 @@ class BatchScheduler:
                 self.initial_carry(snap, last_node_index),
             )
         static = {f: jnp.asarray(getattr(snap, f)) for f in self.STATIC_FIELDS}
+        static.update(self.config_static(self.config, snap))
         pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
         num_zones = int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1
         # num_zones must cover the vocab; zone ids are dense from encoding

@@ -219,3 +219,29 @@ def taint_toleration(
     counts = (node_taint_count @ pod_intolerable_prefer).astype(jnp.int64)
     max_count = counts.max(where=fit_mask, initial=0)
     return normalize_counts_down(counts, max_count)
+
+
+def image_locality(node_img_size, pod_img_count):
+    """priorities.go:149 ImageLocalityPriority -> i64 (N,).
+
+    Per-container sum of the node-local size of its image (0 when absent),
+    bucketed into 0..10 over the 23MB..1GB range (calculateScoreFromSize,
+    priorities.go:192-207) with Go's integer division."""
+    min_img = jnp.int64(23 * 1024 * 1024)
+    max_img = jnp.int64(1000 * 1024 * 1024)
+    if node_img_size.shape[1] == 0:
+        return jnp.zeros((node_img_size.shape[0],), jnp.int64)
+    sum_size = node_img_size @ pod_img_count  # i64 (N,)
+    mid = 10 * (sum_size - min_img) // (max_img - min_img) + 1
+    return jnp.where(
+        sum_size < min_img,
+        jnp.int64(0),
+        jnp.where(sum_size >= max_img, jnp.int64(10), mid),
+    )
+
+
+def node_label(node_has_key, presence):
+    """priorities.go:99 NewNodeLabelPriority -> i64 (N,): 10 where the
+    key's presence matches the config, else 0 (no normalization)."""
+    match = node_has_key if presence else ~node_has_key
+    return jnp.where(match, jnp.int64(10), jnp.int64(0))

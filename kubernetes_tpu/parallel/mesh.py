@@ -20,6 +20,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 from kubernetes_tpu.models.batch import (
     INTER_POD_AFFINITY,
     MATCH_INTER_POD_AFFINITY,
+    MAX_EBS_VOLUME_COUNT,
+    MAX_GCE_PD_VOLUME_COUNT,
+    NO_DISK_CONFLICT,
+    NO_VOLUME_ZONE_CONFLICT,
     BatchScheduler,
     SchedulerConfig,
 )
@@ -27,6 +31,7 @@ from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import select as S
 from kubernetes_tpu.ops import priorities as R
+from kubernetes_tpu.ops import volumes as V
 from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 
 AXIS = "nodes"
@@ -96,6 +101,10 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         ip_rev_pref,
         ip_rev_anti,
         ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
     ) = carry
 
     shard = jax.lax.axis_index(AXIS)
@@ -119,6 +128,25 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
     fit = ~pod["unschedulable"]
     if want_ip_prio:
         fit = fit & ~pod["ip_poison"]
+    if NO_DISK_CONFLICT in config.predicates:
+        fit = fit & V.no_disk_conflict(
+            pod["vp_vol_rw"], pod["vp_vol_ro"], vol_any, vol_rw
+        )
+    if NO_VOLUME_ZONE_CONFLICT in config.predicates:
+        fit = fit & V.volume_zone(
+            pod["vp_vz_zone"], pod["vp_vz_region"], pod["vp_vz_fail"],
+            static["vz_zone"], static["vz_region"], static["vz_has"],
+        )
+    if MAX_EBS_VOLUME_COUNT in config.predicates:
+        fit = fit & V.max_pd_count(
+            pod["vp_ebs"], pod["vp_ebs_bad"], pod["vp_has_ebs"],
+            ebs_mask, static["ebs_bad"], config.max_ebs_volumes,
+        )
+    if MAX_GCE_PD_VOLUME_COUNT in config.predicates:
+        fit = fit & V.max_pd_count(
+            pod["vp_gce"], pod["vp_gce_bad"], pod["vp_has_gce"],
+            gce_mask, static["gce_bad"], config.max_gce_pd_volumes,
+        )
     fit = fit & P.pod_fits_resources(
         pod["req_mcpu"],
         pod["req_mem"],
@@ -166,6 +194,11 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         static["noschedule_taints"],
     )
     fit = fit & P.check_node_memory_pressure(pod["best_effort"], static["mem_pressure"])
+    for entry in config.predicates:
+        if isinstance(entry, tuple) and entry[0] == "CheckNodeLabelPresence":
+            for lbl in entry[1]:
+                has = static[f"nl_pred_{lbl}"]
+                fit = fit & (has if entry[2] else ~has)
     if want_ip_pred:
         own_lt = IP.gather_lt(
             ip_own_anti, static["ip_u_topo"], topo_local,
@@ -252,6 +285,11 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
             s = IP.interpod_normalize(totals, fit, mx, mn)
         elif name == "EqualPriority":
             s = jnp.ones(req_mcpu.shape, jnp.int64)
+        elif name == "ImageLocalityPriority":
+            # unnormalized: shards score their local nodes independently
+            s = R.image_locality(static["img_size"], pod["img_count"])
+        elif isinstance(name, tuple) and name[0] == "NodeLabelPriority":
+            s = R.node_label(static[f"nl_prio_{name[1]}"], name[2])
         else:
             raise ValueError(name)
         score = score + jnp.int64(weight) * s
@@ -302,11 +340,24 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
             scheduled,
         )
 
+    if any(
+        k in config.predicates
+        for k in (NO_DISK_CONFLICT, MAX_EBS_VOLUME_COUNT, MAX_GCE_PD_VOLUME_COUNT)
+    ):
+        sel = jnp.where(mine, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        vol_any = vol_any.at[safe].set(
+            vol_any[safe] | ((pod["vp_vol_rw"] | pod["vp_vol_ro"]) & sel)
+        )
+        vol_rw = vol_rw.at[safe].set(vol_rw[safe] | (pod["vp_vol_rw"] & sel))
+        ebs_mask = ebs_mask.at[safe].set(ebs_mask[safe] | (pod["vp_ebs"] & sel))
+        gce_mask = gce_mask.at[safe].set(gce_mask[safe] | (pod["vp_gce"] & sel))
+
     carry = (
         req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
         pod_count, port_mask, class_count, last_idx,
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
         ip_spec_total,
+        vol_any, vol_rw, ebs_mask, gce_mask,
     )
     return carry, chosen
 
@@ -381,6 +432,7 @@ class MeshBatchScheduler:
         static = {
             f: jnp.asarray(getattr(snap, f)) for f in BatchScheduler.STATIC_FIELDS
         }
+        static.update(BatchScheduler.config_static(self.config, snap))
         static["name_desc_order_global"] = static.pop("name_desc_order")
         pods = {f: jnp.asarray(getattr(batch, f)) for f in BatchScheduler.POD_FIELDS}
         num_zones = max(int(snap.zone_id.max()) + 1, 1)
@@ -392,10 +444,15 @@ class MeshBatchScheduler:
                 in (
                     "alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods",
                     "has_taints", "taint_bad", "mem_pressure", "zone_id",
+                    "ebs_bad", "gce_bad", "vz_zone", "vz_region", "vz_has",
                 )
+                or k.startswith("nl_")  # config-resolved node-label masks
                 else PSpec(AXIS, None)
                 if k
-                in ("label_kv", "label_key", "numval", "taint_mask", "taint_count")
+                in (
+                    "label_kv", "label_key", "numval", "taint_mask",
+                    "taint_count", "img_size",
+                )
                 else PSpec()  # replicated vocab tables + global order
             )
             for k in static
@@ -405,6 +462,9 @@ class MeshBatchScheduler:
             PSpec(AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
             # interpod count tables: replicated (domain-indexed, not node)
             PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
+            # volume masks: node-axis sharded
+            PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
+            PSpec(AXIS, None),
         )
         pod_specs = {k: PSpec() for k in pods}
 

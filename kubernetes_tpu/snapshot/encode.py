@@ -145,15 +145,40 @@ class ClusterSnapshot:
     ip_lt_u: Optional[np.ndarray] = None  # i32[LT, E]
     ip_lt_sign: Optional[np.ndarray] = None  # i8[LT, E]
     ip_term_count: Optional[np.ndarray] = None  # i32[U, D]
-    ip_own_anti: Optional[np.ndarray] = None  # i32[U, D]
-    ip_rev_hard: Optional[np.ndarray] = None  # i32[U, D]
-    ip_rev_pref: Optional[np.ndarray] = None  # i64[U, D]
-    ip_rev_anti: Optional[np.ndarray] = None  # i64[U, D]
+    ip_own_anti: Optional[np.ndarray] = None  # i32[LT, E, D]
+    ip_rev_hard: Optional[np.ndarray] = None  # i32[LT, E, D]
+    ip_rev_pref: Optional[np.ndarray] = None  # i64[LT, E, D]
+    ip_rev_anti: Optional[np.ndarray] = None  # i64[LT, E, D]
     ip_spec_total: Optional[np.ndarray] = None  # i32[S]
+    # volume predicate program (snapshot/volumes.py). The four masks are
+    # initial carry; bad/zone arrays are static.
+    vol_any: Optional[np.ndarray] = None  # u32[N, VW] carry
+    vol_rw: Optional[np.ndarray] = None  # u32[N, VW] carry
+    ebs_mask: Optional[np.ndarray] = None  # u32[N, EW] carry
+    gce_mask: Optional[np.ndarray] = None  # u32[N, GW] carry
+    ebs_bad: Optional[np.ndarray] = None  # bool[N]
+    gce_bad: Optional[np.ndarray] = None  # bool[N]
+    vz_zone: Optional[np.ndarray] = None  # i32[N]
+    vz_region: Optional[np.ndarray] = None  # i32[N]
+    vz_has: Optional[np.ndarray] = None  # bool[N]
+    # ImageLocalityPriority (priorities.go:149): per-node byte size of each
+    # pending-pod container image (first status.images entry whose names
+    # contain it, priorities.go:155-160)
+    img_size: Optional[np.ndarray] = None  # i64[N, CI]
+    # host-only metadata (NOT shipped to device): vocab maps used to
+    # resolve config-parameterized predicates (NodeLabel…) at schedule time
+    key_ids: Optional[Dict[str, int]] = None
 
     @property
     def num_nodes(self) -> int:
         return len(self.node_names)
+
+    def node_has_key(self, label: str) -> np.ndarray:
+        """bool[N]: node carries the label key (from the key bitset)."""
+        kid = (self.key_ids or {}).get(label, -1)
+        if kid < 0:
+            return np.zeros(len(self.node_names), bool)
+        return (self.label_key[:, kid // 32] >> np.uint32(kid % 32)) & 1 == 1
 
 
 @dataclass
@@ -227,6 +252,20 @@ class PodBatch:
     # InterPodAffinityPriority aborts the cycle for EVERY pod when any
     # assigned pod's affinity annotation fails to parse
     ip_poison: Optional[np.ndarray] = None  # bool[P]
+    # volume predicate per-pod program (snapshot/volumes.py)
+    vp_vol_rw: Optional[np.ndarray] = None  # u32[P, VW]
+    vp_vol_ro: Optional[np.ndarray] = None  # u32[P, VW]
+    vp_ebs: Optional[np.ndarray] = None  # u32[P, EW]
+    vp_gce: Optional[np.ndarray] = None  # u32[P, GW]
+    vp_ebs_bad: Optional[np.ndarray] = None  # bool[P]
+    vp_gce_bad: Optional[np.ndarray] = None  # bool[P]
+    vp_has_ebs: Optional[np.ndarray] = None  # bool[P]
+    vp_has_gce: Optional[np.ndarray] = None  # bool[P]
+    vp_vz_zone: Optional[np.ndarray] = None  # i32[P]
+    vp_vz_region: Optional[np.ndarray] = None  # i32[P]
+    vp_vz_fail: Optional[np.ndarray] = None  # bool[P]
+    # container-image name usage counts (ImageLocalityPriority)
+    img_count: Optional[np.ndarray] = None  # i64[P, CI]
 
     @property
     def num_pods(self) -> int:
@@ -257,6 +296,7 @@ class SnapshotEncoder:
         self.sets: Dict[frozenset, int] = {}
         self.set_members: List[frozenset] = []
         self._interpod = None
+        self._volumes = None
         self._build_vocabs()
 
     @property
@@ -270,6 +310,17 @@ class SnapshotEncoder:
                 self.state, self.pods, self.node_names
             ).compile()
         return self._interpod
+
+    @property
+    def volumes(self):
+        """Lazily compiled volume predicate program."""
+        if self._volumes is None:
+            from kubernetes_tpu.snapshot.volumes import VolumeCompiler
+
+            self._volumes = VolumeCompiler(
+                self.state, self.pods, self.node_names
+            ).compile()
+        return self._volumes
 
     # -- vocab construction --------------------------------------------------
 
@@ -327,6 +378,10 @@ class SnapshotEncoder:
             return None
 
     def _build_vocabs(self):
+        self.images = _Dict()
+        for pod in self.pods:
+            for c in pod.spec.containers:
+                self.images.get(c.image)
         for name in self.node_names:
             node = self.state.node_infos[name].node
             for k, v in node.metadata.labels.items():
@@ -405,6 +460,17 @@ class SnapshotEncoder:
             ip_rev_pref=self.interpod.rev_pref,
             ip_rev_anti=self.interpod.rev_anti,
             ip_spec_total=self.interpod.spec_total,
+            vol_any=self.volumes.vol_any,
+            vol_rw=self.volumes.vol_rw,
+            ebs_mask=self.volumes.ebs_mask,
+            gce_mask=self.volumes.gce_mask,
+            ebs_bad=self.volumes.ebs_bad,
+            gce_bad=self.volumes.gce_bad,
+            vz_zone=self.volumes.vz_zone,
+            vz_region=self.volumes.vz_region,
+            vz_has=self.volumes.vz_has,
+            img_size=np.zeros((N, max(0, len(self.images))), np.int64),
+            key_ids=dict(self.keys.ids),
         )
         for i, name in enumerate(self.node_names):
             info = self.state.node_infos[name]
@@ -466,6 +532,15 @@ class SnapshotEncoder:
                     snap.mem_pressure[i] = True
             zone = get_zone_key(node)
             snap.zone_id[i] = self.zones.get(zone) if zone else 0
+            # image sizes: first status.images entry containing the name
+            # wins (priorities.go:155-160 breaks at the first match)
+            seen_img = set()
+            for img in node.status.images:
+                for nm in img.names:
+                    iid = self.images.get(nm, add=False)
+                    if iid >= 0 and iid not in seen_img:
+                        snap.img_size[i, iid] = img.size_bytes
+                        seen_img.add(iid)
             # classes
             for pod in info.pods:
                 snap.class_count[i, self.classes.get(self._class_key(pod))] += 1
@@ -623,6 +698,18 @@ class SnapshotEncoder:
             ip_has_anti=self.interpod.has_anti,
             ip_sym_reject=self.interpod.sym_reject,
             ip_poison=np.full(P, self.interpod.poison, bool),
+            vp_vol_rw=self.volumes.p_vol_rw,
+            vp_vol_ro=self.volumes.p_vol_ro,
+            vp_ebs=self.volumes.p_ebs,
+            vp_gce=self.volumes.p_gce,
+            vp_ebs_bad=self.volumes.p_ebs_bad,
+            vp_gce_bad=self.volumes.p_gce_bad,
+            vp_has_ebs=self.volumes.p_has_ebs,
+            vp_has_gce=self.volumes.p_has_gce,
+            vp_vz_zone=self.volumes.p_vz_zone,
+            vp_vz_region=self.volumes.p_vz_region,
+            vp_vz_fail=self.volumes.p_vz_fail,
+            img_count=np.zeros((P, max(0, len(self.images))), np.int64),
         )
         class_list = list(self.classes.ids.keys())
         for i, pod in enumerate(self.pods):
@@ -741,6 +828,10 @@ class SnapshotEncoder:
                     if any(s.matches(lbls) for s in selectors):
                         b.spread_match[i, c_idx] = 1
             b.class_id[i] = self.classes.get(self._class_key(pod))
+            for c in pod.spec.containers:
+                iid = self.images.get(c.image, add=False)
+                if iid >= 0:
+                    b.img_count[i, iid] += 1
         return b
 
     def encode(self) -> Tuple[ClusterSnapshot, PodBatch]:

@@ -194,6 +194,13 @@ class InterPodCompiler:
             return False
         return sel.matches(pod.metadata.labels)
 
+    def _pod_self_match(self, pod: Pod, s: int) -> bool:
+        """First-pod-of-collection self check (predicates.go:826-832):
+        `names.Has(pod.Namespace)` is a LITERAL set membership — the empty
+        all-namespaces set contains nothing, so the escape is denied."""
+        names, sel = self.spec_impl[s]
+        return pod.namespace in names and sel.matches(pod.metadata.labels)
+
     @staticmethod
     def _affinity(pod: Pod):
         """(affinity, parse_ok)."""
@@ -350,7 +357,7 @@ class InterPodCompiler:
                 if aff.pod_affinity is not None:
                     for t in aff.pod_affinity.required_during_scheduling_ignored_during_execution:
                         lt = self._lt_id(pod, t)
-                        ha.append((lt, self._pod_matches_spec(pod, int(lt_spec[lt]))))
+                        ha.append((lt, self._pod_self_match(pod, int(lt_spec[lt]))))
                     for wt in aff.pod_affinity.preferred_during_scheduling_ignored_during_execution:
                         if wt.weight == 0:
                             continue  # interpod_affinity.go:107 skips

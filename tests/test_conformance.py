@@ -44,19 +44,11 @@ from kubernetes_tpu.snapshot.encode import SnapshotEncoder
 ZONE = "failure-domain.beta.kubernetes.io/zone"
 REGION = "failure-domain.beta.kubernetes.io/region"
 
-ORACLE_PREDICATES = (
-    ("GeneralPredicates", opreds.general_predicates),
-    ("PodToleratesNodeTaints", opreds.pod_tolerates_node_taints),
-    ("CheckNodeMemoryPressure", opreds.check_node_memory_pressure),
-    ("MatchInterPodAffinity", opreds.inter_pod_affinity_matches),
-)
-ORACLE_PRIORITIES = (
-    PriorityConfig(oprios.least_requested_priority, 1, "LeastRequestedPriority"),
-    PriorityConfig(oprios.balanced_resource_allocation, 1, "BalancedResourceAllocation"),
-    PriorityConfig(oprios.selector_spread_priority, 1, "SelectorSpreadPriority"),
-    PriorityConfig(oprios.node_affinity_priority, 1, "NodeAffinityPriority"),
-    PriorityConfig(oprios.taint_toleration_priority, 1, "TaintTolerationPriority"),
-    PriorityConfig(oprios.inter_pod_affinity_priority, 1, "InterPodAffinityPriority"),
+# the full default provider (defaults.go) — the device SchedulerConfig
+# default mirrors this exactly
+from kubernetes_tpu.oracle.scheduler import (  # noqa: E402
+    DEFAULT_PREDICATE_ORDER as ORACLE_PREDICATES,
+    DEFAULT_PRIORITIES as ORACLE_PRIORITIES,
 )
 
 
@@ -123,8 +115,116 @@ def random_pod_affinity(rng: random.Random, interpod_p: float):
     )
 
 
+def random_volumes(rng: random.Random, volumes_p: float):
+    """Random EBS/GCE/RBD/PVC volumes over a small shared universe."""
+    from kubernetes_tpu.api.types import (
+        AWSElasticBlockStore,
+        GCEPersistentDisk,
+        PersistentVolumeClaimSource,
+        RBDVolume,
+        Volume,
+    )
+
+    vols = []
+    if rng.random() >= volumes_p:
+        return vols
+    for _ in range(rng.randint(1, 2)):
+        kind = rng.random()
+        if kind < 0.3:
+            vols.append(
+                Volume(
+                    name="v",
+                    gce_persistent_disk=GCEPersistentDisk(
+                        pd_name=rng.choice(["pd-a", "pd-b", "pd-c"]),
+                        read_only=rng.random() < 0.5,
+                    ),
+                )
+            )
+        elif kind < 0.55:
+            vols.append(
+                Volume(
+                    name="v",
+                    aws_elastic_block_store=AWSElasticBlockStore(
+                        volume_id=rng.choice(["vol-1", "vol-2", "vol-3"])
+                    ),
+                )
+            )
+        elif kind < 0.7:
+            vols.append(
+                Volume(
+                    name="v",
+                    rbd=RBDVolume(
+                        monitors=tuple(
+                            rng.sample(["m1", "m2", "m3"], rng.randint(1, 2))
+                        ),
+                        pool=rng.choice(["p1", "p2"]),
+                        image=rng.choice(["img1", "img2"]),
+                    ),
+                )
+            )
+        else:
+            vols.append(
+                Volume(
+                    name="v",
+                    persistent_volume_claim=PersistentVolumeClaimSource(
+                        claim_name=rng.choice(
+                            ["claim-ebs", "claim-gce", "claim-zoned",
+                             "claim-unbound", "claim-missing"]
+                        )
+                    ),
+                )
+            )
+    return vols
+
+
+def scenario_pvs_pvcs():
+    """A fixed PV/PVC universe: bound EBS + GCE + zone-labeled PVs, an
+    unbound PVC, and a claim with no PV."""
+    from kubernetes_tpu.api.types import (
+        AWSElasticBlockStore,
+        GCEPersistentDisk,
+        PersistentVolume,
+        PersistentVolumeClaim,
+    )
+
+    pvs = [
+        PersistentVolume(
+            metadata=ObjectMeta(name="pv-ebs"),
+            aws_elastic_block_store=AWSElasticBlockStore(volume_id="vol-9"),
+        ),
+        PersistentVolume(
+            metadata=ObjectMeta(name="pv-gce"),
+            gce_persistent_disk=GCEPersistentDisk(pd_name="pd-z"),
+        ),
+        PersistentVolume(
+            metadata=ObjectMeta(name="pv-zoned", labels={ZONE: "a", REGION: "r1"}),
+        ),
+    ]
+    pvcs = [
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim-ebs"), volume_name="pv-ebs"
+        ),
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim-gce"), volume_name="pv-gce"
+        ),
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim-zoned"), volume_name="pv-zoned"
+        ),
+        PersistentVolumeClaim(metadata=ObjectMeta(name="claim-unbound")),
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim-missing"), volume_name="pv-gone"
+        ),
+    ]
+    return pvs, pvcs
+
+
 def random_scenario(
-    rng: random.Random, n_nodes=12, n_existing=15, n_pending=25, interpod_p=0.0
+    rng: random.Random,
+    n_nodes=12,
+    n_existing=15,
+    n_pending=25,
+    interpod_p=0.0,
+    volumes_p=0.0,
 ):
     zones = ["a", "b", "c"]
     nodes = []
@@ -193,6 +293,7 @@ def random_scenario(
                     node_name=f"node-{rng.randrange(n_nodes):03d}",
                     containers=rand_containers(),
                     affinity=random_pod_affinity(rng, interpod_p),
+                    volumes=random_volumes(rng, volumes_p),
                 ),
             )
         )
@@ -272,6 +373,7 @@ def random_scenario(
             spec=PodSpec(
                 containers=rand_containers(),
                 affinity=affinity,
+                volumes=random_volumes(rng, volumes_p),
                 **spec_kw,
             ),
         )
@@ -281,8 +383,14 @@ def random_scenario(
             ]
         pending.append(pod)
 
+    pvs, pvcs = scenario_pvs_pvcs() if volumes_p > 0 else ((), ())
     state = ClusterState.build(
-        nodes, assigned_pods=existing, services=services, controllers=controllers
+        nodes,
+        assigned_pods=existing,
+        services=services,
+        controllers=controllers,
+        pvs=pvs,
+        pvcs=pvcs,
     )
     return state, pending
 
@@ -638,3 +746,278 @@ def test_interpod_priority_reverse_direction():
     assert tpu_result == oracle_result
     zone_of = {f"node-{i}": ["a", "a", "b", "b"][i] for i in range(4)}
     assert zone_of[oracle_result[0]] == "b"  # pulled toward the attractor
+
+
+# --- volume predicate conformance -------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_volume_predicates_random_bit_identical(seed):
+    """Randomized EBS/GCE/RBD/PVC volumes on existing and pending pods:
+    NoDiskConflict, NoVolumeZoneConflict, Max{EBS,GCEPD}VolumeCount all
+    active, committed volumes threaded through the backlog."""
+    rng = random.Random(2000 + seed)
+    state, pending = random_scenario(
+        rng, n_nodes=8, n_existing=12, n_pending=16, volumes_p=0.6
+    )
+    oracle_result, tpu_result = run_both(state, pending)
+    assert tpu_result == oracle_result, (
+        f"seed {seed}: first divergence at "
+        f"{next(i for i, (a, b) in enumerate(zip(oracle_result, tpu_result)) if a != b)}"
+    )
+
+
+def test_max_pd_count_commit_threading():
+    """A node fills to the EBS max via COMMITTED pods mid-backlog; later
+    pods with new EBS volumes must go elsewhere (or nowhere)."""
+    from kubernetes_tpu.api.types import AWSElasticBlockStore, Volume
+    from kubernetes_tpu.models.batch import SchedulerConfig
+    from kubernetes_tpu.oracle import GenericScheduler
+    from kubernetes_tpu.oracle import predicates as op
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+    from kubernetes_tpu.oracle import priorities as opr
+
+    # one node, max 2 EBS volumes
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name="only"),
+            status=NodeStatus(
+                allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+    ]
+    state = ClusterState.build(nodes)
+
+    def ebs_pod(name, vol_id):
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "10m"})],
+                volumes=[
+                    Volume(
+                        name="v",
+                        aws_elastic_block_store=AWSElasticBlockStore(volume_id=vol_id),
+                    )
+                ],
+            ),
+        )
+
+    pods = [
+        ebs_pod("a", "vol-1"),
+        ebs_pod("b", "vol-2"),
+        ebs_pod("c", "vol-1"),  # duplicate id: already on node, still fits
+        ebs_pod("d", "vol-3"),  # third distinct id: over max, unschedulable
+    ]
+    oracle = GenericScheduler(
+        predicates=(("MaxEBSVolumeCount", op.max_pd_volume_count("ebs", 2)),),
+        priorities=(PriorityConfig(opr.equal_priority, 1, "EqualPriority"),),
+    )
+    oracle_result = oracle.schedule_backlog(pods, state.clone())
+
+    enc = SnapshotEncoder(state, pods)
+    snap, batch = enc.encode()
+    cfg = SchedulerConfig(
+        predicates=("MaxEBSVolumeCount",),
+        priorities=(("EqualPriority", 1),),
+        max_ebs_volumes=2,
+    )
+    tpu_result = BatchScheduler(cfg).schedule_names(snap, batch)
+    assert tpu_result == oracle_result
+    assert oracle_result == ["only", "only", "only", None]
+
+
+def test_disk_conflict_ro_gce_shared():
+    """GCE PDs are shareable read-only but conflict on any writable use;
+    conflicts must also arise from pods committed mid-backlog."""
+    from kubernetes_tpu.api.types import GCEPersistentDisk, Volume
+
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name=f"n{i}"),
+            status=NodeStatus(
+                allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(2)
+    ]
+    state = ClusterState.build(nodes)
+
+    def gce_pod(name, ro):
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": "10m"})],
+                volumes=[
+                    Volume(
+                        name="v",
+                        gce_persistent_disk=GCEPersistentDisk(
+                            pd_name="pd-x", read_only=ro
+                        ),
+                    )
+                ],
+            ),
+        )
+
+    # two RO users may share; a writer conflicts with both nodes' users
+    pods = [gce_pod("ro1", True), gce_pod("ro2", True), gce_pod("rw", False)]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    # spreading puts ro1/ro2 on different nodes; the writer then conflicts
+    # with a RO user everywhere
+    assert oracle_result[2] is None
+
+
+def test_volume_zone_conflict():
+    """A pod bound to a zone-labeled PV only fits nodes in that zone (or
+    nodes with no zone labels at all)."""
+    from kubernetes_tpu.api.types import PersistentVolumeClaimSource, Volume
+
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name="in-zone", labels={ZONE: "a", REGION: "r1"}),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ),
+        Node(
+            metadata=ObjectMeta(name="off-zone", labels={ZONE: "b", REGION: "r1"}),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ),
+        Node(
+            metadata=ObjectMeta(name="unlabeled"),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ),
+    ]
+    pvs, pvcs = scenario_pvs_pvcs()
+    state = ClusterState.build(nodes, pvs=pvs, pvcs=pvcs)
+    mk = lambda name, claim: Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": "100m"})],
+            volumes=[
+                Volume(
+                    name="v",
+                    persistent_volume_claim=PersistentVolumeClaimSource(
+                        claim_name=claim
+                    ),
+                )
+            ],
+        ),
+    )
+    pods = [mk("zoned-1", "claim-zoned"), mk("zoned-2", "claim-zoned"),
+            mk("zoned-3", "claim-zoned"), mk("broken", "claim-missing")]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert set(oracle_result[:3]) <= {"in-zone", "unlabeled"}
+    # broken claim: VolumeZone alone would pass on the unlabeled node, but
+    # the Max*VolumeCount predicates error on the unresolvable PVC for
+    # EVERY node (predicates.go:312-317) => unschedulable
+    assert oracle_result[3] is None
+
+
+def test_image_locality_and_node_label():
+    """ImageLocalityPriority (legacy alias) and the Policy-configurable
+    CheckNodeLabelPresence / NodeLabelPriority on the device path."""
+    from kubernetes_tpu.api.types import ContainerImage
+    from kubernetes_tpu.oracle import GenericScheduler
+    from kubernetes_tpu.oracle import predicates as op
+    from kubernetes_tpu.oracle import priorities as opr
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+
+    GB = 1024**3
+    nodes = []
+    for i in range(4):
+        labels = {"region": "r1"} if i < 3 else {}
+        images = []
+        if i == 1:
+            images = [ContainerImage(names=("app:v1",), size_bytes=GB)]
+        if i == 2:
+            images = [ContainerImage(names=("app:v1",), size_bytes=200 * 1024**2)]
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(name=f"n{i}", labels=labels),
+                status=NodeStatus(
+                    allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                    conditions=[NodeCondition("Ready", "True")],
+                    images=images,
+                ),
+            )
+        )
+    state = ClusterState.build(nodes)
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}"),
+            spec=PodSpec(
+                containers=[
+                    Container(image="app:v1", requests={"cpu": "100m"})
+                ]
+            ),
+        )
+        for i in range(3)
+    ]
+    oracle = GenericScheduler(
+        predicates=(
+            ("GeneralPredicates", op.general_predicates),
+            ("NodeLabel", op.node_label_predicate(["region"], True)),
+        ),
+        priorities=(
+            PriorityConfig(opr.image_locality_priority, 2, "ImageLocalityPriority"),
+            PriorityConfig(opr.node_label_priority("region", True), 1, "NodeLabelPriority"),
+        ),
+    )
+    oracle_result = oracle.schedule_backlog(pods, state.clone())
+
+    snap, batch = SnapshotEncoder(state, pods).encode()
+    cfg = SchedulerConfig(
+        predicates=(
+            "GeneralPredicates",
+            ("CheckNodeLabelPresence", ("region",), True),
+        ),
+        priorities=(
+            ("ImageLocalityPriority", 2),
+            (("NodeLabelPriority", "region", True), 1),
+        ),
+    )
+    tpu_result = BatchScheduler(cfg).schedule_names(snap, batch)
+    assert tpu_result == oracle_result
+    # n1 has the full 1GB image -> max image score; n3 is excluded by the
+    # label predicate
+    assert oracle_result[0] == "n1"
+    assert "n3" not in oracle_result
+
+
+def test_interpod_escape_denied_for_all_namespaces_term():
+    """predicates.go:826-832: the first-pod escape checks names.Has(ns)
+    LITERALLY — an explicit empty namespaces list ("all namespaces")
+    contains nothing, so the escape never applies to such terms."""
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+    )
+
+    aff = Affinity(
+        pod_affinity=PodAffinity(
+            required_during_scheduling_ignored_during_execution=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "solo"}),
+                    namespaces=(),  # explicit empty == ALL namespaces
+                    topology_key=ZONE,
+                ),
+            )
+        )
+    )
+    state = ClusterState.build(_affinity_nodes())
+    pods = [_aff_pod("self-matching", {"app": "solo"}, aff)]
+    oracle_result, tpu_result = run_both(state, pods)
+    assert tpu_result == oracle_result
+    assert oracle_result == [None]

@@ -57,3 +57,19 @@ def test_mesh_interpod_affinity_matches_oracle(mesh, seed):
     snap, batch = SnapshotEncoder(state, pending).encode()
     sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
     assert sharded == oracle_result
+
+
+@pytest.mark.parametrize("seed", [3])
+def test_mesh_volumes_match_oracle(mesh, seed):
+    """Mesh path with volume predicates active: the sharded volume-mask
+    commit (shard-local indexing) must thread identically to the serial
+    oracle."""
+    rng = random.Random(seed)
+    state, pending = random_scenario(
+        rng, n_nodes=13, n_existing=12, n_pending=14, volumes_p=0.7
+    )
+    oracle_result, single = run_both(state, pending)
+    assert single == oracle_result
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
+    assert sharded == oracle_result
