@@ -47,6 +47,9 @@ class ObjectMeta:
     resource_version: str = ""
     creation_timestamp: Optional[str] = None
     generate_name: str = ""
+    # spec-change sequence number (bumped by the apiserver on non-status
+    # updates of resources that carry one)
+    generation: int = 0
 
     @property
     def full_name(self) -> str:
@@ -62,6 +65,18 @@ class ContainerPort:
 
 
 @dataclass
+class Probe:
+    """pkg/api/types.go Probe (handler flattened: the kubelet's prober
+    seam interprets `handler` — "exec"/"http"/"tcp" — against the runtime)."""
+
+    handler: str = "exec"
+    initial_delay_seconds: int = 0
+    period_seconds: int = 10
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -69,6 +84,9 @@ class Container:
     requests: Dict[str, object] = field(default_factory=dict)
     limits: Dict[str, object] = field(default_factory=dict)
     ports: List[ContainerPort] = field(default_factory=list)
+    command: List[str] = field(default_factory=list)
+    liveness_probe: Optional["Probe"] = None
+    readiness_probe: Optional["Probe"] = None
 
 
 # --- volume sources relevant to scheduling predicates -----------------------
@@ -235,6 +253,12 @@ class PodSpec:
     # parsed by get_affinity/get_tolerations when the field is None.
     affinity: Optional[Affinity] = None
     tolerations: Optional[List[Toleration]] = None
+    restart_policy: str = "Always"  # Always | OnFailure | Never
+    termination_grace_period_seconds: Optional[int] = None
+    # stable network identity (petset/DNS)
+    hostname: str = ""
+    subdomain: str = ""
+    service_account_name: str = ""
 
 
 @dataclass
@@ -330,8 +354,22 @@ class Node:
 
 
 @dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    # int targetPort or a named container port (intstr.IntOrString)
+    target_port: object = 0
+    node_port: int = 0
+
+
+@dataclass
 class ServiceSpec:
     selector: Dict[str, str] = field(default_factory=dict)
+    ports: List["ServicePort"] = field(default_factory=list)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+    session_affinity: str = "None"  # None | ClientIP
 
 
 @dataclass
@@ -403,7 +441,10 @@ class Binding:
 
 @dataclass
 class NamespaceSpec:
-    finalizers: List[str] = field(default_factory=lambda: ["kubernetes"])
+    # the "kubernetes" finalizer is stamped at create time by the registry
+    # strategy (registry/namespace/strategy.go PrepareForCreate), NOT as a
+    # type default — an empty list must round-trip as empty
+    finalizers: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -471,7 +512,8 @@ class Event:
 @dataclass
 class JobSpec:
     parallelism: int = 1
-    completions: int = 1
+    # None == "any pod succeeding completes the job" (job/types.go)
+    completions: Optional[int] = 1
     selector: Optional[LabelSelector] = None
     template: Optional[PodTemplateSpec] = None
 
@@ -534,6 +576,125 @@ class DaemonSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
     status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    """pkg/apis/autoscaling/types.go HorizontalPodAutoscalerSpec."""
+
+    # scaleRef: the workload to scale ("ReplicationController" |
+    # "Deployment" | "ReplicaSet") + name, same namespace
+    scale_target_kind: str = "ReplicationController"
+    scale_target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    observed_generation: int = 0
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[str] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec
+    )
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus
+    )
+
+
+@dataclass
+class ResourceQuotaSpec:
+    """pkg/api/types.go ResourceQuotaSpec: hard limits keyed by resource
+    name ("pods", "cpu", "memory", "services", ...)."""
+
+    hard: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, object] = field(default_factory=dict)
+    used: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class PetSetSpec:
+    """pkg/apis/apps/types.go PetSetSpec (the 1.3-era StatefulSet):
+    ordered, stably-named pods <name>-0 .. <name>-<replicas-1>."""
+
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplateSpec] = None
+    service_name: str = ""
+
+
+@dataclass
+class PetSetStatus:
+    replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class PetSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PetSetSpec = field(default_factory=PetSetSpec)
+    status: PetSetStatus = field(default_factory=PetSetStatus)
+
+
+@dataclass
+class LimitRangeItem:
+    """pkg/api/types.go LimitRangeItem (type Container/Pod)."""
+
+    type: str = "Container"
+    max: Dict[str, object] = field(default_factory=dict)
+    min: Dict[str, object] = field(default_factory=dict)
+    default: Dict[str, object] = field(default_factory=dict)
+    default_request: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+@dataclass
+class ServiceAccount:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
 
 
 # --- helpers ----------------------------------------------------------------

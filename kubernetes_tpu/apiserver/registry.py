@@ -50,6 +50,15 @@ def prepare_pod(pod: t.Pod) -> None:
         pod.status.phase = "Pending"
 
 
+def prepare_namespace(ns: t.Namespace) -> None:
+    """registry/namespace/strategy.go PrepareForCreate: every namespace
+    gets the kubernetes finalizer."""
+    if "kubernetes" not in ns.spec.finalizers:
+        ns.spec.finalizers = list(ns.spec.finalizers) + ["kubernetes"]
+    if not ns.status.phase:
+        ns.status.phase = "Active"
+
+
 def validate_pod(pod: t.Pod) -> None:
     if not pod.spec.containers:
         raise ValidationError("spec.containers: required value")
@@ -95,7 +104,7 @@ def default_resources() -> Dict[str, ResourceInfo]:
         ResourceInfo("events", "Event", t.Event, "/events"),
         ResourceInfo(
             "namespaces", "Namespace", t.Namespace, "/namespaces",
-            namespaced=False, has_status=True,
+            namespaced=False, has_status=True, prepare=prepare_namespace,
         ),
         ResourceInfo(
             "replicationcontrollers", "ReplicationController",
@@ -124,5 +133,25 @@ def default_resources() -> Dict[str, ResourceInfo]:
         ResourceInfo(
             "jobs", "Job", t.Job, "/jobs", group="batch", has_status=True
         ),
+        ResourceInfo(
+            "horizontalpodautoscalers", "HorizontalPodAutoscaler",
+            t.HorizontalPodAutoscaler, "/horizontalpodautoscalers",
+            group="autoscaling", has_status=True,
+        ),
+        ResourceInfo(
+            "petsets", "PetSet", t.PetSet, "/petsets", group="apps",
+            has_status=True,
+        ),
+        ResourceInfo(
+            "resourcequotas", "ResourceQuota", t.ResourceQuota,
+            "/resourcequotas", has_status=True,
+        ),
+        ResourceInfo("limitranges", "LimitRange", t.LimitRange, "/limitranges"),
+        ResourceInfo(
+            "serviceaccounts", "ServiceAccount", t.ServiceAccount,
+            "/serviceaccounts",
+        ),
+        ResourceInfo("secrets", "Secret", t.Secret, "/secrets"),
+        ResourceInfo("configmaps", "ConfigMap", t.ConfigMap, "/configmaps"),
     ]
     return {info.resource: info for info in infos}

@@ -176,8 +176,12 @@ class APIServer:
         if not self._auto_ns or not name:
             return
         if self.get_namespace(name) is None:
+            from kubernetes_tpu.apiserver.registry import prepare_namespace
+
             ns = t.Namespace(metadata=t.ObjectMeta(name=name, namespace=""))
             prepare_meta(ns)
+            prepare_namespace(ns)  # finalizer-gated deletion applies to
+            # auto-provisioned namespaces too
             try:
                 self.store.create(f"/namespaces/{name}", ns)
             except KeyExists:
@@ -388,12 +392,20 @@ class APIServer:
             # PrepareForStatusUpdate idiom)
             cur.status = new.status
             new = cur
+        elif subresource == "finalize":
+            # namespaces/{name}/finalize: only spec.finalizers moves
+            # (registry/namespace/rest.go FinalizeREST)
+            cur.spec.finalizers = list(new.spec.finalizers)
+            new = cur
         else:
             # preserve immutable meta
             new.metadata.uid = cur.metadata.uid
             new.metadata.creation_timestamp = cur.metadata.creation_timestamp
             new.metadata.namespace = cur.metadata.namespace
             new.metadata.name = cur.metadata.name
+            new.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+            # spec updates bump the generation sequence
+            new.metadata.generation = cur.metadata.generation + 1
             if info.has_status:
                 # status never moves through the main resource (pod
                 # strategy PrepareForUpdate copies old status forward)
@@ -437,7 +449,23 @@ class APIServer:
 
     def _delete(self, info: ResourceInfo, ns: str, name: str):
         self.admission.admit(adm.DELETE, info.resource, ns, None)
-        obj = self.store.delete(info.key(ns, name))
+        key = info.key(ns, name)
+        if info.resource == "namespaces":
+            # namespace deletion is finalizer-gated: the first DELETE only
+            # stamps deletionTimestamp; the object disappears once the
+            # namespace controller strips the finalizers
+            # (registry/namespace/etcd/etcd.go Delete)
+            cur, _rv = self.store.get(key)
+            if cur.spec.finalizers and cur.metadata.deletion_timestamp is None:
+                def stamp(obj):
+                    from kubernetes_tpu.apiserver.registry import now_rfc3339
+
+                    obj.metadata.deletion_timestamp = now_rfc3339()
+                    return obj
+
+                self.store.guaranteed_update(key, stamp)
+                return 200, self.scheme.encode(self.store.get(key)[0])
+        obj = self.store.delete(key)
         return 200, self.scheme.encode(obj)
 
     def _bind(self, ns: str, pod_name: str, body):

@@ -1,0 +1,124 @@
+"""The controller manager (cmd/kube-controller-manager/app/
+controllermanager.go StartControllers:197): one process starting every
+reconciliation loop over a shared informer factory."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from kubernetes_tpu.client.record import EventBroadcaster, EventSink
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.controller.autoscale import (
+    HorizontalController,
+    MetricsClient,
+    ResourceQuotaController,
+)
+from kubernetes_tpu.controller.daemonset import DaemonSetsController
+from kubernetes_tpu.controller.deployment import DeploymentController
+from kubernetes_tpu.controller.endpoints import EndpointsController
+from kubernetes_tpu.controller.framework import SharedInformerFactory
+from kubernetes_tpu.controller.gc import NamespaceController, PodGCController
+from kubernetes_tpu.controller.job import JobController
+from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
+from kubernetes_tpu.controller.petset import PetSetController
+from kubernetes_tpu.controller.replication import (
+    ReplicationManager,
+    new_replicaset_manager,
+)
+
+
+@dataclass
+class ControllerManagerOptions:
+    """componentconfig KubeControllerManagerConfiguration subset."""
+
+    node_monitor_grace_period: float = 40.0
+    pod_eviction_timeout: float = 300.0
+    node_eviction_rate: float = 0.1
+    terminated_pod_gc_threshold: int = 12500
+    node_monitor_period: float = 5.0
+    enable: tuple = (
+        "endpoints",
+        "replication",
+        "podgc",
+        "node",
+        "namespace",
+        "daemonset",
+        "job",
+        "deployment",
+        "replicaset",
+        "petset",
+        "resourcequota",
+    )  # hpa omitted by default: it needs a metrics client
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        client: RESTClient,
+        options: Optional[ControllerManagerOptions] = None,
+        metrics_client: Optional[MetricsClient] = None,
+    ):
+        self.client = client
+        self.options = options or ControllerManagerOptions()
+        self.informers = SharedInformerFactory(client)
+        broadcaster = EventBroadcaster()
+        broadcaster.start_recording_to_sink(EventSink(client))
+        self._broadcaster = broadcaster
+        rec = lambda component: broadcaster.new_recorder(component)
+        o, enabled = self.options, set(self.options.enable)
+        self.controllers: List[object] = []
+
+        def add(name, ctor):
+            if name in enabled:
+                self.controllers.append(ctor())
+
+        add("endpoints", lambda: EndpointsController(
+            client, self.informers, rec("endpoint-controller")))
+        add("replication", lambda: ReplicationManager(
+            client, self.informers, rec("replication-controller")))
+        add("replicaset", lambda: new_replicaset_manager(
+            client, self.informers, rec("replicaset-controller")))
+        add("deployment", lambda: DeploymentController(
+            client, self.informers, rec("deployment-controller")))
+        add("job", lambda: JobController(
+            client, self.informers, rec("job-controller")))
+        add("daemonset", lambda: DaemonSetsController(
+            client, self.informers, rec("daemonset-controller")))
+        add("podgc", lambda: PodGCController(
+            client, self.informers, o.terminated_pod_gc_threshold))
+        add("namespace", lambda: NamespaceController(client, self.informers))
+        add("node", lambda: NodeLifecycleController(
+            client, self.informers, rec("node-controller"),
+            node_monitor_grace_period=o.node_monitor_grace_period,
+            pod_eviction_timeout=o.pod_eviction_timeout,
+            eviction_qps=o.node_eviction_rate))
+        add("petset", lambda: PetSetController(
+            client, self.informers, rec("petset-controller")))
+        add("resourcequota", lambda: ResourceQuotaController(
+            client, self.informers))
+        if metrics_client is not None:
+            self.controllers.append(
+                HorizontalController(
+                    client, self.informers, metrics_client,
+                    rec("horizontal-pod-autoscaler"),
+                )
+            )
+
+    def start(self) -> "ControllerManager":
+        self.informers.start()
+        self.informers.wait_for_sync()
+        for c in self.controllers:
+            if isinstance(c, NodeLifecycleController):
+                c.run(self.options.node_monitor_period)
+            else:
+                c.run()
+        return self
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            try:
+                c.stop()
+            except Exception:
+                pass
+        self.informers.stop()

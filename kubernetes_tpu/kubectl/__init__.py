@@ -1,0 +1,9 @@
+"""The CLI (pkg/kubectl + cmd/kubectl analogue).
+
+`python -m kubernetes_tpu.kubectl --server http://... <verb> ...` — or
+embed `Kubectl(client)` programmatically (the CLI is a thin shell over
+the same REST client every other component uses)."""
+
+from kubernetes_tpu.kubectl.cmd import Kubectl, main
+
+__all__ = ["Kubectl", "main"]

@@ -1,0 +1,3 @@
+from kubernetes_tpu.kubectl.cmd import main
+
+main()

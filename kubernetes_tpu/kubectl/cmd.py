@@ -1,0 +1,492 @@
+"""kubectl verbs (pkg/kubectl/cmd/*.go).
+
+Supported: get, describe, create -f, apply -f, delete, scale, label,
+annotate, cordon, uncordon, drain, run, expose, rollout-status, version.
+Resource name aliases follow kubectl shortcuts (po, no, svc, rc, rs,
+deploy, ds, ns, ev, hpa...)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.client.transport import HTTPTransport
+from kubernetes_tpu.kubectl.printers import print_table
+from kubernetes_tpu.runtime.scheme import scheme
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "rc": "replicationcontrollers", "replicationcontroller": "replicationcontrollers",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "deploy": "deployments", "deployment": "deployments",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "job": "jobs",
+    "ns": "namespaces", "namespace": "namespaces",
+    "ev": "events", "event": "events",
+    "ep": "endpoints",
+    "hpa": "horizontalpodautoscalers",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "petset": "petsets",
+    "secret": "secrets", "configmap": "configmaps", "cm": "configmaps",
+    "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
+    "limits": "limitranges", "limitrange": "limitranges",
+}
+
+SCALABLE = {
+    "replicationcontrollers": "ReplicationController",
+    "replicasets": "ReplicaSet",
+    "deployments": "Deployment",
+    "petsets": "PetSet",
+    "jobs": "Job",
+}
+
+_KIND_TO_RESOURCE = {
+    "Pod": "pods", "Node": "nodes", "Service": "services",
+    "ReplicationController": "replicationcontrollers",
+    "ReplicaSet": "replicasets", "Deployment": "deployments",
+    "DaemonSet": "daemonsets", "Job": "jobs", "Namespace": "namespaces",
+    "Endpoints": "endpoints", "Event": "events",
+    "PersistentVolume": "persistentvolumes",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "HorizontalPodAutoscaler": "horizontalpodautoscalers",
+    "PetSet": "petsets", "ResourceQuota": "resourcequotas",
+    "LimitRange": "limitranges", "ServiceAccount": "serviceaccounts",
+    "Secret": "secrets", "ConfigMap": "configmaps",
+}
+
+
+def resolve(resource: str) -> str:
+    return ALIASES.get(resource.lower(), resource.lower())
+
+
+class Kubectl:
+    """All verbs as methods returning output strings (testable without a
+    process boundary; main() is the argv shell)."""
+
+    def __init__(self, client: RESTClient, namespace: str = "default"):
+        self.client = client
+        self.namespace = namespace
+
+    def _rc(self, resource: str, all_namespaces: bool = False):
+        return self.client.resource(
+            resource, "" if all_namespaces else self.namespace
+        )
+
+    # -- read verbs ----------------------------------------------------------
+
+    def get(
+        self,
+        resource: str,
+        name: str = "",
+        selector: str = "",
+        output: str = "",
+        all_namespaces: bool = False,
+    ) -> str:
+        resource = resolve(resource)
+        rc = self._rc(resource, all_namespaces)
+        if name:
+            objs = [rc.get(name)]
+        else:
+            objs, _rv = rc.list(label_selector=selector)
+            objs.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        if output == "json":
+            items = [scheme.encode(o) for o in objs]
+            if name:
+                return json.dumps(items[0], indent=2, sort_keys=True)
+            return json.dumps(
+                {"kind": "List", "items": items}, indent=2, sort_keys=True
+            )
+        if output == "name":
+            return "\n".join(f"{resource}/{o.metadata.name}" for o in objs)
+        if output == "yaml":
+            import yaml
+
+            items = [scheme.encode(o) for o in objs]
+            return yaml.safe_dump(
+                items[0] if name else {"kind": "List", "items": items},
+                sort_keys=True,
+            )
+        return print_table(resource, objs, namespace_col=all_namespaces)
+
+    def describe(self, resource: str, name: str) -> str:
+        resource = resolve(resource)
+        obj = self._rc(resource).get(name)
+        lines = [
+            f"Name:\t{obj.metadata.name}",
+            f"Namespace:\t{obj.metadata.namespace or '<none>'}",
+            f"Labels:\t{','.join(f'{k}={v}' for k, v in obj.metadata.labels.items()) or '<none>'}",
+            f"Annotations:\t{','.join(f'{k}={v}' for k, v in obj.metadata.annotations.items()) or '<none>'}",
+        ]
+        if resource == "pods":
+            lines += [
+                f"Node:\t{obj.spec.node_name or '<none>'}",
+                f"Status:\t{obj.status.phase}",
+                f"IP:\t{obj.status.pod_ip or '<none>'}",
+                "Containers:",
+            ]
+            for c in obj.spec.containers:
+                lines.append(f"  {c.name or '<unnamed>'}:")
+                lines.append(f"    Image:\t{c.image or '<none>'}")
+                if c.requests:
+                    reqs = ", ".join(f"{k}={v}" for k, v in c.requests.items())
+                    lines.append(f"    Requests:\t{reqs}")
+        elif resource == "nodes":
+            lines.append("Conditions:")
+            for c in obj.status.conditions:
+                lines.append(f"  {c.type}\t{c.status}\t{c.reason}")
+            alloc = ", ".join(
+                f"{k}={v}" for k, v in obj.status.allocatable.items()
+            )
+            lines.append(f"Allocatable:\t{alloc}")
+            lines.append(f"Unschedulable:\t{obj.spec.unschedulable}")
+        # events for the object (describe.go tail)
+        events, _ = self.client.resource(
+            "events", obj.metadata.namespace or "default"
+        ).list()
+        related = [
+            e for e in events if e.involved_object.name == obj.metadata.name
+        ]
+        if related:
+            lines.append("Events:")
+            for e in related[-10:]:
+                lines.append(
+                    f"  {e.type}\t{e.reason}\t{e.source_component}\t{e.message}"
+                )
+        return "\n".join(lines)
+
+    # -- write verbs ---------------------------------------------------------
+
+    def _load_manifests(self, path: str) -> List[Any]:
+        if path == "-":
+            raw = sys.stdin.read()
+        else:
+            with open(path) as f:
+                raw = f.read()
+        docs: List[Dict] = []
+        if raw.lstrip().startswith(("{", "[")):
+            data = json.loads(raw)
+            docs = data if isinstance(data, list) else [data]
+        else:
+            import yaml
+
+            docs = [d for d in yaml.safe_load_all(raw) if d]
+        out = []
+        for d in docs:
+            if d.get("kind") == "List":
+                docs.extend(d.get("items", []))
+                continue
+            out.append(scheme.decode(d))
+        return out
+
+    def _resource_for(self, obj: Any) -> str:
+        kind = scheme.kind_for(obj) or type(obj).__name__
+        return _KIND_TO_RESOURCE[kind]
+
+    def create(self, filename: str) -> str:
+        out = []
+        for obj in self._load_manifests(filename):
+            resource = self._resource_for(obj)
+            ns = obj.metadata.namespace or self.namespace
+            created = self.client.resource(resource, ns).create(obj)
+            out.append(f"{resource}/{created.metadata.name} created")
+        return "\n".join(out)
+
+    def apply(self, filename: str) -> str:
+        """apply.go-lite: create or replace-spec by name."""
+        out = []
+        for obj in self._load_manifests(filename):
+            resource = self._resource_for(obj)
+            ns = obj.metadata.namespace or self.namespace
+            rc = self.client.resource(resource, ns)
+            try:
+                existing = rc.get(obj.metadata.name)
+            except APIStatusError as e:
+                if e.code != 404:
+                    raise
+                created = rc.create(obj)
+                out.append(f"{resource}/{created.metadata.name} created")
+                continue
+            obj.metadata.resource_version = existing.metadata.resource_version
+            rc.update(obj)
+            out.append(f"{resource}/{obj.metadata.name} configured")
+        return "\n".join(out)
+
+    def delete(
+        self, resource: str = "", name: str = "", filename: str = "",
+        selector: str = "",
+    ) -> str:
+        out = []
+        if filename:
+            for obj in self._load_manifests(filename):
+                r = self._resource_for(obj)
+                ns = obj.metadata.namespace or self.namespace
+                self.client.resource(r, ns).delete(obj.metadata.name)
+                out.append(f"{r}/{obj.metadata.name} deleted")
+            return "\n".join(out)
+        resource = resolve(resource)
+        rc = self._rc(resource)
+        names = (
+            [name]
+            if name
+            else [o.metadata.name for o in rc.list(label_selector=selector)[0]]
+        )
+        for n in names:
+            rc.delete(n)
+            out.append(f"{resource}/{n} deleted")
+        return "\n".join(out)
+
+    def scale(self, resource: str, name: str, replicas: int) -> str:
+        resource = resolve(resource)
+        if resource not in SCALABLE:
+            raise ValueError(f"{resource} is not scalable")
+        rc = self._rc(resource)
+        for _ in range(10):
+            obj = rc.get(name)
+            if resource == "jobs":
+                obj.spec.parallelism = replicas
+            else:
+                obj.spec.replicas = replicas
+            try:
+                rc.update(obj)
+                return f"{resource}/{name} scaled"
+            except APIStatusError as e:
+                if e.code != 409:
+                    raise
+                time.sleep(0.05)
+        raise RuntimeError("scale kept conflicting")
+
+    def _edit_meta(self, resource, name, mutate) -> None:
+        rc = self._rc(resolve(resource))
+        for _ in range(10):
+            obj = rc.get(name)
+            mutate(obj)
+            try:
+                rc.update(obj)
+                return
+            except APIStatusError as e:
+                if e.code != 409:
+                    raise
+                time.sleep(0.05)
+        raise RuntimeError("update kept conflicting")
+
+    def label(self, resource: str, name: str, *pairs: str) -> str:
+        def mutate(obj):
+            for pair in pairs:
+                if pair.endswith("-"):
+                    obj.metadata.labels.pop(pair[:-1], None)
+                else:
+                    k, v = pair.split("=", 1)
+                    obj.metadata.labels[k] = v
+
+        self._edit_meta(resource, name, mutate)
+        return f"{resolve(resource)}/{name} labeled"
+
+    def annotate(self, resource: str, name: str, *pairs: str) -> str:
+        def mutate(obj):
+            for pair in pairs:
+                if pair.endswith("-"):
+                    obj.metadata.annotations.pop(pair[:-1], None)
+                else:
+                    k, v = pair.split("=", 1)
+                    obj.metadata.annotations[k] = v
+
+        self._edit_meta(resource, name, mutate)
+        return f"{resolve(resource)}/{name} annotated"
+
+    # -- node ops (cordon.go / drain.go) --------------------------------------
+
+    def cordon(self, node: str) -> str:
+        self._edit_meta("nodes", node, lambda n: setattr(n.spec, "unschedulable", True))
+        return f"node/{node} cordoned"
+
+    def uncordon(self, node: str) -> str:
+        self._edit_meta(
+            "nodes", node, lambda n: setattr(n.spec, "unschedulable", False)
+        )
+        return f"node/{node} uncordoned"
+
+    def drain(self, node: str) -> str:
+        """cordon + delete the node's non-daemon pods (drain.go)."""
+        self.cordon(node)
+        deleted = []
+        pods, _ = self.client.resource("pods", "").list(
+            field_selector=f"spec.nodeName={node}"
+        )
+        for p in pods:
+            created_by = p.metadata.annotations.get("kubernetes.io/created-by", "")
+            if created_by.startswith("DaemonSet/"):
+                continue  # daemons are left (they'd be recreated anyway)
+            self.client.pods(p.metadata.namespace).delete(p.metadata.name)
+            deleted.append(p.metadata.name)
+        return "\n".join(
+            [f"node/{node} cordoned"]
+            + [f"pod/{n} evicted" for n in deleted]
+            + [f"node/{node} drained"]
+        )
+
+    # -- imperative creators (run.go / expose.go) -----------------------------
+
+    def run(self, name: str, image: str = "", replicas: int = 1,
+            labels: str = "") -> str:
+        lbls = dict(p.split("=", 1) for p in labels.split(",") if p) or {
+            "run": name
+        }
+        rc = t.ReplicationController(
+            metadata=t.ObjectMeta(name=name, namespace=self.namespace),
+            spec=t.ReplicationControllerSpec(
+                replicas=replicas,
+                selector=dict(lbls),
+                template=t.PodTemplateSpec(
+                    metadata=t.ObjectMeta(labels=dict(lbls)),
+                    spec=t.PodSpec(containers=[t.Container(name=name, image=image)]),
+                ),
+            ),
+        )
+        self._rc("replicationcontrollers").create(rc)
+        return f"replicationcontroller/{name} created"
+
+    def expose(self, resource: str, name: str, port: int,
+               target_port: int = 0) -> str:
+        resource = resolve(resource)
+        obj = self._rc(resource).get(name)
+        if resource == "replicationcontrollers":
+            selector = dict(obj.spec.selector)
+        else:
+            selector = dict(obj.spec.selector.match_labels)
+        svc = t.Service(
+            metadata=t.ObjectMeta(name=name, namespace=self.namespace),
+            spec=t.ServiceSpec(
+                selector=selector,
+                ports=[t.ServicePort(port=port, target_port=target_port or port)],
+            ),
+        )
+        self._rc("services").create(svc)
+        return f"service/{name} exposed"
+
+    def rollout_status(self, resource: str, name: str) -> str:
+        resource = resolve(resource)
+        obj = self._rc(resource).get(name)
+        if obj.status.updated_replicas < obj.spec.replicas:
+            return (
+                f"Waiting for rollout to finish: {obj.status.updated_replicas} "
+                f"out of {obj.spec.replicas} new replicas have been updated..."
+            )
+        return f'{resource} "{name}" successfully rolled out'
+
+
+def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = None):
+    parser = argparse.ArgumentParser(prog="kubectl")
+    parser.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    parser.add_argument("--namespace", "-n", default="default")
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    p = sub.add_parser("get")
+    p.add_argument("resource")
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("--selector", "-l", default="")
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--all-namespaces", action="store_true")
+
+    p = sub.add_parser("describe")
+    p.add_argument("resource")
+    p.add_argument("name")
+
+    for verb in ("create", "apply"):
+        p = sub.add_parser(verb)
+        p.add_argument("--filename", "-f", required=True)
+
+    p = sub.add_parser("delete")
+    p.add_argument("resource", nargs="?", default="")
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("--filename", "-f", default="")
+    p.add_argument("--selector", "-l", default="")
+
+    p = sub.add_parser("scale")
+    p.add_argument("target")  # resource/name
+    p.add_argument("--replicas", type=int, required=True)
+
+    for verb in ("label", "annotate"):
+        p = sub.add_parser(verb)
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+")
+
+    for verb in ("cordon", "uncordon", "drain"):
+        p = sub.add_parser(verb)
+        p.add_argument("node")
+
+    p = sub.add_parser("run")
+    p.add_argument("name")
+    p.add_argument("--image", default="")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--labels", default="")
+
+    p = sub.add_parser("expose")
+    p.add_argument("target")  # resource/name
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--target-port", type=int, default=0)
+
+    p = sub.add_parser("rollout")
+    p.add_argument("subverb", choices=["status"])
+    p.add_argument("target")
+
+    sub.add_parser("version")
+
+    args = parser.parse_args(argv)
+    if client is None:
+        client = RESTClient(HTTPTransport(args.server))
+    k = Kubectl(client, args.namespace)
+
+    if args.verb == "get":
+        out = k.get(args.resource, args.name, args.selector, args.output,
+                    args.all_namespaces)
+    elif args.verb == "describe":
+        out = k.describe(args.resource, args.name)
+    elif args.verb == "create":
+        out = k.create(args.filename)
+    elif args.verb == "apply":
+        out = k.apply(args.filename)
+    elif args.verb == "delete":
+        out = k.delete(args.resource, args.name, args.filename, args.selector)
+    elif args.verb == "scale":
+        resource, name = args.target.split("/", 1)
+        out = k.scale(resource, name, args.replicas)
+    elif args.verb == "label":
+        out = k.label(args.resource, args.name, *args.pairs)
+    elif args.verb == "annotate":
+        out = k.annotate(args.resource, args.name, *args.pairs)
+    elif args.verb == "cordon":
+        out = k.cordon(args.node)
+    elif args.verb == "uncordon":
+        out = k.uncordon(args.node)
+    elif args.verb == "drain":
+        out = k.drain(args.node)
+    elif args.verb == "run":
+        out = k.run(args.name, args.image, args.replicas, args.labels)
+    elif args.verb == "expose":
+        resource, name = args.target.split("/", 1)
+        out = k.expose(resource, name, args.port, args.target_port)
+    elif args.verb == "rollout":
+        resource, name = args.target.split("/", 1)
+        out = k.rollout_status(resource, name)
+    elif args.verb == "version":
+        out = "kubernetes-tpu v0 (reference parity: kubernetes v1.3-dev)"
+    else:  # pragma: no cover
+        parser.error(f"unknown verb {args.verb}")
+    print(out)
+    return out
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,140 @@
+"""Human-readable table printers (pkg/kubectl/resource_printer.go)."""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, List, Tuple
+
+
+def age(ts) -> str:
+    if not ts:
+        return "<unknown>"
+    try:
+        created = datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        return "<unknown>"
+    secs = int(time.time() - created.timestamp())
+    if secs < 120:
+        return f"{max(secs, 0)}s"
+    if secs < 7200:
+        return f"{secs // 60}m"
+    if secs < 172800:
+        return f"{secs // 3600}h"
+    return f"{secs // 86400}d"
+
+
+def _pod_row(p) -> List[str]:
+    total = len(p.spec.containers)
+    ready = sum(1 for c in p.status.container_statuses if c.ready)
+    restarts = sum(c.restart_count for c in p.status.container_statuses)
+    return [
+        p.metadata.name,
+        f"{ready}/{total}",
+        p.status.phase or "Unknown",
+        str(restarts),
+        age(p.metadata.creation_timestamp),
+    ]
+
+
+def _node_row(n) -> List[str]:
+    ready = "Unknown"
+    for c in n.status.conditions:
+        if c.type == "Ready":
+            ready = "Ready" if c.status == "True" else "NotReady"
+    if n.spec.unschedulable:
+        ready += ",SchedulingDisabled"
+    return [n.metadata.name, ready, age(n.metadata.creation_timestamp)]
+
+
+def _svc_row(s) -> List[str]:
+    ports = ",".join(
+        f"{p.port}/{p.protocol}" for p in s.spec.ports
+    ) or "<none>"
+    return [
+        s.metadata.name,
+        s.spec.cluster_ip or "<none>",
+        ports,
+        age(s.metadata.creation_timestamp),
+    ]
+
+
+def _rc_row(rc) -> List[str]:
+    return [
+        rc.metadata.name,
+        str(rc.spec.replicas),
+        str(rc.status.replicas),
+        age(rc.metadata.creation_timestamp),
+    ]
+
+
+def _deploy_row(d) -> List[str]:
+    return [
+        d.metadata.name,
+        str(d.spec.replicas),
+        str(d.status.replicas),
+        str(d.status.updated_replicas),
+        str(d.status.available_replicas),
+        age(d.metadata.creation_timestamp),
+    ]
+
+
+def _job_row(j) -> List[str]:
+    return [
+        j.metadata.name,
+        str(j.spec.completions if j.spec.completions is not None else "<none>"),
+        str(j.status.succeeded),
+        age(j.metadata.creation_timestamp),
+    ]
+
+
+def _event_row(e) -> List[str]:
+    return [
+        e.last_timestamp or "",
+        str(e.count),
+        f"{e.involved_object.kind}/{e.involved_object.name}",
+        e.type,
+        e.reason,
+        e.source_component,
+        e.message,
+    ]
+
+
+def _generic_row(o) -> List[str]:
+    return [o.metadata.name, age(o.metadata.creation_timestamp)]
+
+
+TABLES: Dict[str, Tuple[List[str], Callable[[Any], List[str]]]] = {
+    "pods": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "AGE"], _node_row),
+    "services": (["NAME", "CLUSTER-IP", "PORT(S)", "AGE"], _svc_row),
+    "replicationcontrollers": (["NAME", "DESIRED", "CURRENT", "AGE"], _rc_row),
+    "replicasets": (["NAME", "DESIRED", "CURRENT", "AGE"], _rc_row),
+    "deployments": (
+        ["NAME", "DESIRED", "CURRENT", "UP-TO-DATE", "AVAILABLE", "AGE"],
+        _deploy_row,
+    ),
+    "jobs": (["NAME", "COMPLETIONS", "SUCCESSFUL", "AGE"], _job_row),
+    "events": (
+        ["LASTSEEN", "COUNT", "OBJECT", "TYPE", "REASON", "SOURCE", "MESSAGE"],
+        _event_row,
+    ),
+}
+
+
+def print_table(resource: str, objs: List[Any], namespace_col: bool = False) -> str:
+    headers, row_fn = TABLES.get(resource, (["NAME", "AGE"], _generic_row))
+    rows = [row_fn(o) for o in objs]
+    if namespace_col:
+        headers = ["NAMESPACE"] + headers
+        rows = [[o.metadata.namespace] + r for o, r in zip(objs, rows)]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = ["   ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()]
+    for r in rows:
+        lines.append("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(lines)

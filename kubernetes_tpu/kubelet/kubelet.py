@@ -1,0 +1,346 @@
+"""The kubelet core (pkg/kubelet/kubelet.go).
+
+syncLoop (kubelet.go:2491) selects over: pod config updates (an apiserver
+watch filtered to spec.nodeName == this node — pkg/kubelet/config), PLEG
+events, and a housekeeping tick. Each pod syncs on its own serialized
+worker (pod_workers.go: one queue per pod, latest-wins), calling syncPod
+(kubelet.go:1734): admit, run containers via the runtime, derive the API
+pod status, hand it to the status manager. Heartbeats: node Ready
+condition refreshed every nodeStatusUpdateFrequency
+(kubelet.go:tryUpdateNodeStatus)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.client.informer import Informer, ResourceEventHandler
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.kubelet.pleg import PLEG, PodLifecycleEvent
+from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
+from kubernetes_tpu.kubelet.status import StatusManager
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class KubeletConfig:
+    node_name: str = ""
+    node_status_update_frequency: float = 10.0  # kubelet.go:10s default
+    sync_frequency: float = 10.0
+    housekeeping_interval: float = 2.0
+    pleg_relist_period: float = 1.0
+    status_sync_period: float = 0.5
+    max_pods: int = 110
+    pod_cidr_ip: str = "10.42.0.0"
+    # node resources advertised in status (hollow nodes fake these, like
+    # kubemark's 4-CPU/32Gi shape, perf/util.go:88-118)
+    allocatable: Dict[str, object] = field(
+        default_factory=lambda: {"cpu": "4", "memory": "32Gi", "pods": "110"}
+    )
+    register_node: bool = True
+
+
+class _PodWorker:
+    """pod_workers.go: one serialized worker per pod, latest update wins."""
+
+    def __init__(self, sync_fn):
+        self._sync = sync_fn
+        self._pending: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def update(self, pod: Optional[t.Pod]) -> None:
+        # collapse to the newest update (managePodLoop semantics)
+        try:
+            self._pending.get_nowait()
+        except queue.Empty:
+            pass
+        self._pending.put(pod)
+
+    def _loop(self) -> None:
+        while True:
+            pod = self._pending.get()
+            if pod is StopIteration:
+                return
+            try:
+                self._sync(pod)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self.update(StopIteration)  # type: ignore[arg-type]
+
+
+class Kubelet:
+    def __init__(
+        self,
+        client: RESTClient,
+        config: KubeletConfig,
+        runtime: Optional[ContainerRuntime] = None,
+        recorder=None,
+    ):
+        self.client = client
+        self.config = config
+        self.runtime = runtime or FakeRuntime()
+        self.recorder = recorder
+        self.status_manager = StatusManager(client)
+        self.pleg = PLEG(self.runtime, config.pleg_relist_period)
+        self._workers: Dict[str, _PodWorker] = {}
+        self._pods: Dict[str, t.Pod] = {}  # uid -> latest spec from config
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._pod_ip_seq = 0
+        self._pod_ips: Dict[str, str] = {}
+        self._start_times: Dict[str, str] = {}
+        # per-node /16-ish pod network: explicit pod_cidr_ip wins, else a
+        # stable hash of the node name keeps IPs distinct across kubelets
+        if config.pod_cidr_ip and config.pod_cidr_ip != "10.42.0.0":
+            octets = config.pod_cidr_ip.split(".")
+            self._ip_base = (octets[0], octets[1])
+        else:
+            import hashlib as _hl
+
+            h = int(_hl.sha1(config.node_name.encode()).hexdigest(), 16)
+            self._ip_base = ("10", str(43 + h % 200))
+        # config source: watch pods bound to this node (kubelet/config/
+        # apiserver.go NewSourceApiserver field selector)
+        self._informer = Informer(
+            client.resource("pods"),
+            field_selector=f"spec.nodeName={config.node_name}",
+            name=f"kubelet-{config.node_name}",
+        )
+        self._informer.add_event_handler(
+            ResourceEventHandler(
+                on_add=self._on_pod_update,
+                on_update=lambda old, new: self._on_pod_update(new),
+                on_delete=self._on_pod_delete,
+            )
+        )
+
+    # -- node registration + heartbeats --------------------------------------
+
+    def _node_object(self) -> t.Node:
+        return t.Node(
+            metadata=t.ObjectMeta(
+                name=self.config.node_name,
+                labels={"kubernetes.io/hostname": self.config.node_name},
+            ),
+            status=t.NodeStatus(
+                capacity=dict(self.config.allocatable),
+                allocatable=dict(self.config.allocatable),
+                conditions=[
+                    t.NodeCondition(
+                        "Ready",
+                        "True",
+                        last_heartbeat_time=_now(),
+                        reason="KubeletReady",
+                    )
+                ],
+            ),
+        )
+
+    def register_node(self) -> None:
+        """kubelet.go registerWithApiserver."""
+        try:
+            self.client.nodes().create(self._node_object())
+        except APIStatusError as e:
+            if e.code != 409:
+                raise
+
+    def update_node_status(self) -> None:
+        """kubelet.go tryUpdateNodeStatus: refresh the Ready heartbeat."""
+        try:
+            node = self.client.nodes().get(self.config.node_name)
+        except APIStatusError:
+            return
+        now = _now()
+        ready = None
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                ready = c
+        if ready is None:
+            ready = t.NodeCondition("Ready", "True")
+            node.status.conditions.append(ready)
+        if ready.status != "True":
+            ready.last_transition_time = now
+        ready.status = "True"
+        ready.reason = "KubeletReady"
+        ready.last_heartbeat_time = now
+        try:
+            self.client.nodes().update_status(node)
+        except APIStatusError:
+            pass
+
+    # -- config handling ------------------------------------------------------
+
+    def _worker_for(self, uid: str) -> _PodWorker:
+        w = self._workers.get(uid)
+        if w is None:
+            w = _PodWorker(self._sync_pod)
+            self._workers[uid] = w
+        return w
+
+    def _on_pod_update(self, pod: t.Pod) -> None:
+        with self._lock:
+            self._pods[pod.metadata.uid] = pod
+            self._worker_for(pod.metadata.uid).update(pod)
+
+    def _on_pod_delete(self, pod: t.Pod) -> None:
+        with self._lock:
+            self._pods.pop(pod.metadata.uid, None)
+            w = self._workers.pop(pod.metadata.uid, None)
+        self.runtime.kill_pod(pod.metadata.uid)
+        self.status_manager.forget(pod.metadata.uid)
+        self._start_times.pop(pod.metadata.uid, None)
+        self._pod_ips.pop(pod.metadata.uid, None)
+        if w is not None:
+            w.stop()
+
+    # -- syncPod --------------------------------------------------------------
+
+    def _pod_ip(self, uid: str) -> str:
+        ip = self._pod_ips.get(uid)
+        if ip is None:
+            self._pod_ip_seq += 1
+            a, b = divmod(self._pod_ip_seq, 254)
+            ip = f"{self._ip_base[0]}.{self._ip_base[1]}.{a % 254}.{b + 1}"
+            self._pod_ips[uid] = ip
+        return ip
+
+    def _sync_pod(self, pod: t.Pod) -> None:
+        """kubelet.go:1734 syncPod (fake-runtime scale): converge runtime,
+        compute API status, queue the status update."""
+        if pod.metadata.deletion_timestamp is not None:
+            self.runtime.kill_pod(pod.metadata.uid)
+            return
+        try:
+            self.runtime.sync_pod(pod)
+        except Exception:
+            status = t.PodStatus(
+                phase="Pending",
+                reason="SyncError",
+                host_ip="",
+            )
+            self.status_manager.set_pod_status(pod, status)
+            raise
+        self.status_manager.set_pod_status(pod, self._generate_status(pod))
+
+    def _generate_status(self, pod: t.Pod) -> t.PodStatus:
+        """kubelet.go generateAPIPodStatus + GetPhase."""
+        rpods = {p.uid: p for p in self.runtime.list_pods()}
+        rp = rpods.get(pod.metadata.uid)
+        statuses: List[t.ContainerStatus] = []
+        running = exited_ok = exited_bad = 0
+        if rp is not None:
+            for c in rp.containers:
+                st = "running" if c.state == "running" else "terminated"
+                statuses.append(
+                    t.ContainerStatus(
+                        name=c.name, ready=c.state == "running", state=st
+                    )
+                )
+                if c.state == "running":
+                    running += 1
+                elif c.exit_code == 0:
+                    exited_ok += 1
+                else:
+                    exited_bad += 1
+        total = len(pod.spec.containers)
+        if rp is None or not statuses:
+            phase = "Pending"
+        elif running > 0:
+            phase = "Running"
+        elif exited_bad > 0 and pod.spec.restart_policy == "Never":
+            phase = "Failed"
+        elif exited_bad == 0 and exited_ok == total and (
+            pod.spec.restart_policy != "Always"
+        ):
+            phase = "Succeeded"
+        elif pod.spec.restart_policy == "Always":
+            phase = "Running"  # restartable containers will come back
+        else:
+            phase = "Failed" if exited_bad else "Succeeded"
+        ready = phase == "Running" and running == total
+        # start_time is set once on the first sync and preserved after
+        # (generateAPIPodStatus keeps the existing status.startTime)
+        start = self._start_times.setdefault(pod.metadata.uid, _now())
+        return t.PodStatus(
+            phase=phase,
+            conditions=[
+                t.PodCondition(type="Ready", status="True" if ready else "False")
+            ],
+            host_ip="",
+            pod_ip=self._pod_ip(pod.metadata.uid) if phase == "Running" else "",
+            start_time=start,
+            container_statuses=statuses,
+        )
+
+    # -- loops ----------------------------------------------------------------
+
+    def _sync_loop(self) -> None:
+        """kubelet.go:2543 syncLoopIteration (PLEG + housekeeping cases;
+        config updates arrive via informer handlers above)."""
+        last_housekeeping = 0.0
+        while not self._stop.is_set():
+            try:
+                ev: PodLifecycleEvent = self.pleg.events.get(timeout=0.2)
+                with self._lock:
+                    pod = self._pods.get(ev.pod_uid)
+                    if pod is not None:
+                        self._worker_for(ev.pod_uid).update(pod)
+            except queue.Empty:
+                pass
+            now = time.monotonic()
+            if now - last_housekeeping > self.config.housekeeping_interval:
+                last_housekeeping = now
+                self._housekeeping()
+
+    def _housekeeping(self) -> None:
+        """HandlePodCleanups: kill runtime pods with no config."""
+        with self._lock:
+            known = set(self._pods)
+        for rp in self.runtime.list_pods():
+            if rp.uid not in known:
+                self.runtime.kill_pod(rp.uid)
+
+    def _status_loop(self) -> None:
+        while not self._stop.wait(self.config.status_sync_period):
+            try:
+                self.status_manager.sync()
+            except Exception:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.config.node_status_update_frequency):
+            self.update_node_status()
+
+    def run(self) -> "Kubelet":
+        """kubelet.go:957 Run."""
+        if self.config.register_node:
+            self.register_node()
+        self._informer.run()
+        self.pleg.run()
+        for target, name in [
+            (self._sync_loop, "kubelet-syncloop"),
+            (self._status_loop, "kubelet-status"),
+            (self._heartbeat_loop, "kubelet-heartbeat"),
+        ]:
+            th = threading.Thread(target=target, name=name, daemon=True)
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.pleg.stop()
+        self._informer.stop()
+        for w in self._workers.values():
+            w.stop()

