@@ -1,0 +1,16 @@
+/* The pod sandbox placeholder (the reference's only C file,
+ * build/pause/pause.c): hold the network namespace open by sleeping
+ * forever; exit cleanly on TERM/INT. */
+#include <signal.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+static void sigdown(int signo) { exit(0); }
+
+int main(void) {
+  signal(SIGINT, sigdown);
+  signal(SIGTERM, sigdown);
+  for (;;)
+    pause();
+  return 1;
+}

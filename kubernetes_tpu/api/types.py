@@ -118,12 +118,18 @@ class PersistentVolumeClaimSource:
 
 
 @dataclass
+class HostPathVolumeSource:
+    path: str = ""
+
+
+@dataclass
 class Volume:
     name: str = ""
     gce_persistent_disk: Optional[GCEPersistentDisk] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStore] = None
     rbd: Optional[RBDVolume] = None
     persistent_volume_claim: Optional[PersistentVolumeClaimSource] = None
+    host_path: Optional["HostPathVolumeSource"] = None
 
 
 @dataclass
@@ -131,12 +137,19 @@ class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     gce_persistent_disk: Optional[GCEPersistentDisk] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStore] = None
+    # spec.capacity ("storage" quantity) + spec.accessModes + claimRef
+    # ("namespace/name" of the bound claim), flattened
+    capacity: Dict[str, object] = field(default_factory=dict)
+    access_modes: Tuple[str, ...] = ()
+    claim_ref: str = ""
 
 
 @dataclass
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     volume_name: str = ""  # bound PV name
+    requests: Dict[str, object] = field(default_factory=dict)
+    access_modes: Tuple[str, ...] = ()
 
 
 # --- affinity ---------------------------------------------------------------

@@ -58,3 +58,142 @@ class NamespaceLifecycle(AdmissionPlugin):
 class AlwaysAdmit(AdmissionPlugin):
     def admit(self, operation, resource, namespace, obj) -> None:
         return
+
+
+class LimitRanger(AdmissionPlugin):
+    """plugin/pkg/admission/limitranger: apply container request defaults
+    from LimitRange objects and enforce min/max bounds on pod CREATE."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def _limit_ranges(self, namespace: str):
+        out = []
+        try:
+            from kubernetes_tpu.storage.store import KeyNotFound  # noqa: F401
+
+            objs, _rv = self._server.store.list(f"/limitranges/{namespace}/")
+            out = objs
+        except Exception:
+            pass
+        return out
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if operation != CREATE or resource != "pods" or obj is None:
+            return
+        from kubernetes_tpu.api.resource import (
+            parse_quantity,
+            resource_list_cpu_milli,
+            resource_list_memory,
+        )
+
+        for lr in self._limit_ranges(namespace):
+            for item in lr.spec.limits:
+                if item.type != "Container":
+                    continue
+                for c in obj.spec.containers:
+                    # defaulting (limitranger.go mergePodResourceRequirements)
+                    for k, v in (item.default_request or item.default).items():
+                        c.requests.setdefault(k, v)
+                    # bounds
+                    cpu = resource_list_cpu_milli(c.requests)
+                    mem = resource_list_memory(c.requests)
+                    max_cpu = resource_list_cpu_milli(item.max) if item.max else None
+                    max_mem = resource_list_memory(item.max) if item.max else None
+                    min_cpu = resource_list_cpu_milli(item.min) if item.min else None
+                    min_mem = resource_list_memory(item.min) if item.min else None
+                    if max_cpu and cpu > max_cpu:
+                        raise AdmissionDenied(
+                            f"maximum cpu usage per Container is "
+                            f"{item.max['cpu']}, but request is {c.requests.get('cpu')}"
+                        )
+                    if max_mem and mem > max_mem:
+                        raise AdmissionDenied(
+                            "maximum memory usage per Container exceeded"
+                        )
+                    if min_cpu and cpu < min_cpu:
+                        raise AdmissionDenied(
+                            "minimum cpu usage per Container not met"
+                        )
+                    if min_mem and mem < min_mem:
+                        raise AdmissionDenied(
+                            "minimum memory usage per Container not met"
+                        )
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/resourcequota: reject pod CREATEs that would
+    exceed any hard limit in the namespace's quotas."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if operation != CREATE or resource != "pods" or obj is None:
+            return
+        try:
+            quotas, _rv = self._server.store.list(f"/resourcequotas/{namespace}/")
+        except Exception:
+            return
+        if not quotas:
+            return
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import pod_resource_request
+
+        pods, _rv = self._server.store.list(f"/pods/{namespace}/")
+        active = [p for p in pods if p.status.phase not in ("Succeeded", "Failed")]
+        new_cpu, new_mem, _ = pod_resource_request(obj)
+        used_cpu = sum(pod_resource_request(p)[0] for p in active)
+        used_mem = sum(pod_resource_request(p)[1] for p in active)
+        for q in quotas:
+            hard = q.spec.hard
+            if "pods" in hard and len(active) + 1 > int(parse_quantity(hard["pods"]).value()):
+                raise AdmissionDenied(
+                    f"exceeded quota: pods={hard['pods']}"
+                )
+            for key in ("cpu", "requests.cpu"):
+                if key in hard:
+                    limit = parse_quantity(hard[key]).milli_value()
+                    if used_cpu + new_cpu > limit:
+                        raise AdmissionDenied(f"exceeded quota: {key}={hard[key]}")
+            for key in ("memory", "requests.memory"):
+                if key in hard:
+                    limit = parse_quantity(hard[key]).value()
+                    if used_mem + new_mem > limit:
+                        raise AdmissionDenied(f"exceeded quota: {key}={hard[key]}")
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/serviceaccount: default the pod's service
+    account to "default"."""
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if operation == CREATE and resource == "pods" and obj is not None:
+            if not obj.spec.service_account_name:
+                obj.spec.service_account_name = "default"
+
+
+class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
+    """plugin/pkg/admission/antiaffinity: hard pod anti-affinity is only
+    allowed with the hostname topology key (admission.go:58-76)."""
+
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if operation != CREATE or resource != "pods" or obj is None:
+            return
+        from kubernetes_tpu.api.types import get_affinity
+
+        try:
+            affinity = get_affinity(obj)
+        except Exception:
+            return  # unparseable annotations fail scheduling, not admission
+        if affinity is None or affinity.pod_anti_affinity is None:
+            return
+        for term in affinity.pod_anti_affinity.required_during_scheduling_ignored_during_execution:
+            if term.topology_key != self.HOSTNAME:
+                raise AdmissionDenied(
+                    "affinity.PodAntiAffinity.RequiredDuringScheduling has "
+                    f"TopologyKey {term.topology_key!r}; only "
+                    f"{self.HOSTNAME!r} is allowed"
+                )

@@ -26,6 +26,36 @@ def start_http_server(api: APIServer, host: str, port: int):
 
         def _dispatch(self, method: str):
             parsed = urlparse(self.path)
+            # authn/authz when the server is configured with them
+            # (handlers.go WithAuthentication/WithAuthorization shape)
+            if getattr(api, "authenticator", None) is not None:
+                from kubernetes_tpu.auth.authn import AuthenticationError
+                from kubernetes_tpu.auth.authz import Attributes
+
+                try:
+                    user = api.authenticator.authenticate(dict(self.headers))
+                except AuthenticationError as e:
+                    self._send_json(401, {"message": str(e)})
+                    return
+                if user is None:
+                    self._send_json(401, {"message": "unauthorized"})
+                    return
+                authorizer = getattr(api, "authorizer", None)
+                if authorizer is not None:
+                    ns, info, _name, _sub = api._route(parsed.path)
+                    attrs = Attributes(
+                        user=user,
+                        verb=method,
+                        resource=info.resource if info else "",
+                        namespace=ns,
+                    )
+                    if not authorizer.authorize(attrs):
+                        self._send_json(
+                            403,
+                            {"message": f"user {user.name!r} cannot "
+                             f"{method} {attrs.resource or parsed.path}"},
+                        )
+                        return
             query = {
                 k: v[0] for k, v in parse_qs(parsed.query).items() if v
             }

@@ -155,6 +155,8 @@ class APIServer:
         store: Optional[MemoryStore] = None,
         scheme=None,
         auto_provision_namespaces: bool = True,
+        authenticator=None,
+        authorizer=None,
     ):
         self.store = store or MemoryStore()
         self.scheme = scheme or default_scheme
@@ -162,6 +164,10 @@ class APIServer:
         self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
         self._auto_ns = auto_provision_namespaces
         self._http_server = None
+        # HTTP-path auth (genericapiserver authn/authz); in-process
+        # transports bypass auth like the reference's integration masters
+        self.authenticator = authenticator
+        self.authorizer = authorizer
 
     # -- namespace helpers ---------------------------------------------------
 

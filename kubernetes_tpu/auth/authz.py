@@ -1,0 +1,118 @@
+"""Authorizers (pkg/auth/authorizer + pkg/auth/authorizer/abac).
+
+ABAC: a policy list where a request is allowed if ANY line matches the
+(user|group, resource, namespace, readonly) attributes — abac.go
+Authorize. Per the v0 policy format, an UNSET property matches any value
+('*' is the explicit spelling of the same); the only mandatory part of a
+line is binding to a user or group."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from kubernetes_tpu.auth.authn import UserInfo
+
+READ_VERBS = {"GET", "HEAD", "OPTIONS", "WATCH"}
+
+
+class Forbidden(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Attributes:
+    user: Optional[UserInfo]
+    verb: str  # HTTP method
+    resource: str
+    namespace: str
+
+    @property
+    def readonly(self) -> bool:
+        return self.verb.upper() in READ_VERBS
+
+
+class Authorizer:
+    def authorize(self, attrs: Attributes) -> bool:
+        raise NotImplementedError
+
+
+class AlwaysAllow(Authorizer):
+    def authorize(self, attrs) -> bool:
+        return True
+
+
+class AlwaysDeny(Authorizer):
+    def authorize(self, attrs) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class ABACPolicy:
+    """One policy line (abac/types.go Policy)."""
+
+    user: str = ""  # username or '*'
+    group: str = ""  # group name or '*'
+    resource: str = ""  # plural resource or '*'
+    namespace: str = ""  # namespace or '*'
+    readonly: bool = False  # True restricts the line to read verbs
+
+    def matches(self, attrs: Attributes) -> bool:
+        name = attrs.user.name if attrs.user else ""
+        groups = attrs.user.groups if attrs.user else ()
+        if self.user and self.user != "*" and self.user != name:
+            return False
+        if self.group and self.group != "*" and self.group not in groups:
+            return False
+        if not self.user and not self.group:
+            return False  # a line must bind to someone
+        if self.resource and self.resource != "*" and self.resource != attrs.resource:
+            return False
+        if (
+            self.namespace
+            and self.namespace != "*"
+            and self.namespace != attrs.namespace
+        ):
+            return False
+        if self.readonly and not attrs.readonly:
+            return False
+        return True
+
+
+class ABACAuthorizer(Authorizer):
+    def __init__(self, policies: Sequence[ABACPolicy]):
+        self.policies = list(policies)
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "ABACAuthorizer":
+        """One JSON policy per line (the 1.x policy file format)."""
+        policies = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            d = json.loads(line)
+            policies.append(
+                ABACPolicy(
+                    user=d.get("user", ""),
+                    group=d.get("group", ""),
+                    resource=d.get("resource", ""),
+                    namespace=d.get("namespace", ""),
+                    readonly=bool(d.get("readonly", False)),
+                )
+            )
+        return cls(policies)
+
+    def authorize(self, attrs: Attributes) -> bool:
+        return any(p.matches(attrs) for p in self.policies)
+
+
+class UnionAuthorizer(Authorizer):
+    """authorizer/union: allowed if any member allows."""
+
+    def __init__(self, authorizers: Sequence[Authorizer]):
+        self.authorizers = list(authorizers)
+
+    def authorize(self, attrs) -> bool:
+        return any(a.authorize(attrs) for a in self.authorizers)

@@ -198,3 +198,11 @@ class RESTClient:
         if code >= 400:
             raise APIStatusError(code, payload)
         return payload
+
+    def healthz(self) -> bool:
+        """GET /healthz (pkg/healthz probe)."""
+        try:
+            self.do_raw("GET", "/healthz")
+            return True
+        except Exception:
+            return False

@@ -1,0 +1,26 @@
+"""Cloud provider interface + registry (pkg/cloudprovider).
+
+The reference's cloud.go Interface split into the capability objects the
+tree actually uses (Instances, Zones, Routes, TCPLoadBalancer), a
+RegisterCloudProvider/GetCloudProvider registry (providers.go), and the
+fake provider every controller test injects (providers/fake)."""
+
+from kubernetes_tpu.cloudprovider.cloud import (
+    CloudProvider,
+    FakeCloud,
+    LoadBalancer,
+    Route,
+    Zone,
+    get_cloud_provider,
+    register_cloud_provider,
+)
+
+__all__ = [
+    "CloudProvider",
+    "FakeCloud",
+    "LoadBalancer",
+    "Route",
+    "Zone",
+    "get_cloud_provider",
+    "register_cloud_provider",
+]

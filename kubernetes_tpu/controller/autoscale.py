@@ -23,6 +23,7 @@ from kubernetes_tpu.api.resource import parse_quantity
 from kubernetes_tpu.api.types import pod_resource_request
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
 from kubernetes_tpu.controller.framework import (
+    PeriodicRunner,
     SharedInformerFactory,
     label_selector_matches,
     selector_matches,
@@ -42,7 +43,9 @@ MetricsClient = Callable[[str, list], Optional[float]]
 TOLERANCE = 0.1
 
 
-class HorizontalController:
+class HorizontalController(PeriodicRunner):
+    SYNC_PERIOD = 30.0
+    THREAD_NAME = "horizontal-pod-autoscaler"
     def __init__(
         self,
         client: RESTClient,
@@ -108,26 +111,15 @@ class HorizontalController:
         )
         self.client.resource("horizontalpodautoscalers", ns).update_status(hpa)
 
-    def run(self, period: float = 30.0) -> "HorizontalController":
-        self._stop = threading.Event()
-
-        def loop():
-            while not self._stop.wait(period):
-                try:
-                    self.reconcile_once()
-                except Exception:
-                    pass
-
-        self._thread = threading.Thread(target=loop, name="horizontal-pod-autoscaler", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()
+    def sync_once(self) -> None:
+        self.reconcile_once()
 
 
-class ResourceQuotaController:
+class ResourceQuotaController(PeriodicRunner):
     """resource_quota_controller.go: recompute status.used per quota."""
+
+    SYNC_PERIOD = 10.0
+    THREAD_NAME = "resourcequota-controller"
 
     def __init__(self, client: RESTClient, informers: SharedInformerFactory):
         self.client = client
@@ -181,19 +173,3 @@ class ResourceQuotaController:
         except APIStatusError:
             pass
 
-    def run(self, period: float = 10.0) -> "ResourceQuotaController":
-        self._stop = threading.Event()
-
-        def loop():
-            while not self._stop.wait(period):
-                try:
-                    self.sync_once()
-                except Exception:
-                    pass
-
-        self._thread = threading.Thread(target=loop, name="resourcequota-controller", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()

@@ -197,6 +197,39 @@ class PodControl:
             )
 
 
+class PeriodicRunner:
+    """Shared periodic-loop harness (the wait.Until idiom): subclasses set
+    SYNC_PERIOD or pass a period to run(); sync_once() does one pass and
+    exceptions are contained per pass."""
+
+    SYNC_PERIOD = 10.0
+    THREAD_NAME = "periodic"
+
+    def sync_once(self) -> object:
+        raise NotImplementedError
+
+    def run(self, period: Optional[float] = None):
+        self._stop_event = threading.Event()
+        period = self.SYNC_PERIOD if period is None else period
+
+        def loop():
+            while not self._stop_event.wait(period):
+                try:
+                    self.sync_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, name=self.THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if hasattr(self, "_stop_event"):
+            self._stop_event.set()
+
+
 class QueueWorker:
     """The informer->workqueue->sync-worker skeleton every controller
     shares (replication_controller.go Run/worker/processNextWorkItem)."""

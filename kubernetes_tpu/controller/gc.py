@@ -20,10 +20,12 @@ from typing import List
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.client.informer import ResourceEventHandler
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
-from kubernetes_tpu.controller.framework import QueueWorker, SharedInformerFactory
+from kubernetes_tpu.controller.framework import PeriodicRunner, QueueWorker, SharedInformerFactory
 
 
-class PodGCController:
+class PodGCController(PeriodicRunner):
+    SYNC_PERIOD = 20.0
+    THREAD_NAME = "podgc"
     """gc_controller.go:45 New — threshold <= 0 disables collection of
     terminated pods (orphan cleanup still runs)."""
 
@@ -65,22 +67,8 @@ class PodGCController:
         except APIStatusError:
             return 0
 
-    def run(self, period: float = 20.0) -> "PodGCController":
-        self._stop = threading.Event()
-
-        def loop():
-            while not self._stop.wait(period):
-                try:
-                    self.gc_once()
-                except Exception:
-                    pass
-
-        self._thread = threading.Thread(target=loop, name="podgc", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()
+    def sync_once(self) -> int:
+        return self.gc_once()
 
 
 # namespaced resources swept during namespace deletion

@@ -22,6 +22,7 @@ from kubernetes_tpu.controller.gc import NamespaceController, PodGCController
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
 from kubernetes_tpu.controller.petset import PetSetController
+from kubernetes_tpu.controller.pv_binder import PersistentVolumeClaimBinder
 from kubernetes_tpu.controller.replication import (
     ReplicationManager,
     new_replicaset_manager,
@@ -49,6 +50,7 @@ class ControllerManagerOptions:
         "replicaset",
         "petset",
         "resourcequota",
+        "pv-binder",
     )  # hpa omitted by default: it needs a metrics client
 
 
@@ -96,6 +98,8 @@ class ControllerManager:
         add("petset", lambda: PetSetController(
             client, self.informers, rec("petset-controller")))
         add("resourcequota", lambda: ResourceQuotaController(
+            client, self.informers))
+        add("pv-binder", lambda: PersistentVolumeClaimBinder(
             client, self.informers))
         if metrics_client is not None:
             self.controllers.append(

@@ -15,7 +15,7 @@ from typing import Callable, Dict, Optional
 
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
-from kubernetes_tpu.controller.framework import SharedInformerFactory
+from kubernetes_tpu.controller.framework import PeriodicRunner, SharedInformerFactory
 from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
 
 
@@ -31,7 +31,9 @@ def _parse_ts(ts: Optional[str]) -> float:
     )
 
 
-class NodeLifecycleController:
+class NodeLifecycleController(PeriodicRunner):
+    SYNC_PERIOD = 5.0
+    THREAD_NAME = "node-controller"
     def __init__(
         self,
         client: RESTClient,
@@ -116,21 +118,5 @@ class NodeLifecycleController:
                 except APIStatusError:
                     pass
 
-    # -- lifecycle -----------------------------------------------------------
-
-    def run(self, period: float = 5.0) -> "NodeLifecycleController":
-        self._stop = threading.Event()
-
-        def loop():
-            while not self._stop.wait(period):
-                try:
-                    self.monitor_once()
-                except Exception:
-                    pass
-
-        self._thread = threading.Thread(target=loop, name="node-controller", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._stop.set()
+    def sync_once(self) -> None:
+        self.monitor_once()

@@ -1,0 +1,13 @@
+"""Service discovery DNS (cmd/kube-dns + pkg/dns, skydns-based).
+
+Resolves the reference's record shapes from live service/endpoints
+watches:
+  <svc>.<ns>.svc.<domain>            -> A     cluster IP
+  headless <svc>.<ns>.svc.<domain>   -> A*    ready endpoint IPs
+  <pod-host>.<svc>.<ns>.svc.<domain> -> A     that endpoint (petset names)
+  _<port>._<proto>.<svc>.<ns>.svc... -> SRV   port + target
+"""
+
+from kubernetes_tpu.dns.server import DNSRecords
+
+__all__ = ["DNSRecords"]

@@ -1,0 +1,24 @@
+"""Federation (federation/ analogue): a control plane over clusters.
+
+The 1.3-era federation ("ubernetes") runs a federated apiserver whose
+object universe is Clusters + federated workloads, and a federation
+controller manager that health-checks member clusters and spreads
+replicas across the healthy ones."""
+
+from kubernetes_tpu.federation.federation import (
+    Cluster,
+    ClusterController,
+    ClusterSpec,
+    ClusterStatus,
+    FederatedAPIServer,
+    FederatedReplicationManager,
+)
+
+__all__ = [
+    "Cluster",
+    "ClusterController",
+    "ClusterSpec",
+    "ClusterStatus",
+    "FederatedAPIServer",
+    "FederatedReplicationManager",
+]

@@ -1,0 +1,1 @@
+"""Benchmark + scale harnesses (test/component/scheduler/perf analogue)."""

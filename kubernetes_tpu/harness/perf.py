@@ -1,0 +1,134 @@
+"""scheduler_perf density harness (test/component/scheduler/perf).
+
+Reproduces the reference benchmark shape end to end through the REAL
+control plane: in-process apiserver, N fake node objects (4 CPU / 32Gi /
+110 pods — perf/util.go:88-118), P pause pods (100m/500Mi —
+perf/util.go:120-141) created through an RC-shaped generator, the
+scheduler daemon binding through the API, and the reference's per-second
+"rate/total" printout (scheduler_test.go:48-61).
+
+    python -m kubernetes_tpu.harness.perf --nodes 100 --pods 3000
+    python -m kubernetes_tpu.harness.perf --nodes 1000 --pods 30000 \
+        --provider TPUProvider
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
+from kubernetes_tpu.utils.workqueue import parallelize
+
+
+def make_nodes(client: RESTClient, n: int) -> None:
+    """perf/util.go:88-118 node shape."""
+    for i in range(n):
+        client.nodes().create(
+            Node(
+                metadata=ObjectMeta(name=f"node-{i:05d}"),
+                status=NodeStatus(
+                    capacity={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                    allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+
+
+def make_pods(client: RESTClient, p: int, creators: int = 30) -> None:
+    """perf/util.go:143-175 makePodsFromRC: pause pods, 30-way parallel
+    creation."""
+
+    def create(i: int) -> None:
+        client.pods().create(
+            Pod(
+                metadata=ObjectMeta(
+                    generate_name="sched-perf-pod-",
+                    labels={"name": "sched-perf"},
+                ),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            name="pause",
+                            image="kubernetes/pause:go",
+                            requests={"cpu": "100m", "memory": "500Mi"},
+                        )
+                    ]
+                ),
+            )
+        )
+
+    parallelize(creators, p, create)
+
+
+def schedule_pods(
+    num_nodes: int, num_pods: int, provider: str = "TPUProvider", out=sys.stdout
+) -> float:
+    """scheduler_test.go:41 schedulePods -> pods/sec over the steady
+    window (prints rate/total each second like the reference)."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    make_nodes(client, num_nodes)
+    sched = SchedulerServer(
+        client, SchedulerServerOptions(algorithm_provider=provider)
+    ).start()
+    try:
+        t0 = time.time()
+        make_pods(client, num_pods)
+        print(
+            f"created {num_pods} pods in {time.time() - t0:.1f}s; scheduling...",
+            file=out,
+        )
+        prev, start = 0, time.time()
+        while True:
+            time.sleep(1)
+            scheduled = sum(
+                1 for p in client.pods().list()[0] if p.spec.node_name
+            )
+            rate = scheduled - prev
+            print(
+                f"{time.strftime('%H:%M:%S')} Rate: {rate:5d} Total: {scheduled}",
+                file=out,
+            )
+            if scheduled >= num_pods:
+                elapsed = time.time() - start
+                throughput = num_pods / elapsed
+                print(
+                    f"scheduled {num_pods} pods on {num_nodes} nodes in "
+                    f"{elapsed:.1f}s ({throughput:.0f} pods/s)",
+                    file=out,
+                )
+                return throughput
+            prev = scheduled
+    finally:
+        sched.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--pods", type=int, default=3000)
+    ap.add_argument(
+        "--provider", default="TPUProvider",
+        choices=["TPUProvider", "DefaultProvider"],
+    )
+    args = ap.parse_args(argv)
+    schedule_pods(args.nodes, args.pods, args.provider)
+
+
+if __name__ == "__main__":
+    main()

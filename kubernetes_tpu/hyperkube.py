@@ -1,0 +1,161 @@
+"""hyperkube (cmd/hyperkube): every component behind one entry point.
+
+    python -m kubernetes_tpu.hyperkube apiserver --port 8080
+    python -m kubernetes_tpu.hyperkube scheduler --server http://...
+    python -m kubernetes_tpu.hyperkube controller-manager --server http://...
+    python -m kubernetes_tpu.hyperkube kubelet --server http://... --node n1
+    python -m kubernetes_tpu.hyperkube proxy --server http://... --node n1
+    python -m kubernetes_tpu.hyperkube local-up   # all-in-one cluster
+                                                  # (hack/local-up-cluster.sh)
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def _client(server: str):
+    from kubernetes_tpu.client.rest import RESTClient
+    from kubernetes_tpu.client.transport import HTTPTransport
+
+    return RESTClient(HTTPTransport(server))
+
+
+def _wait_forever():
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def run_apiserver(args) -> None:
+    from kubernetes_tpu.apiserver.server import APIServer
+
+    server = APIServer()
+    host, port = server.serve_http(port=args.port)
+    print(f"kube-apiserver listening on http://{host}:{port}", flush=True)
+    _wait_forever()
+
+
+def run_scheduler(args) -> None:
+    from kubernetes_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerOptions,
+    )
+
+    sched = SchedulerServer(
+        _client(args.server),
+        SchedulerServerOptions(algorithm_provider=args.algorithm_provider),
+    ).start()
+    print("kube-scheduler running", flush=True)
+    _wait_forever()
+    sched.stop()
+
+
+def run_controller_manager(args) -> None:
+    from kubernetes_tpu.controller.manager import ControllerManager
+
+    mgr = ControllerManager(_client(args.server)).start()
+    print("kube-controller-manager running", flush=True)
+    _wait_forever()
+    mgr.stop()
+
+
+def run_kubelet(args) -> None:
+    from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
+
+    kl = Kubelet(
+        _client(args.server),
+        KubeletConfig(node_name=args.node),
+        FakeRuntime() if args.fake_runtime else None,
+    ).run()
+    print(f"kubelet {args.node} running", flush=True)
+    _wait_forever()
+    kl.stop()
+
+
+def run_proxy(args) -> None:
+    from kubernetes_tpu.proxy import Proxier
+
+    p = Proxier(_client(args.server), args.node).run()
+    print(f"kube-proxy {args.node} running", flush=True)
+    _wait_forever()
+    p.stop()
+
+
+def run_local_up(args) -> None:
+    """hack/local-up-cluster.sh: a full cluster in one process."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.controller.manager import ControllerManager
+    from kubernetes_tpu.dns import DNSRecords
+    from kubernetes_tpu.kubemark import HollowCluster
+    from kubernetes_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerOptions,
+    )
+
+    server = APIServer()
+    host, port = server.serve_http(port=args.port)
+    client = _client(f"http://{host}:{port}")
+    cluster = HollowCluster(client, args.nodes).run()
+    mgr = ControllerManager(client).start()
+    sched = SchedulerServer(
+        client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
+    ).start()
+    dns = DNSRecords(client).run()
+    print(
+        f"local cluster up: http://{host}:{port} ({args.nodes} hollow nodes)\n"
+        f"try: python -m kubernetes_tpu.kubectl -s http://{host}:{port} get nodes",
+        flush=True,
+    )
+    _wait_forever()
+    dns.stop()
+    sched.stop()
+    mgr.stop()
+    cluster.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="hyperkube")
+    sub = ap.add_subparsers(dest="component", required=True)
+
+    p = sub.add_parser("apiserver")
+    p.add_argument("--port", type=int, default=8080)
+
+    for name in ("scheduler", "controller-manager"):
+        p = sub.add_parser(name)
+        p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+        if name == "scheduler":
+            p.add_argument("--algorithm-provider", default="TPUProvider")
+
+    p = sub.add_parser("kubelet")
+    p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    p.add_argument("--node", required=True)
+    p.add_argument("--fake-runtime", action="store_true", default=True)
+
+    p = sub.add_parser("proxy")
+    p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    p.add_argument("--node", default="")
+
+    p = sub.add_parser("local-up")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--algorithm-provider", default="TPUProvider")
+
+    args = ap.parse_args(argv)
+    {
+        "apiserver": run_apiserver,
+        "scheduler": run_scheduler,
+        "controller-manager": run_controller_manager,
+        "kubelet": run_kubelet,
+        "proxy": run_proxy,
+        "local-up": run_local_up,
+    }[args.component](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -207,13 +207,16 @@ class Kubelet:
     # -- syncPod --------------------------------------------------------------
 
     def _pod_ip(self, uid: str) -> str:
-        ip = self._pod_ips.get(uid)
-        if ip is None:
-            self._pod_ip_seq += 1
-            a, b = divmod(self._pod_ip_seq, 254)
-            ip = f"{self._ip_base[0]}.{self._ip_base[1]}.{a % 254}.{b + 1}"
-            self._pod_ips[uid] = ip
-        return ip
+        # per-pod workers call this concurrently; the lock keeps the
+        # sequence allocation atomic so no two pods share an IP
+        with self._lock:
+            ip = self._pod_ips.get(uid)
+            if ip is None:
+                self._pod_ip_seq += 1
+                a, b = divmod(self._pod_ip_seq, 254)
+                ip = f"{self._ip_base[0]}.{self._ip_base[1]}.{a % 254}.{b + 1}"
+                self._pod_ips[uid] = ip
+            return ip
 
     def _sync_pod(self, pod: t.Pod) -> None:
         """kubelet.go:1734 syncPod (fake-runtime scale): converge runtime,

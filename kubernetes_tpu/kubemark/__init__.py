@@ -1,0 +1,11 @@
+"""Kubemark (pkg/kubemark + cmd/kubemark analogue): hollow nodes.
+
+A HollowNode runs the REAL kubelet and kube-proxy code against fake
+runtime/dataplane seams (hollow-node.go:102-120 wires the real kubelet
+to FakeDockerClient + fake cadvisor + stub container manager), so a
+single process can host hundreds of nodes and exercise the control
+plane at scale with ~1% of the hardware."""
+
+from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode
+
+__all__ = ["HollowCluster", "HollowNode"]

@@ -1,0 +1,12 @@
+"""Native (C) components.
+
+- `_kquantity`: resource-quantity parser fast path (built from
+  _kquantity.c via `make -C kubernetes_tpu/native` or
+  `python setup.py build_ext --inplace` at the repo root). Importing this
+  package without the built extension raises ImportError; callers
+  (api/resource.py) degrade to the pure-Python parser.
+- `pause.c` (under build/pause/): the pod sandbox placeholder binary,
+  mirroring the reference's only C file (build/pause/pause.c).
+"""
+
+from kubernetes_tpu.native import _kquantity  # noqa: F401

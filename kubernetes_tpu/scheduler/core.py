@@ -93,7 +93,10 @@ class SchedulerConfig:
     # pop up to this many additional waiting pods per cycle (0 = strictly
     # serial, reference-identical pacing)
     drain_waiting: Callable[[int], List[Pod]] = None
-    max_batch: int = 4096
+    # wave cap: with power-of-two bucketing in the TPU algorithm this also
+    # bounds the set of compiled program shapes ({64,128,256} by default) —
+    # each fresh shape costs a full XLA compile on a tunneled chip
+    max_batch: int = 256
     # schedulable-node filter (factory.go:412 getNodeConditionPredicate
     # applied through the NodeLister, generic_scheduler.go:81)
     node_lister: object = None

@@ -42,8 +42,13 @@ class TPUScheduleAlgorithm:
             return []
         snap, batch = SnapshotEncoder(state, list(pods)).encode()
         # bucket both axes so the live daemon (ever-changing node/backlog
-        # counts) reuses compiled programs instead of re-jitting per wave
-        snap, batch, n_real, p_real = pad_to_buckets(snap, batch)
+        # counts) reuses compiled programs instead of re-jitting per wave.
+        # Generous floors keep the bucket COUNT tiny (compiles are ~30s on
+        # a tunneled chip); scanning a few dozen padded no-op pods costs
+        # microseconds
+        snap, batch, n_real, p_real = pad_to_buckets(
+            snap, batch, node_floor=64, pod_floor=64
+        )
         chosen, final = self._sched.schedule(
             snap, batch, last_node_index=self._last_node_index
         )

@@ -1,0 +1,21 @@
+"""Volume plugins (pkg/volume analogue).
+
+A VolumePlugin turns a Volume source into setup/teardown operations on a
+host path; the registry resolves plugins by spec (plugins.go
+VolumePluginMgr.FindPluginBySpec). The mount fabric is a recording fake
+(like pkg/util/mount FakeMounter) so hollow nodes can "mount" thousands
+of volumes in-process."""
+
+from kubernetes_tpu.volume.plugins import (
+    FakeMounter,
+    VolumePlugin,
+    VolumePluginMgr,
+    default_plugin_mgr,
+)
+
+__all__ = [
+    "FakeMounter",
+    "VolumePlugin",
+    "VolumePluginMgr",
+    "default_plugin_mgr",
+]

@@ -1,0 +1,183 @@
+"""Volume plugin registry + plugins (pkg/volume/plugins.go + per-plugin
+dirs: empty_dir, host_path, gce_pd, aws_ebs, nfs, rbd, secret,
+configmap, persistent_claim)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import types as t
+
+
+class FakeMounter:
+    """pkg/util/mount FakeMounter: records mount/unmount calls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mounts: Dict[str, Tuple[str, str]] = {}  # path -> (device, fstype)
+        self.log: List[Tuple[str, str]] = []
+
+    def mount(self, device: str, path: str, fstype: str = "ext4") -> None:
+        with self._lock:
+            self.mounts[path] = (device, fstype)
+            self.log.append(("mount", path))
+
+    def unmount(self, path: str) -> None:
+        with self._lock:
+            self.mounts.pop(path, None)
+            self.log.append(("unmount", path))
+
+    def is_mounted(self, path: str) -> bool:
+        with self._lock:
+            return path in self.mounts
+
+
+@dataclass
+class VolumeSpec:
+    """pkg/volume Spec: either a pod-inline Volume or a PersistentVolume."""
+
+    volume: Optional[t.Volume] = None
+    pv: Optional[t.PersistentVolume] = None
+
+    @property
+    def name(self) -> str:
+        if self.volume is not None:
+            return self.volume.name
+        return self.pv.metadata.name if self.pv else ""
+
+
+class VolumePlugin:
+    """plugins.go VolumePlugin: name + support check + setup/teardown."""
+
+    name = ""
+    # attachable plugins need a node attach step before mount
+    # (pkg/volume/util/operationexecutor; gce_pd/aws_ebs are attachable)
+    attachable = False
+
+    def can_support(self, spec: VolumeSpec) -> bool:
+        raise NotImplementedError
+
+    def device_of(self, spec: VolumeSpec) -> str:
+        return spec.name
+
+    def setup(self, mounter: FakeMounter, spec: VolumeSpec, pod_uid: str) -> str:
+        """Mount; returns the volume path inside the pod dir (SetUpAt)."""
+        path = f"/var/lib/kubelet/pods/{pod_uid}/volumes/{self.name}/{spec.name}"
+        mounter.mount(self.device_of(spec), path)
+        return path
+
+    def teardown(self, mounter: FakeMounter, spec: VolumeSpec, pod_uid: str) -> None:
+        path = f"/var/lib/kubelet/pods/{pod_uid}/volumes/{self.name}/{spec.name}"
+        mounter.unmount(path)
+
+
+class EmptyDirPlugin(VolumePlugin):
+    name = "kubernetes.io/empty-dir"
+
+    def can_support(self, spec):
+        # the fallback medium: an inline volume with no other source
+        v = spec.volume
+        return v is not None and not any(
+            (v.gce_persistent_disk, v.aws_elastic_block_store, v.rbd,
+             v.persistent_volume_claim, v.host_path)
+        )
+
+    def device_of(self, spec):
+        return "tmpfs"
+
+
+class HostPathPlugin(VolumePlugin):
+    name = "kubernetes.io/host-path"
+
+    def can_support(self, spec):
+        return spec.volume is not None and spec.volume.host_path is not None
+
+    def device_of(self, spec):
+        return spec.volume.host_path.path
+
+
+class GCEPDPlugin(VolumePlugin):
+    name = "kubernetes.io/gce-pd"
+    attachable = True
+
+    def can_support(self, spec):
+        if spec.volume is not None:
+            return spec.volume.gce_persistent_disk is not None
+        return spec.pv is not None and spec.pv.gce_persistent_disk is not None
+
+    def device_of(self, spec):
+        src = (
+            spec.volume.gce_persistent_disk
+            if spec.volume is not None
+            else spec.pv.gce_persistent_disk
+        )
+        return f"gce-pd/{src.pd_name}"
+
+
+class AWSEBSPlugin(VolumePlugin):
+    name = "kubernetes.io/aws-ebs"
+    attachable = True
+
+    def can_support(self, spec):
+        if spec.volume is not None:
+            return spec.volume.aws_elastic_block_store is not None
+        return spec.pv is not None and spec.pv.aws_elastic_block_store is not None
+
+    def device_of(self, spec):
+        src = (
+            spec.volume.aws_elastic_block_store
+            if spec.volume is not None
+            else spec.pv.aws_elastic_block_store
+        )
+        return f"aws-ebs/{src.volume_id}"
+
+
+class RBDPlugin(VolumePlugin):
+    name = "kubernetes.io/rbd"
+
+    def can_support(self, spec):
+        return spec.volume is not None and spec.volume.rbd is not None
+
+    def device_of(self, spec):
+        r = spec.volume.rbd
+        return f"rbd/{r.pool}/{r.image}"
+
+
+class VolumePluginMgr:
+    """plugins.go VolumePluginMgr."""
+
+    def __init__(self, plugins: Optional[List[VolumePlugin]] = None):
+        self.plugins: List[VolumePlugin] = plugins or []
+
+    def register(self, plugin: VolumePlugin) -> None:
+        self.plugins.append(plugin)
+
+    def find_plugin_by_spec(self, spec: VolumeSpec) -> VolumePlugin:
+        matches = [p for p in self.plugins if p.can_support(spec)]
+        if not matches:
+            raise LookupError(f"no volume plugin matched spec {spec.name!r}")
+        if len(matches) > 1:
+            names = ", ".join(p.name for p in matches)
+            raise LookupError(f"multiple plugins matched: {names}")
+        return matches[0]
+
+    def find_plugin_by_name(self, name: str) -> VolumePlugin:
+        for p in self.plugins:
+            if p.name == name:
+                return p
+        raise LookupError(f"no volume plugin named {name!r}")
+
+
+def default_plugin_mgr() -> VolumePluginMgr:
+    """ProbeVolumePlugins (cmd/kubelet app plugins.go)."""
+    return VolumePluginMgr(
+        [
+            GCEPDPlugin(),
+            AWSEBSPlugin(),
+            RBDPlugin(),
+            HostPathPlugin(),
+            EmptyDirPlugin(),
+        ]
+    )

@@ -1,0 +1,208 @@
+"""Authn/authz over HTTP + the admission plugin chain (pkg/auth,
+plugin/pkg/admission)."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    LimitRange,
+    LimitRangeItem,
+    LimitRangeSpec,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceQuota,
+    ResourceQuotaSpec,
+)
+from kubernetes_tpu.apiserver import admission as adm
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.auth import (
+    ABACAuthorizer,
+    ABACPolicy,
+    BasicAuthAuthenticator,
+    TokenAuthenticator,
+    UnionAuthenticator,
+    UserInfo,
+)
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.client.transport import HTTPTransport, LocalTransport
+
+
+def pod(name, cpu=None, affinity=None):
+    reqs = {"cpu": cpu} if cpu else {}
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[Container(name="c", requests=reqs)],
+                     affinity=affinity),
+    )
+
+
+# --- authn / authz over HTTP -------------------------------------------------
+
+
+class _AuthedTransport(HTTPTransport):
+    def __init__(self, base_url, headers):
+        super().__init__(base_url)
+        self._headers = headers
+
+    def _request(self, req):  # inject headers on every request
+        for k, v in self._headers.items():
+            req.add_header(k, v)
+        return req
+
+
+def _send(base, method, path, headers, body=None):
+    import json as _json
+    from urllib import error, request
+
+    req = request.Request(
+        base + path,
+        data=_json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json", **headers},
+    )
+    try:
+        with request.urlopen(req, timeout=10) as resp:
+            return resp.status, _json.loads(resp.read() or b"{}")
+    except error.HTTPError as e:
+        return e.code, _json.loads(e.read() or b"{}")
+
+
+def test_token_and_abac_over_http():
+    authn = UnionAuthenticator([
+        TokenAuthenticator.from_csv(
+            "secret-admin,admin,1\nsecret-bob,bob,2\n"
+        ),
+        BasicAuthAuthenticator({"carol": ("pw", UserInfo("carol"))}),
+    ])
+    authz = ABACAuthorizer([
+        ABACPolicy(user="admin", resource="*", namespace="*"),
+        ABACPolicy(user="bob", resource="pods", namespace="default",
+                   readonly=True),
+        ABACPolicy(user="carol", resource="nodes", readonly=True),
+    ])
+    server = APIServer(authenticator=authn, authorizer=authz)
+    host, port = server.serve_http()
+    base = f"http://{host}:{port}"
+
+    # no credentials -> 401
+    code, _ = _send(base, "GET", "/api/v1/pods", {})
+    assert code == 401
+    # bad token -> 401
+    code, _ = _send(base, "GET", "/api/v1/pods",
+                    {"Authorization": "Bearer nope"})
+    assert code == 401
+    # admin can write
+    code, _ = _send(
+        base, "POST", "/api/v1/namespaces/default/pods",
+        {"Authorization": "Bearer secret-admin"},
+        {"kind": "Pod", "metadata": {"name": "p1"},
+         "spec": {"containers": [{"name": "c"}]}},
+    )
+    assert code == 201
+    # bob can read pods...
+    code, _ = _send(base, "GET", "/api/v1/namespaces/default/pods",
+                    {"Authorization": "Bearer secret-bob"})
+    assert code == 200
+    # ...but not write them (readonly policy)
+    code, _ = _send(
+        base, "POST", "/api/v1/namespaces/default/pods",
+        {"Authorization": "Bearer secret-bob"},
+        {"kind": "Pod", "metadata": {"name": "p2"},
+         "spec": {"containers": [{"name": "c"}]}},
+    )
+    assert code == 403
+    # basic auth + resource restriction
+    import base64
+
+    basic = {"Authorization": "Basic " + base64.b64encode(b"carol:pw").decode()}
+    code, _ = _send(base, "GET", "/api/v1/nodes", basic)
+    assert code == 200
+    code, _ = _send(base, "GET", "/api/v1/namespaces/default/pods", basic)
+    assert code == 403
+    server.shutdown_http()
+
+
+# --- admission plugins -------------------------------------------------------
+
+
+@pytest.fixture()
+def plane():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    return server, client
+
+
+def test_limit_ranger_defaults_and_bounds(plane):
+    server, client = plane
+    server.admission.plugins.append(adm.LimitRanger(server))
+    client.resource("limitranges", "default").create(
+        LimitRange(
+            metadata=ObjectMeta(name="limits"),
+            spec=LimitRangeSpec(limits=[
+                LimitRangeItem(
+                    type="Container",
+                    default_request={"cpu": "200m"},
+                    max={"cpu": "1"},
+                )
+            ]),
+        )
+    )
+    client.pods().create(pod("defaulted"))
+    assert client.pods().get("defaulted").spec.containers[0].requests["cpu"] == "200m"
+    with pytest.raises(APIStatusError) as exc:
+        client.pods().create(pod("hog", cpu="2"))
+    assert "maximum cpu" in str(exc.value)
+
+
+def test_resource_quota_admission(plane):
+    server, client = plane
+    server.admission.plugins.append(adm.ResourceQuotaAdmission(server))
+    client.resource("resourcequotas", "default").create(
+        ResourceQuota(
+            metadata=ObjectMeta(name="quota"),
+            spec=ResourceQuotaSpec(hard={"pods": "2", "requests.cpu": "500m"}),
+        )
+    )
+    client.pods().create(pod("a", cpu="200m"))
+    client.pods().create(pod("b", cpu="200m"))
+    # third pod violates pods=2
+    with pytest.raises(APIStatusError) as exc:
+        client.pods().create(pod("c"))
+    assert "exceeded quota" in str(exc.value)
+    client.pods().delete("b")
+    # cpu quota: 200m used + 400m requested > 500m
+    with pytest.raises(APIStatusError):
+        client.pods().create(pod("d", cpu="400m"))
+
+
+def test_service_account_and_antiaffinity_admission(plane):
+    server, client = plane
+    server.admission.plugins.append(adm.ServiceAccountAdmission())
+    server.admission.plugins.append(adm.LimitPodHardAntiAffinityTopology())
+    client.pods().create(pod("sa-pod"))
+    assert client.pods().get("sa-pod").spec.service_account_name == "default"
+    bad = Affinity(pod_anti_affinity=PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=(
+            PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"a": "b"}),
+                topology_key="failure-domain.beta.kubernetes.io/zone",
+            ),
+        )
+    ))
+    with pytest.raises(APIStatusError) as exc:
+        client.pods().create(pod("zonal-anti", affinity=bad))
+    assert "hostname" in str(exc.value).lower()
+    ok = Affinity(pod_anti_affinity=PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=(
+            PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"a": "b"}),
+                topology_key="kubernetes.io/hostname",
+            ),
+        )
+    ))
+    client.pods().create(pod("host-anti", affinity=ok))  # allowed

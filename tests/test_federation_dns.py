@@ -1,0 +1,158 @@
+"""Federation (multi-cluster) + DNS + hyperkube local-up pieces."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicationController,
+    ReplicationControllerSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.dns import DNSRecords
+from kubernetes_tpu.federation import (
+    Cluster,
+    ClusterController,
+    ClusterSpec,
+    FederatedAPIServer,
+    FederatedReplicationManager,
+)
+from kubernetes_tpu.federation.federation import spread_replicas
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_federation_health_and_spread():
+    fed = FederatedAPIServer()
+    fed_client = RESTClient(LocalTransport(fed))
+    members = {f"c{i}": APIServer() for i in range(3)}
+    clients = {n: RESTClient(LocalTransport(s)) for n, s in members.items()}
+
+    def member_client(cluster):
+        return clients.get(cluster.metadata.name)
+
+    for name in members:
+        fed_client.resource("clusters").create(
+            Cluster(metadata=ObjectMeta(name=name),
+                    spec=ClusterSpec(server_address=f"local://{name}"))
+        )
+    # an unreachable member
+    fed_client.resource("clusters").create(
+        Cluster(metadata=ObjectMeta(name="gone"),
+                spec=ClusterSpec(server_address="local://gone"))
+    )
+    cc = ClusterController(fed_client, member_client)
+    cc.sync_once()
+    ready = {
+        c.metadata.name: c.status.conditions[0].status
+        for c in fed_client.resource("clusters").list()[0]
+    }
+    assert ready == {"c0": "True", "c1": "True", "c2": "True", "gone": "False"}
+
+    # federated RC of 8 replicas spread 3/3/2 across ready clusters
+    fed_client.resource("replicationcontrollers", "default").create(
+        ReplicationController(
+            metadata=ObjectMeta(name="web"),
+            spec=ReplicationControllerSpec(
+                replicas=8, selector={"app": "web"},
+                template=PodTemplateSpec(
+                    metadata=ObjectMeta(labels={"app": "web"}),
+                    spec=PodSpec(containers=[Container(name="c")]),
+                ),
+            ),
+        )
+    )
+    frm = FederatedReplicationManager(fed_client, member_client)
+    frm.sync_once()
+    shares = [
+        clients[n].resource("replicationcontrollers", "default").get("web").spec.replicas
+        for n in ("c0", "c1", "c2")
+    ]
+    assert shares == [3, 3, 2]
+    # scaling the federated object rebalances members
+    rc = fed_client.resource("replicationcontrollers", "default").get("web")
+    rc.spec.replicas = 4
+    fed_client.resource("replicationcontrollers", "default").update(rc)
+    frm.sync_once()
+    shares = [
+        clients[n].resource("replicationcontrollers", "default").get("web").spec.replicas
+        for n in ("c0", "c1", "c2")
+    ]
+    assert shares == [2, 1, 1]
+
+
+def test_spread_replicas():
+    assert spread_replicas(10, 3) == [4, 3, 3]
+    assert spread_replicas(2, 3) == [1, 1, 0]
+    assert spread_replicas(0, 2) == [0, 0]
+    assert spread_replicas(5, 0) == []
+
+
+def test_dns_records():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    dns = DNSRecords(client).run()
+    try:
+        client.resource("services", "default").create(
+            Service(
+                metadata=ObjectMeta(name="web"),
+                spec=ServiceSpec(
+                    selector={"app": "web"},
+                    cluster_ip="10.0.0.10",
+                    ports=[ServicePort(name="http", port=80)],
+                ),
+            )
+        )
+        client.resource("services", "default").create(
+            Service(
+                metadata=ObjectMeta(name="db"),
+                spec=ServiceSpec(selector={"app": "db"}, cluster_ip="None"),
+            )
+        )
+        client.resource("endpoints", "default").create(
+            Endpoints(
+                metadata=ObjectMeta(name="db"),
+                subsets=[EndpointSubset(
+                    addresses=[
+                        EndpointAddress(ip="10.1.0.5", target_ref="default/db-0"),
+                        EndpointAddress(ip="10.1.0.6", target_ref="default/db-1"),
+                    ],
+                    ports=[EndpointPort(port=5432)],
+                )],
+            )
+        )
+        assert wait_until(
+            lambda: dns.resolve("web.default.svc.cluster.local") == ["10.0.0.10"]
+        )
+        # headless -> endpoint IPs; pet hostname -> its own IP
+        assert wait_until(
+            lambda: dns.resolve("db.default.svc.cluster.local")
+            == ["10.1.0.5", "10.1.0.6"]
+        )
+        assert dns.resolve("db-1.db.default.svc.cluster.local") == ["10.1.0.6"]
+        assert dns.resolve("nope.default.svc.cluster.local") == []
+        srv = dns.resolve_srv("_http._tcp.web.default.svc.cluster.local")
+        assert len(srv) == 1 and srv[0].port == 80
+        assert srv[0].target == "web.default.svc.cluster.local"
+    finally:
+        dns.stop()

@@ -1,0 +1,97 @@
+"""Kubemark-tier scale: hollow nodes + the real scheduler + controllers in
+one process (test/kubemark; SURVEY.md section 4 'multi-node without a
+cluster')."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicationController,
+    ReplicationControllerSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.controller.framework import SharedInformerFactory
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
+
+
+def wait_until(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def test_hollow_cluster_runs_workload():
+    """20 hollow nodes, an RC of 60 pods: everything must reach Running
+    via real scheduler bindings and real kubelet status updates."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    cluster = HollowCluster(client, 20).run()
+    informers = SharedInformerFactory(client)
+    rcm = ReplicationManager(client, informers)
+    informers.start()
+    informers.wait_for_sync()
+    rcm.run()
+    sched = SchedulerServer(client, SchedulerServerOptions()).start()
+    try:
+        assert wait_until(lambda: len(client.nodes().list()[0]) == 20, 30)
+        client.resource("replicationcontrollers", "default").create(
+            ReplicationController(
+                metadata=ObjectMeta(name="load"),
+                spec=ReplicationControllerSpec(
+                    replicas=60,
+                    selector={"app": "load"},
+                    template=PodTemplateSpec(
+                        metadata=ObjectMeta(labels={"app": "load"}),
+                        spec=PodSpec(
+                            containers=[
+                                Container(name="pause", requests={"cpu": "100m"})
+                            ]
+                        ),
+                    ),
+                ),
+            )
+        )
+        assert wait_until(
+            lambda: sum(
+                1
+                for p in client.pods().list()[0]
+                if p.status.phase == "Running"
+            )
+            == 60,
+            60,
+        ), [
+            (p.metadata.name, p.status.phase, p.spec.node_name)
+            for p in client.pods().list()[0]
+        ][:10]
+        nodes_used = {p.spec.node_name for p in client.pods().list()[0]}
+        assert len(nodes_used) == 20  # spreading across every hollow node
+    finally:
+        sched.stop()
+        rcm.stop()
+        informers.stop()
+        cluster.stop()
+
+
+def test_perf_harness_small():
+    """The density harness runs end-to-end (tiny config in CI; the real
+    configs are 100n/3kp and 1000n/30kp per the reference README)."""
+    import io
+
+    from kubernetes_tpu.harness.perf import schedule_pods
+
+    out = io.StringIO()
+    throughput = schedule_pods(10, 50, provider="DefaultProvider", out=out)
+    assert throughput > 0
+    assert "Total: 50" in out.getvalue()

@@ -219,10 +219,13 @@ class TestEndToEnd:
         try:
             for i in range(n_pods):
                 client.pods().create(pod(f"p{i}"))
+            # generous: the TPU provider's first wave compiles a full
+            # bucket-sized program, which crawls under parallel-suite load
             assert wait_until(
                 lambda: all(
                     p.spec.node_name for p in client.pods().list()[0]
-                )
+                ),
+                timeout=40.0,
             ), [
                 (p.metadata.name, p.spec.node_name)
                 for p in client.pods().list()[0]

@@ -1,0 +1,136 @@
+"""Volume plugin registry, cloud provider fake, and the PV claim binder
+(pkg/volume, pkg/cloudprovider, pkg/controller/persistentvolume)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    AWSElasticBlockStore,
+    GCEPersistentDisk,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    RBDVolume,
+    Volume,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.cloudprovider import FakeCloud, get_cloud_provider
+from kubernetes_tpu.controller.framework import SharedInformerFactory
+from kubernetes_tpu.controller.pv_binder import PersistentVolumeClaimBinder
+from kubernetes_tpu.volume import FakeMounter, default_plugin_mgr
+from kubernetes_tpu.volume.plugins import VolumeSpec
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_plugin_resolution_and_mount_cycle():
+    mgr = default_plugin_mgr()
+    mounter = FakeMounter()
+    specs = {
+        "kubernetes.io/gce-pd": VolumeSpec(
+            volume=Volume(name="d", gce_persistent_disk=GCEPersistentDisk(pd_name="pd1"))
+        ),
+        "kubernetes.io/aws-ebs": VolumeSpec(
+            volume=Volume(name="e", aws_elastic_block_store=AWSElasticBlockStore(volume_id="v1"))
+        ),
+        "kubernetes.io/rbd": VolumeSpec(
+            volume=Volume(name="r", rbd=RBDVolume(monitors=("m",), pool="p", image="i"))
+        ),
+        "kubernetes.io/empty-dir": VolumeSpec(volume=Volume(name="scratch")),
+    }
+    for want, spec in specs.items():
+        plugin = mgr.find_plugin_by_spec(spec)
+        assert plugin.name == want
+        path = plugin.setup(mounter, spec, pod_uid="u1")
+        assert mounter.is_mounted(path)
+        plugin.teardown(mounter, spec, pod_uid="u1")
+        assert not mounter.is_mounted(path)
+    # PV-backed spec resolves too
+    pv_spec = VolumeSpec(
+        pv=PersistentVolume(
+            metadata=ObjectMeta(name="pv1"),
+            gce_persistent_disk=GCEPersistentDisk(pd_name="pd9"),
+        )
+    )
+    assert mgr.find_plugin_by_spec(pv_spec).name == "kubernetes.io/gce-pd"
+    assert mgr.find_plugin_by_name("kubernetes.io/aws-ebs").attachable
+
+
+def test_fake_cloud_provider():
+    cloud = get_cloud_provider("fake")
+    assert isinstance(cloud, FakeCloud)
+    cloud.instances = ["n1", "n2"]
+    assert cloud.external_id("n1") == "ext-n1"
+    assert cloud.list_instances() == ["n1", "n2"]
+    assert cloud.get_zone().region == "us-central1"
+    lb = cloud.ensure_tcp_load_balancer("svc", "r1", (80,), ("n1",))
+    assert cloud.get_tcp_load_balancer("svc", "r1") == lb
+    cloud.ensure_tcp_load_balancer_deleted("svc", "r1")
+    assert cloud.get_tcp_load_balancer("svc", "r1") is None
+    assert "ensure-lb" in cloud.calls
+
+
+def test_pv_claim_binder():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    informers = SharedInformerFactory(client)
+    binder = PersistentVolumeClaimBinder(client, informers)
+    pv_client = client.resource("persistentvolumes")
+    pvc_client = client.resource("persistentvolumeclaims", "default")
+    pv_client.create(PersistentVolume(
+        metadata=ObjectMeta(name="small"), capacity={"storage": "1Gi"}))
+    pv_client.create(PersistentVolume(
+        metadata=ObjectMeta(name="big"), capacity={"storage": "100Gi"}))
+    pvc_client.create(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="claim"), requests={"storage": "500Mi"}))
+    informers.start()
+    informers.wait_for_sync()
+    assert wait_until(lambda: len(informers.informer("persistentvolumes").store.list()) == 2)
+    assert binder.sync_once() == 1
+    # smallest fitting PV wins; two-way binding recorded
+    assert pvc_client.get("claim").volume_name == "small"
+    assert pv_client.get("small").claim_ref == "default/claim"
+    assert pv_client.get("big").claim_ref == ""
+    # claim deleted -> PV released
+    pvc_client.delete("claim")
+    assert wait_until(
+        lambda: len(informers.informer("persistentvolumeclaims").store.list()) == 0
+    )
+    binder.sync_once()
+    assert pv_client.get("small").claim_ref == ""
+    informers.stop()
+
+
+def test_pv_binder_no_double_bind():
+    """Review regression: two unbound PVCs and one PV must result in
+    exactly one binding, not both claims sharing the volume."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    informers = SharedInformerFactory(client)
+    binder = PersistentVolumeClaimBinder(client, informers)
+    client.resource("persistentvolumes").create(PersistentVolume(
+        metadata=ObjectMeta(name="only"), capacity={"storage": "10Gi"}))
+    pvc_client = client.resource("persistentvolumeclaims", "default")
+    pvc_client.create(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="a"), requests={"storage": "1Gi"}))
+    pvc_client.create(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="b"), requests={"storage": "1Gi"}))
+    informers.start()
+    informers.wait_for_sync()
+    assert wait_until(
+        lambda: len(informers.informer("persistentvolumeclaims").store.list()) == 2
+    )
+    assert binder.sync_once() == 1
+    bound = [pvc_client.get(n).volume_name for n in ("a", "b")]
+    assert sorted(bound) == ["", "only"]
+    informers.stop()
