@@ -1,0 +1,176 @@
+"""Fault injection / elastic recovery (the reference's chaosmonkey +
+daemon_restart e2e tier, SURVEY.md section 5.3-5.4): every component is a
+stateless cache of the API rebuilt via list+watch, so kill + restart must
+resume exactly where the dead instance stopped."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicationController,
+    ReplicationControllerSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.controller.framework import SharedInformerFactory
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
+from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
+
+
+def wait_until(cond, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def ready_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(
+            allocatable={"cpu": "64", "memory": "256Gi", "pods": "500"},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def pending_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(containers=[Container(requests={"cpu": "50m"})]),
+    )
+
+
+def n_bound(client):
+    return sum(1 for p in client.pods().list()[0] if p.spec.node_name)
+
+
+def test_scheduler_restart_resumes_backlog():
+    """daemon_restart.go for the scheduler: kill it mid-backlog; a FRESH
+    instance (new process state, nothing carried over) must pick up the
+    remaining pending pods from the watch and finish. This is the
+    checkpoint/resume model: the API IS the checkpoint."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    for i in range(4):
+        client.nodes().create(ready_node(f"n{i}"))
+    for i in range(20):
+        client.pods().create(pending_pod(f"p{i:03d}"))
+    first = SchedulerServer(client, SchedulerServerOptions()).start()
+    assert wait_until(lambda: n_bound(client) >= 1)
+    first.stop()
+    # the cluster keeps moving while NO scheduler runs: a backlog builds
+    for i in range(20, 40):
+        client.pods().create(pending_pod(f"p{i:03d}"))
+    before = n_bound(client)
+    assert before < 40
+    # a FRESH instance must find the backlog via its initial LIST (no
+    # watch event will ever replay the creations it missed)
+    second = SchedulerServer(client, SchedulerServerOptions()).start()
+    try:
+        assert wait_until(lambda: n_bound(client) == 40)
+        # every pod exactly once: no double-binding across instances
+        nodes = [p.spec.node_name for p in client.pods().list()[0]]
+        assert all(nodes)
+    finally:
+        second.stop()
+
+
+def test_kubelet_restart_recovers_pods():
+    """A kubelet restart (fresh runtime — the machine rebooted) must
+    re-run its bound pods and report Running again."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    cfg = dict(pleg_relist_period=0.05, status_sync_period=0.05)
+    kl = Kubelet(client, KubeletConfig(node_name="n1", **cfg), FakeRuntime()).run()
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="p1"),
+            spec=PodSpec(node_name="n1", containers=[Container(name="c")]))
+    )
+    assert wait_until(lambda: client.pods().get("p1").status.phase == "Running")
+    kl.stop()
+    # fresh kubelet, empty runtime: the config watch replays the bound pod
+    kl2 = Kubelet(client, KubeletConfig(node_name="n1", **cfg), FakeRuntime()).run()
+    try:
+        assert wait_until(
+            lambda: any(rp.name == "p1" for rp in kl2.runtime.list_pods())
+        )
+        assert client.pods().get("p1").status.phase == "Running"
+    finally:
+        kl2.stop()
+
+
+def test_controller_manager_restart_mid_scale():
+    """Kill the replication manager mid-scale-up; a fresh one must
+    complete the scale without duplicating pods (expectations are local
+    state and die with the process — the API world is the truth)."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    informers = SharedInformerFactory(client)
+    rcm = ReplicationManager(client, informers)
+    informers.start()
+    informers.wait_for_sync()
+    rcm.run()
+    client.resource("replicationcontrollers", "default").create(
+        ReplicationController(
+            metadata=ObjectMeta(name="web"),
+            spec=ReplicationControllerSpec(
+                replicas=30, selector={"app": "web"},
+                template=PodTemplateSpec(
+                    metadata=ObjectMeta(labels={"app": "web"}),
+                    spec=PodSpec(containers=[Container(requests={"cpu": "10m"})]),
+                ),
+            ),
+        )
+    )
+    assert wait_until(lambda: len(client.pods().list()[0]) >= 5)
+    rcm.stop()
+    informers.stop()
+    informers2 = SharedInformerFactory(client)
+    rcm2 = ReplicationManager(client, informers2)
+    informers2.start()
+    informers2.wait_for_sync()
+    rcm2.run()
+    try:
+        assert wait_until(lambda: len(client.pods().list()[0]) == 30)
+        time.sleep(0.5)  # stability: no over-creation afterwards
+        assert len(client.pods().list()[0]) == 30
+    finally:
+        rcm2.stop()
+        informers2.stop()
+
+
+def test_assumed_pod_ttl_self_heals():
+    """cache.go:278-299: a bind that never lands (assumed pod whose watch
+    confirmation is lost) expires after the TTL, releasing the resources
+    in the scheduler cache — verified through the SchedulerCache API."""
+    from kubernetes_tpu.scheduler.cache import SchedulerCache
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    clock = FakeClock(1000.0)
+    cache = SchedulerCache(ttl=30.0, clock=clock)
+    cache.add_node(ready_node("n1"))
+    pod = Pod(metadata=ObjectMeta(name="ghost", uid="u1"),
+              spec=PodSpec(node_name="n1",
+                           containers=[Container(requests={"cpu": "1"})]))
+    cache.assume_pod(pod)
+    state = cache.snapshot()
+    assert state.node_infos["n1"].requested_milli_cpu == 1000
+    # TTL passes with no Add confirmation: cleanup drops the assumption
+    clock.step(31.0)
+    cache.cleanup_expired(clock.now())
+    state = cache.snapshot()
+    assert state.node_infos["n1"].requested_milli_cpu == 0
