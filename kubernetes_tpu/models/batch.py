@@ -30,8 +30,13 @@ from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import priorities as R
 from kubernetes_tpu.ops import select as S
+from kubernetes_tpu.ops import services as SV
 from kubernetes_tpu.ops import volumes as V
-from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
+from kubernetes_tpu.snapshot.encode import (
+    ClusterSnapshot,
+    PodBatch,
+    service_config_labels,
+)
 
 # predicate keys (factory/plugins.go registry names)
 GENERAL_PREDICATES = "GeneralPredicates"
@@ -56,6 +61,8 @@ IMAGE_LOCALITY = "ImageLocalityPriority"
 # (("NodeLabelPriority", label, presence), weight) as a priority
 NODE_LABEL_PREDICATE = "CheckNodeLabelPresence"
 NODE_LABEL_PRIORITY = "NodeLabelPriority"
+SERVICE_AFFINITY = "ServiceAffinity"
+SERVICE_ANTI_AFFINITY = "ServiceAntiAffinity"
 
 
 @dataclass(frozen=True)
@@ -90,7 +97,7 @@ class SchedulerConfig:
     max_gce_pd_volumes: int = 16
 
 
-def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
+def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
     (
         req_mcpu,
         req_mem,
@@ -111,8 +118,12 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         vol_rw,
         ebs_mask,
         gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
     ) = carry
     num_nodes = req_mcpu.shape[0]
+    svc_labels = service_config_labels(config)
 
     want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
     want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
@@ -212,6 +223,16 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
             for lbl in entry[1]:
                 has = static[f"nl_pred_{lbl}"]
                 fit = fit & (has if entry[2] else ~has)
+        elif isinstance(entry, tuple) and entry[0] == SERVICE_AFFINITY:
+            fit = fit & SV.service_affinity(
+                svc_first_peer,
+                static["svc_lbl_val"],
+                static["svc_ord_node"],
+                pod["svc_group"],
+                pod["svc_fixed"],
+                tuple(svc_labels.index(l) for l in entry[1]),
+                num_nodes,
+            )
     if want_ip_pred:
         own_lt = IP.gather_lt(
             ip_own_anti,
@@ -314,6 +335,16 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
             s = R.image_locality(static["img_size"], pod["img_count"])
         elif isinstance(name, tuple) and name[0] == NODE_LABEL_PRIORITY:
             s = R.node_label(static[f"nl_prio_{name[1]}"], name[2])
+        elif isinstance(name, tuple) and name[0] == SERVICE_ANTI_AFFINITY:
+            s = SV.service_anti_affinity(
+                svc_peer_node_count,
+                svc_peer_total,
+                static["svc_lbl_val"][svc_labels.index(name[1])],
+                pod["svc_group"],
+                fit,
+                num_values,
+                num_nodes,
+            )
         else:
             raise ValueError(f"unknown priority {name!r}")
         score = score + jnp.int64(weight) * s
@@ -376,6 +407,16 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         vol_rw = vol_rw.at[safe].set(vol_rw[safe] | (pod["vp_vol_rw"] & sel))
         ebs_mask = ebs_mask.at[safe].set(ebs_mask[safe] | (pod["vp_ebs"] & sel))
         gce_mask = gce_mask.at[safe].set(gce_mask[safe] | (pod["vp_gce"] & sel))
+    if svc_labels:
+        svc_first_peer, svc_peer_node_count, svc_peer_total = SV.service_commit(
+            svc_first_peer,
+            svc_peer_node_count,
+            svc_peer_total,
+            static["svc_node_ord"],
+            pod["svc_member"],
+            chosen,
+            scheduled,
+        )
 
     carry = (
         req_mcpu,
@@ -397,6 +438,9 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, static, carry, pod):
         vol_rw,
         ebs_mask,
         gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
     )
     return carry, chosen
 
@@ -473,6 +517,9 @@ class BatchScheduler:
         "vp_vz_region",
         "vp_vz_fail",
         "img_count",
+        "svc_group",
+        "svc_member",
+        "svc_fixed",
     ]
     STATIC_FIELDS = [
         "alloc_mcpu",
@@ -504,6 +551,9 @@ class BatchScheduler:
         "vz_region",
         "vz_has",
         "img_size",
+        "svc_lbl_val",
+        "svc_node_ord",
+        "svc_ord_node",
     ]
 
     @classmethod
@@ -525,11 +575,13 @@ class BatchScheduler:
         self.config = config or SchedulerConfig()
         self._jitted = {}
 
-    def _compiled(self, num_zones: int):
-        key = num_zones
+    def _compiled(self, num_zones: int, num_values: int = 0):
+        key = (num_zones, num_values)
         fn = self._jitted.get(key)
         if fn is None:
-            scan_body = functools.partial(_scan_fn, self.config, num_zones)
+            scan_body = functools.partial(
+                _scan_fn, self.config, num_zones, num_values
+            )
 
             @jax.jit
             def run(static, carry, pods):
@@ -566,6 +618,9 @@ class BatchScheduler:
             jnp.asarray(snap.vol_rw),
             jnp.asarray(snap.ebs_mask),
             jnp.asarray(snap.gce_mask),
+            jnp.asarray(snap.svc_first_peer),
+            jnp.asarray(snap.svc_peer_node_count),
+            jnp.asarray(snap.svc_peer_total),
         )
 
     def schedule(
@@ -584,7 +639,7 @@ class BatchScheduler:
         pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
         num_zones = int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1
         # num_zones must cover the vocab; zone ids are dense from encoding
-        run = self._compiled(max(num_zones, 1))
+        run = self._compiled(max(num_zones, 1), int(snap.svc_num_values))
         final, chosen = run(
             static, self.initial_carry(snap, last_node_index), pods
         )

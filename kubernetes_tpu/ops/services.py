@@ -1,0 +1,115 @@
+"""Device kernels for ServiceAffinity / ServiceAntiAffinity (see
+snapshot/services.py for the compilation)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.snapshot.services import ORD_NONE
+
+MAX_PRIORITY = 10
+
+
+def service_affinity(
+    first_peer,  # (G,) carry
+    lbl_val,  # (L, N) static
+    ord_node,  # (ORD,) static
+    pod_group,  # scalar i32
+    pod_fixed,  # (L,) i32
+    label_rows,  # tuple of row indices into lbl_val for this predicate
+    num_nodes,
+):
+    """predicates.go:596 ServiceAffinity -> bool (N,).
+
+    For each config label: a value pinned by the pod's nodeSelector wins;
+    otherwise the first peer's node supplies it (when that node carries
+    the label); otherwise the label is unconstrained. A first peer on an
+    unknown/None node fails every candidate (the oracle's GetNodeInfo
+    error branch)."""
+    ok = jnp.ones((num_nodes,), bool)
+    G = first_peer.shape[0]
+    if G == 0 or not label_rows:
+        # no groups compiled: only nodeSelector-pinned labels constrain
+        for li in label_rows:
+            fixed = pod_fixed[li]
+            ok = ok & ((fixed < 0) | (lbl_val[li] == fixed))
+        return ok
+    has_group = pod_group >= 0
+    peer_ord = first_peer[jnp.clip(pod_group, 0, G - 1)]
+    has_peer = has_group & (peer_ord != ORD_NONE)
+    peer_row = ord_node[jnp.clip(peer_ord, 0, ord_node.shape[0] - 1)]
+    safe_row = jnp.clip(peer_row, 0, num_nodes - 1)
+    any_unresolved = jnp.bool_(False)
+    for li in label_rows:
+        fixed = pod_fixed[li]
+        any_unresolved = any_unresolved | (fixed < 0)
+        peer_val = lbl_val[li, safe_row]
+        req = jnp.where(
+            fixed >= 0,
+            fixed,
+            jnp.where(has_peer & (peer_row >= 0) & (peer_val >= 0), peer_val, -1),
+        )
+        ok = ok & ((req < 0) | (lbl_val[li] == req))
+    # a first peer on an unknown/None node fails every candidate — but the
+    # oracle only consults the peer at all when some label is unresolved
+    # (predicates.py 'if unresolved:' gate)
+    peer_bad = has_peer & (peer_row < 0) & any_unresolved
+    return ok & ~peer_bad
+
+
+def service_anti_affinity(
+    peer_node_count,  # (G, N) carry
+    peer_total,  # (G,) carry
+    lbl_val_row,  # (N,) static: value ids under the config label
+    pod_group,  # scalar i32
+    fit,  # (N,) bool
+    num_values: int,
+    num_nodes: int,
+):
+    """selector_spreading.go:244 ServiceAntiAffinity -> i64 (N,).
+
+    Spread the pod's service peers across values of a node label:
+    labeled nodes score 10*(total - peers_at_their_value)/total (float32
+    then truncate, matching Go), unlabeled nodes score 0. Peers are
+    counted only on labeled FIT nodes (the reference builds labeledNodes
+    from the filtered node list)."""
+    G = peer_node_count.shape[0]
+    labeled = lbl_val_row >= 0
+    if G == 0 or num_values == 0:
+        return jnp.where(labeled, jnp.int64(MAX_PRIORITY), jnp.int64(0))
+    g = jnp.clip(pod_group, 0, G - 1)
+    has_group = pod_group >= 0
+    counts_row = jnp.where(has_group, peer_node_count[g], 0)  # (N,)
+    total = jnp.where(has_group, peer_total[g], 0)
+    eligible = fit & labeled
+    by_value = jnp.zeros((num_values,), jnp.int32).at[
+        jnp.clip(lbl_val_row, 0, num_values - 1)
+    ].add(jnp.where(eligible, counts_row, 0).astype(jnp.int32))
+    at_node = by_value[jnp.clip(lbl_val_row, 0, num_values - 1)]
+    f = jnp.where(
+        total > 0,
+        jnp.float32(MAX_PRIORITY)
+        * ((total - at_node).astype(jnp.float32) / total.astype(jnp.float32)),
+        jnp.float32(MAX_PRIORITY),
+    )
+    return jnp.where(labeled, f.astype(jnp.int64), jnp.int64(0))
+
+
+def service_commit(
+    first_peer, peer_node_count, peer_total, node_ord, pod_member, chosen, scheduled
+):
+    """Fold a committed pod into the peer state."""
+    G = first_peer.shape[0]
+    if G == 0:
+        return first_peer, peer_node_count, peer_total
+    safe = jnp.maximum(chosen, 0)
+    inc = (pod_member > 0) & scheduled  # (G,)
+    peer_node_count = peer_node_count.at[:, safe].add(
+        inc.astype(jnp.int32)
+    )
+    peer_total = peer_total + inc.astype(jnp.int32)
+    this_ord = node_ord[safe]
+    first_peer = jnp.minimum(
+        first_peer, jnp.where(inc, this_ord, ORD_NONE)
+    )
+    return first_peer, peer_node_count, peer_total

@@ -70,6 +70,13 @@ def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
             fields[f.name] = np.pad(
                 v, [(0, 0), (0, pad)], constant_values=-1
             )
+        elif f.name in ("svc_lbl_val", "svc_peer_node_count"):
+            fields[f.name] = np.pad(v, [(0, 0), (0, pad)], constant_values=(-1 if f.name == "svc_lbl_val" else 0))
+        elif f.name == "svc_node_ord":
+            from kubernetes_tpu.snapshot.services import ORD_NONE
+            fields[f.name] = np.pad(v, [(0, pad)], constant_values=int(ORD_NONE))
+        elif f.name in ("svc_ord_node", "svc_first_peer", "svc_peer_total", "svc_labels", "svc_num_values", "key_ids"):
+            fields[f.name] = v
         elif f.name in ("set_table", "noschedule_taints", "prefer_taints") or (
             f.name.startswith("ip_")
         ):
@@ -105,6 +112,9 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         vol_rw,
         ebs_mask,
         gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
     ) = carry
 
     shard = jax.lax.axis_index(AXIS)
@@ -358,6 +368,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
         ip_spec_total,
         vol_any, vol_rw, ebs_mask, gce_mask,
+        svc_first_peer, svc_peer_node_count, svc_peer_total,
     )
     return carry, chosen
 
@@ -418,6 +429,13 @@ class MeshBatchScheduler:
     def schedule(
         self, snap: ClusterSnapshot, batch: PodBatch, last_node_index: int = 0
     ):
+        from kubernetes_tpu.snapshot.encode import service_config_labels
+
+        if service_config_labels(self.config):
+            raise NotImplementedError(
+                "ServiceAffinity/ServiceAntiAffinity are not implemented on "
+                "the mesh path yet; use the single-chip BatchScheduler"
+            )
         n_dev = self.mesh.devices.size
         if len(snap.node_names) == 0:
             sched = BatchScheduler(self.config)
@@ -465,6 +483,8 @@ class MeshBatchScheduler:
             # volume masks: node-axis sharded
             PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
             PSpec(AXIS, None),
+            # service-group tables (zero-width on this path)
+            PSpec(), PSpec(), PSpec(),
         )
         pod_specs = {k: PSpec() for k in pods}
 

@@ -40,7 +40,9 @@ class TPUScheduleAlgorithm:
 
         if not pods:
             return []
-        snap, batch = SnapshotEncoder(state, list(pods)).encode()
+        snap, batch = SnapshotEncoder(
+            state, list(pods), config=getattr(self._sched, "config", None)
+        ).encode()
         # bucket both axes so the live daemon (ever-changing node/backlog
         # counts) reuses compiled programs instead of re-jitting per wave.
         # Generous floors keep the bucket COUNT tiny (compiles are ~30s on

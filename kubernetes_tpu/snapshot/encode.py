@@ -68,6 +68,20 @@ _OP_BY_NAME = {
 }
 
 
+def service_config_labels(config) -> Tuple[str, ...]:
+    """The node-label set a SchedulerConfig's ServiceAffinity /
+    ServiceAntiAffinity entries need, in deterministic order (the scan
+    body recomputes this mapping from the config alone)."""
+    labels = []
+    for e in getattr(config, "predicates", ()):
+        if isinstance(e, tuple) and e[0] == "ServiceAffinity":
+            labels.extend(e[1])
+    for name, _w in getattr(config, "priorities", ()):
+        if isinstance(name, tuple) and name[0] == "ServiceAntiAffinity":
+            labels.append(name[1])
+    return tuple(dict.fromkeys(labels))
+
+
 def _pack_bits(ids: Sequence[int], words: int) -> np.ndarray:
     out = np.zeros((words,), dtype=np.uint32)
     for i in ids:
@@ -165,9 +179,20 @@ class ClusterSnapshot:
     # pending-pod container image (first status.images entry whose names
     # contain it, priorities.go:155-160)
     img_size: Optional[np.ndarray] = None  # i64[N, CI]
+    # ServiceAffinity/ServiceAntiAffinity program (snapshot/services.py;
+    # zero-width unless the encoder was given a config that uses them).
+    # first_peer/peer_* are initial carry.
+    svc_lbl_val: Optional[np.ndarray] = None  # i32[L, N]
+    svc_node_ord: Optional[np.ndarray] = None  # i32[N]
+    svc_ord_node: Optional[np.ndarray] = None  # i32[ORD]
+    svc_first_peer: Optional[np.ndarray] = None  # i32[G]
+    svc_peer_node_count: Optional[np.ndarray] = None  # i32[G, N]
+    svc_peer_total: Optional[np.ndarray] = None  # i32[G]
     # host-only metadata (NOT shipped to device): vocab maps used to
     # resolve config-parameterized predicates (NodeLabel…) at schedule time
     key_ids: Optional[Dict[str, int]] = None
+    svc_labels: Tuple[str, ...] = ()
+    svc_num_values: int = 0
 
     @property
     def num_nodes(self) -> int:
@@ -266,6 +291,10 @@ class PodBatch:
     vp_vz_fail: Optional[np.ndarray] = None  # bool[P]
     # container-image name usage counts (ImageLocalityPriority)
     img_count: Optional[np.ndarray] = None  # i64[P, CI]
+    # service-group program (ServiceAffinity/ServiceAntiAffinity)
+    svc_group: Optional[np.ndarray] = None  # i32[P]
+    svc_member: Optional[np.ndarray] = None  # i8[P, G]
+    svc_fixed: Optional[np.ndarray] = None  # i32[P, L]
 
     @property
     def num_pods(self) -> int:
@@ -277,9 +306,12 @@ class SnapshotEncoder:
     the columnar snapshot + pod batch. Vocabularies are derived jointly so
     pod-side and node-side ids agree."""
 
-    def __init__(self, state: ClusterState, pods: Sequence[Pod]):
+    def __init__(self, state: ClusterState, pods: Sequence[Pod], config=None):
         self.state = state
         self.pods = list(pods)
+        # config-parameterized compilation (ServiceAffinity labels etc.);
+        # None keeps those programs zero-width
+        self.config = config
         self.node_names = [
             name for name, info in state.node_infos.items() if info.node is not None
         ]
@@ -297,6 +329,7 @@ class SnapshotEncoder:
         self.set_members: List[frozenset] = []
         self._interpod = None
         self._volumes = None
+        self._services = None
         self._build_vocabs()
 
     @property
@@ -321,6 +354,19 @@ class SnapshotEncoder:
                 self.state, self.pods, self.node_names
             ).compile()
         return self._volumes
+
+    @property
+    def services_program(self):
+        if self._services is None:
+            from kubernetes_tpu.snapshot.services import ServiceCompiler
+
+            labels = ()
+            if self.config is not None:
+                labels = service_config_labels(self.config)
+            self._services = ServiceCompiler(
+                self.state, self.pods, self.node_names, labels
+            ).compile()
+        return self._services
 
     # -- vocab construction --------------------------------------------------
 
@@ -471,6 +517,20 @@ class SnapshotEncoder:
             vz_has=self.volumes.vz_has,
             img_size=np.zeros((N, max(0, len(self.images))), np.int64),
             key_ids=dict(self.keys.ids),
+            svc_lbl_val=self.services_program.lbl_val,
+            svc_node_ord=self.services_program.node_ord,
+            svc_ord_node=self.services_program.ord_node,
+            svc_first_peer=self.services_program.first_peer,
+            svc_peer_node_count=self.services_program.peer_node_count,
+            svc_peer_total=self.services_program.peer_total,
+            svc_labels=self.services_program.labels,
+            svc_num_values=int(
+                max(
+                    self.services_program.lbl_val.max(initial=-1),
+                    self.services_program.fixed.max(initial=-1),
+                )
+                + 1
+            ),
         )
         for i, name in enumerate(self.node_names):
             info = self.state.node_infos[name]
@@ -710,6 +770,9 @@ class SnapshotEncoder:
             vp_vz_region=self.volumes.p_vz_region,
             vp_vz_fail=self.volumes.p_vz_fail,
             img_count=np.zeros((P, max(0, len(self.images))), np.int64),
+            svc_group=self.services_program.group,
+            svc_member=self.services_program.member,
+            svc_fixed=self.services_program.fixed,
         )
         class_list = list(self.classes.ids.keys())
         for i, pod in enumerate(self.pods):

@@ -1040,3 +1040,186 @@ def test_bucket_padding_bit_identical():
     chosen, _ = BatchScheduler().schedule(ps, pb)
     padded = [ps.node_names[i] if 0 <= i < n_real else None for i in chosen[:p_real]]
     assert padded == plain
+
+
+# --- ServiceAffinity / ServiceAntiAffinity (Policy args) ---------------------
+
+
+def _svc_affinity_cluster(rng=None):
+    nodes = []
+    for i in range(9):
+        labels = {"kubernetes.io/hostname": f"node-{i}"}
+        if i % 3 != 2:  # one node per triple lacks the labels entirely
+            labels["region"] = ["r1", "r2"][i % 2]
+            labels["rack"] = f"rack-{i % 3}"
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(name=f"node-{i}", labels=labels),
+                status=NodeStatus(
+                    allocatable={"cpu": "8", "memory": "32Gi", "pods": "110"},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+    services = [
+        Service(metadata=ObjectMeta(name="web"),
+                spec=ServiceSpec(selector={"app": "web"})),
+        Service(metadata=ObjectMeta(name="db"),
+                spec=ServiceSpec(selector={"app": "db"})),
+    ]
+    return nodes, services
+
+
+def _svc_pod(name, labels, node=None, node_selector=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": "100m"})],
+            node_name=node,
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+
+
+def _run_both_svc(state, pending, labels=("region",), anti_label=None):
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+
+    preds = (
+        ("GeneralPredicates", opreds.general_predicates),
+        ("ServiceAffinity", opreds.service_affinity_predicate(list(labels))),
+    )
+    prios = [
+        PriorityConfig(oprios.least_requested_priority, 1, "LeastRequestedPriority"),
+    ]
+    cfg_prios = [("LeastRequestedPriority", 1)]
+    if anti_label:
+        prios.append(
+            PriorityConfig(
+                oprios.service_anti_affinity_priority(anti_label), 2,
+                "ServiceAntiAffinityPriority",
+            )
+        )
+        cfg_prios.append((("ServiceAntiAffinity", anti_label), 2))
+    oracle = GenericScheduler(predicates=preds, priorities=tuple(prios))
+    oracle_result = oracle.schedule_backlog(pending, state.clone())
+
+    cfg = SchedulerConfig(
+        predicates=("GeneralPredicates", ("ServiceAffinity", tuple(labels))),
+        priorities=tuple(cfg_prios),
+    )
+    snap, batch = SnapshotEncoder(state, pending, config=cfg).encode()
+    tpu_result = BatchScheduler(cfg).schedule_names(snap, batch)
+    return oracle_result, tpu_result
+
+
+def test_service_affinity_follows_first_peer():
+    """predicates.go:596: the first peer's node pins the affinity labels
+    for every later pod of the service — including peers committed
+    mid-backlog."""
+    nodes, services = _svc_affinity_cluster()
+    # a peer already sits on node-0 (region r1): every later web pod must
+    # stay in r1 (and off the unlabeled nodes)
+    state = ClusterState.build(
+        nodes,
+        services=services,
+        assigned_pods=[_svc_pod("web-0", {"app": "web"}, node="node-0")],
+    )
+    pending = [
+        _svc_pod("web-1", {"app": "web"}),
+        _svc_pod("web-2", {"app": "web"}),
+        _svc_pod("lone", {"app": "none"}),  # no service: unconstrained
+    ]
+    oracle_result, tpu_result = _run_both_svc(state, pending)
+    assert tpu_result == oracle_result
+    region_of = {
+        n.metadata.name: n.metadata.labels.get("region") for n in nodes
+    }
+    assert {region_of[h] for h in oracle_result[:2]} == {"r1"}
+
+
+def test_service_affinity_node_selector_pins():
+    """A label value pinned by the pod's own nodeSelector wins over the
+    peer's node."""
+    nodes, services = _svc_affinity_cluster()
+    state = ClusterState.build(
+        nodes,
+        services=services,
+        assigned_pods=[_svc_pod("web-0", {"app": "web"}, node="node-0")],
+    )
+    # peer sits in r1 (node-0); the pinned pod demands r2 -> conflict with
+    # the implicit selector is impossible since nodeSelector wins, so it
+    # lands in r2 per the oracle
+    pending = [
+        _svc_pod("web-pinned", {"app": "web"}, node_selector={"region": "r2"})
+    ]
+    oracle_result, tpu_result = _run_both_svc(state, pending)
+    assert tpu_result == oracle_result
+
+
+def test_service_anti_affinity_spreads_across_label_values():
+    """selector_spreading.go:244: peers spread across values of the config
+    label; unlabeled nodes score 0."""
+    nodes, services = _svc_affinity_cluster()
+    state = ClusterState.build(nodes, services=services)
+    pending = [_svc_pod(f"db-{i}", {"app": "db"}) for i in range(4)]
+    oracle_result, tpu_result = _run_both_svc(
+        state, pending, labels=(), anti_label="region"
+    )
+    assert tpu_result == oracle_result
+    region_of = {
+        n.metadata.name: n.metadata.labels.get("region") for n in nodes
+    }
+    placed = [region_of[h] for h in oracle_result]
+    # spread: both regions used
+    assert set(placed) >= {"r1", "r2"}
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_service_affinity_random_bit_identical(seed):
+    rng = random.Random(3000 + seed)
+    nodes, services = _svc_affinity_cluster()
+    existing = []
+    for i in range(rng.randint(0, 6)):
+        existing.append(
+            _svc_pod(
+                f"e{i}",
+                rng.choice([{"app": "web"}, {"app": "db"}, {"app": "x"}]),
+                node=f"node-{rng.randrange(9)}",
+            )
+        )
+    state = ClusterState.build(nodes, services=services, assigned_pods=existing)
+    pending = [
+        _svc_pod(
+            f"p{i}",
+            rng.choice([{"app": "web"}, {"app": "db"}, {"app": "x"}]),
+            node_selector=rng.choice([{}, {}, {"region": rng.choice(["r1", "r2"])}]),
+        )
+        for i in range(10)
+    ]
+    oracle_result, tpu_result = _run_both_svc(
+        state, pending, labels=("region", "rack"), anti_label="rack"
+    )
+    assert tpu_result == oracle_result, (
+        f"seed {seed}: divergence at "
+        f"{next(i for i, (a, b) in enumerate(zip(oracle_result, tpu_result)) if a != b)}"
+    )
+
+
+def test_service_affinity_all_labels_pinned_ignores_bad_peer():
+    """Review regression (predicates.py 'if unresolved:' gate): when every
+    affinity label is pinned by the pod's nodeSelector, the first peer is
+    never consulted — even a peer on a deleted/None node must not reject
+    candidates."""
+    nodes, services = _svc_affinity_cluster()
+    state = ClusterState.build(nodes, services=services)
+    # a peer assigned to a node that does not exist in the cluster
+    ghost = _svc_pod("ghost", {"app": "web"}, node="gone-node")
+    state.assign(ghost)
+    pending = [
+        _svc_pod("unpinned", {"app": "web"}),  # consults the bad peer: unfit
+        _svc_pod("pinned", {"app": "web"}, node_selector={"region": "r2"}),
+    ]
+    oracle_result, tpu_result = _run_both_svc(state, pending, labels=("region",))
+    assert tpu_result == oracle_result
+    assert oracle_result[0] is None  # unresolved label + bad peer -> unfit
+    assert oracle_result[1] is not None  # all labels pinned: peer ignored
