@@ -38,6 +38,11 @@ class ControllerManagerOptions:
     node_eviction_rate: float = 0.1
     terminated_pod_gc_threshold: int = 12500
     node_monitor_period: float = 5.0
+    # HA active/standby via lease CAS (controllermanager.go:142-170)
+    leader_elect: bool = False
+    leader_elect_identity: str = ""
+    lock_object_namespace: str = "kube-system"
+    lock_object_name: str = "kube-controller-manager"
     enable: tuple = (
         "endpoints",
         "replication",
@@ -110,16 +115,65 @@ class ControllerManager:
             )
 
     def start(self) -> "ControllerManager":
-        self.informers.start()
-        self.informers.wait_for_sync()
-        for c in self.controllers:
-            if isinstance(c, NodeLifecycleController):
-                c.run(self.options.node_monitor_period)
-            else:
-                c.run()
+        import threading
+
+        self._lifecycle_lock = threading.Lock()
+        self._stopped = False
+        if not self.options.leader_elect:
+            self._start_controllers()
+            return self
+        import socket
+        import uuid
+
+        from kubernetes_tpu.client.leaderelection import LeaderElector
+
+        # hostname+uuid like the reference: a process-unique identity
+        # (memory addresses collide across processes)
+        identity = self.options.leader_elect_identity or (
+            f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        )
+        self._elector = LeaderElector(
+            self.client,
+            self.options.lock_object_namespace,
+            self.options.lock_object_name,
+            identity,
+            on_started_leading=self._start_controllers,
+            on_stopped_leading=self.stop,
+        )
+        threading.Thread(target=self._elector.run, daemon=True).start()
         return self
 
+    def is_leader(self) -> bool:
+        elector = getattr(self, "_elector", None)
+        return elector is None or elector.is_leader()
+
+    def _start_controllers(self) -> None:
+        # serialized with stop(): a lease lost while controllers are still
+        # coming up must not leave loops running on a non-leader. The sync
+        # wait stays inside the lock so no controller's first periodic pass
+        # ever sees a half-filled store (stop() blocks at most the bounded
+        # sync wait).
+        with self._lifecycle_lock:
+            if self._stopped:
+                return
+            self.informers.start()
+            self.informers.wait_for_sync()
+            if self._stopped:
+                return
+            for c in self.controllers:
+                if isinstance(c, NodeLifecycleController):
+                    c.run(self.options.node_monitor_period)
+                else:
+                    c.run()
+
     def stop(self) -> None:
+        lock = getattr(self, "_lifecycle_lock", None)
+        if lock is not None:
+            with lock:
+                self._stopped = True
+        elector = getattr(self, "_elector", None)
+        if elector is not None:
+            elector.stop()  # release the lease race to the standby
         for c in self.controllers:
             try:
                 c.stop()

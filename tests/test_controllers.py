@@ -574,3 +574,36 @@ def test_controller_manager_starts_all(plane):
         assert wait_until(lambda: len(pods_of(client)) == 2)
     finally:
         mgr.stop()
+
+
+def test_controller_manager_leader_election():
+    """controllermanager.go:142-170: two managers, one lease — only the
+    leader runs loops; the standby takes over when the leader dies."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    opts = ControllerManagerOptions(
+        leader_elect=True, enable=("replication",),
+    )
+    m1 = ControllerManager(client, opts).start()
+    assert wait_until(lambda: m1.is_leader() and m1.informers._started)
+    m2 = ControllerManager(client, ControllerManagerOptions(
+        leader_elect=True, enable=("replication",))).start()
+    time.sleep(0.5)
+    assert not m2.informers._started  # standby idles without the lease
+    client.resource("replicationcontrollers", "default").create(
+        ReplicationController(
+            metadata=ObjectMeta(name="web"),
+            spec=ReplicationControllerSpec(
+                replicas=2, selector={"app": "web"},
+                template=template({"app": "web"}),
+            ),
+        )
+    )
+    assert wait_until(lambda: len(pods_of(client)) == 2)
+    m1.stop()  # public stop releases the lease (stops the elector too)
+    # standby acquires after the lease expires (15s duration)
+    assert wait_until(lambda: m2.informers._started, timeout=30.0)
+    update_spec(client, "replicationcontrollers", "web",
+                lambda rc: setattr(rc.spec, "replicas", 4))
+    assert wait_until(lambda: len(pods_of(client)) == 4, timeout=30.0)
+    m2.stop()
