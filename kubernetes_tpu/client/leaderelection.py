@@ -75,8 +75,32 @@ class LeaderElector:
         if self.on_stopped_leading:
             self.on_stopped_leading()
 
-    def stop(self) -> None:
+    def stop(self, release: bool = True) -> None:
+        """Stop participating; when currently leading and `release` is
+        True, zero out the lease so a standby acquires immediately instead
+        of waiting out lease_duration (the releasedLease pattern)."""
+        was_leader = self.is_leader()
         self._stop.set()
+        if release and was_leader:
+            try:
+                self._release()
+            except Exception:
+                pass  # best effort; the lease will expire anyway
+
+    def _release(self) -> None:
+        endpoints = self.client.resource("endpoints", self.namespace)
+        obj = endpoints.get(self.name)
+        existing = _decode(obj.metadata.annotations.get(LEADER_ANNOTATION, ""))
+        if existing is None or existing.holder_identity != self.identity:
+            return
+        released = LeaderElectionRecord(
+            holder_identity=self.identity,
+            lease_duration_seconds=0.0,  # freshness check fails instantly
+            acquire_time=existing.acquire_time,
+            renew_time=self.clock.now(),
+        )
+        obj.metadata.annotations[LEADER_ANNOTATION] = _encode(released)
+        endpoints.update(obj)
 
     # -- internals -----------------------------------------------------------
 

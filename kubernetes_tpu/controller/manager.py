@@ -1,14 +1,26 @@
 """The controller manager (cmd/kube-controller-manager/app/
 controllermanager.go StartControllers:197): one process starting every
-reconciliation loop over a shared informer factory."""
+reconciliation loop over a shared informer factory.
+
+HA model mirrors the reference (crash-and-restart): losing the leader
+lease stops every loop and sets `lost_lease`; the hosting process is
+expected to exit and rejoin as a fresh standby (controllermanager.go
+Fatalf on leaderelection loss). Embedders poll `lost_lease` or pass
+their own on_stopped_leading via the elector."""
 
 from __future__ import annotations
 
+import socket
+import threading
+import uuid
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from kubernetes_tpu.client.leaderelection import LeaderElector
+
 from kubernetes_tpu.client.record import EventBroadcaster, EventSink
 from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.controller.cloud import RouteController, ServiceController
 from kubernetes_tpu.controller.autoscale import (
     HorizontalController,
     MetricsClient,
@@ -65,6 +77,7 @@ class ControllerManager:
         client: RESTClient,
         options: Optional[ControllerManagerOptions] = None,
         metrics_client: Optional[MetricsClient] = None,
+        cloud=None,
     ):
         self.client = client
         self.options = options or ControllerManagerOptions()
@@ -106,6 +119,13 @@ class ControllerManager:
             client, self.informers))
         add("pv-binder", lambda: PersistentVolumeClaimBinder(
             client, self.informers))
+        if cloud is not None:
+            # cloud-facing loops only run with a provider configured
+            # (controllermanager.go:239-258 gates on cloudprovider too)
+            self.controllers.append(
+                ServiceController(client, self.informers, cloud))
+            self.controllers.append(
+                RouteController(client, self.informers, cloud))
         if metrics_client is not None:
             self.controllers.append(
                 HorizontalController(
@@ -115,18 +135,14 @@ class ControllerManager:
             )
 
     def start(self) -> "ControllerManager":
-        import threading
-
         self._lifecycle_lock = threading.Lock()
         self._stopped = False
+        #: set when the leader lease was LOST (not a voluntary stop); the
+        #: hosting process should exit and restart (crash-restart HA)
+        self.lost_lease = False
         if not self.options.leader_elect:
             self._start_controllers()
             return self
-        import socket
-        import uuid
-
-        from kubernetes_tpu.client.leaderelection import LeaderElector
-
         # hostname+uuid like the reference: a process-unique identity
         # (memory addresses collide across processes)
         identity = self.options.leader_elect_identity or (
@@ -138,28 +154,35 @@ class ControllerManager:
             self.options.lock_object_name,
             identity,
             on_started_leading=self._start_controllers,
-            on_stopped_leading=self.stop,
+            on_stopped_leading=self._on_lease_lost,
         )
         threading.Thread(target=self._elector.run, daemon=True).start()
         return self
 
+    def _on_lease_lost(self) -> None:
+        if not self._stopped:  # voluntary stop() is not a lost lease
+            self.lost_lease = True
+        self.stop()
+
     def is_leader(self) -> bool:
+        if not self.options.leader_elect:
+            return True
         elector = getattr(self, "_elector", None)
-        return elector is None or elector.is_leader()
+        # leader_elect configured but not yet started/acquired: NOT leader
+        return elector is not None and elector.is_leader()
 
     def _start_controllers(self) -> None:
-        # serialized with stop(): a lease lost while controllers are still
-        # coming up must not leave loops running on a non-leader. The sync
-        # wait stays inside the lock so no controller's first periodic pass
-        # ever sees a half-filled store (stop() blocks at most the bounded
-        # sync wait).
+        # serialized with stop(): once stop() has run (and set _stopped),
+        # a late-firing on_started_leading must be a no-op rather than
+        # starting loops on a non-leader. The sync wait stays inside the
+        # lock so no controller's first periodic pass ever sees a
+        # half-filled store (a concurrent stop() blocks for at most the
+        # bounded sync wait).
         with self._lifecycle_lock:
             if self._stopped:
                 return
             self.informers.start()
             self.informers.wait_for_sync()
-            if self._stopped:
-                return
             for c in self.controllers:
                 if isinstance(c, NodeLifecycleController):
                     c.run(self.options.node_monitor_period)
@@ -173,7 +196,9 @@ class ControllerManager:
                 self._stopped = True
         elector = getattr(self, "_elector", None)
         if elector is not None:
-            elector.stop()  # release the lease race to the standby
+            # stop renewing AND zero the lease record so the standby
+            # acquires immediately instead of waiting out lease_duration
+            elector.stop(release=True)
         for c in self.controllers:
             try:
                 c.stop()

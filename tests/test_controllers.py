@@ -600,10 +600,59 @@ def test_controller_manager_leader_election():
         )
     )
     assert wait_until(lambda: len(pods_of(client)) == 2)
-    m1.stop()  # public stop releases the lease (stops the elector too)
-    # standby acquires after the lease expires (15s duration)
-    assert wait_until(lambda: m2.informers._started, timeout=30.0)
+    m1.stop()  # releases the lease: the standby acquires without
+    # waiting out the 15s lease_duration
+    assert not m1.lost_lease  # voluntary stop is not a lost lease
+    assert wait_until(lambda: m2.informers._started, timeout=15.0)
     update_spec(client, "replicationcontrollers", "web",
                 lambda rc: setattr(rc.spec, "replicas", 4))
     assert wait_until(lambda: len(pods_of(client)) == 4, timeout=30.0)
     m2.stop()
+
+
+def test_service_and_route_controllers(plane):
+    """servicecontroller.go + routecontroller.go against the fake cloud:
+    LoadBalancer services get balancers spanning the nodes; nodes get pod
+    CIDR routes; deletions tear both down."""
+    from kubernetes_tpu.cloudprovider import FakeCloud
+    from kubernetes_tpu.controller.cloud import RouteController, ServiceController
+
+    server, client, informers, start = plane
+    cloud = FakeCloud()
+    sc = ServiceController(client, informers, cloud)
+    rc = RouteController(client, informers, cloud)
+    client.nodes().create(ready_node("n1"))
+    client.nodes().create(ready_node("n2"))
+    client.resource("services", "default").create(
+        Service(
+            metadata=ObjectMeta(name="lb"),
+            spec=ServiceSpec(
+                selector={"app": "web"},
+                type="LoadBalancer",
+                ports=[ServicePort(port=443)],
+            ),
+        )
+    )
+    informers.start()
+    informers.wait_for_sync()
+    assert wait_until(lambda: len(informers.nodes().store.list()) == 2)
+    sc.sync_once()
+    rc.sync_once()
+    lbs = list(cloud.balancers.values())
+    assert len(lbs) == 1
+    assert lbs[0].ports == (443,) and lbs[0].hosts == ("n1", "n2")
+    assert lbs[0].region == cloud.get_zone().region
+    routes = cloud.list_routes("kubernetes")
+    assert sorted(r.target_instance for r in routes) == ["n1", "n2"]
+    assert all(r.destination_cidr.endswith("/24") for r in routes)
+    # service deleted -> balancer torn down; node gone -> route removed
+    client.resource("services", "default").delete("lb")
+    client.nodes().delete("n2")
+    assert wait_until(lambda: len(informers.nodes().store.list()) == 1)
+    assert wait_until(
+        lambda: len(informers.informer("services").store.list()) == 0
+    )
+    sc.sync_once()
+    rc.sync_once()
+    assert cloud.balancers == {}
+    assert [r.target_instance for r in cloud.list_routes("kubernetes")] == ["n1"]
