@@ -230,6 +230,10 @@ class APIServer:
             from kubernetes_tpu.metrics import registry as metrics_registry
 
             return 200, {"text": metrics_registry.render()}
+        if path == "/configz":
+            from kubernetes_tpu.utils import configz
+
+            return 200, configz.snapshot()
         if path in ("/api", "/api/v1", "/apis"):
             return 200, {"resources": sorted(self.resources)}
 

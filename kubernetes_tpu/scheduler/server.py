@@ -64,6 +64,11 @@ class SchedulerServer:
 
     def start(self) -> "SchedulerServer":
         opts = self.options
+        # config introspection (server.go:72-76: configz.New +
+        # InstallHandler; served at the shared mux's /configz)
+        from kubernetes_tpu.utils import configz
+
+        configz.install("componentconfig", opts)
         self.factory = ConfigFactory(
             self.client,
             scheduler_name=opts.scheduler_name,
@@ -115,6 +120,9 @@ class SchedulerServer:
         return self._elector is None or self._elector.is_leader()
 
     def stop(self) -> None:
+        from kubernetes_tpu.utils import configz
+
+        configz.delete("componentconfig")
         if self._elector is not None:
             self._elector.stop()
         if self.scheduler is not None:

@@ -336,3 +336,20 @@ class TestHTTPFrontend:
             assert [e["object"]["metadata"]["name"] for e in events] == ["a", "b"]
         finally:
             api.shutdown_http()
+
+
+def test_configz_endpoint():
+    """pkg/util/configz: components install live config; /configz serves
+    the merged JSON view."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.scheduler.server import SchedulerServerOptions
+    from kubernetes_tpu.utils import configz
+
+    configz.install("componentconfig", SchedulerServerOptions())
+    try:
+        server = APIServer()
+        code, payload = server.handle("GET", "/configz", {}, None)
+        assert code == 200
+        assert payload["componentconfig"]["scheduler_name"] == "default-scheduler"
+    finally:
+        configz.delete("componentconfig")
