@@ -99,12 +99,10 @@ class SchedulerConfig:
 
 def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
     (
-        req_mcpu,
-        req_mem,
-        req_gpu,
-        nz_mcpu,
-        nz_mem,
-        pod_count,
+        # res: i64 (6, N) = [req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
+        # pod_count] stacked so the per-step commit is ONE scatter (the
+        # scan body is fusion-count-bound on TPU)
+        res,
         port_mask,
         class_count,
         last_idx,
@@ -122,6 +120,7 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
         svc_peer_node_count,
         svc_peer_total,
     ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
     num_nodes = req_mcpu.shape[0]
     svc_labels = service_config_labels(config)
 
@@ -356,12 +355,19 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
     # max rule (node_info.go:158), hence commit_* not req_*.
     safe = jnp.maximum(chosen, 0)
     inc = scheduled.astype(jnp.int64)
-    req_mcpu = req_mcpu.at[safe].add(pod["commit_mcpu"] * inc)
-    req_mem = req_mem.at[safe].add(pod["commit_mem"] * inc)
-    req_gpu = req_gpu.at[safe].add(pod["commit_gpu"] * inc)
-    nz_mcpu = nz_mcpu.at[safe].add(pod["nz_mcpu"] * inc)
-    nz_mem = nz_mem.at[safe].add(pod["nz_mem"] * inc)
-    pod_count = pod_count.at[safe].add(inc)
+    res = res.at[:, safe].add(
+        jnp.stack(
+            [
+                pod["commit_mcpu"],
+                pod["commit_mem"],
+                pod["commit_gpu"],
+                pod["nz_mcpu"],
+                pod["nz_mem"],
+                jnp.int64(1),
+            ]
+        )
+        * inc
+    )
     port_mask = port_mask.at[safe].set(
         jnp.where(scheduled, port_mask[safe] | pod["port_mask"], port_mask[safe])
     )
@@ -419,12 +425,7 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
         )
 
     carry = (
-        req_mcpu,
-        req_mem,
-        req_gpu,
-        nz_mcpu,
-        nz_mem,
-        pod_count,
+        res,
         port_mask,
         class_count,
         last_idx,
@@ -450,7 +451,7 @@ class BatchScheduler:
     to the serial reference loop. One compile per (N, P, widths) shape."""
 
     # carry tuple index of selectHost's round-robin counter
-    LAST_IDX = 8
+    LAST_IDX = 3
 
     POD_FIELDS = [
         "req_mcpu",
@@ -596,12 +597,16 @@ class BatchScheduler:
 
     def initial_carry(self, snap: ClusterSnapshot, last_node_index: int = 0):
         return (
-            jnp.asarray(snap.req_mcpu),
-            jnp.asarray(snap.req_mem),
-            jnp.asarray(snap.req_gpu),
-            jnp.asarray(snap.nz_mcpu),
-            jnp.asarray(snap.nz_mem),
-            jnp.asarray(snap.pod_count),
+            jnp.stack(
+                [
+                    jnp.asarray(snap.req_mcpu),
+                    jnp.asarray(snap.req_mem),
+                    jnp.asarray(snap.req_gpu),
+                    jnp.asarray(snap.nz_mcpu),
+                    jnp.asarray(snap.nz_mem),
+                    jnp.asarray(snap.pod_count),
+                ]
+            ),
             jnp.asarray(snap.port_mask),
             jnp.asarray(snap.class_count),
             # selectHost's persistent round-robin counter

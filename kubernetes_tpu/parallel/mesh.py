@@ -93,12 +93,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
     slice; `pod` is replicated. Mirrors models.batch._scan_fn with the
     normalization maxes and selection made global via collectives."""
     (
-        req_mcpu,
-        req_mem,
-        req_gpu,
-        nz_mcpu,
-        nz_mem,
-        pod_count,
+        res,
         port_mask,
         class_count,
         last_idx,
@@ -116,6 +111,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         svc_peer_node_count,
         svc_peer_total,
     ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
 
     shard = jax.lax.axis_index(AXIS)
     offset = shard.astype(jnp.int32) * n_per_shard
@@ -316,12 +312,15 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
     mine = scheduled & (local >= 0) & (local < n_per_shard)
     safe = jnp.clip(local, 0, n_per_shard - 1)
     inc = mine.astype(jnp.int64)
-    req_mcpu = req_mcpu.at[safe].add(pod["commit_mcpu"] * inc)
-    req_mem = req_mem.at[safe].add(pod["commit_mem"] * inc)
-    req_gpu = req_gpu.at[safe].add(pod["commit_gpu"] * inc)
-    nz_mcpu = nz_mcpu.at[safe].add(pod["nz_mcpu"] * inc)
-    nz_mem = nz_mem.at[safe].add(pod["nz_mem"] * inc)
-    pod_count = pod_count.at[safe].add(inc)
+    res = res.at[:, safe].add(
+        jnp.stack(
+            [
+                pod["commit_mcpu"], pod["commit_mem"], pod["commit_gpu"],
+                pod["nz_mcpu"], pod["nz_mem"], jnp.int64(1),
+            ]
+        )
+        * inc
+    )
     port_mask = port_mask.at[safe].set(
         jnp.where(mine, port_mask[safe] | pod["port_mask"], port_mask[safe])
     )
@@ -363,8 +362,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         gce_mask = gce_mask.at[safe].set(gce_mask[safe] | (pod["vp_gce"] & sel))
 
     carry = (
-        req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
-        pod_count, port_mask, class_count, last_idx,
+        res, port_mask, class_count, last_idx,
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref, ip_rev_anti,
         ip_spec_total,
         vol_any, vol_rw, ebs_mask, gce_mask,
@@ -476,8 +474,8 @@ class MeshBatchScheduler:
             for k in static
         }
         carry_specs = (
-            PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS), PSpec(AXIS),
-            PSpec(AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
+            # stacked resources: node axis is axis 1
+            PSpec(None, AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
             # interpod count tables: replicated (domain-indexed, not node)
             PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
             # volume masks: node-axis sharded
