@@ -97,11 +97,33 @@ class SchedulerConfig:
     max_gce_pd_volumes: int = 16
 
 
-def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
+def interpod_carry_tables(static, ip_term_count, num_nodes):
+    """cnt_lt — the per-node expansion of the inter-pod term counts
+    carried between steps. Shared by the scan body and the wave probe
+    (models/probe.py)."""
+    cnt_u = IP.gather_counts(
+        ip_term_count, static["ip_u_topo"], static["ip_topo_dom"]
+    )
+    return IP.expand_lt(
+        cnt_u, static["ip_lt_u"], static["ip_lt_sign"], num_nodes
+    )
+
+
+def fit_mask(
+    config: "SchedulerConfig",
+    static,
+    carry,
+    pod,
+    cnt_lt,
+    include_resources: bool = True,
+):
+    """The full predicate AND for one pod against one carry state.
+
+    `include_resources=False` drops the carry-dependent PodFitsResources
+    term (the wave probe tabulates it separately over the commit count —
+    models/probe.py); everything else is evaluated against the given
+    carry exactly as the serial scan does."""
     (
-        # res: i64 (6, N) = [req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
-        # pod_count] stacked so the per-step commit is ONE scatter (the
-        # scan body is fusion-count-bound on TPU)
         res,
         port_mask,
         class_count,
@@ -123,16 +145,9 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
     req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
     num_nodes = req_mcpu.shape[0]
     svc_labels = service_config_labels(config)
-
     want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
     want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
-    if want_ip_pred or want_ip_prio:
-        cnt_u = IP.gather_counts(
-            ip_term_count, static["ip_u_topo"], static["ip_topo_dom"]
-        )
-        cnt_lt = IP.expand_lt(
-            cnt_u, static["ip_lt_u"], static["ip_lt_sign"], num_nodes
-        )
+
     fit = ~pod["unschedulable"]
     if want_ip_prio:
         # a bad assigned-pod annotation errors the priority for every pod
@@ -169,20 +184,21 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
             config.max_gce_pd_volumes,
         )
     if GENERAL_PREDICATES in config.predicates:
-        fit = fit & P.pod_fits_resources(
-            pod["req_mcpu"],
-            pod["req_mem"],
-            pod["req_gpu"],
-            pod["zero_req"],
-            static["alloc_mcpu"],
-            static["alloc_mem"],
-            static["alloc_gpu"],
-            static["alloc_pods"],
-            req_mcpu,
-            req_mem,
-            req_gpu,
-            pod_count,
-        )
+        if include_resources:
+            fit = fit & P.pod_fits_resources(
+                pod["req_mcpu"],
+                pod["req_mem"],
+                pod["req_gpu"],
+                pod["zero_req"],
+                static["alloc_mcpu"],
+                static["alloc_mem"],
+                static["alloc_gpu"],
+                static["alloc_pods"],
+                req_mcpu,
+                req_mem,
+                req_gpu,
+                pod_count,
+            )
         fit = fit & P.pod_fits_host(pod["host_req"], static["alloc_mcpu"].shape[0])
         fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
         fit = fit & P.match_node_selector(
@@ -254,6 +270,43 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
             pod["ip_sym_reject"],
             num_nodes,
         )
+    return fit
+
+
+def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
+    (
+        # res: i64 (6, N) = [req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
+        # pod_count] stacked so the per-step commit is ONE scatter (the
+        # scan body is fusion-count-bound on TPU)
+        res,
+        port_mask,
+        class_count,
+        last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
+    ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
+    num_nodes = req_mcpu.shape[0]
+    svc_labels = service_config_labels(config)
+
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    cnt_lt = None
+    if want_ip_pred or want_ip_prio:
+        cnt_lt = interpod_carry_tables(static, ip_term_count, num_nodes)
+
+    fit = fit_mask(config, static, carry, pod, cnt_lt, include_resources=True)
 
     score = jnp.zeros(req_mcpu.shape, jnp.int64)
     for name, weight in config.priorities:

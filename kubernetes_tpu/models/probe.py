@@ -1,0 +1,258 @@
+"""Wave probe: one device program that tabulates everything a run of
+identical pods needs, so the host replay can reproduce the serial pick
+sequence without 50k serial device steps.
+
+For a run of identical pending pods (same encoded feature row — see
+snapshot/encode.pod_feature_key) scheduled back-to-back, every
+scheduling-relevant quantity is one of:
+
+  * static during the run (node labels/taints/affinity matching, volume
+    zone, image locality, host ports vs. the frozen mask, inter-pod
+    state when the pod owns no affinity terms), or
+  * a per-node function of j = how many of the run's pods have already
+    been committed to that node (PodFitsResources, LeastRequested,
+    BalancedResourceAllocation — the carry contribution of j identical
+    commits is j * the pod's commit vector), or
+  * a normalization over the live fit set / live counts that changes
+    only on rare events (SelectorSpread's maxCount, the
+    NodeAffinity/TaintToleration/InterPod normalizers) — recomputed by
+    the replay when those events fire.
+
+The probe evaluates the static parts and the j-tables in ONE jitted
+program reusing the exact scan ops (models/batch.fit_mask and ops/*),
+so every number the replay consumes is produced by the same kernels the
+serial scan would have used.  Reference analogue: this is the hot loop
+of generic_scheduler.go:72-135 factored into "what changes per pod" vs
+"what doesn't" — a restructuring the serial Go scheduler never needed
+because its per-pod cost was already CPU-bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.batch import (
+    BALANCED_ALLOCATION,
+    EQUAL,
+    GENERAL_PREDICATES,
+    IMAGE_LOCALITY,
+    INTER_POD_AFFINITY,
+    LEAST_REQUESTED,
+    NODE_AFFINITY,
+    NODE_LABEL_PRIORITY,
+    SELECTOR_SPREAD,
+    SERVICE_ANTI_AFFINITY,
+    TAINT_TOLERATION,
+    MATCH_INTER_POD_AFFINITY,
+    SchedulerConfig,
+    fit_mask,
+    interpod_carry_tables,
+)
+from kubernetes_tpu.ops import interpod as IP
+from kubernetes_tpu.ops import predicates as P
+from kubernetes_tpu.ops import priorities as R
+
+
+@dataclass
+class RunTables:
+    """Host-side tables for one run (all numpy; see models/replay.py)."""
+
+    fit_static: np.ndarray  # bool[N]
+    res_fit: np.ndarray  # bool[J, N]
+    tab: np.ndarray  # i64[J, N] weighted LeastRequested+Balanced
+    static_add: np.ndarray  # i64[N] Equal/ImageLocality/NodeLabel sum
+    # SelectorSpread (None when not configured)
+    w_spread: int
+    spread_base: Optional[np.ndarray]  # i64[N]
+    spread_selfmatch: bool
+    has_selectors: bool
+    # NodeAffinity preferred (unnormalized weight counts)
+    w_na: int
+    na_counts: Optional[np.ndarray]  # i64[N]
+    # TaintToleration (unnormalized intolerable counts)
+    w_tt: int
+    tt_counts: Optional[np.ndarray]  # i64[N]
+    # InterPodAffinity (unnormalized totals; static because the pod owns
+    # no terms — the eligibility gate guarantees it)
+    w_ip: int
+    ip_totals: Optional[np.ndarray]  # i64[N]
+
+
+def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
+              static, carry, pod):
+    (
+        res,
+        port_mask,
+        class_count,
+        last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
+    ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
+    N = req_mcpu.shape[0]
+
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    cnt_lt = None
+    if want_ip_pred or want_ip_prio:
+        cnt_lt = interpod_carry_tables(static, ip_term_count, N)
+
+    fit_static = fit_mask(
+        config, static, carry, pod, cnt_lt, include_resources=False
+    )
+
+    j = jnp.arange(J, dtype=jnp.int64)[:, None]  # (J, 1)
+    if GENERAL_PREDICATES in config.predicates:
+        res_fit = P.pod_fits_resources(
+            pod["req_mcpu"],
+            pod["req_mem"],
+            pod["req_gpu"],
+            pod["zero_req"],
+            static["alloc_mcpu"],
+            static["alloc_mem"],
+            static["alloc_gpu"],
+            static["alloc_pods"],
+            req_mcpu[None, :] + j * pod["commit_mcpu"],
+            req_mem[None, :] + j * pod["commit_mem"],
+            req_gpu[None, :] + j * pod["commit_gpu"],
+            pod_count[None, :] + j,
+        )
+        # host-port self-conflict: once one copy holds the pod's host
+        # ports on a node, no further copy fits there (predicates.go:574)
+        has_ports = (pod["port_mask"] != 0).any()
+        res_fit = res_fit & ((j == 0) | ~has_ports)
+    else:
+        res_fit = jnp.ones((J, N), bool)
+
+    nzj_cpu = nz_mcpu[None, :] + j * pod["nz_mcpu"]
+    nzj_mem = nz_mem[None, :] + j * pod["nz_mem"]
+    tab = jnp.zeros((J, N), jnp.int64)
+    static_add = jnp.zeros((N,), jnp.int64)
+    out = {"fit_static": fit_static, "res_fit": res_fit}
+    for name, weight in config.priorities:
+        if name == LEAST_REQUESTED:
+            tab = tab + jnp.int64(weight) * R.least_requested(
+                pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == BALANCED_ALLOCATION:
+            tab = tab + jnp.int64(weight) * R.balanced_resource_allocation(
+                pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == SELECTOR_SPREAD:
+            # unmasked base counts; the replay applies the fit mask and
+            # maxCount normalization per pick (ops/priorities.py:62)
+            out["spread_base"] = (
+                class_count.astype(jnp.int32)
+                @ pod["spread_match"].astype(jnp.int32)
+            ).astype(jnp.int64)
+            out["spread_selfmatch"] = pod["spread_match"][pod["class_id"]] > 0
+        elif name == NODE_AFFINITY:
+            out["na_counts"] = R.node_affinity_counts(
+                pod["pref_valid"], pod["pref_weight"], pod["pref_ops"],
+                pod["pref_key"], pod["pref_set"], pod["pref_numkey"],
+                pod["pref_num"], static["label_kv"], static["label_key"],
+                static["numval"], static["set_table"],
+            )
+        elif name == TAINT_TOLERATION:
+            out["tt_counts"] = (
+                static["taint_count"] @ pod["intolerable_prefer"]
+            ).astype(jnp.int64)
+        elif name == INTER_POD_AFFINITY:
+            out["ip_totals"] = IP.interpod_totals(
+                cnt_lt,
+                IP.gather_lt(ip_rev_hard, static["ip_u_topo"],
+                             static["ip_topo_dom"], static["ip_lt_u"],
+                             static["ip_lt_sign"]),
+                IP.gather_lt(ip_rev_pref, static["ip_u_topo"],
+                             static["ip_topo_dom"], static["ip_lt_u"],
+                             static["ip_lt_sign"]),
+                IP.gather_lt(ip_rev_anti, static["ip_u_topo"],
+                             static["ip_topo_dom"], static["ip_lt_u"],
+                             static["ip_lt_sign"]),
+                static["ip_lt_spec"], pod["ip_match_spec"],
+                pod["ip_fwd_lt"], pod["ip_fwd_w"],
+                config.hard_pod_affinity_weight, N,
+            )
+        elif name == EQUAL:
+            static_add = static_add + jnp.int64(weight) * R.equal(N)
+        elif name == IMAGE_LOCALITY:
+            static_add = static_add + jnp.int64(weight) * R.image_locality(
+                static["img_size"], pod["img_count"]
+            )
+        elif isinstance(name, tuple) and name[0] == NODE_LABEL_PRIORITY:
+            static_add = static_add + jnp.int64(weight) * R.node_label(
+                static[f"nl_prio_{name[1]}"], name[2]
+            )
+        elif isinstance(name, tuple) and name[0] == SERVICE_ANTI_AFFINITY:
+            raise ValueError("ServiceAntiAffinity is not wave-eligible")
+        else:
+            raise ValueError(f"unknown priority {name!r}")
+    out["tab"] = tab
+    out["static_add"] = static_add
+    return out
+
+
+class WaveProbe:
+    """Compiles/caches the probe program per (config, J); emits RunTables."""
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        self._jitted = {}
+
+    def _compiled(self, num_zones: int, num_values: int, J: int):
+        key = (num_zones, num_values, J)
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    _probe_fn, self.config, num_zones, num_values, J
+                )
+            )
+            self._jitted[key] = fn
+        return fn
+
+    def probe(self, static, carry, pod, num_zones: int, num_values: int,
+              J: int) -> RunTables:
+        raw = self._compiled(num_zones, num_values, J)(static, carry, pod)
+        raw = jax.device_get(raw)
+        weights = {n if isinstance(n, str) else n[0]: w
+                   for n, w in self.config.priorities}
+        return RunTables(
+            fit_static=np.asarray(raw["fit_static"]),
+            res_fit=np.asarray(raw["res_fit"]),
+            tab=np.asarray(raw["tab"]),
+            static_add=np.asarray(raw["static_add"]),
+            w_spread=int(weights.get(SELECTOR_SPREAD, 0)),
+            spread_base=(np.asarray(raw["spread_base"])
+                         if "spread_base" in raw else None),
+            spread_selfmatch=bool(raw.get("spread_selfmatch", False)),
+            has_selectors=bool(np.asarray(pod["has_selectors"])),
+            w_na=int(weights.get(NODE_AFFINITY, 0)),
+            na_counts=(np.asarray(raw["na_counts"])
+                       if "na_counts" in raw else None),
+            w_tt=int(weights.get(TAINT_TOLERATION, 0)),
+            tt_counts=(np.asarray(raw["tt_counts"])
+                       if "tt_counts" in raw else None),
+            w_ip=int(weights.get(INTER_POD_AFFINITY, 0)),
+            ip_totals=(np.asarray(raw["ip_totals"])
+                       if "ip_totals" in raw else None),
+        )

@@ -1,0 +1,341 @@
+"""Wave backlog driver: runs of identical pods bypass the serial scan.
+
+The serial scan (models/batch.py) is bit-identical to the reference's
+scheduleOne loop but fundamentally serial: 50k pods = 50k sequential
+device steps, which no per-step optimization can bring under the
+50k-pods-in-1s target. This driver splits the FIFO backlog into maximal
+runs of consecutive *identical* pods (equal snapshot/encode
+pod_feature_key — exactly what an RC/RS/Job template emits), and for
+each eligible run:
+
+  1. probes the frozen carry once on device (models/probe.py) —
+     static fit + score tables over the per-node commit count, and
+  2. replays the pick sequence on the host (models/replay.py, C engine
+     in native/replay.c) in O(log N) per pod, reproducing selectHost's
+     exact round-robin tie rule, then
+  3. applies the run's commits to the carry in one device scatter
+     (the AssumePod fold of j identical pods is linear in the counts).
+
+Ineligible pods (own inter-pod terms, volumes, service-affinity
+membership — anything whose commit feeds back into its own run's
+decisions in ways the tables can't express) fall back to the serial
+scan, threading the same carry, so the combined output is bit-identical
+to scanning the whole backlog. Eligibility is per-run and conservative;
+tests/test_wave.py fuzzes equivalence.
+
+Reference hot loop this replaces: generic_scheduler.go:72-135 +
+scheduler.go:122 AssumePod, iterated per pod.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.batch import (
+    BALANCED_ALLOCATION,
+    EQUAL,
+    IMAGE_LOCALITY,
+    INTER_POD_AFFINITY,
+    LEAST_REQUESTED,
+    NODE_AFFINITY,
+    NODE_LABEL_PRIORITY,
+    SELECTOR_SPREAD,
+    TAINT_TOLERATION,
+    BatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.models.probe import RunTables, WaveProbe
+from kubernetes_tpu.models.replay import ReplayResult, replay_fast
+from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
+from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
+
+_WAVE_PRIORITIES = {
+    LEAST_REQUESTED,
+    BALANCED_ALLOCATION,
+    SELECTOR_SPREAD,
+    NODE_AFFINITY,
+    TAINT_TOLERATION,
+    INTER_POD_AFFINITY,
+    EQUAL,
+    IMAGE_LOCALITY,
+}
+
+
+def config_eligible(config: SchedulerConfig) -> bool:
+    total_w = 0
+    for name, w in config.priorities:
+        if isinstance(name, tuple):
+            if name[0] != NODE_LABEL_PRIORITY:
+                return False  # ServiceAntiAffinity renormalizes per pick
+        elif name not in _WAVE_PRIORITIES:
+            return False
+        total_w += abs(w)
+    # replay score range guard (C engine buckets by score value)
+    return total_w * 10 < (1 << 20)
+
+
+def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
+                 snap: ClusterSnapshot, *, config_ok: bool = None,
+                 zoned: bool = None) -> bool:
+    """True when pod row i's run can take the fast path: its commits
+    must not feed back into its own fit/score except through the
+    channels the tables model (resources, ports-self, spread counts).
+    config_ok/zoned are hoistable per-backlog invariants."""
+    if config_ok is None:
+        config_ok = config_eligible(config)
+    if not config_ok:
+        return False
+    b = batch
+    # own inter-pod terms make fit/score depend on intra-run commits
+    if b.ip_ha_lt.size and np.any(b.ip_ha_lt[i] >= 0):
+        return False
+    if b.ip_hq_lt.size and np.any(b.ip_hq_lt[i] >= 0):
+        return False
+    if b.ip_fwd_lt.size and np.any(b.ip_fwd_lt[i] >= 0):
+        return False
+    for f in ("ip_own_hard", "ip_own_pref", "ip_own_anti_hard",
+              "ip_own_anti_pref"):
+        v = getattr(b, f)
+        if v.size and np.any(v[i]):
+            return False
+    # volume commits conflict with the run's own copies
+    if np.any(b.vp_vol_rw[i]) or np.any(b.vp_vol_ro[i]):
+        return False
+    if np.any(b.vp_ebs[i]) or np.any(b.vp_gce[i]):
+        return False
+    if b.vp_has_ebs[i] or b.vp_has_gce[i] or b.vp_ebs_bad[i] or b.vp_gce_bad[i]:
+        return False
+    # a service member's commits move the ServiceAffinity first-peer /
+    # ServiceAntiAffinity counts
+    if b.svc_member.ndim == 2 and b.svc_member.shape[1] and np.any(b.svc_member[i]):
+        return False
+    # zone-blended spread couples all nodes of a zone per commit
+    if zoned is None:
+        zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
+    if b.has_selectors[i] and zoned:
+        return False
+    return True
+
+
+def gather_batch(batch: PodBatch, rows: np.ndarray) -> PodBatch:
+    """Materialize per-position rows from the unique-representative
+    batch (fancy-index every pod-axis array)."""
+    import dataclasses
+
+    fields = {}
+    for f in dataclasses.fields(batch):
+        v = getattr(batch, f.name)
+        if f.name == "pod_keys":
+            fields[f.name] = [v[r] for r in rows]
+        elif isinstance(v, np.ndarray) and v.ndim >= 1 and v.shape[0] == batch.num_pods:
+            fields[f.name] = v[rows]
+        else:
+            fields[f.name] = v
+    return dc_replace(batch, **fields)
+
+
+def _permute_tables(t: RunTables, perm: np.ndarray) -> RunTables:
+    def p1(a):
+        return None if a is None else a[perm]
+
+    return RunTables(
+        fit_static=t.fit_static[perm],
+        res_fit=t.res_fit[:, perm],
+        tab=t.tab[:, perm],
+        static_add=t.static_add[perm],
+        w_spread=t.w_spread,
+        spread_base=p1(t.spread_base),
+        spread_selfmatch=t.spread_selfmatch,
+        has_selectors=t.has_selectors,
+        w_na=t.w_na,
+        na_counts=p1(t.na_counts),
+        w_tt=t.w_tt,
+        tt_counts=p1(t.tt_counts),
+        w_ip=t.w_ip,
+        ip_totals=p1(t.ip_totals),
+    )
+
+
+class WaveScheduler:
+    """Schedules an encoded backlog (unique rows + per-position rep
+    index) bit-identically to the serial scan, fast-pathing runs."""
+
+    LAST_IDX = BatchScheduler.LAST_IDX
+
+    def __init__(self, config: Optional[SchedulerConfig] = None,
+                 min_run: int = 16, max_j: int = 1024, pod_floor: int = 64,
+                 replay=None):
+        self.config = config or SchedulerConfig()
+        self.scan = BatchScheduler(self.config)
+        self.probe = WaveProbe(self.config)
+        self.min_run = min_run
+        self.max_j = max_j
+        self.pod_floor = pod_floor
+        self._replay = replay or replay_fast
+        self._apply = jax.jit(self._apply_fn)
+
+    # -- carry commit of a whole run -----------------------------------------
+
+    def _apply_fn(self, static, carry, pod, counts):
+        """Fold j identical commits per node into the carry — the exact
+        sum of the scan's per-step commit section over the run."""
+        (
+            res, port_mask, class_count, last_idx,
+            ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
+            ip_rev_anti, ip_spec_total,
+            vol_any, vol_rw, ebs_mask, gce_mask,
+            svc_first_peer, svc_peer_node_count, svc_peer_total,
+        ) = carry
+        k = counts.sum()
+        commit = jnp.stack([
+            pod["commit_mcpu"], pod["commit_mem"], pod["commit_gpu"],
+            pod["nz_mcpu"], pod["nz_mem"], jnp.int64(1),
+        ])
+        res = res + commit[:, None] * counts[None, :]
+        port_mask = jnp.where(
+            (counts > 0)[:, None], port_mask | pod["port_mask"][None, :],
+            port_mask,
+        )
+        class_count = class_count.at[:, pod["class_id"]].add(counts)
+        last_idx = last_idx + k
+        U = static["ip_u_topo"].shape[0]
+        if U and ip_term_count.shape[1]:
+            # term_count[u, dom(u, n)] += match_spec[spec(u)] * counts[n]
+            # — interpod_commit is linear in the commit count (the gate
+            # guarantees the pod owns no terms, so own/rev are untouched)
+            dom = static["ip_topo_dom"][static["ip_u_topo"]]  # (U, N)
+            mu = pod["ip_match_spec"][static["ip_u_spec"]]  # (U,)
+            add = jnp.where(
+                dom >= 0, mu[:, None].astype(jnp.int64) * counts[None, :], 0
+            )
+            ip_term_count = ip_term_count.at[
+                jnp.arange(U)[:, None],
+                jnp.clip(dom, 0, ip_term_count.shape[1] - 1),
+            ].add(add.astype(ip_term_count.dtype))
+        if ip_spec_total.shape[0]:
+            ip_spec_total = ip_spec_total + (
+                pod["ip_match_spec"].astype(jnp.int64) * k
+            ).astype(ip_spec_total.dtype)
+        return (
+            res, port_mask, class_count, last_idx,
+            ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
+            ip_rev_anti, ip_spec_total,
+            vol_any, vol_rw, ebs_mask, gce_mask,
+            svc_first_peer, svc_peer_node_count, svc_peer_total,
+        )
+
+    # -- backlog -------------------------------------------------------------
+
+    def _pod_row(self, batch: PodBatch, i: int):
+        return {
+            f: jnp.asarray(getattr(batch, f)[i])
+            for f in BatchScheduler.POD_FIELDS
+        }
+
+    def _pick_j(self, snap: ClusterSnapshot, carry, K: int) -> int:
+        """Table depth: enough j rows to cover the deepest possible
+        per-node commit count, bucketed for compile reuse."""
+        pod_count = np.asarray(carry[0][5])
+        cap = int(
+            np.maximum(np.asarray(snap.alloc_pods) - pod_count, 0).max()
+        ) if pod_count.size else 0
+        J = min(K, max(cap, 0)) + 1
+        return next_pow2(min(J, self.max_j), floor=16)
+
+    def schedule_backlog(
+        self,
+        snap: ClusterSnapshot,
+        batch: PodBatch,
+        rep_idx: np.ndarray,
+        last_node_index: int = 0,
+    ) -> Tuple[np.ndarray, tuple]:
+        """-> (chosen i32[P] node ids with -1 == unschedulable,
+        final carry). snap may be node-padded; batch holds one row per
+        unique pod; rep_idx maps backlog position -> row."""
+        P = len(rep_idx)
+        static = {
+            f: jnp.asarray(getattr(snap, f))
+            for f in BatchScheduler.STATIC_FIELDS
+        }
+        static.update(BatchScheduler.config_static(self.config, snap))
+        num_zones = max(
+            int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
+        )
+        num_values = int(snap.svc_num_values)
+        carry = self.scan.initial_carry(snap, last_node_index)
+        out = np.full(P, -1, np.int32)
+        perm = np.asarray(snap.name_desc_order).astype(np.int64)
+        N = snap.num_nodes
+
+        # maximal runs of consecutive equal reps
+        runs: List[Tuple[int, int, int]] = []  # (rep, start, length)
+        i = 0
+        while i < P:
+            r = rep_idx[i]
+            s = i
+            while i < P and rep_idx[i] == r:
+                i += 1
+            runs.append((int(r), s, i - s))
+
+        pending: List[int] = []
+
+        def flush(carry):
+            if not pending:
+                return carry
+            rows = np.asarray(pending, np.int64)
+            seg = gather_batch(batch, rep_idx[rows])
+            seg = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
+            pods = {
+                f: jnp.asarray(getattr(seg, f))
+                for f in BatchScheduler.POD_FIELDS
+            }
+            run = self.scan._compiled(num_zones, num_values)
+            new_carry, chosen = run(static, carry, pods)
+            out[rows] = np.asarray(chosen)[: len(rows)]
+            pending.clear()
+            return new_carry
+
+        config_ok = config_eligible(self.config)
+        zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
+        for rep, start, length in runs:
+            if length < self.min_run or not run_eligible(
+                self.config, batch, rep, snap, config_ok=config_ok,
+                zoned=zoned,
+            ):
+                pending.extend(range(start, start + length))
+                continue
+            carry = flush(carry)
+            pod = self._pod_row(batch, rep)
+            done = 0
+            while done < length:
+                K = length - done
+                J = self._pick_j(snap, carry, K)
+                tables = self.probe.probe(
+                    static, carry, pod, num_zones, num_values, J
+                )
+                res: ReplayResult = self._replay(
+                    _permute_tables(tables, perm), K, int(carry[self.LAST_IDX])
+                )
+                if res.n_done == 0:
+                    # no progress possible through tables; scan the rest
+                    pending.extend(range(start + done, start + length))
+                    break
+                ids = np.where(res.chosen >= 0, perm[res.chosen], -1)
+                out[start + done : start + done + res.n_done] = ids.astype(
+                    np.int32
+                )
+                counts = np.zeros(N, np.int64)
+                counts[perm] = res.counts
+                carry = self._apply(
+                    static, carry, pod, jnp.asarray(counts)
+                )
+                # replay already accounted last_idx; _apply_fn added
+                # counts.sum() == res.scheduled, which matches
+                done += res.n_done
+        carry = flush(carry)
+        return out, carry

@@ -18,16 +18,27 @@ from kubernetes_tpu.oracle.scheduler import FitError
 from kubernetes_tpu.oracle.state import ClusterState
 
 
+def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
+    """Device node ids -> names; -1 and padded ids mean unschedulable."""
+    return [
+        node_names[i] if 0 <= i < n_real else None
+        for i in (int(c) for c in chosen)
+    ]
+
+
 class TPUScheduleAlgorithm:
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, min_run: int = 16):
+        self._mesh_sched = None
         if mesh is not None:
             from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
 
-            self._sched = MeshBatchScheduler(mesh)
+            self._mesh_sched = MeshBatchScheduler(mesh)
+            self._sched = self._mesh_sched
         else:
-            from kubernetes_tpu.models.batch import BatchScheduler
+            from kubernetes_tpu.models.wave import WaveScheduler
 
-            self._sched = BatchScheduler()
+            self._wave = WaveScheduler(min_run=min_run)
+            self._sched = self._wave.scan
         # selectHost's round-robin counter persists across waves, like the
         # reference's genericScheduler.lastNodeIndex persists across pods
         self._last_node_index = 0
@@ -35,11 +46,56 @@ class TPUScheduleAlgorithm:
     def schedule_backlog(
         self, pods: Sequence[Pod], state: ClusterState
     ) -> List[Optional[str]]:
+        if not pods:
+            return []
+        if self._mesh_sched is not None:
+            return self._schedule_backlog_mesh(pods, state)
+        import numpy as np
+
+        from kubernetes_tpu.models.batch import BatchScheduler
+        from kubernetes_tpu.parallel.mesh import _pad_snapshot
+        from kubernetes_tpu.snapshot.encode import (
+            SnapshotEncoder,
+            pod_feature_key,
+        )
+        from kubernetes_tpu.snapshot.pad import next_pow2
+
+        # deduplicate the backlog: template-created pods (RC/RS/Job) are
+        # identical up to their name, so encode one representative per
+        # distinct feature key — O(unique) encode instead of O(backlog)
+        reps: List[Pod] = []
+        rep_of_key = {}
+        rep_idx = np.empty(len(pods), np.int64)
+        for i, p in enumerate(pods):
+            k = pod_feature_key(p)
+            r = rep_of_key.get(k)
+            if r is None:
+                r = len(reps)
+                rep_of_key[k] = r
+                reps.append(p)
+            rep_idx[i] = r
+        enc = SnapshotEncoder(state, reps, config=self._wave.config)
+        snap = enc.encode_nodes()
+        batch = enc.encode_pods()
+        n_real = snap.num_nodes
+        if n_real == 0:
+            # empty cluster: every pod fails with FitError in the reference
+            return [None] * len(pods)
+        n_bucket = next_pow2(n_real, 64)
+        if n_bucket > n_real:
+            snap = _pad_snapshot(snap, n_bucket)
+        chosen, final = self._wave.schedule_backlog(
+            snap, batch, rep_idx, last_node_index=self._last_node_index
+        )
+        self._last_node_index = int(final[BatchScheduler.LAST_IDX])
+        return _ids_to_names(chosen, snap.node_names, n_real)
+
+    def _schedule_backlog_mesh(
+        self, pods: Sequence[Pod], state: ClusterState
+    ) -> List[Optional[str]]:
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
         from kubernetes_tpu.snapshot.pad import pad_to_buckets
 
-        if not pods:
-            return []
         snap, batch = SnapshotEncoder(
             state, list(pods), config=getattr(self._sched, "config", None)
         ).encode()
@@ -57,11 +113,7 @@ class TPUScheduleAlgorithm:
         from kubernetes_tpu.models.batch import BatchScheduler
 
         self._last_node_index = int(final[BatchScheduler.LAST_IDX])
-        out: List[Optional[str]] = []
-        for c in chosen[:p_real]:
-            i = int(c)
-            out.append(snap.node_names[i] if 0 <= i < n_real else None)
-        return out
+        return _ids_to_names(chosen[:p_real], snap.node_names, n_real)
 
     def schedule(self, pod: Pod, state: ClusterState) -> str:
         host = self.schedule_backlog([pod], state)[0]

@@ -25,6 +25,7 @@ import numpy as np
 from kubernetes_tpu.api import labels as labelpkg
 from kubernetes_tpu.api.types import (
     Affinity,
+    Container,
     Node,
     NodeSelectorRequirement,
     Pod,
@@ -80,6 +81,40 @@ def service_config_labels(config) -> Tuple[str, ...]:
         if isinstance(name, tuple) and name[0] == "ServiceAntiAffinity":
             labels.append(name[1])
     return tuple(dict.fromkeys(labels))
+
+
+def pod_feature_key(pod: Pod) -> tuple:
+    """Structural scheduling identity: two pods with equal keys encode to
+    identical PodBatch rows (property fuzzed in tests/test_wave.py), so a
+    backlog run of equal-key pods — the shape every RC/RS/Job template
+    produces — can take the wave fast path (models/wave.py).
+
+    Covers every pod field the encoder (and the interpod/volume/service
+    compilers) read. The name is deliberately absent: predicates,
+    priorities and selectHost never consult it for the pending pod."""
+
+    def _cont(c: Container) -> tuple:
+        return (
+            c.image,
+            tuple(sorted((k, str(v)) for k, v in c.requests.items())),
+            tuple(sorted((k, str(v)) for k, v in c.limits.items())),
+            tuple((p.host_port, p.container_port, p.protocol) for p in c.ports),
+        )
+
+    m = pod.metadata
+    return (
+        pod.namespace,
+        tuple(sorted(m.labels.items())),
+        tuple(sorted(m.annotations.items())),
+        m.deletion_timestamp is not None,
+        pod.spec.node_name,
+        tuple(sorted(pod.spec.node_selector.items())),
+        tuple(_cont(c) for c in pod.spec.containers),
+        tuple(_cont(c) for c in pod.spec.init_containers),
+        repr(pod.spec.affinity),
+        repr(pod.spec.tolerations),
+        repr(pod.spec.volumes),
+    )
 
 
 def _pack_bits(ids: Sequence[int], words: int) -> np.ndarray:

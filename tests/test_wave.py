@@ -1,0 +1,318 @@
+"""Wave fast-path conformance: the run-splitting driver (models/wave.py)
+must be bit-identical to the serial scan — and therefore to the oracle —
+on any backlog, fast-pathing eligible runs and falling back for the
+rest with exact carry handoff.
+
+The replay's float formulas and the selectHost round-robin are the risky
+parts; fixtures here are tie-heavy (identical nodes), fill nodes to
+capacity mid-run (fit-set changes → normalizer rebuilds), and mix
+eligible runs with ineligible pods (volumes, inter-pod terms)."""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Service,
+    ServiceSpec,
+    Taint,
+    NodeSpec,
+)
+from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+from kubernetes_tpu.snapshot.encode import SnapshotEncoder, pod_feature_key
+
+from tests.test_conformance import (
+    ORACLE_PREDICATES,
+    ORACLE_PRIORITIES,
+    random_scenario,
+)
+
+
+def oracle_backlog(state, pending):
+    oracle = GenericScheduler(
+        predicates=ORACLE_PREDICATES, priorities=ORACLE_PRIORITIES
+    )
+    return oracle.schedule_backlog(pending, state.clone())
+
+
+def wave_backlog(state, pending, min_run=1):
+    algo = TPUScheduleAlgorithm(min_run=min_run)
+    return algo.schedule_backlog(pending, state)
+
+
+def clone_named(pod: Pod, name: str) -> Pod:
+    out = copy.deepcopy(pod)
+    out.metadata.name = name
+    return out
+
+
+def density_nodes(n, pods_cap="110", cpu="4", mem="32Gi", taint_every=0):
+    nodes = []
+    for i in range(n):
+        spec = NodeSpec()
+        if taint_every and i % taint_every == 0:
+            spec = NodeSpec(
+                taints=[Taint(key="dedicated", value="a",
+                              effect="PreferNoSchedule")]
+            )
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(name=f"node-{i:04d}"),
+                spec=spec,
+                status=NodeStatus(
+                    allocatable={"cpu": cpu, "memory": mem, "pods": pods_cap},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+    return nodes
+
+
+def pause_pods(k, labels=None, requests=None):
+    labels = labels or {"name": "sched-perf"}
+    requests = requests or {"cpu": "100m", "memory": "500Mi"}
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"pod-{i:06d}", labels=dict(labels)),
+            spec=PodSpec(containers=[Container(requests=dict(requests))]),
+        )
+        for i in range(k)
+    ]
+
+
+def test_feature_key_implies_identical_rows():
+    rng = random.Random(1234)
+    state, pending = random_scenario(
+        rng, n_nodes=6, n_existing=8, n_pending=20,
+        interpod_p=0.3, volumes_p=0.3,
+    )
+    # clones share the feature key with their template by construction;
+    # the property under test is key-equality => row-equality
+    pending = pending + [
+        clone_named(p, f"{p.metadata.name}-x") for p in pending[::2]
+    ]
+    enc = SnapshotEncoder(state, pending)
+    batch = enc.encode_pods()
+    by_key = {}
+    for i, p in enumerate(pending):
+        by_key.setdefault(pod_feature_key(p), []).append(i)
+    import dataclasses
+
+    checked_groups = 0
+    for rows in by_key.values():
+        if len(rows) < 2:
+            continue
+        checked_groups += 1
+        a = rows[0]
+        for b in rows[1:]:
+            for f in dataclasses.fields(batch):
+                v = getattr(batch, f.name)
+                if f.name == "pod_keys" or not isinstance(v, np.ndarray):
+                    continue
+                if v.ndim >= 1 and v.shape[0] == batch.num_pods:
+                    assert np.array_equal(v[a], v[b]), (
+                        f"rows {a},{b} differ in {f.name}"
+                    )
+    assert checked_groups >= 1  # the fixture produced at least one run
+
+
+def test_wave_homogeneous_tie_heavy_matches_oracle():
+    # 20 identical nodes (every pick is a 20-way tie at first), service
+    # selecting all pods => dynamic SelectorSpread with maxCount changes
+    nodes = density_nodes(20)
+    pods = pause_pods(150)
+    state = ClusterState.build(
+        nodes,
+        services=[Service(metadata=ObjectMeta(name="svc"),
+                          spec=ServiceSpec(selector={"name": "sched-perf"}))],
+    )
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_capacity_exhaustion_tail():
+    # 5 nodes x 4 pods cap = 20 slots for 40 pods: nodes leave the fit
+    # set mid-run and the tail must be unschedulable (None), with the
+    # round-robin counter frozen once scheduling stops
+    nodes = density_nodes(5, pods_cap="4")
+    pods = pause_pods(40)
+    state = ClusterState.build(
+        nodes,
+        services=[Service(metadata=ObjectMeta(name="svc"),
+                          spec=ServiceSpec(selector={"name": "sched-perf"}))],
+    )
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert want[-1] is None and got.count(None) == 20
+
+
+def test_wave_taints_and_fill_rebuilds():
+    # PreferNoSchedule taints on every 3rd node make TaintToleration
+    # normalize over a nonuniform count vector; tiny capacity forces
+    # fit-set changes => per-event renormalization in the replay
+    nodes = density_nodes(9, pods_cap="3", taint_every=3)
+    pods = pause_pods(30)
+    state = ClusterState.build(nodes)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_cpu_bound_fill():
+    # cpu exhausts before the pod-count cap: res_fit flips from the
+    # resource side of the table
+    nodes = density_nodes(4, pods_cap="110", cpu="1", mem="32Gi")
+    pods = pause_pods(50, requests={"cpu": "250m", "memory": "100Mi"})
+    state = ClusterState.build(nodes)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert got.count(None) == 50 - 4 * 4
+
+
+def test_wave_host_port_self_conflict():
+    # a host port means each node takes exactly one copy of the run
+    nodes = density_nodes(6)
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"pod-{i}", labels={"app": "p"}),
+            spec=PodSpec(containers=[
+                Container(requests={"cpu": "100m"},
+                          ports=[ContainerPort(host_port=8080)])
+            ]),
+        )
+        for i in range(10)
+    ]
+    state = ClusterState.build(nodes)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert got.count(None) == 4 and len(set(x for x in got if x)) == 6
+
+
+def test_wave_reprobe_on_table_horizon():
+    # max_j=16 forces the replay to bail at the table horizon and
+    # re-probe with a fresh carry; output must still be identical
+    from kubernetes_tpu.models.wave import WaveScheduler
+    from kubernetes_tpu.snapshot.pad import next_pow2
+    from kubernetes_tpu.parallel.mesh import _pad_snapshot
+
+    nodes = density_nodes(3)
+    pods = pause_pods(100, requests={"cpu": "10m", "memory": "10Mi"})
+    state = ClusterState.build(nodes)
+    want = oracle_backlog(state, pods)
+
+    enc = SnapshotEncoder(state, [pods[0]])
+    snap = enc.encode_nodes()
+    batch = enc.encode_pods()
+    snap_p = _pad_snapshot(snap, next_pow2(snap.num_nodes, 4))
+    ws = WaveScheduler(min_run=1, max_j=16)
+    chosen, _ = ws.schedule_backlog(
+        snap_p, batch, np.zeros(len(pods), np.int64)
+    )
+    got = [snap.node_names[c] if 0 <= c < snap.num_nodes else None
+           for c in chosen]
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_wave_mixed_backlog_random(seed):
+    # random heterogeneous scenario, then pending expanded into runs:
+    # every pod is cloned 0-6 times in place — runs of identical pods
+    # interleaved with singles, some ineligible (volumes/interpod)
+    rng = random.Random(1000 + seed)
+    state, pending = random_scenario(
+        rng,
+        n_nodes=8,
+        n_existing=10,
+        n_pending=10,
+        interpod_p=0.25 if seed % 2 else 0.0,
+        volumes_p=0.25 if seed >= 3 else 0.0,
+    )
+    backlog = []
+    for i, p in enumerate(pending):
+        for c in range(rng.randint(1, 7)):
+            backlog.append(clone_named(p, f"{p.metadata.name}-c{c}"))
+    want = oracle_backlog(state, backlog)
+    got = wave_backlog(state, backlog)
+    assert got == want, (
+        f"seed {seed}: first divergence at "
+        f"{next(i for i, (a, b) in enumerate(zip(want, got)) if a != b)}"
+        f" of {len(backlog)}"
+    )
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_replay_c_matches_spec_fuzz(seed):
+    # synthetic RunTables stress the C engine's bucket/Fenwick/rebuild
+    # machinery far beyond what end-to-end fixtures reach: plateaus,
+    # score raises (Balanced can go up), deep ties, horizon bails
+    from kubernetes_tpu.models.probe import RunTables
+    from kubernetes_tpu.models.replay import (
+        _load_lib,
+        replay_fast,
+        replay_spec,
+    )
+
+    if _load_lib() is None:
+        pytest.skip("native/_replay.so not built")
+    rng = np.random.default_rng(seed)
+    N = int(rng.integers(1, 40))
+    J = int(rng.integers(2, 20))
+    K = int(rng.integers(1, 120))
+    # mostly-flat tables maximize ties; occasional jumps exercise
+    # bucket moves in both directions
+    tab = rng.integers(0, 4, (J, N)).astype(np.int64)
+    if rng.random() < 0.5:
+        tab = np.maximum(tab, tab[::1] * 0 + rng.integers(0, 3, (J, N)))
+    tab = np.sort(tab, axis=0)[::-1].copy()  # mostly decreasing in j
+    if rng.random() < 0.4:  # inject raises
+        r0 = int(rng.integers(0, J))
+        tab[r0] = tab[r0] + rng.integers(0, 3, N)
+    t = RunTables(
+        fit_static=rng.random(N) < 0.9,
+        res_fit=(rng.random((J, N)) < 0.97).cumprod(axis=0).astype(bool),
+        tab=tab,
+        static_add=rng.integers(0, 3, N).astype(np.int64),
+        w_spread=int(rng.integers(0, 3)),
+        spread_base=(rng.integers(0, 4, N).astype(np.int64)
+                     if rng.random() < 0.7 else None),
+        spread_selfmatch=bool(rng.random() < 0.7),
+        has_selectors=bool(rng.random() < 0.8),
+        w_na=int(rng.integers(0, 3)),
+        na_counts=(rng.integers(0, 6, N).astype(np.int64)
+                   if rng.random() < 0.5 else None),
+        w_tt=int(rng.integers(0, 3)),
+        tt_counts=(rng.integers(0, 4, N).astype(np.int64)
+                   if rng.random() < 0.5 else None),
+        w_ip=int(rng.integers(0, 3)),
+        ip_totals=(rng.integers(-5, 6, N).astype(np.int64)
+                   if rng.random() < 0.4 else None),
+    )
+    L0 = int(rng.integers(0, 1000))
+    spec = replay_spec(t, K, L0)
+    fast = replay_fast(t, K, L0)
+    assert fast.n_done == spec.n_done
+    assert np.array_equal(fast.chosen, spec.chosen)
+    assert np.array_equal(fast.counts, spec.counts)
+    assert fast.last_node_index == spec.last_node_index
+    assert fast.scheduled == spec.scheduled
+
+
+def test_wave_min_run_fallback_matches():
+    # with min_run above every run length, everything goes through the
+    # scan fallback — the driver must still match (pure handoff test)
+    nodes = density_nodes(5)
+    pods = pause_pods(20)
+    state = ClusterState.build(nodes)
+    assert wave_backlog(state, pods, min_run=64) == oracle_backlog(state, pods)
