@@ -206,7 +206,9 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
             raise ValueError("ServiceAntiAffinity is not wave-eligible")
         else:
             raise ValueError(f"unknown priority {name!r}")
-    out["tab"] = tab
+    # scores are small (weights are range-guarded in models/wave.py);
+    # i32 halves the device->host table transfer
+    out["tab"] = tab.astype(jnp.int32)
     out["static_add"] = static_add
     return out
 
@@ -239,7 +241,7 @@ class WaveProbe:
         return RunTables(
             fit_static=np.asarray(raw["fit_static"]),
             res_fit=np.asarray(raw["res_fit"]),
-            tab=np.asarray(raw["tab"]),
+            tab=np.asarray(raw["tab"]).astype(np.int64),
             static_add=np.asarray(raw["static_add"]),
             w_spread=int(weights.get(SELECTOR_SPREAD, 0)),
             spread_base=(np.asarray(raw["spread_base"])

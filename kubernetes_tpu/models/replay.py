@@ -96,7 +96,6 @@ def replay_spec(
     J, N = t.res_fit.shape
     j = np.zeros(N, np.int64)
     fit = t.fit_static & t.res_fit[0]
-    order = None  # name-desc order is implicit: see below
     chosen = np.full(K, -1, np.int32)
     L = int(last_node_index)
     n_done = K

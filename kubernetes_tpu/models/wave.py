@@ -237,14 +237,28 @@ class WaveScheduler:
             for f in BatchScheduler.POD_FIELDS
         }
 
-    def _pick_j(self, snap: ClusterSnapshot, carry, K: int) -> int:
+    def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
+                K: int) -> int:
         """Table depth: enough j rows to cover the deepest possible
-        per-node commit count, bucketed for compile reuse."""
-        pod_count = np.asarray(carry[0][5])
-        cap = int(
-            np.maximum(np.asarray(snap.alloc_pods) - pod_count, 0).max()
-        ) if pod_count.size else 0
-        J = min(K, max(cap, 0)) + 1
+        per-node commit count, bucketed for compile reuse. Computed
+        from the run-start snapshot only — commits monotonically shrink
+        every node's remaining capacity, so this stays an upper bound
+        for the whole backlog (no device sync needed)."""
+        alloc_pods = np.asarray(snap.alloc_pods)
+        if not alloc_pods.size:
+            return 16
+        cap = np.maximum(alloc_pods - np.asarray(snap.pod_count), 0)
+        # the commit vector shrinks cpu/mem headroom too (a fit at j
+        # implies j*commit + request <= alloc); use whichever bound is
+        # tightest so the table stays small
+        for commit, alloc, used in (
+            (int(batch.commit_mcpu[rep]), snap.alloc_mcpu, snap.req_mcpu),
+            (int(batch.commit_mem[rep]), snap.alloc_mem, snap.req_mem),
+        ):
+            if commit > 0:
+                room = np.maximum(np.asarray(alloc) - np.asarray(used), 0)
+                cap = np.minimum(cap, room // commit + 1)
+        J = min(K, int(cap.max())) + 1
         return next_pow2(min(J, self.max_j), floor=16)
 
     def schedule_backlog(
@@ -253,10 +267,11 @@ class WaveScheduler:
         batch: PodBatch,
         rep_idx: np.ndarray,
         last_node_index: int = 0,
-    ) -> Tuple[np.ndarray, tuple]:
+    ) -> Tuple[np.ndarray, tuple, int]:
         """-> (chosen i32[P] node ids with -1 == unschedulable,
-        final carry). snap may be node-padded; batch holds one row per
-        unique pod; rep_idx maps backlog position -> row."""
+        final carry, final lastNodeIndex). snap may be node-padded;
+        batch holds one row per unique pod; rep_idx maps backlog
+        position -> row."""
         P = len(rep_idx)
         static = {
             f: jnp.asarray(getattr(snap, f))
@@ -283,8 +298,12 @@ class WaveScheduler:
             runs.append((int(r), s, i - s))
 
         pending: List[int] = []
+        # lastNodeIndex is tracked host-side (the replay computes it
+        # exactly) so the fast path never blocks on the device carry
+        L_host = int(last_node_index)
 
         def flush(carry):
+            nonlocal L_host
             if not pending:
                 return carry
             rows = np.asarray(pending, np.int64)
@@ -297,6 +316,7 @@ class WaveScheduler:
             run = self.scan._compiled(num_zones, num_values)
             new_carry, chosen = run(static, carry, pods)
             out[rows] = np.asarray(chosen)[: len(rows)]
+            L_host = int(new_carry[self.LAST_IDX])
             pending.clear()
             return new_carry
 
@@ -314,12 +334,12 @@ class WaveScheduler:
             done = 0
             while done < length:
                 K = length - done
-                J = self._pick_j(snap, carry, K)
+                J = self._pick_j(snap, batch, rep, K)
                 tables = self.probe.probe(
                     static, carry, pod, num_zones, num_values, J
                 )
                 res: ReplayResult = self._replay(
-                    _permute_tables(tables, perm), K, int(carry[self.LAST_IDX])
+                    _permute_tables(tables, perm), K, L_host
                 )
                 if res.n_done == 0:
                     # no progress possible through tables; scan the rest
@@ -334,8 +354,9 @@ class WaveScheduler:
                 carry = self._apply(
                     static, carry, pod, jnp.asarray(counts)
                 )
-                # replay already accounted last_idx; _apply_fn added
-                # counts.sum() == res.scheduled, which matches
+                # _apply_fn added counts.sum() == res.scheduled to the
+                # device last_idx; mirror it host-side
+                L_host = res.last_node_index
                 done += res.n_done
         carry = flush(carry)
-        return out, carry
+        return out, carry, L_host

@@ -52,7 +52,6 @@ class TPUScheduleAlgorithm:
             return self._schedule_backlog_mesh(pods, state)
         import numpy as np
 
-        from kubernetes_tpu.models.batch import BatchScheduler
         from kubernetes_tpu.parallel.mesh import _pad_snapshot
         from kubernetes_tpu.snapshot.encode import (
             SnapshotEncoder,
@@ -84,10 +83,10 @@ class TPUScheduleAlgorithm:
         n_bucket = next_pow2(n_real, 64)
         if n_bucket > n_real:
             snap = _pad_snapshot(snap, n_bucket)
-        chosen, final = self._wave.schedule_backlog(
+        chosen, _final, last = self._wave.schedule_backlog(
             snap, batch, rep_idx, last_node_index=self._last_node_index
         )
-        self._last_node_index = int(final[BatchScheduler.LAST_IDX])
+        self._last_node_index = last
         return _ids_to_names(chosen, snap.node_names, n_real)
 
     def _schedule_backlog_mesh(

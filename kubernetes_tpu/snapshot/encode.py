@@ -97,23 +97,27 @@ def pod_feature_key(pod: Pod) -> tuple:
         return (
             c.image,
             tuple(sorted((k, str(v)) for k, v in c.requests.items())),
-            tuple(sorted((k, str(v)) for k, v in c.limits.items())),
-            tuple((p.host_port, p.container_port, p.protocol) for p in c.ports),
+            tuple(sorted((k, str(v)) for k, v in c.limits.items()))
+            if c.limits else (),
+            tuple((p.host_port, p.container_port, p.protocol) for p in c.ports)
+            if c.ports else (),
         )
 
     m = pod.metadata
+    spec = pod.spec
     return (
         pod.namespace,
-        tuple(sorted(m.labels.items())),
-        tuple(sorted(m.annotations.items())),
+        tuple(sorted(m.labels.items())) if m.labels else (),
+        tuple(sorted(m.annotations.items())) if m.annotations else (),
         m.deletion_timestamp is not None,
-        pod.spec.node_name,
-        tuple(sorted(pod.spec.node_selector.items())),
-        tuple(_cont(c) for c in pod.spec.containers),
-        tuple(_cont(c) for c in pod.spec.init_containers),
-        repr(pod.spec.affinity),
-        repr(pod.spec.tolerations),
-        repr(pod.spec.volumes),
+        spec.node_name,
+        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
+        tuple(_cont(c) for c in spec.containers),
+        tuple(_cont(c) for c in spec.init_containers)
+        if spec.init_containers else (),
+        repr(spec.affinity) if spec.affinity is not None else None,
+        repr(spec.tolerations) if spec.tolerations is not None else None,
+        repr(spec.volumes) if spec.volumes else None,
     )
 
 
