@@ -92,33 +92,46 @@ class LimitRanger(AdmissionPlugin):
                 if item.type != "Container":
                     continue
                 for c in obj.spec.containers:
-                    # defaulting (limitranger.go mergePodResourceRequirements)
+                    # defaulting (limitranger.go mergePodResourceRequirements):
+                    # requests get defaultRequest (falling back to default),
+                    # limits get default
                     for k, v in (item.default_request or item.default).items():
                         c.requests.setdefault(k, v)
-                    # bounds
-                    cpu = resource_list_cpu_milli(c.requests)
-                    mem = resource_list_memory(c.requests)
+                    for k, v in item.default.items():
+                        c.limits.setdefault(k, v)
+                    # bounds apply to requests AND limits; a bound parsing
+                    # to 0 is still a bound ('is not None', not truthiness)
                     max_cpu = resource_list_cpu_milli(item.max) if item.max else None
                     max_mem = resource_list_memory(item.max) if item.max else None
                     min_cpu = resource_list_cpu_milli(item.min) if item.min else None
                     min_mem = resource_list_memory(item.min) if item.min else None
-                    if max_cpu and cpu > max_cpu:
-                        raise AdmissionDenied(
-                            f"maximum cpu usage per Container is "
-                            f"{item.max['cpu']}, but request is {c.requests.get('cpu')}"
-                        )
-                    if max_mem and mem > max_mem:
-                        raise AdmissionDenied(
-                            "maximum memory usage per Container exceeded"
-                        )
-                    if min_cpu and cpu < min_cpu:
-                        raise AdmissionDenied(
-                            "minimum cpu usage per Container not met"
-                        )
-                    if min_mem and mem < min_mem:
-                        raise AdmissionDenied(
-                            "minimum memory usage per Container not met"
-                        )
+                    for which, rl, observed_only in (
+                        ("request", c.requests, False),
+                        ("limit", c.limits, True),
+                    ):
+                        # requests are always bounded (absent == 0, as the
+                        # reference sums them); limits only when present
+                        if observed_only and not rl:
+                            continue
+                        cpu = resource_list_cpu_milli(rl)
+                        mem = resource_list_memory(rl)
+                        if max_cpu is not None and (not observed_only or "cpu" in rl) and cpu > max_cpu:
+                            raise AdmissionDenied(
+                                f"maximum cpu usage per Container is "
+                                f"{item.max['cpu']}, but {which} is {rl.get('cpu')}"
+                            )
+                        if max_mem is not None and (not observed_only or "memory" in rl) and mem > max_mem:
+                            raise AdmissionDenied(
+                                "maximum memory usage per Container exceeded"
+                            )
+                        if min_cpu is not None and (not observed_only or "cpu" in rl) and cpu < min_cpu:
+                            raise AdmissionDenied(
+                                "minimum cpu usage per Container not met"
+                            )
+                        if min_mem is not None and (not observed_only or "memory" in rl) and mem < min_mem:
+                            raise AdmissionDenied(
+                                "minimum memory usage per Container not met"
+                            )
 
 
 class ResourceQuotaAdmission(AdmissionPlugin):

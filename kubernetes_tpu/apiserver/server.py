@@ -414,8 +414,19 @@ class APIServer:
             new.metadata.namespace = cur.metadata.namespace
             new.metadata.name = cur.metadata.name
             new.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
-            # spec updates bump the generation sequence
-            new.metadata.generation = cur.metadata.generation + 1
+            # the generation sequence moves only on a real spec change
+            # (strategy PrepareForUpdate compares semantic specs), so
+            # no-op writes don't churn observedGeneration consumers;
+            # compare only the spec subtrees (wire form), not the whole
+            # objects, on this hot path
+            from kubernetes_tpu.runtime.scheme import encode_value
+
+            if encode_value(getattr(new, "spec", None)) == encode_value(
+                getattr(cur, "spec", None)
+            ):
+                new.metadata.generation = cur.metadata.generation
+            else:
+                new.metadata.generation = cur.metadata.generation + 1
             if info.has_status:
                 # status never moves through the main resource (pod
                 # strategy PrepareForUpdate copies old status forward)

@@ -58,6 +58,9 @@ class LeaderElector:
         self.observed_record: Optional[LeaderElectionRecord] = None
         self.observed_time: float = 0.0
         self._stop = threading.Event()
+        # serializes lease writes vs. stop(): a renew in flight on the
+        # elector thread must not overwrite the released record
+        self._write_lock = threading.Lock()
 
     def is_leader(self) -> bool:
         return (
@@ -83,7 +86,11 @@ class LeaderElector:
         self._stop.set()
         if release and was_leader:
             try:
-                self._release()
+                # the write lock orders this after any in-flight renew, and
+                # the stop flag keeps later renews from resurrecting the
+                # lease — standbys acquire immediately
+                with self._write_lock:
+                    self._release()
             except Exception:
                 pass  # best effort; the lease will expire anyway
 
@@ -145,12 +152,15 @@ class LeaderElector:
                     annotations={LEADER_ANNOTATION: _encode(record)},
                 )
             )
-            try:
-                endpoints.create(ep)
-            except APIStatusError:
-                return False
-            self.observed_record = record
-            self.observed_time = now
+            with self._write_lock:
+                if self._stop.is_set():
+                    return False
+                try:
+                    endpoints.create(ep)
+                except APIStatusError:
+                    return False
+                self.observed_record = record
+                self.observed_time = now
             return True
 
         existing = _decode(obj.metadata.annotations.get(LEADER_ANNOTATION, ""))
@@ -170,12 +180,15 @@ class LeaderElector:
                 record.acquire_time = existing.acquire_time
 
         obj.metadata.annotations[LEADER_ANNOTATION] = _encode(record)
-        try:
-            endpoints.update(obj)  # CAS via resourceVersion
-        except APIStatusError:
-            return False
-        self.observed_record = record
-        self.observed_time = self.clock.now()
+        with self._write_lock:
+            if self._stop.is_set():
+                return False  # stop() won the race: keep its released lease
+            try:
+                endpoints.update(obj)  # CAS via resourceVersion
+            except APIStatusError:
+                return False
+            self.observed_record = record
+            self.observed_time = self.clock.now()
         return True
 
 

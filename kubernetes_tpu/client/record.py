@@ -37,12 +37,57 @@ def object_reference(obj: Any) -> t.ObjectReference:
     )
 
 
+_SHUTDOWN = object()
+
+
 class EventBroadcaster:
-    """Fan events out to registered sinks (record/event.go broadcaster)."""
+    """Fan events out to registered sinks (record/event.go broadcaster).
+
+    Like the reference's watch.Broadcaster (queue length 1000,
+    DropIfChannelFull), publishing is asynchronous on a bounded queue:
+    recording an event must never block or slow a scheduling/bind path,
+    and overload sheds events rather than throughput."""
+
+    QUEUE_LEN = 1000
 
     def __init__(self):
         self._lock = threading.Lock()
         self._sinks: List[Callable[[t.Event], None]] = []
+        import queue as _queue
+
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=self.QUEUE_LEN)
+        self._worker: Optional[threading.Thread] = None
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            with self._lock:
+                if self._worker is None or not self._worker.is_alive():
+                    self._worker = threading.Thread(
+                        target=self._drain, daemon=True, name="event-broadcaster"
+                    )
+                    self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is _SHUTDOWN:
+                return
+            with self._lock:
+                sinks = list(self._sinks)
+            for fn in sinks:
+                try:
+                    fn(ev)
+                except Exception:
+                    log.exception("event sink failed")
+
+    def shutdown(self) -> None:
+        """Flush queued events and stop the worker (the reference's
+        watch.Broadcaster.Shutdown)."""
+        worker = self._worker
+        if worker is None or not worker.is_alive():
+            return
+        self._queue.put(_SHUTDOWN)
+        worker.join(timeout=5.0)
 
     def start_logging(self, logf: Callable[[str], None] = log.info) -> None:
         self._add(
@@ -64,13 +109,13 @@ class EventBroadcaster:
         return EventRecorder(self, component)
 
     def _publish(self, ev: t.Event) -> None:
-        with self._lock:
-            sinks = list(self._sinks)
-        for fn in sinks:
-            try:
-                fn(ev)
-            except Exception:
-                log.exception("event sink failed")
+        import queue as _queue
+
+        self._ensure_worker()
+        try:
+            self._queue.put_nowait(ev)
+        except _queue.Full:
+            pass  # DropIfChannelFull (watch/mux.go:40)
 
 
 _event_seq = itertools.count()

@@ -161,8 +161,12 @@ class DaemonSetsController:
         for strays in by_node.values():
             to_delete.extend(s for s in strays if s.spec.node_name)
 
-        if to_create:
-            self.expectations.expect_creations(key, len(to_create))
+        if to_create or to_delete:
+            # one joint expectation per sync (controller.go:285-300): a
+            # create-and-delete sync must track both sides
+            self.expectations.set_expectations(
+                key, len(to_create), len(to_delete)
+            )
         for node_name in to_create:
             try:
                 template = copy.deepcopy(ds.spec.template)
@@ -170,8 +174,6 @@ class DaemonSetsController:
                 self.pod_control.create_pods(ns, template, ds, "DaemonSet")
             except Exception:
                 self.expectations.creation_observed(key)
-        if to_delete:
-            self.expectations.expect_deletions(key, len(to_delete))
         for pod in to_delete:
             try:
                 self.pod_control.delete_pod(ns, pod.metadata.name, ds)

@@ -96,13 +96,18 @@ class ControllerExpectations:
                 return True  # expired: sync anyway (controller_utils.go:124)
             return False
 
-    def expect_creations(self, key: str, count: int) -> None:
+    def set_expectations(self, key: str, adds: int, dels: int) -> None:
+        """controller_utils.go SetExpectations: adds and dels together —
+        a sync that both creates and deletes must not overwrite one side
+        with zero (that would allow a premature follow-up burst)."""
         with self._lock:
-            self._by_key[key] = [count, 0, self._clock()]
+            self._by_key[key] = [adds, dels, self._clock()]
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self.set_expectations(key, count, 0)
 
     def expect_deletions(self, key: str, count: int) -> None:
-        with self._lock:
-            self._by_key[key] = [0, count, self._clock()]
+        self.set_expectations(key, 0, count)
 
     def creation_observed(self, key: str) -> None:
         self._lower(key, 0)

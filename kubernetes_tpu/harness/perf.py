@@ -54,23 +54,32 @@ def make_pods(client: RESTClient, p: int, creators: int = 30) -> None:
     creation."""
 
     def create(i: int) -> None:
-        client.pods().create(
-            Pod(
-                metadata=ObjectMeta(
-                    generate_name="sched-perf-pod-",
-                    labels={"name": "sched-perf"},
-                ),
-                spec=PodSpec(
-                    containers=[
-                        Container(
-                            name="pause",
-                            image="kubernetes/pause:go",
-                            requests={"cpu": "100m", "memory": "500Mi"},
-                        )
-                    ]
-                ),
-            )
-        )
+        # generateName suffixes can collide (the reference's RC manager
+        # self-heals by re-creating on the next sync); retry like it
+        for _ in range(5):
+            try:
+                client.pods().create(
+                    Pod(
+                        metadata=ObjectMeta(
+                            generate_name="sched-perf-pod-",
+                            labels={"name": "sched-perf"},
+                        ),
+                        spec=PodSpec(
+                            containers=[
+                                Container(
+                                    name="pause",
+                                    image="kubernetes/pause:go",
+                                    requests={"cpu": "100m", "memory": "500Mi"},
+                                )
+                            ]
+                        ),
+                    )
+                )
+                return
+            except Exception as e:
+                if "already exists" not in str(e):
+                    raise
+        raise RuntimeError("pod create kept colliding")
 
     parallelize(creators, p, create)
 
@@ -80,12 +89,49 @@ def schedule_pods(
 ) -> float:
     """scheduler_test.go:41 schedulePods -> pods/sec over the steady
     window (prints rate/total each second like the reference)."""
+    import threading
+
     server = APIServer()
     client = RESTClient(LocalTransport(server))
     make_nodes(client, num_nodes)
     sched = SchedulerServer(
         client, SchedulerServerOptions(algorithm_provider=provider)
     ).start()
+
+    # count bindings from a pod watch (the reference counts from its
+    # informer, scheduler_test.go:48): a per-second full LIST would
+    # decode every pod object each tick and steal a large slice of the
+    # interpreter from the scheduler under measurement
+    bound: set = set()
+    bound_lock = threading.Lock()
+    stop_watch = threading.Event()
+
+    def relist():
+        pods, rv = client.pods().list()
+        with bound_lock:
+            for p in pods:
+                if p.spec.node_name:
+                    bound.add(p.metadata.name)
+        return rv
+
+    def watch_bindings():
+        rv = relist()
+        while not stop_watch.is_set():
+            try:
+                for etype, obj in client.pods().watch(resource_version=rv):
+                    rv = obj.metadata.resource_version or rv
+                    if etype in ("ADDED", "MODIFIED") and obj.spec.node_name:
+                        with bound_lock:
+                            bound.add(obj.metadata.name)
+                    if stop_watch.is_set():
+                        return
+            except Exception:
+                # watch gap: the fresh list re-captures anything bound
+                # while the stream was down
+                rv = relist()
+
+    watcher = threading.Thread(target=watch_bindings, daemon=True)
+    watcher.start()
     try:
         t0 = time.time()
         make_pods(client, num_pods)
@@ -96,9 +142,8 @@ def schedule_pods(
         prev, start = 0, time.time()
         while True:
             time.sleep(1)
-            scheduled = sum(
-                1 for p in client.pods().list()[0] if p.spec.node_name
-            )
+            with bound_lock:
+                scheduled = len(bound)
             rate = scheduled - prev
             print(
                 f"{time.strftime('%H:%M:%S')} Rate: {rate:5d} Total: {scheduled}",
@@ -115,6 +160,7 @@ def schedule_pods(
                 return throughput
             prev = scheduled
     finally:
+        stop_watch.set()
         sched.stop()
 
 

@@ -178,6 +178,28 @@ class WaveScheduler:
         self.pod_floor = pod_floor
         self._replay = replay or replay_fast
         self._apply = jax.jit(self._apply_fn)
+        # device-resident snapshot fields across waves: field ->
+        # (shape, dtype, device array). The caller's `keep` set says which
+        # host fields are unchanged since the previous wave. `_dev_source`
+        # guards against reuse across snapshot provenances: arrays from a
+        # from-scratch encoder (fresh vocab bit/slot assignments) must
+        # never satisfy a `keep` computed by the incremental encoder.
+        self._dev: dict = {}
+        self._dev_source: Optional[str] = None
+
+    def _to_dev(self, snap, field: str, keep: frozenset):
+        host = getattr(snap, field)
+        ent = self._dev.get(field)
+        if (
+            ent is not None
+            and field in keep
+            and ent[0] == host.shape
+            and ent[1] == host.dtype
+        ):
+            return ent[2]
+        arr = jnp.asarray(host)
+        self._dev[field] = (host.shape, host.dtype, arr)
+        return arr
 
     # -- carry commit of a whole run -----------------------------------------
 
@@ -229,6 +251,36 @@ class WaveScheduler:
             svc_first_peer, svc_peer_node_count, svc_peer_total,
         )
 
+    def _initial_carry(self, snap: ClusterSnapshot, last_node_index: int,
+                       keep: frozenset):
+        """BatchScheduler.initial_carry with device reuse: the resource
+        block ships as ONE stacked transfer and the (usually empty)
+        ip/vol/svc blocks reuse their device copies when unchanged."""
+        res_host = np.stack([
+            np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
+            np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
+            np.asarray(snap.nz_mem), np.asarray(snap.pod_count),
+        ])
+        return (
+            jnp.asarray(res_host),
+            self._to_dev(snap, "port_mask", keep),
+            self._to_dev(snap, "class_count", keep),
+            jnp.int64(last_node_index),
+            self._to_dev(snap, "ip_term_count", keep),
+            self._to_dev(snap, "ip_own_anti", keep),
+            self._to_dev(snap, "ip_rev_hard", keep),
+            self._to_dev(snap, "ip_rev_pref", keep),
+            self._to_dev(snap, "ip_rev_anti", keep),
+            self._to_dev(snap, "ip_spec_total", keep),
+            self._to_dev(snap, "vol_any", keep),
+            self._to_dev(snap, "vol_rw", keep),
+            self._to_dev(snap, "ebs_mask", keep),
+            self._to_dev(snap, "gce_mask", keep),
+            self._to_dev(snap, "svc_first_peer", keep),
+            self._to_dev(snap, "svc_peer_node_count", keep),
+            self._to_dev(snap, "svc_peer_total", keep),
+        )
+
     # -- backlog -------------------------------------------------------------
 
     def _pod_row(self, batch: PodBatch, i: int):
@@ -259,7 +311,9 @@ class WaveScheduler:
                 room = np.maximum(np.asarray(alloc) - np.asarray(used), 0)
                 cap = np.minimum(cap, room // commit + 1)
         J = min(K, int(cap.max())) + 1
-        return next_pow2(min(J, self.max_j), floor=16)
+        # floor 128: one probe program serves every wave size (a small
+        # K would otherwise compile J=16/32/64 variants for nothing)
+        return next_pow2(min(J, self.max_j), floor=128)
 
     def schedule_backlog(
         self,
@@ -267,14 +321,23 @@ class WaveScheduler:
         batch: PodBatch,
         rep_idx: np.ndarray,
         last_node_index: int = 0,
+        keep: frozenset = frozenset(),
+        source: str = "full",
     ) -> Tuple[np.ndarray, tuple, int]:
         """-> (chosen i32[P] node ids with -1 == unschedulable,
         final carry, final lastNodeIndex). snap may be node-padded;
         batch holds one row per unique pod; rep_idx maps backlog
-        position -> row."""
+        position -> row. `keep` (from the incremental encoder) names
+        snapshot fields unchanged since the previous wave — their
+        device copies are reused instead of re-shipped. `source`
+        identifies the snapshot's producer; a producer change drops the
+        device cache (ids/bit positions are producer-relative)."""
+        if source != self._dev_source:
+            self._dev.clear()
+            self._dev_source = source
         P = len(rep_idx)
         static = {
-            f: jnp.asarray(getattr(snap, f))
+            f: self._to_dev(snap, f, keep)
             for f in BatchScheduler.STATIC_FIELDS
         }
         static.update(BatchScheduler.config_static(self.config, snap))
@@ -282,7 +345,7 @@ class WaveScheduler:
             int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
         )
         num_values = int(snap.svc_num_values)
-        carry = self.scan.initial_carry(snap, last_node_index)
+        carry = self._initial_carry(snap, last_node_index, keep)
         out = np.full(P, -1, np.int32)
         perm = np.asarray(snap.name_desc_order).astype(np.int64)
         N = snap.num_nodes

@@ -37,24 +37,54 @@ def _is_dataclass_type(t: Any) -> bool:
     return isinstance(t, type) and dataclasses.is_dataclass(t)
 
 
+# Per-class reflection plans. Resolving type hints reflectively on every
+# call made the codec the daemon's single hottest path (typing.get_type_hints
+# walks ForwardRefs each time); one plan per class restores generated-code
+# speed while keeping the schema = the dataclass.
+_ENCODE_PLAN: Dict[type, list] = {}
+_DECODE_PLAN: Dict[type, Dict[str, tuple]] = {}
+
+
+def _encode_plan(cls: type) -> list:
+    plan = _ENCODE_PLAN.get(cls)
+    if plan is None:
+        plan = [(f.name, to_camel(f.name)) for f in dataclasses.fields(cls)]
+        _ENCODE_PLAN[cls] = plan
+    return plan
+
+
+def _decode_plan(cls: type) -> Dict[str, tuple]:
+    plan = _DECODE_PLAN.get(cls)
+    if plan is None:
+        hints = typing.get_type_hints(cls)
+        plan = {
+            to_camel(f.name): (f.name, _strip_optional(hints[f.name]))
+            for f in dataclasses.fields(cls)
+        }
+        _DECODE_PLAN[cls] = plan
+    return plan
+
+
 def encode_value(v: Any) -> Any:
     """Recursively encode a value into JSON-compatible data."""
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        cls = type(v)
+        is_meta = cls.__name__ == "ObjectMeta"
         out: Dict[str, Any] = {}
-        for f in dataclasses.fields(v):
-            fv = getattr(v, f.name)
+        for fname, camel in _encode_plan(cls):
+            fv = getattr(v, fname)
             if fv is None:
                 continue
             # metadata.namespace is NEVER omitted: cluster-scoped objects
             # carry an explicit "" (the dataclass default is "default", so
             # omitempty would resurrect a namespace on decode)
-            if f.name == "namespace" and type(v).__name__ == "ObjectMeta":
-                out[to_camel(f.name)] = fv
+            if is_meta and fname == "namespace":
+                out[camel] = fv
                 continue
             # omitempty: skip empty containers and default-empty strings
             if fv == {} or fv == [] or fv == () or fv == "":
                 continue
-            out[to_camel(f.name)] = encode_value(fv)
+            out[camel] = encode_value(fv)
         return out
     if isinstance(v, dict):
         return {k: encode_value(x) for k, x in v.items()}
@@ -71,37 +101,61 @@ def _strip_optional(t: Any) -> Any:
     return t
 
 
+# container-type plans: t -> ("list"|"tuple"|"dict"|"scalar", elem type)
+_CONTAINER_PLAN: Dict[Any, tuple] = {}
+
+
+def _container_plan(t: Any) -> tuple:
+    try:
+        plan = _CONTAINER_PLAN.get(t)
+    except TypeError:  # unhashable typing construct: no caching
+        plan = None
+    if plan is None:
+        origin = typing.get_origin(t)
+        if origin in (list, typing.List):
+            (elem,) = typing.get_args(t) or (Any,)
+            plan = ("list", _strip_optional(elem))
+        elif origin in (tuple, typing.Tuple):
+            args = typing.get_args(t)
+            plan = ("tuple", _strip_optional(args[0]) if args else Any)
+        elif origin in (dict, typing.Dict):
+            args = typing.get_args(t)
+            vt = args[1] if len(args) == 2 else Any
+            plan = ("dict", vt if vt in (object, Any) else _strip_optional(vt))
+        else:
+            plan = ("scalar", None)
+        try:
+            _CONTAINER_PLAN[t] = plan
+        except TypeError:
+            pass
+    return plan
+
+
 def decode_value(t: Any, v: Any) -> Any:
     """Recursively decode JSON data into the typed form `t`."""
     t = _strip_optional(t)
     if v is None:
         return None
-    origin = typing.get_origin(t)
     if _is_dataclass_type(t):
         if not isinstance(v, dict):
             raise ValueError(f"expected object for {t.__name__}, got {type(v)}")
-        hints = typing.get_type_hints(t)
+        plan = _decode_plan(t)
         kwargs = {}
-        by_camel = {to_camel(f.name): f.name for f in dataclasses.fields(t)}
         for k, fv in v.items():
-            fname = by_camel.get(k)
-            if fname is None:
+            ent = plan.get(k)
+            if ent is None:
                 continue  # unknown fields are dropped, like strict-less json
-            kwargs[fname] = decode_value(hints[fname], fv)
+            kwargs[ent[0]] = decode_value(ent[1], fv)
         return t(**kwargs)
-    if origin in (list, typing.List):
-        (elem,) = typing.get_args(t) or (Any,)
+    kind, elem = _container_plan(t)
+    if kind == "list":
         return [decode_value(elem, x) for x in v]
-    if origin in (tuple, typing.Tuple):
-        args = typing.get_args(t)
-        elem = args[0] if args else Any
+    if kind == "tuple":
         return tuple(decode_value(elem, x) for x in v)
-    if origin in (dict, typing.Dict):
-        args = typing.get_args(t)
-        vt = args[1] if len(args) == 2 else Any
-        if vt is object or vt is Any:
+    if kind == "dict":
+        if elem is object or elem is Any:
             return dict(v)
-        return {k: decode_value(vt, x) for k, x in v.items()}
+        return {k: decode_value(elem, x) for k, x in v.items()}
     return v
 
 

@@ -151,10 +151,16 @@ def _register_all() -> None:
 
 def _tpu_algorithm_factory(factory_args):
     """Build the batched TPU ScheduleAlgorithm (lazy import keeps jax out
-    of pure control-plane processes)."""
+    of pure control-plane processes). The daemon wires the scheduler
+    cache so waves run off the incrementally-maintained snapshot."""
     from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
 
-    return TPUScheduleAlgorithm()
+    return TPUScheduleAlgorithm(
+        cache=factory_args.scheduler_cache,
+        service_lister=factory_args.service_lister,
+        controller_lister=factory_args.controller_lister,
+        replica_set_lister=factory_args.replica_set_lister,
+    )
 
 
 _register_all()

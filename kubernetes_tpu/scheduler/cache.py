@@ -53,6 +53,31 @@ class SchedulerCache:
         self._nodes: Dict[str, NodeInfo] = {}
         self._stop = threading.Event()
         self._cleanup_thread: Optional[threading.Thread] = None
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to cache mutations: fn(kind, obj) called under the
+        cache lock with kind in {pod_add, pod_remove, node_set,
+        node_remove}. Every pod transition (assume, confirm, update,
+        remove, expire, forget) decomposes into pod_add/pod_remove, so a
+        listener integrating the stream reconstructs the cache state —
+        the seam the incremental snapshot (snapshot/incremental.py)
+        feeds from, mirroring how the reference's cache is itself the
+        integral of the informer stream (cache.go:44).
+
+        Current contents are replayed into the listener first (under the
+        same lock), so subscribing late loses nothing."""
+        with self._lock:
+            for info in self._nodes.values():
+                if info.node is not None:
+                    fn("node_set", info.node)
+            for st in self._pod_states.values():
+                fn("pod_add", st.pod)
+            self._listeners.append(fn)
+
+    def _notify(self, kind: str, obj) -> None:
+        for fn in self._listeners:
+            fn(kind, obj)
 
     # -- lifecycle (factory.go:101 starts the 1s cleanup loop) ---------------
 
@@ -153,6 +178,7 @@ class SchedulerCache:
                 info = NodeInfo()
                 self._nodes[node.metadata.name] = info
             info.node = node
+            self._notify("node_set", node)
 
     def update_node(self, old: Node, new: Node) -> None:
         self.add_node(new)
@@ -167,6 +193,7 @@ class SchedulerCache:
             info.node = None
             if not info.pods:
                 del self._nodes[node.metadata.name]
+            self._notify("node_remove", node)
 
     # -- snapshot + expiry ---------------------------------------------------
 
@@ -212,6 +239,7 @@ class SchedulerCache:
             info = NodeInfo()
             self._nodes[node_name] = info
         info.add_pod(pod)
+        self._notify("pod_add", pod)
 
     def _remove_pod_locked(self, pod: Pod) -> None:
         node_name = pod.spec.node_name
@@ -221,6 +249,8 @@ class SchedulerCache:
         try:
             info.remove_pod(pod)
         except KeyError:
-            pass
-        if info.node is None and not info.pods:
-            del self._nodes[node_name]
+            return  # nothing removed: don't notify
+        finally:
+            if info.node is None and not info.pods:
+                del self._nodes[node_name]
+        self._notify("pod_remove", pod)

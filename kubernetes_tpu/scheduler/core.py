@@ -94,9 +94,11 @@ class SchedulerConfig:
     # serial, reference-identical pacing)
     drain_waiting: Callable[[int], List[Pod]] = None
     # wave cap: with power-of-two bucketing in the TPU algorithm this also
-    # bounds the set of compiled program shapes ({64,128,256} by default) —
-    # each fresh shape costs a full XLA compile on a tunneled chip
-    max_batch: int = 256
+    # bounds the set of compiled program shapes — each fresh shape costs a
+    # full XLA compile on a tunneled chip. Runs of identical pods bypass
+    # the scan entirely (models/wave.py), so large waves are cheap for
+    # template-created backlogs.
+    max_batch: int = 1024
     # schedulable-node filter (factory.go:412 getNodeConditionPredicate
     # applied through the NodeLister, generic_scheduler.go:81)
     node_lister: object = None
@@ -106,11 +108,35 @@ class SchedulerConfig:
     stop_everything: threading.Event = field(default_factory=threading.Event)
 
 
+class _LazyState:
+    """Builds the ClusterState on first attribute access."""
+
+    def __init__(self, build):
+        object.__setattr__(self, "_build_fn", build)
+        object.__setattr__(self, "_built", None)
+
+    def _real(self) -> ClusterState:
+        if self._built is None:
+            object.__setattr__(self, "_built", self._build_fn())
+        return self._built
+
+    def __getattr__(self, name):
+        return getattr(self._real(), name)
+
+
 class Scheduler:
     """scheduler.go Scheduler."""
 
     def __init__(self, config: SchedulerConfig):
         self.config = config
+        # bounded bind pool: the reference spawns a goroutine per bind
+        # (scheduler.go:124); Python threads are ~3 orders costlier, so a
+        # reused pool keeps wave-sized bind floods cheap
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._bind_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="bind"
+        )
 
     def run(self) -> threading.Thread:
         """scheduler.go:89 Run — the loop in a daemon thread."""
@@ -122,6 +148,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self.config.stop_everything.set()
+        self._bind_pool.shutdown(wait=False)
 
     def _loop(self) -> None:
         while not self.config.stop_everything.is_set():
@@ -135,6 +162,14 @@ class Scheduler:
     # -- one cycle -----------------------------------------------------------
 
     def _snapshot(self) -> ClusterState:
+        """Deferred: the TPU wave path schedules off the incrementally
+        maintained snapshot (snapshot/incremental.py) and never touches
+        this ClusterState, so the O(cluster) cache clone only happens
+        when something actually reads it (oracle path, fallback encode,
+        failure explanation)."""
+        return _LazyState(self._build_snapshot)
+
+    def _build_snapshot(self) -> ClusterState:
         extras = self.config.snapshot_extras() if self.config.snapshot_extras else {}
         state = self.config.scheduler_cache.snapshot(**extras)
         if self.config.node_lister is None:
@@ -251,8 +286,13 @@ class Scheduler:
                     host,
                 )
 
-        # async bind goroutine (scheduler.go:124-152)
-        threading.Thread(target=bind, daemon=True, name="bind").start()
+        # async bind (scheduler.go:124-152), on the shared pool
+        try:
+            self._bind_pool.submit(bind)
+        except RuntimeError:
+            # stop() shut the pool down mid-cycle: bind inline so the
+            # assumed pod isn't orphaned until TTL expiry
+            bind()
 
     def _handle_failure(
         self, pod: Pod, err: Exception, reason: str = "FailedScheduling"

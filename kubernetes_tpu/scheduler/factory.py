@@ -173,6 +173,7 @@ class ConfigFactory:
             node_lister=self.node_lister,
             hard_pod_affinity_weight=self.hard_pod_affinity_weight,
             failure_domains=self.failure_domains,
+            scheduler_cache=self.scheduler_cache,
         )
 
     def create_from_provider(self, provider_name: str) -> SchedulerConfig:

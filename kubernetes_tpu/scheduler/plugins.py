@@ -32,6 +32,9 @@ class PluginFactoryArgs:
     pvc_lister: object = None
     hard_pod_affinity_weight: int = 1
     failure_domains: Sequence[str] = ()
+    # the TPU algorithm factory subscribes its incremental snapshot
+    # encoder to cache mutations (snapshot/incremental.py)
+    scheduler_cache: object = None
 
 
 PredicateFactory = Callable[[PluginFactoryArgs], Predicate]

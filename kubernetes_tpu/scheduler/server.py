@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -86,13 +87,35 @@ class SchedulerServer:
             config = self.factory.create_from_provider(opts.algorithm_provider)
 
         # event broadcaster -> apiserver (server.go:117-120)
-        broadcaster = EventBroadcaster()
-        broadcaster.start_recording_to_sink(EventSink(self.client))
-        config.recorder = broadcaster.new_recorder("scheduler")
+        self._broadcaster = EventBroadcaster()
+        self._broadcaster.start_recording_to_sink(EventSink(self.client))
+        config.recorder = self._broadcaster.new_recorder("scheduler")
 
         self.scheduler = Scheduler(config)
         if not opts.leader_elect:
-            self._thread = self.scheduler.run()
+            # compile the TPU wave programs off the hot path: wait for
+            # the node informer to sync (cluster size sets the program
+            # shapes), warm up, then open the scheduling loop. Pods
+            # arriving meanwhile queue in the FIFO.
+            def _warm_then_run():
+                algo = config.algorithm
+                if hasattr(algo, "warmup"):
+                    deadline = time.time() + 2.0
+                    n = 0
+                    while time.time() < deadline:
+                        n = len(self.factory.node_lister.list())
+                        if n:
+                            break
+                        time.sleep(0.05)
+                    # no nodes yet: don't compile a made-up shape while
+                    # pods queue; open the loop and compile on demand
+                    if n:
+                        algo.warmup(n)
+                self._thread = self.scheduler.run()
+
+            threading.Thread(
+                target=_warm_then_run, daemon=True, name="sched-warmup"
+            ).start()
             return self
 
         # leader election (server.go:140-157): run() schedules only while
@@ -129,3 +152,5 @@ class SchedulerServer:
             self.scheduler.stop()
         if self.factory is not None:
             self.factory.stop()
+        if getattr(self, "_broadcaster", None) is not None:
+            self._broadcaster.shutdown()

@@ -11,11 +11,15 @@ for a whole FIFO wave in one dispatch.
 
 from __future__ import annotations
 
+import logging
+import threading
 from typing import List, Optional, Sequence
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.oracle.scheduler import FitError
 from kubernetes_tpu.oracle.state import ClusterState
+
+log = logging.getLogger(__name__)
 
 
 def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
@@ -27,8 +31,11 @@ def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
 
 
 class TPUScheduleAlgorithm:
-    def __init__(self, mesh=None, min_run: int = 16):
+    def __init__(self, mesh=None, min_run: int = 16, cache=None,
+                 service_lister=None, controller_lister=None,
+                 replica_set_lister=None):
         self._mesh_sched = None
+        self._inc = None
         if mesh is not None:
             from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
 
@@ -39,29 +46,32 @@ class TPUScheduleAlgorithm:
 
             self._wave = WaveScheduler(min_run=min_run)
             self._sched = self._wave.scan
+            if cache is not None:
+                # daemon mode: maintain the snapshot incrementally from
+                # cache deltas instead of re-encoding the cluster per wave
+                from kubernetes_tpu.snapshot.incremental import (
+                    IncrementalEncoder,
+                )
+
+                self._inc = IncrementalEncoder(config=self._wave.config)
+                cache.add_listener(self._inc.on_cache_event)
+                self._service_lister = service_lister
+                self._controller_lister = controller_lister
+                self._replica_set_lister = replica_set_lister
         # selectHost's round-robin counter persists across waves, like the
         # reference's genericScheduler.lastNodeIndex persists across pods
         self._last_node_index = 0
+        # serializes warmup against real waves (the scheduler loop itself
+        # is single-threaded; warmup runs on a server thread)
+        self._sched_lock = threading.Lock()
 
-    def schedule_backlog(
-        self, pods: Sequence[Pod], state: ClusterState
-    ) -> List[Optional[str]]:
-        if not pods:
-            return []
-        if self._mesh_sched is not None:
-            return self._schedule_backlog_mesh(pods, state)
+    def _dedup(self, pods: Sequence[Pod]):
+        """Template-created pods (RC/RS/Job) are identical up to their
+        name: encode one representative per distinct feature key."""
         import numpy as np
 
-        from kubernetes_tpu.parallel.mesh import _pad_snapshot
-        from kubernetes_tpu.snapshot.encode import (
-            SnapshotEncoder,
-            pod_feature_key,
-        )
-        from kubernetes_tpu.snapshot.pad import next_pow2
+        from kubernetes_tpu.snapshot.encode import pod_feature_key
 
-        # deduplicate the backlog: template-created pods (RC/RS/Job) are
-        # identical up to their name, so encode one representative per
-        # distinct feature key — O(unique) encode instead of O(backlog)
         reps: List[Pod] = []
         rep_of_key = {}
         rep_idx = np.empty(len(pods), np.int64)
@@ -73,21 +83,121 @@ class TPUScheduleAlgorithm:
                 rep_of_key[k] = r
                 reps.append(p)
             rep_idx[i] = r
-        enc = SnapshotEncoder(state, reps, config=self._wave.config)
-        snap = enc.encode_nodes()
-        batch = enc.encode_pods()
-        n_real = snap.num_nodes
-        if n_real == 0:
-            # empty cluster: every pod fails with FitError in the reference
-            return [None] * len(pods)
-        n_bucket = next_pow2(n_real, 64)
-        if n_bucket > n_real:
-            snap = _pad_snapshot(snap, n_bucket)
+        return reps, rep_idx
+
+    def warmup(self, num_nodes: int) -> None:
+        """Compile the wave programs for an `num_nodes`-sized cluster
+        before the first real pod arrives (server.py runs this in the
+        background while informers sync): a cold XLA compile on a
+        tunneled chip otherwise lands on the first scheduling cycle.
+        Uses a synthetic cluster shaped like the common case (label-only
+        pods, unlabeled nodes) so the program shapes match."""
+        if self._mesh_sched is not None:
+            return
+        from kubernetes_tpu.api.types import (
+            Container,
+            Node,
+            NodeCondition,
+            NodeStatus,
+            ObjectMeta,
+            Pod as PodT,
+            PodSpec,
+        )
+        from kubernetes_tpu.oracle.state import ClusterState as CS
+
+        nodes = [
+            Node(
+                metadata=ObjectMeta(name=f"warm-{i:05d}"),
+                status=NodeStatus(
+                    allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+            for i in range(max(num_nodes, 1))
+        ]
+
+        def pod(name, cpu):
+            return PodT(
+                metadata=ObjectMeta(name=name, labels={"app": "warm"}),
+                spec=PodSpec(containers=[
+                    Container(image="warm", requests={"cpu": cpu})
+                ]),
+            )
+
+        # an eligible run (probe+replay+apply programs) and a lone pod
+        # distinct only in its requests (below min_run => the scan
+        # program) — differing by resources keeps every vocab width,
+        # and therefore every compiled shape, identical to the run's
+        backlog = [pod(f"w{i}", "100m") for i in range(max(self._wave.min_run, 2))]
+        backlog.append(pod("w-scan", "200m"))
+        state = CS.build(nodes)
+        with self._sched_lock:
+            saved_last, saved_inc = self._last_node_index, self._inc
+            try:
+                self._inc = None  # compile via the full-encode path
+                self._schedule_backlog_locked(backlog, state)
+            except Exception:
+                log.debug("scheduler warmup failed", exc_info=True)
+            finally:
+                self._inc = saved_inc
+                self._last_node_index = saved_last
+
+    def schedule_backlog(
+        self, pods: Sequence[Pod], state: ClusterState
+    ) -> List[Optional[str]]:
+        if not pods:
+            return []
+        if self._mesh_sched is not None:
+            return self._schedule_backlog_mesh(pods, state)
+        with self._sched_lock:
+            return self._schedule_backlog_locked(pods, state)
+
+    def _schedule_backlog_locked(
+        self, pods: Sequence[Pod], state: ClusterState
+    ) -> List[Optional[str]]:
+        from kubernetes_tpu.parallel.mesh import _pad_snapshot
+        from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+        from kubernetes_tpu.snapshot.pad import next_pow2
+
+        reps, rep_idx = self._dedup(pods)
+        snap = batch = None
+        keep = frozenset()
+        source = "full"
+        if self._inc is not None:
+            def ls(l):
+                return l.list() if l is not None else ()
+
+            snap, batch, keep = self._inc.wave_view(
+                reps,
+                services=ls(self._service_lister),
+                controllers=ls(self._controller_lister),
+                replica_sets=ls(self._replica_set_lister),
+            )
+            if snap is not None:
+                source = "inc"
+        if snap is None:
+            # from-scratch encode (no daemon cache, or a scope gate hit:
+            # inter-pod affinity / volumes / SA-SAA config)
+            enc = SnapshotEncoder(state, reps, config=self._wave.config)
+            snap = enc.encode_nodes()
+            batch = enc.encode_pods()
+            n_real = snap.num_nodes
+            if n_real == 0:
+                # empty cluster: every pod fails with FitError
+                return [None] * len(pods)
+            n_bucket = next_pow2(n_real, 64)
+            if n_bucket > n_real:
+                snap = _pad_snapshot(snap, n_bucket)
         chosen, _final, last = self._wave.schedule_backlog(
-            snap, batch, rep_idx, last_node_index=self._last_node_index
+            snap, batch, rep_idx, last_node_index=self._last_node_index,
+            keep=keep, source=source,
         )
         self._last_node_index = last
-        return _ids_to_names(chosen, snap.node_names, n_real)
+        names = snap.node_names
+        return [
+            (names[i] or None) if 0 <= i < len(names) else None
+            for i in (int(c) for c in chosen)
+        ]
 
     def _schedule_backlog_mesh(
         self, pods: Sequence[Pod], state: ClusterState

@@ -151,6 +151,35 @@ class _Dict:
         return len(self.ids)
 
 
+class VocabBundle:
+    """The append-only vocabularies a SnapshotEncoder interns into.
+
+    Normally private to one encoder; the incremental snapshot
+    (snapshot/incremental.py) owns a persistent bundle so per-wave
+    pod encodes and the long-lived node arrays agree on ids."""
+
+    def __init__(self):
+        self.ports = _Dict()
+        self.kv = _Dict()  # (key, value) pairs
+        self.keys = _Dict()  # label keys
+        self.numkeys = _Dict()  # keys used by Gt/Lt
+        self.taints = _Dict()  # (key, value, effect)
+        self.zones = _Dict()
+        self.zones.get("")  # id 0 == no zone
+        self.classes = _Dict()  # (ns, frozenset(labels.items()), deleted)
+        self.sets: Dict[frozenset, int] = {}
+        self.set_members: List[frozenset] = []
+
+
+def build_set_table(set_members, kv_ids, lw: int) -> np.ndarray:
+    """Requirement value-sets as kv-bitmask rows (shared by the full
+    encoder and the incremental per-wave view)."""
+    out = np.zeros((max(1, len(set_members)), lw), np.uint32)
+    for idx, fs in enumerate(set_members):
+        out[idx] = _pack_bits([kv_ids[kv] for kv in fs], lw)
+    return out
+
+
 @dataclass
 class ClusterSnapshot:
     """Node-axis arrays + vocabulary tables (numpy, host-resident; the
@@ -345,7 +374,9 @@ class SnapshotEncoder:
     the columnar snapshot + pod batch. Vocabularies are derived jointly so
     pod-side and node-side ids agree."""
 
-    def __init__(self, state: ClusterState, pods: Sequence[Pod], config=None):
+    def __init__(self, state: ClusterState, pods: Sequence[Pod], config=None,
+                 vocabs: Optional[VocabBundle] = None, visit_state: bool = True,
+                 node_id: Optional[Dict[str, int]] = None):
         self.state = state
         self.pods = list(pods)
         # config-parameterized compilation (ServiceAffinity labels etc.);
@@ -354,18 +385,27 @@ class SnapshotEncoder:
         self.node_names = [
             name for name, info in state.node_infos.items() if info.node is not None
         ]
-        self.node_id = {n: i for i, n in enumerate(self.node_names)}
-        # --- vocabularies
-        self.ports = _Dict()
-        self.kv = _Dict()  # (key, value) pairs
-        self.keys = _Dict()  # label keys
-        self.numkeys = _Dict()  # keys used by Gt/Lt
-        self.taints = _Dict()  # (key, value, effect)
-        self.zones = _Dict()
-        self.zones.get("")  # id 0 == no zone
-        self.classes = _Dict()  # (ns, frozenset(labels.items()), deleted)
-        self.sets: Dict[frozenset, int] = {}
-        self.set_members: List[frozenset] = []
+        # node ids may be injected (incremental slot map) so host_req and
+        # compilers agree with externally-maintained node arrays
+        self.node_id = (
+            node_id if node_id is not None
+            else {n: i for i, n in enumerate(self.node_names)}
+        )
+        # --- vocabularies (shared, append-only, when a bundle is given)
+        self.vocabs = vocabs or VocabBundle()
+        self.ports = self.vocabs.ports
+        self.kv = self.vocabs.kv
+        self.keys = self.vocabs.keys
+        self.numkeys = self.vocabs.numkeys
+        self.taints = self.vocabs.taints
+        self.zones = self.vocabs.zones
+        self.classes = self.vocabs.classes
+        self.sets = self.vocabs.sets
+        self.set_members = self.vocabs.set_members
+        # visit_state=False: the caller maintains node/assigned-pod vocab
+        # entries itself (snapshot/incremental.py); only the pending pods
+        # are visited here
+        self._visit_state = visit_state
         self._interpod = None
         self._volumes = None
         self._services = None
@@ -463,26 +503,30 @@ class SnapshotEncoder:
             return None
 
     def _build_vocabs(self):
+        # images are deliberately per-encoder (not in the shared bundle):
+        # ImageLocality only needs pod-ids and node sizes to agree within
+        # one wave, and a per-wave vocab keeps the image axis small
         self.images = _Dict()
         for pod in self.pods:
             for c in pod.spec.containers:
                 self.images.get(c.image)
-        for name in self.node_names:
-            node = self.state.node_infos[name].node
-            for k, v in node.metadata.labels.items():
-                self.keys.get(k)
-                self.kv.get((k, v))
-            try:
-                for t in get_taints(node):
-                    self.taints.get((t.key, t.value, t.effect))
-            except Exception:
-                pass  # malformed annotation; encode_nodes marks taint_bad
-            zone = get_zone_key(node)
-            if zone:
-                self.zones.get(zone)
-        for info in self.state.node_infos.values():
-            for pod in info.pods:
-                self._visit_pod_vocab(pod)
+        if self._visit_state:
+            for name in self.node_names:
+                node = self.state.node_infos[name].node
+                for k, v in node.metadata.labels.items():
+                    self.keys.get(k)
+                    self.kv.get((k, v))
+                try:
+                    for t in get_taints(node):
+                        self.taints.get((t.key, t.value, t.effect))
+                except Exception:
+                    pass  # malformed annotation; encode_nodes marks taint_bad
+                zone = get_zone_key(node)
+                if zone:
+                    self.zones.get(zone)
+            for info in self.state.node_infos.values():
+                for pod in info.pods:
+                    self._visit_pod_vocab(pod)
         for pod in self.pods:
             self._visit_pod_vocab(pod)
 
@@ -650,7 +694,7 @@ class SnapshotEncoder:
         table = np.zeros((max(1, len(self.set_members)), w["LW"]), np.uint32)
         for idx, fs in enumerate(self.set_members):
             table[idx] = _pack_bits(
-                [self.kv.get(kv, add=False) for kv in fs], w["LW"]
+                [self.kv.ids[kv] for kv in fs], w["LW"]
             )
         return table
 

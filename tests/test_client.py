@@ -195,7 +195,17 @@ class TestEvents:
         target = pod("p")
         rec.event(target, "Normal", "Scheduled", "bound to node-1")
         rec.event(target, "Normal", "Scheduled", "bound to node-1")
-        events, _ = c.events().list()
+        # publishing is async (bounded queue, like the reference's
+        # watch.Broadcaster): poll for delivery
+        import time as _time
+
+        deadline = _time.time() + 5.0
+        events = []
+        while _time.time() < deadline:
+            events, _ = c.events().list()
+            if len(events) == 1 and events[0].count == 2:
+                break
+            _time.sleep(0.01)
         assert len(events) == 1
         assert events[0].count == 2
         assert events[0].reason == "Scheduled"

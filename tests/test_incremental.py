@@ -1,0 +1,279 @@
+"""Incremental snapshot maintenance: snapshot-after-deltas must equal
+snapshot-from-scratch (VERDICT round-1 item #2; reference analogue:
+schedulercache/node_info.go:118-156 O(1) deltas + cache.go:77 clone).
+
+Two layers of proof:
+  1. semantic: after a random cache event stream, every decoded per-node
+     quantity in the incremental arrays equals what a from-scratch
+     SnapshotEncoder derives from the same cluster state;
+  2. end-to-end: scheduling decisions through the cache-wired
+     TPUScheduleAlgorithm (incremental wave path, with fallback gates)
+     are identical to the sequential oracle on the equivalently
+     restricted state, across interleaved event batches.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    NodeSpec,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Service,
+    ServiceSpec,
+    Taint,
+)
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.factory import node_schedulable
+from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+from kubernetes_tpu.snapshot.incremental import IncrementalEncoder
+from kubernetes_tpu.utils.clock import FakeClock
+
+from tests.test_conformance import ORACLE_PREDICATES, ORACLE_PRIORITIES
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+class _Lister:
+    def __init__(self):
+        self.items = []
+
+    def list(self):
+        return list(self.items)
+
+
+def rand_node(rng, name):
+    labels = {"kubernetes.io/hostname": name}
+    if rng.random() < 0.4:
+        labels[ZONE] = rng.choice(["a", "b"])
+    if rng.random() < 0.5:
+        labels["disktype"] = rng.choice(["ssd", "hdd"])
+    taints = None
+    if rng.random() < 0.25:
+        taints = [Taint(key="dedicated", value=rng.choice(["a", "b"]),
+                        effect=rng.choice(["NoSchedule", "PreferNoSchedule"]))]
+    conds = [NodeCondition("Ready", rng.choice(["True", "True", "True", "False"]))]
+    if rng.random() < 0.2:
+        conds.append(NodeCondition("MemoryPressure", "True"))
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=NodeSpec(taints=taints),
+        status=NodeStatus(
+            allocatable={
+                "cpu": f"{rng.choice([1000, 2000, 4000])}m",
+                "memory": str(rng.choice([2, 4, 8]) * 1024**3),
+                "pods": str(rng.choice([5, 20, 110])),
+            },
+            conditions=conds,
+        ),
+    )
+
+
+def rand_assigned(rng, i, node_name):
+    reqs = {}
+    if rng.random() < 0.8:
+        reqs["cpu"] = f"{rng.choice([0, 100, 300])}m"
+    if rng.random() < 0.8:
+        reqs["memory"] = str(rng.choice([0, 256, 512]) * 1024**2)
+    ports = []
+    if rng.random() < 0.3:
+        ports.append(ContainerPort(host_port=rng.choice([8080, 9090])))
+    return Pod(
+        metadata=ObjectMeta(
+            name=f"assigned-{i}",
+            labels=rng.choice([{"app": "web"}, {"app": "db"}, {}]),
+        ),
+        spec=PodSpec(
+            node_name=node_name,
+            containers=[Container(requests=reqs, ports=ports)],
+        ),
+    )
+
+
+def rand_pending(rng, i):
+    kw = {}
+    if rng.random() < 0.3:
+        kw["node_selector"] = rng.choice([{"disktype": "ssd"}, {ZONE: "a"}])
+    return Pod(
+        metadata=ObjectMeta(
+            name=f"pending-{i}",
+            labels=rng.choice([{"app": "web"}, {"app": "db"}]),
+        ),
+        spec=PodSpec(
+            containers=[
+                Container(requests={"cpu": "100m", "memory": "100Mi"})
+            ],
+            **kw,
+        ),
+    )
+
+
+def drive_events(rng, cache, steps, live_nodes, live_pods, pod_seq):
+    """Apply `steps` random mutations to the cache, mirroring them in
+    live_nodes / live_pods dicts (name -> object)."""
+    for _ in range(steps):
+        op = rng.random()
+        if op < 0.25 or not live_nodes:
+            name = f"node-{rng.randrange(200):03d}"
+            node = rand_node(rng, name)
+            if name in live_nodes:
+                cache.update_node(live_nodes[name], node)
+            else:
+                cache.add_node(node)
+            live_nodes[name] = node
+        elif op < 0.35 and live_nodes:
+            name = rng.choice(list(live_nodes))
+            cache.remove_node(live_nodes.pop(name))
+        elif op < 0.75:
+            pod_seq[0] += 1
+            pod = rand_assigned(rng, pod_seq[0], rng.choice(list(live_nodes)))
+            cache.add_pod(pod)
+            live_pods[pod.metadata.name] = pod
+        elif live_pods:
+            name = rng.choice(list(live_pods))
+            cache.remove_pod(live_pods.pop(name))
+
+
+def restricted_state(cache, services=(), controllers=()):
+    """core.py Scheduler._snapshot semantics: schedulable nodes only."""
+    state = cache.snapshot(services=list(services), controllers=list(controllers))
+    sub = ClusterState(services=list(services), controllers=list(controllers))
+    sub.node_infos = {
+        n: info
+        for n, info in state.node_infos.items()
+        if info.node is not None and node_schedulable(info.node)
+    }
+    sub.full = state
+    return sub
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_semantic_equality(seed):
+    rng = random.Random(7000 + seed)
+    cache = SchedulerCache(clock=FakeClock(0.0))
+    inc = IncrementalEncoder()
+    cache.add_listener(inc.on_cache_event)
+    live_nodes, live_pods, seq = {}, {}, [0]
+    for _round in range(4):
+        drive_events(rng, cache, 40, live_nodes, live_pods, seq)
+        snap, _batch, _keep = inc.wave_view([rand_pending(rng, 0)])
+        assert snap is not None
+        v = inc.vocabs
+        state = cache.snapshot()
+        for name, info in state.node_infos.items():
+            if info.node is None:
+                slot = inc.slot_of[name]
+                assert inc._node_gone[slot]
+                continue
+            slot = inc.slot_of[name]
+            node = info.node
+            # resources: cache aggregates vs incremental arrays
+            assert snap.req_mcpu[slot] == info.requested_milli_cpu
+            assert snap.req_mem[slot] == info.requested_memory
+            assert snap.nz_mcpu[slot] == info.nonzero_milli_cpu
+            assert snap.nz_mem[slot] == info.nonzero_memory
+            assert snap.pod_count[slot] == len(info.pods)
+            # labels: decode the kv bitset back to pairs
+            got_kv = {
+                kv
+                for kv, kid in v.kv.ids.items()
+                if snap.label_kv[slot, kid // 32] >> np.uint32(kid % 32) & 1
+            }
+            assert got_kv == set(node.metadata.labels.items())
+            # taints (multiset via taint_count)
+            from kubernetes_tpu.api.types import get_taints
+
+            want_taints = {}
+            for t in get_taints(node):
+                k = (t.key, t.value, t.effect)
+                want_taints[k] = want_taints.get(k, 0) + 1
+            got_taints = {
+                k: int(snap.taint_count[slot, tid])
+                for k, tid in v.taints.ids.items()
+                if snap.taint_count[slot, tid]
+            }
+            assert got_taints == want_taints
+            # ports union
+            want_ports = set()
+            for p in info.pods:
+                for c in p.spec.containers:
+                    for pp in c.ports:
+                        if pp.host_port:
+                            want_ports.add(pp.host_port)
+            got_ports = {
+                port
+                for port, pid in v.ports.ids.items()
+                if snap.port_mask[slot, pid // 32] >> np.uint32(pid % 32) & 1
+            }
+            assert got_ports == want_ports
+            # spread classes
+            for ckey, cid in v.classes.ids.items():
+                ns, labels_fs, deleted = ckey
+                want = sum(
+                    1
+                    for p in info.pods
+                    if p.namespace == ns
+                    and frozenset(p.metadata.labels.items()) == labels_fs
+                    and (p.metadata.deletion_timestamp is not None) == deleted
+                )
+                assert snap.class_count[slot, cid] == want
+            # schedulability masking
+            if node_schedulable(node):
+                assert snap.alloc_mcpu[slot] > 0
+            else:
+                assert snap.alloc_pods[slot] == 0
+        # every live slot maps to a live node or a gone-with-pods slot
+        for name, slot in inc.slot_of.items():
+            assert name in state.node_infos
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_decisions_match_oracle(seed):
+    rng = random.Random(8000 + seed)
+    cache = SchedulerCache(clock=FakeClock(0.0))
+    svc_lister, rc_lister, rs_lister = _Lister(), _Lister(), _Lister()
+    svc_lister.items = [
+        Service(metadata=ObjectMeta(name="web"),
+                spec=ServiceSpec(selector={"app": "web"}))
+    ]
+    algo = TPUScheduleAlgorithm(
+        min_run=1, cache=cache, service_lister=svc_lister,
+        controller_lister=rc_lister, replica_set_lister=rs_lister,
+    )
+    oracle = GenericScheduler(
+        predicates=ORACLE_PREDICATES, priorities=ORACLE_PRIORITIES
+    )
+    live_nodes, live_pods, seq = {}, {}, [0]
+    pend_seq = 0
+    for _round in range(5):
+        drive_events(rng, cache, 30, live_nodes, live_pods, seq)
+        pending = []
+        for _ in range(rng.randint(1, 12)):
+            pend_seq += 1
+            p = rand_pending(rng, pend_seq)
+            pending += [p] * rng.randint(1, 4)  # runs of identical pods
+        state = restricted_state(cache, services=svc_lister.items)
+        want = oracle.schedule_backlog(pending, state.clone())
+        got = algo.schedule_backlog(pending, state)
+        assert got == want, f"seed {seed} round {_round}"
+        # decisions consumed: mirror what binding would do, so later
+        # rounds schedule against the updated cluster
+        for p, host in zip(pending, want):
+            if host is None:
+                continue
+            import copy
+
+            bound = copy.deepcopy(p)
+            bound.metadata.name = f"{p.metadata.name}-b{len(live_pods)}"
+            bound.spec.node_name = host
+            cache.add_pod(bound)
+            live_pods[bound.metadata.name] = bound
