@@ -749,22 +749,36 @@ def pod_nonzero_request(pod: Pod) -> Tuple[int, int]:
     return mcpu, mem
 
 
+def _jget(d: dict, key: str, default=None):
+    """Go encoding/json field matching: exact key first, else
+    case-insensitive. The reference's alpha-annotation payloads rely on
+    this (predicates_test.go writes "PodAntiAffinity"), so exact-case
+    lookups silently drop terms Go would honor."""
+    if key in d:
+        return d[key]
+    lk = key.lower()
+    for k, v in d.items():
+        if k.lower() == lk:
+            return v
+    return default
+
+
 def _node_selector_requirement_from_json(d: dict) -> NodeSelectorRequirement:
     return NodeSelectorRequirement(
-        key=d.get("key", ""),
-        operator=d.get("operator", "In"),
-        values=tuple(d.get("values") or ()),
+        key=_jget(d, "key", ""),
+        operator=_jget(d, "operator", "In"),
+        values=tuple(_jget(d, "values") or ()),
     )
 
 
 def _node_selector_from_json(d: dict) -> NodeSelector:
     terms = []
-    for t in d.get("nodeSelectorTerms") or ():
+    for t in _jget(d, "nodeSelectorTerms") or ():
         terms.append(
             NodeSelectorTerm(
                 match_expressions=tuple(
                     _node_selector_requirement_from_json(e)
-                    for e in t.get("matchExpressions") or ()
+                    for e in _jget(t, "matchExpressions") or ()
                 )
             )
         )
@@ -775,24 +789,24 @@ def _label_selector_from_json(d: Optional[dict]) -> Optional[LabelSelector]:
     if d is None:
         return None
     return LabelSelector(
-        match_labels=dict(d.get("matchLabels") or {}),
+        match_labels=dict(_jget(d, "matchLabels") or {}),
         match_expressions=tuple(
             LabelSelectorRequirement(
-                key=e.get("key", ""),
-                operator=e.get("operator", "In"),
-                values=tuple(e.get("values") or ()),
+                key=_jget(e, "key", ""),
+                operator=_jget(e, "operator", "In"),
+                values=tuple(_jget(e, "values") or ()),
             )
-            for e in d.get("matchExpressions") or ()
+            for e in _jget(d, "matchExpressions") or ()
         ),
     )
 
 
 def _pod_affinity_term_from_json(d: dict) -> PodAffinityTerm:
-    ns = d.get("namespaces")
+    ns = _jget(d, "namespaces")
     return PodAffinityTerm(
-        label_selector=_label_selector_from_json(d.get("labelSelector")),
+        label_selector=_label_selector_from_json(_jget(d, "labelSelector")),
         namespaces=None if ns is None else tuple(ns),
-        topology_key=d.get("topologyKey", ""),
+        topology_key=_jget(d, "topologyKey", ""),
     )
 
 
@@ -806,22 +820,22 @@ def get_affinity(pod: Pod) -> Optional[Affinity]:
         return None
     d = json.loads(raw)
     aff = Affinity()
-    na = d.get("nodeAffinity")
+    na = _jget(d, "nodeAffinity")
     if na:
-        req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
-        pref = na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+        req = _jget(na, "requiredDuringSchedulingIgnoredDuringExecution")
+        pref = _jget(na, "preferredDuringSchedulingIgnoredDuringExecution") or ()
         aff.node_affinity = NodeAffinity(
             required_during_scheduling_ignored_during_execution=(
                 _node_selector_from_json(req) if req else None
             ),
             preferred_during_scheduling_ignored_during_execution=tuple(
                 PreferredSchedulingTerm(
-                    weight=p.get("weight", 1),
+                    weight=_jget(p, "weight", 1),
                     preference=NodeSelectorTerm(
                         match_expressions=tuple(
                             _node_selector_requirement_from_json(e)
-                            for e in (p.get("preference") or {}).get(
-                                "matchExpressions"
+                            for e in _jget(
+                                _jget(p, "preference") or {}, "matchExpressions"
                             )
                             or ()
                         )
@@ -830,40 +844,40 @@ def get_affinity(pod: Pod) -> Optional[Affinity]:
                 for p in pref
             ),
         )
-    pa = d.get("podAffinity")
+    pa = _jget(d, "podAffinity")
     if pa:
         aff.pod_affinity = PodAffinity(
             required_during_scheduling_ignored_during_execution=tuple(
                 _pod_affinity_term_from_json(t)
-                for t in pa.get("requiredDuringSchedulingIgnoredDuringExecution") or ()
+                for t in _jget(pa, "requiredDuringSchedulingIgnoredDuringExecution") or ()
             ),
             preferred_during_scheduling_ignored_during_execution=tuple(
                 WeightedPodAffinityTerm(
-                    weight=t.get("weight", 1),
+                    weight=_jget(t, "weight", 1),
                     pod_affinity_term=_pod_affinity_term_from_json(
-                        t.get("podAffinityTerm") or {}
+                        _jget(t, "podAffinityTerm") or {}
                     ),
                 )
-                for t in pa.get("preferredDuringSchedulingIgnoredDuringExecution")
+                for t in _jget(pa, "preferredDuringSchedulingIgnoredDuringExecution")
                 or ()
             ),
         )
-    paa = d.get("podAntiAffinity")
+    paa = _jget(d, "podAntiAffinity")
     if paa:
         aff.pod_anti_affinity = PodAntiAffinity(
             required_during_scheduling_ignored_during_execution=tuple(
                 _pod_affinity_term_from_json(t)
-                for t in paa.get("requiredDuringSchedulingIgnoredDuringExecution")
+                for t in _jget(paa, "requiredDuringSchedulingIgnoredDuringExecution")
                 or ()
             ),
             preferred_during_scheduling_ignored_during_execution=tuple(
                 WeightedPodAffinityTerm(
-                    weight=t.get("weight", 1),
+                    weight=_jget(t, "weight", 1),
                     pod_affinity_term=_pod_affinity_term_from_json(
-                        t.get("podAffinityTerm") or {}
+                        _jget(t, "podAffinityTerm") or {}
                     ),
                 )
-                for t in paa.get("preferredDuringSchedulingIgnoredDuringExecution")
+                for t in _jget(paa, "preferredDuringSchedulingIgnoredDuringExecution")
                 or ()
             ),
         )
@@ -879,10 +893,10 @@ def get_tolerations(pod: Pod) -> List[Toleration]:
         return []
     return [
         Toleration(
-            key=t.get("key", ""),
-            operator=t.get("operator", "") or "Equal",
-            value=t.get("value", ""),
-            effect=t.get("effect", ""),
+            key=_jget(t, "key", ""),
+            operator=_jget(t, "operator", "") or "Equal",
+            value=_jget(t, "value", ""),
+            effect=_jget(t, "effect", ""),
         )
         for t in json.loads(raw)
     ]
@@ -897,9 +911,9 @@ def get_taints(node: Node) -> List[Taint]:
         return []
     return [
         Taint(
-            key=t.get("key", ""),
-            value=t.get("value", ""),
-            effect=t.get("effect", "NoSchedule"),
+            key=_jget(t, "key", ""),
+            value=_jget(t, "value", ""),
+            effect=_jget(t, "effect", "NoSchedule"),
         )
         for t in json.loads(raw)
     ]

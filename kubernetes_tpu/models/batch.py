@@ -273,7 +273,11 @@ def fit_mask(
     return fit
 
 
-def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
+def evaluate_pod(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
+    """Fit mask + weighted priority total for one pod against a frozen
+    carry — Schedule() up to selectHost (generic_scheduler.go:72-115).
+    Shared by the scan body and debug_evaluate (the conformance probe for
+    ported reference test tables)."""
     (
         # res: i64 (6, N) = [req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem,
         # pod_count] stacked so the per-step commit is ONE scatter (the
@@ -400,6 +404,35 @@ def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, c
         else:
             raise ValueError(f"unknown priority {name!r}")
         score = score + jnp.int64(weight) * s
+
+    return fit, score
+
+
+def _scan_fn(config: SchedulerConfig, num_zones: int, num_values: int, static, carry, pod):
+    (
+        res,
+        port_mask,
+        class_count,
+        last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
+    ) = carry
+    svc_labels = service_config_labels(config)
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+
+    fit, score = evaluate_pod(config, num_zones, num_values, static, carry, pod)
 
     chosen, scheduled = S.select_host(score, fit, last_idx, static["name_desc_order"])
 
@@ -707,3 +740,20 @@ class BatchScheduler:
         """Like schedule() but returns node names (None == unschedulable)."""
         chosen, _ = self.schedule(snap, batch)
         return [snap.node_names[i] if i >= 0 else None for i in chosen]
+
+    def debug_evaluate(self, snap: ClusterSnapshot, batch: PodBatch):
+        """Per-(pod, node) fit and weighted score against the initial carry,
+        with no commits between pods. This is how the reference unit tables
+        (predicates_test.go / priorities_test.go) exercise each function:
+        every case is evaluated against a frozen NodeInfo. Returns
+        (fit[P, N] bool, score[P, N] int64) as numpy."""
+        static = {f: jnp.asarray(getattr(snap, f)) for f in self.STATIC_FIELDS}
+        static.update(self.config_static(self.config, snap))
+        pods = {f: jnp.asarray(getattr(batch, f)) for f in self.POD_FIELDS}
+        num_zones = max(int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1)
+        carry = self.initial_carry(snap)
+        fn = functools.partial(
+            evaluate_pod, self.config, num_zones, int(snap.svc_num_values), static, carry
+        )
+        fit, score = jax.vmap(fn)(pods)
+        return np.asarray(fit), np.asarray(score)

@@ -22,6 +22,7 @@ from kubernetes_tpu.api.types import (
     get_tolerations,
 )
 from kubernetes_tpu.oracle.predicates import (
+    DEFAULT_FAILURE_DOMAINS,
     LABEL_ZONE_FAILURE_DOMAIN,
     LABEL_ZONE_REGION,
     check_if_pod_match_term,
@@ -338,8 +339,12 @@ def inter_pod_affinity_priority(
     pod: Pod,
     state: ClusterState,
     hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+    failure_domains=None,
 ) -> Dict[str, int]:
-    """interpod_affinity.go:86 CalculateInterPodAffinityPriority."""
+    """interpod_affinity.go:86 CalculateInterPodAffinityPriority.
+    failure_domains overrides the default --failure-domains keys used when
+    a term has an empty topologyKey (options.go:52; () disables them)."""
+    fd = DEFAULT_FAILURE_DOMAINS if failure_domains is None else tuple(failure_domains)
     all_pods = state.all_assigned_pods()
     try:
         affinity = get_affinity(pod)
@@ -365,7 +370,7 @@ def inter_pod_affinity_priority(
                     1
                     for ep in all_pods
                     if check_if_pod_match_term(
-                        ep, pod, wt.pod_affinity_term, ep_node(ep), node
+                        ep, pod, wt.pod_affinity_term, ep_node(ep), node, fd
                     )
                 )
                 total += wt.weight * matched
@@ -377,7 +382,7 @@ def inter_pod_affinity_priority(
                     1
                     for ep in all_pods
                     if check_if_pod_match_term(
-                        ep, pod, wt.pod_affinity_term, ep_node(ep), node
+                        ep, pod, wt.pod_affinity_term, ep_node(ep), node, fd
                     )
                 )
                 total += (0 - wt.weight) * matched
@@ -396,18 +401,18 @@ def inter_pod_affinity_priority(
                 if hard_pod_affinity_weight > 0:
                     for term in ep_aff.pod_affinity.required_during_scheduling_ignored_during_execution:
                         if check_if_pod_match_term(
-                            pod, ep, term, node, ep_node(ep)
+                            pod, ep, term, node, ep_node(ep), fd
                         ):
                             total += hard_pod_affinity_weight
                 for wt in ep_aff.pod_affinity.preferred_during_scheduling_ignored_during_execution:
                     if check_if_pod_match_term(
-                        pod, ep, wt.pod_affinity_term, node, ep_node(ep)
+                        pod, ep, wt.pod_affinity_term, node, ep_node(ep), fd
                     ):
                         total += wt.weight
             if ep_aff.pod_anti_affinity is not None:
                 for wt in ep_aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution:
                     if check_if_pod_match_term(
-                        pod, ep, wt.pod_affinity_term, node, ep_node(ep)
+                        pod, ep, wt.pod_affinity_term, node, ep_node(ep), fd
                     ):
                         total -= wt.weight
         counts[name] = total

@@ -106,6 +106,9 @@ def _register_all() -> None:
             functools.partial(
                 prios.inter_pod_affinity_priority,
                 hard_pod_affinity_weight=args.hard_pod_affinity_weight,
+                # --failure-domains (options.go:52): empty/unset keeps the
+                # built-in defaults
+                failure_domains=tuple(args.failure_domains) or None,
             ),
             1,
             "InterPodAffinityPriority",

@@ -1,0 +1,861 @@
+"""Transcription of the reference predicate test tables into JSON fixtures.
+
+Source: plugin/pkg/scheduler/algorithm/predicates/predicates_test.go
+(table data only — scenarios, expected fits, expected failure reasons).
+Run `python tests/corpus/builders/build_predicates.py` to regenerate.
+"""
+
+from kubernetes_tpu.api.types import (
+    AWSElasticBlockStore,
+    Container,
+    GCEPersistentDisk,
+    HostPathVolumeSource,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSource,
+    Pod,
+    PodSpec,
+    RBDVolume,
+    Service,
+    ServiceSpec,
+    Volume,
+)
+
+from common import (
+    AFFINITY_ANNOTATION,
+    TAINTS_ANNOTATION,
+    TOLERATIONS_ANNOTATION,
+    affinity_pod,
+    enc,
+    enc_list,
+    make_resources,
+    new_port_pod,
+    new_resource_init_pod,
+    new_resource_pod,
+    node_with,
+    write_fixture,
+)
+
+
+def insufficient(resource, requested, used, capacity):
+    return {
+        "kind": "insufficient",
+        "resource": resource,
+        "requested": requested,
+        "used": used,
+        "capacity": capacity,
+    }
+
+
+def perr(name):
+    return {"kind": "predicate", "name": name}
+
+
+# --- TestPodFitsResources (predicates_test.go:119) --------------------------
+
+
+def build_pod_fits_resources():
+    rp = new_resource_pod
+    ip = new_resource_init_pod
+    enough = [
+        # (pod, existing-on-node, fits, reason, test)
+        (Pod(), [rp((10, 20))], True, None, "no resources requested always fits"),
+        (rp((1, 1)), [rp((10, 20))], False, insufficient("CPU", 1, 10, 10),
+         "too many resources fails"),
+        (ip(rp((1, 1)), (3, 1)), [rp((8, 19))], False, insufficient("CPU", 3, 8, 10),
+         "too many resources fails due to init container cpu"),
+        (ip(rp((1, 1)), (3, 1), (2, 1)), [rp((8, 19))], False,
+         insufficient("CPU", 3, 8, 10),
+         "too many resources fails due to highest init container cpu"),
+        (ip(rp((1, 1)), (1, 3)), [rp((9, 19))], False,
+         insufficient("Memory", 3, 19, 20),
+         "too many resources fails due to init container memory"),
+        (ip(rp((1, 1)), (1, 3), (1, 2)), [rp((9, 19))], False,
+         insufficient("Memory", 3, 19, 20),
+         "too many resources fails due to highest init container memory"),
+        (ip(rp((1, 1)), (1, 1)), [rp((9, 19))], True, None,
+         "init container fits because it's the max, not sum, of containers and init containers"),
+        (ip(rp((1, 1)), (1, 1), (1, 1)), [rp((9, 19))], True, None,
+         "multiple init containers fit because it's the max, not sum, of containers and init containers"),
+        (rp((1, 1)), [rp((5, 5))], True, None, "both resources fit"),
+        (rp((1, 2)), [rp((5, 19))], False, insufficient("Memory", 2, 19, 20),
+         "one resources fits"),
+        (rp((5, 1)), [rp((5, 19))], True, None, "equal edge case"),
+        (ip(rp((4, 1)), (5, 1)), [rp((5, 19))], True, None,
+         "equal edge case for init container"),
+    ]
+    not_enough = [
+        (Pod(), [rp((10, 20))], False, insufficient("PodCount", 1, 1, 1),
+         "even without specified resources predicate fails when there's no space for additional pod"),
+        (rp((1, 1)), [rp((5, 5))], False, insufficient("PodCount", 1, 1, 1),
+         "even if both resources fit predicate fails when there's no space for additional pod"),
+        (rp((5, 1)), [rp((5, 19))], False, insufficient("PodCount", 1, 1, 1),
+         "even for equal edge case predicate fails when there's no space for additional pod"),
+        (ip(rp((5, 1)), (5, 1)), [rp((5, 19))], False,
+         insufficient("PodCount", 1, 1, 1),
+         "even for equal edge case predicate fails when there's no space for additional pod due to init container"),
+    ]
+    cases = []
+    for pod, existing, fits, reason, test in enough:
+        cases.append({
+            "test": test,
+            "pod": enc(pod),
+            "existing": enc_list(existing),
+            "node": enc(node_with(name="machine1",
+                                  capacity=make_resources(10, 20, 0, 32),
+                                  allocatable=make_resources(10, 20, 0, 32))),
+            "fits": fits,
+            "reason": reason,
+        })
+    for pod, existing, fits, reason, test in not_enough:
+        cases.append({
+            "test": test,
+            "pod": enc(pod),
+            "existing": enc_list(existing),
+            "node": enc(node_with(name="machine1",
+                                  allocatable=make_resources(10, 20, 0, 1))),
+            "fits": fits,
+            "reason": reason,
+        })
+    write_fixture("pod_fits_resources", {
+        "source": "predicates_test.go:119 TestPodFitsResources",
+        "predicate": "PodFitsResources",
+        "cases": cases,
+    })
+
+
+# --- TestPodFitsHost (predicates_test.go:292) -------------------------------
+
+
+def build_pod_fits_host():
+    cases = [
+        {"test": "no host specified", "pod": enc(Pod()),
+         "node": enc(node_with(name="")), "fits": True, "reason": None},
+        {"test": "host matches",
+         "pod": enc(Pod(spec=PodSpec(node_name="foo"))),
+         "node": enc(node_with(name="foo")), "fits": True, "reason": None},
+        {"test": "host doesn't match",
+         "pod": enc(Pod(spec=PodSpec(node_name="bar"))),
+         "node": enc(node_with(name="foo")), "fits": False,
+         "reason": perr("HostName")},
+    ]
+    write_fixture("pod_fits_host", {
+        "source": "predicates_test.go:292 TestPodFitsHost",
+        "predicate": "PodFitsHost",
+        "cases": cases,
+    })
+
+
+# --- TestPodFitsHostPorts (predicates_test.go:368) --------------------------
+
+
+def build_pod_fits_host_ports():
+    np = new_port_pod
+    table = [
+        (Pod(), [], True, "nothing running"),
+        (np("m1", 8080), [np("m1", 9090)], True, "other port"),
+        (np("m1", 8080), [np("m1", 8080)], False, "same port"),
+        (np("m1", 8000, 8080), [np("m1", 8080)], False, "second port"),
+        (np("m1", 8000, 8080), [np("m1", 8001, 8080)], False, "second port conflict"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "existing": enc_list(existing),
+        "node": enc(node_with(name="m1")),
+        "fits": fits,
+        "reason": None if fits else perr("PodFitsHostPorts"),
+    } for pod, existing, fits, test in table]
+    write_fixture("pod_fits_host_ports", {
+        "source": "predicates_test.go:368 TestPodFitsHostPorts",
+        "predicate": "PodFitsHostPorts",
+        "cases": cases,
+    })
+
+
+# --- TestDiskConflicts / TestAWSDiskConflicts / TestRBDDiskConflicts --------
+
+
+def build_no_disk_conflict():
+    def vol_pod(vol):
+        return Pod(spec=PodSpec(volumes=[vol]))
+
+    gce1 = Volume(gce_persistent_disk=GCEPersistentDisk(pd_name="foo"))
+    gce2 = Volume(gce_persistent_disk=GCEPersistentDisk(pd_name="bar"))
+    aws1 = Volume(aws_elastic_block_store=AWSElasticBlockStore(volume_id="foo"))
+    aws2 = Volume(aws_elastic_block_store=AWSElasticBlockStore(volume_id="bar"))
+    rbd1 = Volume(rbd=RBDVolume(monitors=("a", "b"), pool="foo", image="bar"))
+    rbd2 = Volume(rbd=RBDVolume(monitors=("c", "d"), pool="foo", image="bar"))
+
+    cases = []
+    for flavor, v1, v2 in [("gce", gce1, gce2), ("aws", aws1, aws2),
+                           ("rbd", rbd1, rbd2)]:
+        table = [
+            (Pod(), [], True, f"{flavor}: nothing"),
+            (Pod(), [vol_pod(v1)], True, f"{flavor}: one state"),
+            (vol_pod(v1), [vol_pod(v1)], False, f"{flavor}: same state"),
+            (vol_pod(v2), [vol_pod(v1)], True, f"{flavor}: different state"),
+        ]
+        for pod, existing, fits, test in table:
+            cases.append({
+                "test": test,
+                "pod": enc(pod),
+                "existing": enc_list(existing),
+                "node": enc(node_with(name="m1")),
+                "fits": fits,
+                "reason": None if fits else perr("NoDiskConflict"),
+            })
+    write_fixture("no_disk_conflict", {
+        "source": "predicates_test.go:460,512,564 Test{GCE,AWS,RBD}DiskConflicts",
+        "predicate": "NoDiskConflict",
+        "cases": cases,
+    })
+
+
+# --- TestPodFitsSelector (predicates_test.go:622) ---------------------------
+
+
+def build_pod_fits_selector():
+    a = affinity_pod
+    table = [
+        (Pod(), None, True, "no selector"),
+        (a(None, node_selector={"foo": "bar"}), None, False, "missing labels"),
+        (a(None, node_selector={"foo": "bar"}), {"foo": "bar"}, True,
+         "same labels"),
+        (a(None, node_selector={"foo": "bar"}), {"foo": "bar", "baz": "blah"},
+         True, "node labels are superset"),
+        (a(None, node_selector={"foo": "bar", "baz": "blah"}), {"foo": "bar"},
+         False, "node labels are subset"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "foo", "operator": "In",'
+           ' "values": ["bar", "value2"]}]}]}}}'),
+         {"foo": "bar"}, True,
+         "Pod with matchExpressions using In operator that matches the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "kernel-version",'
+           ' "operator": "Gt", "values": ["2.4"]}]}]}}}'),
+         {"kernel-version": "2.6"}, True,
+         "Pod with matchExpressions using Gt operator that matches the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "mem-type",'
+           ' "operator": "NotIn", "values": ["DDR", "DDR2"]}]}]}}}'),
+         {"mem-type": "DDR3"}, True,
+         "Pod with matchExpressions using NotIn operator that matches the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "GPU",'
+           ' "operator": "Exists"}]}]}}}'),
+         {"GPU": "NVIDIA-GRID-K1"}, True,
+         "Pod with matchExpressions using Exists operator that matches the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "foo", "operator": "In",'
+           ' "values": ["value1", "value2"]}]}]}}}'),
+         {"foo": "bar"}, False,
+         "Pod with affinity that don't match node's labels won't schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": null}}}'),
+         {"foo": "bar"}, False,
+         "Pod with a nil []NodeSelectorTerm in affinity, can't match the node's labels and won't schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": []}}}'),
+         {"foo": "bar"}, False,
+         "Pod with an empty []NodeSelectorTerm in affinity, can't match the node's labels and won't schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{}, {}]}}}'),
+         {"foo": "bar"}, False,
+         "Pod with invalid NodeSelectTerms in affinity will match no objects and won't schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{}]}]}}}'),
+         {"foo": "bar"}, False,
+         "Pod with empty MatchExpressions is not a valid value will match no objects and won't schedule onto the node"),
+        (Pod(metadata=ObjectMeta(annotations={"some-key": "some-value"})),
+         {"foo": "bar"}, True, "Pod with no Affinity will schedule onto a node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": null}}'),
+         {"foo": "bar"}, True,
+         "Pod with Affinity but nil NodeSelector will schedule onto a node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "GPU", "operator":'
+           ' "Exists"}, {"key": "GPU", "operator": "NotIn", "values": ["AMD",'
+           ' "INTER"]}]}]}}}'),
+         {"GPU": "NVIDIA-GRID-K1"}, True,
+         "Pod with multiple matchExpressions ANDed that matches the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "GPU", "operator":'
+           ' "Exists"}, {"key": "GPU", "operator": "In", "values": ["AMD",'
+           ' "INTER"]}]}]}}}'),
+         {"GPU": "NVIDIA-GRID-K1"}, False,
+         "Pod with multiple matchExpressions ANDed that doesn't match the existing node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "foo", "operator":'
+           ' "In", "values": ["bar", "value2"]}]}, {"matchExpressions": [{"key":'
+           ' "diffkey", "operator": "In", "values": ["wrong", "value2"]}]}]}}}'),
+         {"foo": "bar"}, True,
+         "Pod with multiple NodeSelectorTerms ORed in affinity, matches the node's labels and will schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "foo", "operator":'
+           ' "Exists"}]}]}}}', node_selector={"foo": "bar"}),
+         {"foo": "bar"}, True,
+         "Pod with an Affinity and a PodSpec.NodeSelector both are satisfied, will schedule onto the node"),
+        (a('{"nodeAffinity": { "requiredDuringSchedulingIgnoredDuringExecution": {'
+           '"nodeSelectorTerms": [{"matchExpressions": [{"key": "foo", "operator":'
+           ' "Exists"}]}]}}}', node_selector={"foo": "bar"}),
+         {"foo": "barrrrrr"}, False,
+         "Pod with an Affinity matches node's labels but the PodSpec.NodeSelector is not satisfied, won't schedule onto the node"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "node": enc(node_with(name="m1", labels=labels or {})),
+        "fits": fits,
+        "reason": None if fits else perr("MatchNodeSelector"),
+    } for pod, labels, fits, test in table]
+    write_fixture("pod_fits_selector", {
+        "source": "predicates_test.go:622 TestPodFitsSelector",
+        "predicate": "PodSelectorMatches",
+        "cases": cases,
+    })
+
+
+# --- TestNodeLabelPresence (predicates_test.go:1097) ------------------------
+
+
+def build_node_label_presence():
+    table = [
+        (["baz"], True, False, "label does not match, presence true"),
+        (["baz"], False, True, "label does not match, presence false"),
+        (["foo", "baz"], True, False, "one label matches, presence true"),
+        (["foo", "baz"], False, False, "one label matches, presence false"),
+        (["foo", "bar"], True, True, "all labels match, presence true"),
+        (["foo", "bar"], False, False, "all labels match, presence false"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(Pod()),
+        "node": enc(node_with(name="m1", labels={"foo": "bar", "bar": "foo"})),
+        "labels": labels,
+        "presence": presence,
+        "fits": fits,
+        "reason": None if fits else perr("CheckNodeLabelPresence"),
+    } for labels, presence, fits, test in table]
+    write_fixture("node_label_presence", {
+        "source": "predicates_test.go:1097 TestNodeLabelPresence",
+        "predicate": "CheckNodeLabelPresence",
+        "cases": cases,
+    })
+
+
+# --- TestServiceAffinity (predicates_test.go:1162) --------------------------
+
+
+def build_service_affinity():
+    selector = {"foo": "bar"}
+    labels1 = {"region": "r1", "zone": "z11"}
+    labels2 = {"region": "r1", "zone": "z12"}
+    labels3 = {"region": "r2", "zone": "z21"}
+    labels4 = {"region": "r2", "zone": "z22"}
+    nodes = [
+        node_with(name="machine1", labels=labels1),
+        node_with(name="machine2", labels=labels2),
+        node_with(name="machine3", labels=labels3),
+        node_with(name="machine4", labels=labels4),
+        node_with(name="machine5", labels=labels4),
+    ]
+
+    def lp(node_name, labels_=None, namespace="default"):
+        return Pod(metadata=ObjectMeta(labels=labels_ or {}, namespace=namespace),
+                   spec=PodSpec(node_name=node_name))
+
+    def svc(sel, namespace="default"):
+        return Service(metadata=ObjectMeta(namespace=namespace),
+                       spec=ServiceSpec(selector=sel))
+
+    table = [
+        # (pod, lister-pods, services, node-under-test, labels, fits, test)
+        (Pod(), [], [], "machine1", ["region"], True, "nothing scheduled"),
+        (Pod(spec=PodSpec(node_selector={"region": "r1"})), [], [], "machine1",
+         ["region"], True, "pod with region label match"),
+        (Pod(spec=PodSpec(node_selector={"region": "r2"})), [], [], "machine1",
+         ["region"], False, "pod with region label mismatch"),
+        (Pod(metadata=ObjectMeta(labels=selector)), [lp("machine1", selector)],
+         [svc(selector)], "machine1", ["region"], True, "service pod on same node"),
+        (Pod(metadata=ObjectMeta(labels=selector)), [lp("machine2", selector)],
+         [svc(selector)], "machine1", ["region"], True,
+         "service pod on different node, region match"),
+        (Pod(metadata=ObjectMeta(labels=selector)), [lp("machine3", selector)],
+         [svc(selector)], "machine1", ["region"], False,
+         "service pod on different node, region mismatch"),
+        (Pod(metadata=ObjectMeta(labels=selector, namespace="ns1")),
+         [lp("machine3", selector, namespace="ns1")], [svc(selector, namespace="ns2")],
+         "machine1", ["region"], True, "service in different namespace, region mismatch"),
+        (Pod(metadata=ObjectMeta(labels=selector, namespace="ns1")),
+         [lp("machine3", selector, namespace="ns2")], [svc(selector, namespace="ns1")],
+         "machine1", ["region"], True, "pod in different namespace, region mismatch"),
+        (Pod(metadata=ObjectMeta(labels=selector, namespace="ns1")),
+         [lp("machine3", selector, namespace="ns1")], [svc(selector, namespace="ns1")],
+         "machine1", ["region"], False,
+         "service and pod in same namespace, region mismatch"),
+        (Pod(metadata=ObjectMeta(labels=selector)), [lp("machine2", selector)],
+         [svc(selector)], "machine1", ["region", "zone"], False,
+         "service pod on different node, multiple labels, not all match"),
+        (Pod(metadata=ObjectMeta(labels=selector)), [lp("machine5", selector)],
+         [svc(selector)], "machine4", ["region", "zone"], True,
+         "service pod on different node, multiple labels, all match"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "services": enc_list(services),
+        "nodes": enc_list(nodes),
+        "node": node,
+        "labels": labels,
+        "fits": fits,
+        "reason": None if fits else perr("CheckServiceAffinity"),
+    } for pod, pods, services, node, labels, fits, test in table]
+    write_fixture("service_affinity", {
+        "source": "predicates_test.go:1162 TestServiceAffinity",
+        "predicate": "CheckServiceAffinity",
+        "cases": cases,
+    })
+
+
+# --- TestEBSVolumeCountConflicts (predicates_test.go:1307) ------------------
+
+
+def build_max_pd_volume_count():
+    def vols_pod(*vols):
+        return Pod(spec=PodSpec(volumes=list(vols)))
+
+    ebs = lambda vid: Volume(aws_elastic_block_store=AWSElasticBlockStore(volume_id=vid))
+    pvc = lambda name: Volume(persistent_volume_claim=PersistentVolumeClaimSource(claim_name=name))
+    host_path = Volume(host_path=HostPathVolumeSource())
+
+    one_vol_pod = vols_pod(ebs("ovp"))
+    ebs_pvc_pod = vols_pod(pvc("someEBSVol"))
+    split_pvc_pod = vols_pod(pvc("someNonEBSVol"), pvc("someEBSVol"))
+    two_vol_pod = vols_pod(ebs("tvp1"), ebs("tvp2"))
+    split_vols_pod = vols_pod(host_path, ebs("svp"))
+    non_applicable_pod = vols_pod(host_path)
+    empty_pod = Pod(spec=PodSpec())
+
+    pvs = [
+        PersistentVolume(metadata=ObjectMeta(name="someEBSVol"),
+                         aws_elastic_block_store=AWSElasticBlockStore()),
+        PersistentVolume(metadata=ObjectMeta(name="someNonEBSVol")),
+    ]
+    pvcs = [
+        PersistentVolumeClaim(metadata=ObjectMeta(name="someEBSVol"),
+                              volume_name="someEBSVol"),
+        PersistentVolumeClaim(metadata=ObjectMeta(name="someNonEBSVol"),
+                              volume_name="someNonEBSVol"),
+    ]
+
+    table = [
+        (one_vol_pod, [two_vol_pod, one_vol_pod], 4, True,
+         "fits when node capacity >= new pod's EBS volumes"),
+        (two_vol_pod, [one_vol_pod], 2, False,
+         "doesn't fit when node capacity < new pod's EBS volumes"),
+        (split_vols_pod, [two_vol_pod], 3, True,
+         "new pod's count ignores non-EBS volumes"),
+        (two_vol_pod, [split_vols_pod, non_applicable_pod, empty_pod], 3, True,
+         "existing pods' counts ignore non-EBS volumes"),
+        (ebs_pvc_pod, [split_vols_pod, non_applicable_pod, empty_pod], 3, True,
+         "new pod's count considers PVCs backed by EBS volumes"),
+        (split_pvc_pod, [split_vols_pod, one_vol_pod], 3, True,
+         "new pod's count ignores PVCs not backed by EBS volumes"),
+        (two_vol_pod, [one_vol_pod, ebs_pvc_pod], 3, False,
+         "existing pods' counts considers PVCs backed by EBS volumes"),
+        (two_vol_pod, [one_vol_pod, two_vol_pod, ebs_pvc_pod], 4, True,
+         "already-mounted EBS volumes are always ok to allow"),
+        (split_vols_pod, [one_vol_pod, one_vol_pod, ebs_pvc_pod], 3, True,
+         "the same EBS volumes are not counted multiple times"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "existing": enc_list(existing),
+        "node": enc(node_with(name="m1")),
+        "max_vols": max_vols,
+        "filter": "ebs",
+        "pvs": enc_list(pvs),
+        "pvcs": enc_list(pvcs),
+        "fits": fits,
+        "reason": None if fits else perr("MaxVolumeCount"),
+    } for pod, existing, max_vols, fits, test in table]
+    write_fixture("max_pd_volume_count", {
+        "source": "predicates_test.go:1307 TestEBSVolumeCountConflicts",
+        "predicate": "MaxPDVolumeCountPredicate",
+        "cases": cases,
+    })
+
+
+# --- TestRunGeneralPredicates (predicates_test.go:1589) ---------------------
+
+
+def build_general_predicates():
+    rp = new_resource_pod
+
+    from kubernetes_tpu.api.types import ContainerPort
+
+    def pp(*ports):
+        return Pod(spec=PodSpec(containers=[
+            Container(ports=[ContainerPort(host_port=p) for p in ports])]))
+
+    node_10_20_0 = node_with(name="machine1",
+                             capacity=make_resources(10, 20, 0, 32),
+                             allocatable=make_resources(10, 20, 0, 32))
+    node_10_20_1 = node_with(name="machine1",
+                             capacity=make_resources(10, 20, 1, 32),
+                             allocatable=make_resources(10, 20, 1, 32))
+    table = [
+        (Pod(), [rp((9, 19))], node_10_20_0, True, None,
+         "no resources/port/host requested always fits"),
+        (rp((8, 10)), [rp((5, 19))], node_10_20_0, False,
+         insufficient("CPU", 8, 5, 10), "not enough cpu resource"),
+        (Pod(), [rp((9, 19))], node_10_20_1, True, None,
+         "no resources/port/host requested always fits on GPU machine"),
+        (rp((3, 1, 1)), [rp((5, 10, 1))], node_10_20_1, False,
+         insufficient("NvidiaGpu", 1, 1, 1), "not enough GPU resource"),
+        (rp((3, 1, 1)), [rp((5, 10, 0))], node_10_20_1, True, None,
+         "enough GPU resource"),
+        (Pod(spec=PodSpec(node_name="machine2")), [], node_10_20_0, False,
+         perr("HostName"), "host not match"),
+        (pp(123), [pp(123)], node_10_20_0, False, perr("PodFitsHostPorts"),
+         "hostport conflict"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "existing": enc_list(existing),
+        "node": enc(node),
+        "fits": fits,
+        "reason": reason,
+    } for pod, existing, node, fits, reason, test in table]
+    write_fixture("general_predicates", {
+        "source": "predicates_test.go:1589 TestRunGeneralPredicates",
+        "predicate": "GeneralPredicates",
+        "cases": cases,
+    })
+
+
+# --- TestInterPodAffinity (predicates_test.go:1688) -------------------------
+
+
+def build_interpod_affinity():
+    pod_label = {"service": "securityscan"}
+    pod_label2 = {"security": "S1"}
+    node1 = node_with(name="machine1", labels={"region": "r1", "zone": "z11"})
+
+    def ap(annot, labels):
+        return affinity_pod(annot, labels=labels)
+
+    def existing(labels, annot=None, node_name="machine1", namespace="default"):
+        meta = ObjectMeta(labels=labels, namespace=namespace)
+        if annot:
+            meta.annotations = {AFFINITY_ANNOTATION: annot}
+        return Pod(metadata=meta, spec=PodSpec(node_name=node_name))
+
+    table = [
+        (Pod(), [], True,
+         "A pod that has no required pod affinity scheduling rules can schedule onto a node with no existing pods"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]}}',
+            pod_label2),
+         [existing(pod_label)], True,
+         "satisfies with requiredDuringSchedulingIgnoredDuringExecution in PodAffinity using In operator that matches the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "NotIn", "values": ["securityscan3", "value3"]}]}, "topologyKey": "region"}]}}',
+            pod_label2),
+         [existing(pod_label)], True,
+         "satisfies the pod with requiredDuringSchedulingIgnoredDuringExecution in PodAffinity using not in operator in labelSelector that matches the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "namespaces":["DiffNameSpace"]}]}}',
+            pod_label2),
+         [existing(pod_label, namespace="ns")], False,
+         "Does not satisfy the PodAffinity with labelSelector because of diff Namespace"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["antivirusscan", "value2"]}]}}]}}',
+            pod_label),
+         [existing(pod_label)], False,
+         "Doesn't satisfy the PodAffinity because of unmatching labelSelector with the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": ['
+            '{"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "Exists"}, {"key": "wrongkey", "operator": "DoesNotExist"}]},'
+            ' "topologyKey": "region"}, {"labelSelector": {"matchExpressions": [{'
+            '"key": "service", "operator": "In", "values": ["securityscan"]},'
+            ' {"key": "service", "operator": "NotIn", "values": ["WrongValue"]}]},'
+            ' "topologyKey": "region"}]}}',
+            pod_label2),
+         [existing(pod_label)], True,
+         "satisfies the PodAffinity with different label Operators in multiple RequiredDuringSchedulingIgnoredDuringExecution"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": ['
+            '{"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "Exists"}, {"key": "wrongkey", "operator": "DoesNotExist"}]},'
+            ' "topologyKey": "region"}, {"labelSelector": {"matchExpressions": [{'
+            '"key": "service", "operator": "In", "values": ["securityscan2"]},'
+            ' {"key": "service", "operator": "NotIn", "values": ["WrongValue"]}]},'
+            ' "topologyKey": "region"}]}}',
+            pod_label2),
+         [existing(pod_label)], False,
+         "The labelSelector requirements(items of matchExpressions) are ANDed, the pod cannot schedule onto the node because one of the matchExpression items doesn't match"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]},'
+            ' "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["antivirusscan", "value2"]}]}, "topologyKey": "node"}]}}',
+            pod_label2),
+         [existing(pod_label)], True,
+         "satisfies the PodAffinity and PodAntiAffinity with the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]},'
+            ' "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["antivirusscan", "value2"]}]}, "topologyKey": "node"}]}}',
+            pod_label2),
+         [existing(pod_label,
+                   '{"PodAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":'
+                   ' [{"labelSelector": {"matchExpressions": [{"key": "service",'
+                   ' "operator": "In", "values": ["antivirusscan", "value2"]}]},'
+                   ' "topologyKey": "node"}]}}')], True,
+         "satisfies the PodAffinity and PodAntiAffinity and PodAntiAffinity symmetry with the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]},'
+            ' "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "zone"}]}}',
+            pod_label2),
+         [existing(pod_label)], False,
+         "satisfies the PodAffinity but doesn't satisfy the PodAntiAffinity with the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]},'
+            ' "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "In", "values": ["antivirusscan", "value2"]}]}, "topologyKey": "node"}]}}',
+            pod_label),
+         [existing(pod_label,
+                   '{"PodAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":'
+                   ' [{"labelSelector": {"matchExpressions": [{"key": "service",'
+                   ' "operator": "In", "values": ["securityscan", "value2"]}]},'
+                   ' "topologyKey": "zone"}]}}')], False,
+         "satisfies the PodAffinity and PodAntiAffinity but doesn't satisfy PodAntiAffinity symmetry with the existing pod"),
+        (ap('{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [{'
+            '"labelSelector": {"matchExpressions": [{"key": "service", "operator":'
+            ' "NotIn", "values": ["securityscan", "value2"]}]}, "topologyKey": "region"}]}}',
+            pod_label),
+         [existing(pod_label, node_name="machine2")], False,
+         "pod matches its own Label in PodAffinity and that matches the existing pod Labels"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": [enc(node1)],
+        "expect": {"machine1": {"fits": fits,
+                                "reason": None if fits else perr("MatchInterPodAffinity")}},
+    } for pod, pods, fits, test in table]
+    write_fixture("interpod_affinity", {
+        "source": "predicates_test.go:1688 TestInterPodAffinity",
+        "predicate": "InterPodAffinityMatches",
+        "cases": cases,
+    })
+
+
+# --- TestInterPodAffinityWithMultipleNodes (predicates_test.go:2181) --------
+
+
+def build_interpod_affinity_multi():
+    def lpod(node_name, labels):
+        return Pod(metadata=ObjectMeta(labels=labels),
+                   spec=PodSpec(node_name=node_name))
+
+    cases = [
+        {
+            "test": "A pod can be scheduled onto all the nodes that have the same topology key & label value with one of them has an existing pod that match the affinity rules",
+            "pod": enc(affinity_pod(
+                '{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":'
+                ' [{"labelSelector": {"matchExpressions": [{"key": "foo", "operator":'
+                ' "In", "values": ["bar"]}]}, "topologyKey": "region"}]}}')),
+            "pods": enc_list([lpod("machine1", {"foo": "bar"})]),
+            "nodes": enc_list([
+                node_with(name="machine1", labels={"region": "China"}),
+                node_with(name="machine2", labels={"region": "China", "az": "az1"}),
+                node_with(name="machine3", labels={"region": "India"}),
+            ]),
+            "expect": {
+                "machine1": {"fits": True, "reason": None},
+                "machine2": {"fits": True, "reason": None},
+                "machine3": {"fits": False, "reason": perr("MatchInterPodAffinity")},
+            },
+        },
+        {
+            "test": "NodeA and nodeB have same topologyKey and label value. NodeA does not satisfy node affinity rule, but has an existing pod that matches the inter pod affinity rule. The pod can be scheduled onto nodeB.",
+            "also_node_selector": True,
+            "pod": enc(affinity_pod(
+                '{"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":'
+                ' {"nodeSelectorTerms": [{"matchExpressions": [{"key": "hostname",'
+                ' "operator": "NotIn", "values": ["h1"]}]}]}}, "podAffinity": {'
+                '"requiredDuringSchedulingIgnoredDuringExecution": [{"labelSelector":'
+                ' {"matchExpressions": [{"key": "foo", "operator": "In", "values":'
+                ' ["abc"]}]}, "topologyKey": "region"}]}}')),
+            "pods": enc_list([lpod("nodeA", {"foo": "abc"}),
+                              lpod("nodeB", {"foo": "def"})]),
+            "nodes": enc_list([
+                node_with(name="nodeA", labels={"region": "r1", "hostname": "h1"}),
+                node_with(name="nodeB", labels={"region": "r1", "hostname": "h2"}),
+            ]),
+            "expect": {
+                "nodeA": {"fits": False, "reason": None},
+                "nodeB": {"fits": True, "reason": None},
+            },
+        },
+        {
+            "test": "The affinity rule is to schedule all of the pods of this collection to the same zone. The first pod of the collection should not be blocked from being scheduled onto any node, even there's no existing pod that matches the rule anywhere.",
+            "pod": enc(affinity_pod(
+                '{"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":'
+                ' [{"labelSelector": {"matchExpressions": [{"key": "foo", "operator":'
+                ' "In", "values": ["bar"]}]}, "topologyKey": "zone"}]}}',
+                labels={"foo": "bar"})),
+            "pods": [],
+            "nodes": enc_list([
+                node_with(name="nodeA", labels={"zone": "az1", "hostname": "h1"}),
+                node_with(name="nodeB", labels={"zone": "az2", "hostname": "h2"}),
+            ]),
+            "expect": {
+                "nodeA": {"fits": True, "reason": None},
+                "nodeB": {"fits": True, "reason": None},
+            },
+        },
+    ]
+    write_fixture("interpod_affinity_multi", {
+        "source": "predicates_test.go:2181 TestInterPodAffinityWithMultipleNodes",
+        "predicate": "InterPodAffinityMatches",
+        "cases": cases,
+    })
+
+
+# --- TestPodToleratesTaints (predicates_test.go:2362) -----------------------
+
+
+def build_pod_tolerates_taints():
+    def tpod(name, tolerations_json=None):
+        annotations = {}
+        if tolerations_json:
+            annotations[TOLERATIONS_ANNOTATION] = tolerations_json
+        return Pod(metadata=ObjectMeta(name=name, annotations=annotations),
+                   spec=PodSpec(containers=[Container(image=f"{name}:V1")]))
+
+    def tnode(taints_json):
+        return node_with(name="m1", annotations={TAINTS_ANNOTATION: taints_json})
+
+    table = [
+        (tpod("pod0"),
+         tnode('[{"key": "dedicated", "value": "user1", "effect": "NoSchedule"}]'),
+         False,
+         "a pod having no tolerations can't be scheduled onto a node with nonempty taints"),
+        (tpod("pod1", '[{"key": "dedicated", "value": "user1", "effect": "NoSchedule"}]'),
+         tnode('[{"key": "dedicated", "value": "user1", "effect": "NoSchedule"}]'),
+         True,
+         "a pod which can be scheduled on a dedicated node assigned to user1 with effect NoSchedule"),
+        (tpod("pod2", '[{"key": "dedicated", "operator": "Equal", "value": "user2", "effect": "NoSchedule"}]'),
+         tnode('[{"key": "dedicated", "value": "user1", "effect": "NoSchedule"}]'),
+         False,
+         "a pod which can't be scheduled on a dedicated node assigned to user2 with effect NoSchedule"),
+        (tpod("pod2", '[{"key": "foo", "operator": "Exists", "effect": "NoSchedule"}]'),
+         tnode('[{"key": "foo", "value": "bar", "effect": "NoSchedule"}]'),
+         True,
+         "a pod can be scheduled onto the node, with a toleration uses operator Exists that tolerates the taints on the node"),
+        (tpod("pod2", '[{"key": "dedicated", "operator": "Equal", "value": "user2",'
+                      ' "effect": "NoSchedule"}, {"key": "foo", "operator": "Exists",'
+                      ' "effect": "NoSchedule"}]'),
+         tnode('[{"key": "dedicated", "value": "user2", "effect": "NoSchedule"},'
+               ' {"key": "foo", "value": "bar", "effect": "NoSchedule"}]'),
+         True,
+         "a pod has multiple tolerations, node has multiple taints, all the taints are tolerated, pod can be scheduled onto the node"),
+        (tpod("pod2", '[{"key": "foo", "operator": "Equal", "value": "bar", "effect":'
+                      ' "PreferNoSchedule"}]'),
+         tnode('[{"key": "foo", "value": "bar", "effect": "NoSchedule"}]'),
+         False,
+         "a pod has a toleration that keys and values match the taint on the node, but (non-empty) effect doesn't match, can't be scheduled onto the node"),
+        (tpod("pod2", '[{"key": "foo", "operator": "Equal", "value": "bar"}]'),
+         tnode('[{"key": "foo", "value": "bar", "effect": "NoSchedule"}]'),
+         True,
+         "The pod has a toleration that keys and values match the taint on the node, the effect of toleration is empty, and the effect of taint is NoSchedule. Pod can be scheduled onto the node"),
+        (tpod("pod2", '[{"key": "dedicated", "operator": "Equal", "value": "user2",'
+                      ' "effect": "NoSchedule"}]'),
+         tnode('[{"key": "dedicated", "value": "user1", "effect": "PreferNoSchedule"}]'),
+         True,
+         "The pod has a toleration that key and value don't match the taint on the node, but the effect of taint on node is PreferNoSchedule. Pod can be scheduled onto the node"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "node": enc(node),
+        "fits": fits,
+        "reason": None if fits else perr("PodToleratesNodeTaints"),
+    } for pod, node, fits, test in table]
+    write_fixture("pod_tolerates_taints", {
+        "source": "predicates_test.go:2362 TestPodToleratesTaints",
+        "predicate": "PodToleratesNodeTaints",
+        "cases": cases,
+    })
+
+
+# --- TestPodSchedulesOnNodeWithMemoryPressureCondition (:2651) --------------
+
+
+def build_memory_pressure():
+    best_effort = Pod(spec=PodSpec(containers=[
+        Container(name="container", image="image")]))
+    non_best_effort = Pod(spec=PodSpec(containers=[
+        Container(name="container", image="image",
+                  requests=make_resources(100, 100, 100, 100))]))
+    no_pressure = node_with(name="m1", conditions=[
+        {"type": "Ready", "status": "True"}])
+    pressure = node_with(name="m1", conditions=[
+        {"type": "MemoryPressure", "status": "True"}])
+    table = [
+        (best_effort, no_pressure, True,
+         "best-effort pod schedulable on node without memory pressure condition on"),
+        (best_effort, pressure, False,
+         "best-effort pod not schedulable on node with memory pressure condition on"),
+        (non_best_effort, pressure, True,
+         "non best-effort pod schedulable on node with memory pressure condition on"),
+        (non_best_effort, no_pressure, True,
+         "non best-effort pod schedulable on node without memory pressure condition on"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "node": enc(node),
+        "fits": fits,
+        "reason": None if fits else perr("NodeUnderMemoryPressure"),
+    } for pod, node, fits, test in table]
+    write_fixture("memory_pressure", {
+        "source": "predicates_test.go:2651 TestPodSchedulesOnNodeWithMemoryPressureCondition",
+        "predicate": "CheckNodeMemoryPressure",
+        "cases": cases,
+    })
+
+
+if __name__ == "__main__":
+    build_pod_fits_resources()
+    build_pod_fits_host()
+    build_pod_fits_host_ports()
+    build_no_disk_conflict()
+    build_pod_fits_selector()
+    build_node_label_presence()
+    build_service_affinity()
+    build_max_pd_volume_count()
+    build_general_predicates()
+    build_interpod_affinity()
+    build_interpod_affinity_multi()
+    build_pod_tolerates_taints()
+    build_memory_pressure()

@@ -1,0 +1,1003 @@
+"""Transcription of the reference priority test tables into JSON fixtures.
+
+Sources: plugin/pkg/scheduler/algorithm/priorities/priorities_test.go,
+selector_spreading_test.go, node_affinity_test.go, taint_toleration_test.go,
+interpod_affinity_test.go (table data only).
+Run `python tests/corpus/builders/build_priorities.py` to regenerate.
+"""
+
+import json
+
+from kubernetes_tpu.api.types import (
+    AFFINITY_ANNOTATION,
+    TAINTS_ANNOTATION,
+    TOLERATIONS_ANNOTATION,
+    Container,
+    ContainerImage,
+    LabelSelector,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ReplicaSet,
+    ReplicaSetSpec,
+    ReplicationController,
+    ReplicationControllerSpec,
+    Service,
+    ServiceSpec,
+)
+
+from common import enc, enc_list, write_fixture
+
+MB = 1024 * 1024
+# priorities/util/non_zero.go DefaultMilliCpuRequest / DefaultMemoryRequest
+DEFAULT_MILLI_CPU = 100
+DEFAULT_MEMORY = 200 * MB
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def make_node(name, milli_cpu, memory):
+    """priorities_test.go:37 makeNode."""
+    rl = {"cpu": f"{milli_cpu}m", "memory": memory}
+    return Node(metadata=ObjectMeta(name=name),
+                status=NodeStatus(capacity=dict(rl), allocatable=dict(rl)))
+
+
+def plain_node(name, labels=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}))
+
+
+def req_pod(node_name="", reqs=(), labels=None, namespace="default",
+            annotations=None):
+    """A pod with per-container (milli_cpu, memory) requests."""
+    containers = []
+    for mc, mem in reqs:
+        r = {}
+        if mc is not None:
+            r["cpu"] = f"{mc}m"
+        if mem is not None:
+            r["memory"] = mem
+        containers.append(Container(requests=r))
+    return Pod(
+        metadata=ObjectMeta(labels=labels or {}, namespace=namespace,
+                            annotations=annotations or {}),
+        spec=PodSpec(node_name=node_name, containers=containers),
+    )
+
+
+def expected_map(pairs):
+    return {host: score for host, score in pairs}
+
+
+# --- TestZeroRequest (priorities_test.go:53) --------------------------------
+
+
+def build_zero_request():
+    no_resources = [(None, None)]  # one container, no requests
+    small = [(DEFAULT_MILLI_CPU, DEFAULT_MEMORY)]
+    large = [(DEFAULT_MILLI_CPU * 3, DEFAULT_MEMORY * 3)]
+    nodes = [make_node("machine1", 1000, DEFAULT_MEMORY * 10),
+             make_node("machine2", 1000, DEFAULT_MEMORY * 10)]
+    backdrop = [
+        req_pod("machine1", large), req_pod("machine1", no_resources),
+        req_pod("machine2", large), req_pod("machine2", small),
+    ]
+    cases = [
+        {"test": "test priority of zero-request pod with machine with zero-request pod",
+         "pod": enc(req_pod("", no_resources)), "expect_all": 25},
+        {"test": "test priority of nonzero-request pod with machine with zero-request pod",
+         "pod": enc(req_pod("", small)), "expect_all": 25},
+        {"test": "test priority of larger pod with machine with zero-request pod",
+         "pod": enc(req_pod("", large)), "expect_all_not": 25},
+    ]
+    for c in cases:
+        c["pods"] = enc_list(backdrop)
+        c["nodes"] = enc_list(nodes)
+    write_fixture("zero_request", {
+        "source": "priorities_test.go:53 TestZeroRequest",
+        "priorities": ["LeastRequestedPriority", "BalancedResourceAllocation",
+                       "SelectorSpreadPriority"],
+        "cases": cases,
+    })
+
+
+# --- TestLeastRequested (priorities_test.go:165) ----------------------------
+
+LABELS1 = {"foo": "bar", "baz": "blah"}
+LABELS2 = {"bar": "foo", "baz": "blah"}
+
+
+def _cpu_only(node):
+    return req_pod(node, [(1000, 0), (2000, 0)])
+
+
+def _cpu_mem(node="machine2"):
+    return req_pod(node, [(1000, 2000), (2000, 3000)])
+
+
+def build_least_requested():
+    m1 = req_pod("machine1")
+    m2 = req_pod("machine2")
+    table = [
+        (req_pod(), [], [make_node("machine1", 4000, 10000),
+                         make_node("machine2", 4000, 10000)],
+         [("machine1", 10), ("machine2", 10)],
+         "nothing scheduled, nothing requested"),
+        (_cpu_mem(""), [], [make_node("machine1", 4000, 10000),
+                            make_node("machine2", 6000, 10000)],
+         [("machine1", 3), ("machine2", 5)],
+         "nothing scheduled, resources requested, differently sized machines"),
+        (req_pod(), [req_pod("machine1", labels=LABELS2),
+                     req_pod("machine1", labels=LABELS1),
+                     req_pod("machine2", labels=LABELS1),
+                     req_pod("machine2", labels=LABELS1)],
+         [make_node("machine1", 4000, 10000), make_node("machine2", 4000, 10000)],
+         [("machine1", 10), ("machine2", 10)],
+         "no resources requested, pods scheduled"),
+        (req_pod(), [_cpu_only("machine1"), _cpu_only("machine1"),
+                     _cpu_only("machine2"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 20000)],
+         [("machine1", 7), ("machine2", 5)],
+         "no resources requested, pods scheduled with resources"),
+        (_cpu_mem(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 20000)],
+         [("machine1", 5), ("machine2", 4)],
+         "resources requested, pods scheduled with resources"),
+        (_cpu_mem(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 50000)],
+         [("machine1", 5), ("machine2", 6)],
+         "resources requested, pods scheduled with resources, differently sized machines"),
+        (_cpu_only(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 4000, 10000), make_node("machine2", 4000, 10000)],
+         [("machine1", 5), ("machine2", 2)],
+         "requested resources exceed node capacity"),
+        (req_pod(), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 0, 0), make_node("machine2", 0, 0)],
+         [("machine1", 0), ("machine2", 0)],
+         "zero node resources, pods scheduled with resources"),
+    ]
+    # the labels on backdrop pods in cases 3/4 are irrelevant to this
+    # priority; retained for fidelity
+    _ = m1, m2
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "expected": expected_map(exp),
+    } for pod, pods, nodes, exp, test in table]
+    write_fixture("least_requested", {
+        "source": "priorities_test.go:165 TestLeastRequested",
+        "priority": "LeastRequestedPriority",
+        "cases": cases,
+    })
+
+
+# --- TestBalancedResourceAllocation (priorities_test.go:498) ----------------
+
+
+def build_balanced_allocation():
+    table = [
+        (req_pod(), [], [make_node("machine1", 4000, 10000),
+                         make_node("machine2", 4000, 10000)],
+         [("machine1", 10), ("machine2", 10)],
+         "nothing scheduled, nothing requested"),
+        (_cpu_mem(""), [], [make_node("machine1", 4000, 10000),
+                            make_node("machine2", 6000, 10000)],
+         [("machine1", 7), ("machine2", 10)],
+         "nothing scheduled, resources requested, differently sized machines"),
+        (req_pod(), [req_pod("machine1", labels=LABELS2),
+                     req_pod("machine1", labels=LABELS1),
+                     req_pod("machine2", labels=LABELS1),
+                     req_pod("machine2", labels=LABELS1)],
+         [make_node("machine1", 4000, 10000), make_node("machine2", 4000, 10000)],
+         [("machine1", 10), ("machine2", 10)],
+         "no resources requested, pods scheduled"),
+        (req_pod(), [_cpu_only("machine1"), _cpu_only("machine1"),
+                     _cpu_only("machine2"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 20000)],
+         [("machine1", 4), ("machine2", 6)],
+         "no resources requested, pods scheduled with resources"),
+        (_cpu_mem(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 20000)],
+         [("machine1", 6), ("machine2", 9)],
+         "resources requested, pods scheduled with resources"),
+        (_cpu_mem(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 10000, 20000), make_node("machine2", 10000, 50000)],
+         [("machine1", 6), ("machine2", 6)],
+         "resources requested, pods scheduled with resources, differently sized machines"),
+        (_cpu_only(""), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 4000, 10000), make_node("machine2", 4000, 10000)],
+         [("machine1", 0), ("machine2", 0)],
+         "requested resources exceed node capacity"),
+        (req_pod(), [_cpu_only("machine1"), _cpu_mem("machine2")],
+         [make_node("machine1", 0, 0), make_node("machine2", 0, 0)],
+         [("machine1", 0), ("machine2", 0)],
+         "zero node resources, pods scheduled with resources"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "expected": expected_map(exp),
+    } for pod, pods, nodes, exp, test in table]
+    write_fixture("balanced_allocation", {
+        "source": "priorities_test.go:498 TestBalancedResourceAllocation",
+        "priority": "BalancedResourceAllocation",
+        "cases": cases,
+    })
+
+
+# --- TestNewNodeLabelPriority (priorities_test.go:401) ----------------------
+
+
+def build_node_label_priority():
+    nodes = [plain_node("machine1", {"foo": "bar"}),
+             plain_node("machine2", {"bar": "foo"}),
+             plain_node("machine3", {"bar": "baz"})]
+    table = [
+        ("baz", True, [("machine1", 0), ("machine2", 0), ("machine3", 0)],
+         "no match found, presence true"),
+        ("baz", False, [("machine1", 10), ("machine2", 10), ("machine3", 10)],
+         "no match found, presence false"),
+        ("foo", True, [("machine1", 10), ("machine2", 0), ("machine3", 0)],
+         "one match found, presence true"),
+        ("foo", False, [("machine1", 0), ("machine2", 10), ("machine3", 10)],
+         "one match found, presence false"),
+        ("bar", True, [("machine1", 0), ("machine2", 10), ("machine3", 10)],
+         "two matches found, presence true"),
+        ("bar", False, [("machine1", 10), ("machine2", 0), ("machine3", 0)],
+         "two matches found, presence false"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(Pod()),
+        "pods": [],
+        "nodes": enc_list(nodes),
+        "label": label,
+        "presence": presence,
+        "expected": expected_map(exp),
+    } for label, presence, exp, test in table]
+    write_fixture("node_label_priority", {
+        "source": "priorities_test.go:401 TestNewNodeLabelPriority",
+        "priority": "NodeLabelPriority",
+        "cases": cases,
+    })
+
+
+# --- TestImageLocalityPriority (priorities_test.go:734) ---------------------
+
+
+def build_image_locality():
+    def image_pod(*images):
+        return Pod(spec=PodSpec(containers=[Container(image=i) for i in images]))
+
+    node_40_140_2000 = Node(
+        metadata=ObjectMeta(name="machine1"),
+        status=NodeStatus(images=[
+            ContainerImage(names=("gcr.io/40", "gcr.io/40:v1", "gcr.io/40:v1"),
+                           size_bytes=40 * MB),
+            ContainerImage(names=("gcr.io/140", "gcr.io/140:v1"),
+                           size_bytes=140 * MB),
+            ContainerImage(names=("gcr.io/2000",), size_bytes=2000 * MB),
+        ]))
+    node_250_10 = Node(
+        metadata=ObjectMeta(name="machine2"),
+        status=NodeStatus(images=[
+            ContainerImage(names=("gcr.io/250",), size_bytes=250 * MB),
+            ContainerImage(names=("gcr.io/10", "gcr.io/10:v1"),
+                           size_bytes=10 * MB),
+        ]))
+    nodes = [node_40_140_2000, node_250_10]
+    table = [
+        (image_pod("gcr.io/40", "gcr.io/250"),
+         [("machine1", 1), ("machine2", 3)],
+         "two images spread on two nodes, prefer the larger image one"),
+        (image_pod("gcr.io/40", "gcr.io/140"),
+         [("machine1", 2), ("machine2", 0)],
+         "two images on one node, prefer this node"),
+        (image_pod("gcr.io/10", "gcr.io/2000"),
+         [("machine1", 10), ("machine2", 0)],
+         "if exceed limit, use limit"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": [],
+        "nodes": enc_list(nodes),
+        "expected": expected_map(exp),
+    } for pod, exp, test in table]
+    write_fixture("image_locality", {
+        "source": "priorities_test.go:734 TestImageLocalityPriority",
+        "priority": "ImageLocalityPriority",
+        "cases": cases,
+    })
+
+
+# --- TestSelectorSpreadPriority (selector_spreading_test.go:33) -------------
+
+
+def lpod(node, labels=None, namespace=""):
+    # Go's zero-value Namespace is "" and the spreading tables rely on ""
+    # differing from NamespaceDefault — preserve it exactly.
+    return Pod(metadata=ObjectMeta(labels=labels or {}, namespace=namespace),
+               spec=PodSpec(node_name=node))
+
+
+def svc(selector, namespace=""):
+    return Service(metadata=ObjectMeta(namespace=namespace),
+                   spec=ServiceSpec(selector=selector))
+
+
+def rc(selector):
+    return ReplicationController(
+        metadata=ObjectMeta(namespace=""),
+        spec=ReplicationControllerSpec(selector=selector))
+
+
+def rs(match_labels):
+    return ReplicaSet(
+        metadata=ObjectMeta(namespace=""),
+        spec=ReplicaSetSpec(selector=LabelSelector(match_labels=match_labels)))
+
+
+def build_selector_spread():
+    z1 = "machine1"
+    z2 = "machine2"
+    nodes = [plain_node("machine1"), plain_node("machine2")]
+    table = [
+        (Pod(), [], [], [], [], [("machine1", 10), ("machine2", 10)],
+         "nothing scheduled"),
+        (lpod("", LABELS1), [lpod(z1)], [], [], [],
+         [("machine1", 10), ("machine2", 10)], "no services"),
+        (lpod("", LABELS1), [lpod(z1, LABELS2)], [svc({"key": "value"})], [], [],
+         [("machine1", 10), ("machine2", 10)], "different services"),
+        (lpod("", LABELS1), [lpod(z1, LABELS2), lpod(z2, LABELS1)],
+         [svc(LABELS1)], [], [],
+         [("machine1", 10), ("machine2", 0)], "two pods, one service pod"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1, "default"),
+          lpod(z1, LABELS1, "ns1"), lpod(z2, LABELS1), lpod(z2, LABELS2)],
+         [svc(LABELS1)], [], [],
+         [("machine1", 10), ("machine2", 0)],
+         "five pods, one service pod in no namespace"),
+        (lpod("", LABELS1, "default"),
+         [lpod(z1, LABELS1), lpod(z1, LABELS1, "ns1"),
+          lpod(z2, LABELS1, "default"), lpod(z2, LABELS2)],
+         [svc(LABELS1, "default")], [], [],
+         [("machine1", 10), ("machine2", 0)],
+         "four pods, one service pod in default namespace"),
+        (lpod("", LABELS1, "ns1"),
+         [lpod(z1, LABELS1), lpod(z1, LABELS1, "default"),
+          lpod(z1, LABELS1, "ns2"), lpod(z2, LABELS1, "ns1"),
+          lpod(z2, LABELS2)],
+         [svc(LABELS1, "ns1")], [], [],
+         [("machine1", 10), ("machine2", 0)],
+         "five pods, one service pod in specific namespace"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc(LABELS1)], [], [],
+         [("machine1", 0), ("machine2", 0)],
+         "three pods, two service pods on different machines"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1),
+          lpod(z2, LABELS1)],
+         [svc(LABELS1)], [], [],
+         [("machine1", 5), ("machine2", 0)],
+         "four pods, three service pods"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc({"baz": "blah"})], [], [],
+         [("machine1", 0), ("machine2", 5)],
+         "service with partial pod label matches"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc({"baz": "blah"})], [rc({"foo": "bar"})], [],
+         [("machine1", 0), ("machine2", 5)],
+         "service with partial pod label matches with service and replication controller"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc({"baz": "blah"})], [], [rs({"foo": "bar"})],
+         [("machine1", 0), ("machine2", 5)],
+         "service with partial pod label matches with service and replica set"),
+        (lpod("", {"foo": "bar", "bar": "foo"}),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc({"bar": "foo"})], [rc({"foo": "bar"})], [],
+         [("machine1", 0), ("machine2", 5)],
+         "disjoined service and replication controller should be treated equally"),
+        (lpod("", {"foo": "bar", "bar": "foo"}),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [svc({"bar": "foo"})], [], [rs({"foo": "bar"})],
+         [("machine1", 0), ("machine2", 5)],
+         "disjoined service and replica set should be treated equally"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [], [rc({"foo": "bar"})], [],
+         [("machine1", 0), ("machine2", 0)],
+         "Replication controller with partial pod label matches"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [], [], [rs({"foo": "bar"})],
+         [("machine1", 0), ("machine2", 0)],
+         "Replica set with partial pod label matches"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [], [rc({"baz": "blah"})], [],
+         [("machine1", 0), ("machine2", 5)],
+         "Replication controller with full pod label matches"),
+        (lpod("", LABELS1),
+         [lpod(z1, LABELS2), lpod(z1, LABELS1), lpod(z2, LABELS1)],
+         [], [], [rs({"baz": "blah"})],
+         [("machine1", 0), ("machine2", 5)],
+         "Replica set with full pod label matches"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "services": enc_list(services),
+        "rcs": enc_list(rcs),
+        "rss": enc_list(rss),
+        "expected": expected_map(exp),
+    } for pod, pods, services, rcs, rss, exp, test in table]
+    write_fixture("selector_spread", {
+        "source": "selector_spreading_test.go:33 TestSelectorSpreadPriority",
+        "priority": "SelectorSpreadPriority",
+        "cases": cases,
+    })
+
+
+# --- TestZoneSelectorSpreadPriority (selector_spreading_test.go:291) --------
+
+
+def build_zone_selector_spread():
+    zlabels1 = {"label1": "l1", "baz": "blah"}
+    zlabels2 = {"label2": "l2", "baz": "blah"}
+    n11, n12, n22 = "machine1.zone1", "machine1.zone2", "machine2.zone2"
+    n13, n23, n33 = "machine1.zone3", "machine2.zone3", "machine3.zone3"
+    nodes = [plain_node(n11, {ZONE: "zone1"}),
+             plain_node(n12, {ZONE: "zone2"}),
+             plain_node(n22, {ZONE: "zone2"}),
+             plain_node(n13, {ZONE: "zone3"}),
+             plain_node(n23, {ZONE: "zone3"}),
+             plain_node(n33, {ZONE: "zone3"})]
+    all10 = [(n11, 10), (n12, 10), (n22, 10), (n13, 10), (n23, 10), (n33, 10)]
+    table = [
+        (Pod(), [], [], [], all10, "nothing scheduled"),
+        (lpod("", zlabels1), [lpod(n11)], [], [], all10, "no services"),
+        (lpod("", zlabels1), [lpod(n11, zlabels2)],
+         [svc({"key": "value"})], [], all10, "different services"),
+        (lpod("", zlabels1), [lpod(n11, zlabels2), lpod(n12, zlabels1)],
+         [svc(zlabels1)], [],
+         [(n11, 10), (n12, 0), (n22, 3), (n13, 10), (n23, 10), (n33, 10)],
+         "two pods, 1 matching (in z2)"),
+        (lpod("", zlabels1),
+         [lpod(n11, zlabels2), lpod(n12, zlabels1), lpod(n22, zlabels1),
+          lpod(n13, zlabels2), lpod(n23, zlabels1)],
+         [svc(zlabels1)], [],
+         [(n11, 10), (n12, 0), (n22, 0), (n13, 6), (n23, 3), (n33, 6)],
+         "five pods, 3 matching (z2=2, z3=1)"),
+        (lpod("", zlabels1),
+         [lpod(n11, zlabels1), lpod(n12, zlabels1), lpod(n22, zlabels2),
+          lpod(n13, zlabels1)],
+         [svc(zlabels1)], [],
+         [(n11, 0), (n12, 0), (n22, 3), (n13, 0), (n23, 3), (n33, 3)],
+         "four pods, 3 matching (z1=1, z2=1, z3=1)"),
+        (lpod("", zlabels1),
+         [lpod(n11, zlabels1), lpod(n12, zlabels1), lpod(n13, zlabels1),
+          lpod(n22, zlabels2)],
+         [svc(zlabels1)], [],
+         [(n11, 0), (n12, 0), (n22, 3), (n13, 0), (n23, 3), (n33, 3)],
+         "four pods, 3 matching (z1=1, z2=1, z3=1) (2)"),
+        (lpod("", zlabels1),
+         [lpod(n13, zlabels1), lpod(n12, zlabels1), lpod(n13, zlabels1)],
+         [], [rc(zlabels1)],
+         [(n11, 10), (n12, 5), (n22, 6), (n13, 0), (n23, 3), (n33, 3)],
+         "Replication controller spreading (z1=0, z2=1, z3=2)"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "services": enc_list(services),
+        "rcs": enc_list(rcs),
+        "rss": [],
+        "expected": expected_map(exp),
+    } for pod, pods, services, rcs, exp, test in table]
+    write_fixture("zone_selector_spread", {
+        "source": "selector_spreading_test.go:291 TestZoneSelectorSpreadPriority",
+        "priority": "SelectorSpreadPriority",
+        "cases": cases,
+    })
+
+
+# --- TestZoneSpreadPriority (selector_spreading_test.go:495) ----------------
+
+
+def build_zone_spread():
+    zone1 = {"zone": "zone1"}
+    zone2 = {"zone": "zone2"}
+    nozone = {"name": "value"}
+    nodes = [plain_node("machine01", nozone), plain_node("machine02", nozone),
+             plain_node("machine11", zone1), plain_node("machine12", zone1),
+             plain_node("machine21", zone2), plain_node("machine22", zone2)]
+    z0, z1s, z2s = "machine01", "machine11", "machine21"
+    table = [
+        (Pod(), [], [],
+         [("machine11", 10), ("machine12", 10), ("machine21", 10),
+          ("machine22", 10), ("machine01", 0), ("machine02", 0)],
+         "nothing scheduled"),
+        (lpod("", LABELS1), [lpod(z1s)], [],
+         [("machine11", 10), ("machine12", 10), ("machine21", 10),
+          ("machine22", 10), ("machine01", 0), ("machine02", 0)],
+         "no services"),
+        (lpod("", LABELS1), [lpod(z1s, LABELS2)], [svc({"key": "value"})],
+         [("machine11", 10), ("machine12", 10), ("machine21", 10),
+          ("machine22", 10), ("machine01", 0), ("machine02", 0)],
+         "different services"),
+        (lpod("", LABELS1),
+         [lpod(z0, LABELS2), lpod(z1s, LABELS2), lpod(z2s, LABELS1)],
+         [svc(LABELS1)],
+         [("machine11", 10), ("machine12", 10), ("machine21", 0),
+          ("machine22", 0), ("machine01", 0), ("machine02", 0)],
+         "three pods, one service pod"),
+        (lpod("", LABELS1),
+         [lpod(z1s, LABELS2), lpod(z1s, LABELS1), lpod(z2s, LABELS1)],
+         [svc(LABELS1)],
+         [("machine11", 5), ("machine12", 5), ("machine21", 5),
+          ("machine22", 5), ("machine01", 0), ("machine02", 0)],
+         "three pods, two service pods on different machines"),
+        (lpod("", LABELS1, "default"),
+         [lpod(z1s, LABELS1), lpod(z1s, LABELS1, "default"),
+          lpod(z2s, LABELS1), lpod(z2s, LABELS1, "ns1")],
+         [svc(LABELS1, "default")],
+         [("machine11", 0), ("machine12", 0), ("machine21", 10),
+          ("machine22", 10), ("machine01", 0), ("machine02", 0)],
+         "three service label match pods in different namespaces"),
+        (lpod("", LABELS1),
+         [lpod(z1s, LABELS2), lpod(z1s, LABELS1), lpod(z2s, LABELS1),
+          lpod(z2s, LABELS1)],
+         [svc(LABELS1)],
+         [("machine11", 6), ("machine12", 6), ("machine21", 3),
+          ("machine22", 3), ("machine01", 0), ("machine02", 0)],
+         "four pods, three service pods"),
+        (lpod("", LABELS1),
+         [lpod(z1s, LABELS2), lpod(z1s, LABELS1), lpod(z2s, LABELS1)],
+         [svc({"baz": "blah"})],
+         [("machine11", 3), ("machine12", 3), ("machine21", 6),
+          ("machine22", 6), ("machine01", 0), ("machine02", 0)],
+         "service with partial pod label matches"),
+        (lpod("", LABELS1),
+         [lpod(z0, LABELS1), lpod(z1s, LABELS1), lpod(z2s, LABELS1),
+          lpod(z2s, LABELS1)],
+         [svc(LABELS1)],
+         [("machine11", 7), ("machine12", 7), ("machine21", 5),
+          ("machine22", 5), ("machine01", 0), ("machine02", 0)],
+         "service pod on non-zoned node"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "services": enc_list(services),
+        "label": "zone",
+        "expected": expected_map(exp),
+    } for pod, pods, services, exp, test in table]
+    write_fixture("zone_spread", {
+        "source": "selector_spreading_test.go:495 TestZoneSpreadPriority",
+        "priority": "ServiceAntiAffinityPriority",
+        "cases": cases,
+    })
+
+
+# --- TestNodeAffinityPriority (node_affinity_test.go:29) --------------------
+
+
+def build_node_affinity_priority():
+    affinity1 = {AFFINITY_ANNOTATION: json.dumps({
+        "nodeAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 2, "preference": {"matchExpressions": [
+                {"key": "foo", "operator": "In", "values": ["bar"]}]}},
+        ]}})}
+    affinity2 = {AFFINITY_ANNOTATION: json.dumps({
+        "nodeAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 2, "preference": {"matchExpressions": [
+                {"key": "foo", "operator": "In", "values": ["bar"]}]}},
+            {"weight": 4, "preference": {"matchExpressions": [
+                {"key": "key", "operator": "In", "values": ["value"]}]}},
+            {"weight": 5, "preference": {"matchExpressions": [
+                {"key": "foo", "operator": "In", "values": ["bar"]},
+                {"key": "key", "operator": "In", "values": ["value"]},
+                {"key": "az", "operator": "In", "values": ["az1"]}]}},
+        ]}})}
+    label1 = {"foo": "bar"}
+    label2 = {"key": "value"}
+    label3 = {"az": "az1"}
+    label4 = {"abc": "az11", "def": "az22"}
+    label5 = {"foo": "bar", "key": "value", "az": "az1"}
+    table = [
+        (Pod(metadata=ObjectMeta(annotations={})),
+         [plain_node("machine1", label1), plain_node("machine2", label2),
+          plain_node("machine3", label3)],
+         [("machine1", 0), ("machine2", 0), ("machine3", 0)],
+         "all machines are same priority as NodeAffinity is nil"),
+        (Pod(metadata=ObjectMeta(annotations=affinity1)),
+         [plain_node("machine1", label4), plain_node("machine2", label2),
+          plain_node("machine3", label3)],
+         [("machine1", 0), ("machine2", 0), ("machine3", 0)],
+         "no machine matches preferred scheduling requirements in NodeAffinity of pod so all machines' priority is zero"),
+        (Pod(metadata=ObjectMeta(annotations=affinity1)),
+         [plain_node("machine1", label1), plain_node("machine2", label2),
+          plain_node("machine3", label3)],
+         [("machine1", 10), ("machine2", 0), ("machine3", 0)],
+         "only machine1 matches the preferred scheduling requirements of pod"),
+        (Pod(metadata=ObjectMeta(annotations=affinity2)),
+         [plain_node("machine1", label1), plain_node("machine5", label5),
+          plain_node("machine2", label2)],
+         [("machine1", 1), ("machine5", 10), ("machine2", 3)],
+         "all machines matches the preferred scheduling requirements of pod but with different priorities"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": [],
+        "nodes": enc_list(nodes),
+        "expected": expected_map(exp),
+    } for pod, nodes, exp, test in table]
+    write_fixture("node_affinity_priority", {
+        "source": "node_affinity_test.go:29 TestNodeAffinityPriority",
+        "priority": "NodeAffinityPriority",
+        "cases": cases,
+    })
+
+
+# --- TestTaintAndToleration (taint_toleration_test.go:57) -------------------
+
+
+def build_taint_toleration_priority():
+    def tnode(name, taints):
+        return Node(metadata=ObjectMeta(
+            name=name, annotations={TAINTS_ANNOTATION: json.dumps(taints)}))
+
+    def tpod(tolerations):
+        return Pod(metadata=ObjectMeta(
+            annotations={TOLERATIONS_ANNOTATION: json.dumps(tolerations)}))
+
+    table = [
+        (tpod([{"key": "foo", "operator": "Equal", "value": "bar",
+                "effect": "PreferNoSchedule"}]),
+         [tnode("nodeA", [{"key": "foo", "value": "bar",
+                           "effect": "PreferNoSchedule"}]),
+          tnode("nodeB", [{"key": "foo", "value": "blah",
+                           "effect": "PreferNoSchedule"}])],
+         [("nodeA", 10), ("nodeB", 0)],
+         "node with taints tolerated by the pod, gets a higher score than those node with intolerable taints"),
+        (tpod([{"key": "cpu-type", "operator": "Equal", "value": "arm64",
+                "effect": "PreferNoSchedule"},
+               {"key": "disk-type", "operator": "Equal", "value": "ssd",
+                "effect": "PreferNoSchedule"}]),
+         [tnode("nodeA", []),
+          tnode("nodeB", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "PreferNoSchedule"}]),
+          tnode("nodeC", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "PreferNoSchedule"},
+                          {"key": "disk-type", "value": "ssd",
+                           "effect": "PreferNoSchedule"}])],
+         [("nodeA", 10), ("nodeB", 10), ("nodeC", 10)],
+         "the nodes that all of their taints are tolerated by the pod, get the same score, no matter how many tolerable taints a node has"),
+        (tpod([{"key": "foo", "operator": "Equal", "value": "bar",
+                "effect": "PreferNoSchedule"}]),
+         [tnode("nodeA", []),
+          tnode("nodeB", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "PreferNoSchedule"}]),
+          tnode("nodeC", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "PreferNoSchedule"},
+                          {"key": "disk-type", "value": "ssd",
+                           "effect": "PreferNoSchedule"}])],
+         [("nodeA", 10), ("nodeB", 5), ("nodeC", 0)],
+         "the more intolerable taints a node has, the lower score it gets."),
+        (tpod([{"key": "cpu-type", "operator": "Equal", "value": "arm64",
+                "effect": "NoSchedule"},
+               {"key": "disk-type", "operator": "Equal", "value": "ssd",
+                "effect": "NoSchedule"}]),
+         [tnode("nodeA", []),
+          tnode("nodeB", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "NoSchedule"}]),
+          tnode("nodeC", [{"key": "cpu-type", "value": "arm64",
+                           "effect": "PreferNoSchedule"},
+                          {"key": "disk-type", "value": "ssd",
+                           "effect": "PreferNoSchedule"}])],
+         [("nodeA", 10), ("nodeB", 10), ("nodeC", 0)],
+         "only taints and tolerations that have effect PreferNoSchedule are checked by taints-tolerations priority function"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": [],
+        "nodes": enc_list(nodes),
+        "expected": expected_map(exp),
+    } for pod, nodes, exp, test in table]
+    write_fixture("taint_toleration_priority", {
+        "source": "taint_toleration_test.go:57 TestTaintAndToleration",
+        "priority": "TaintTolerationPriority",
+        "cases": cases,
+    })
+
+
+# --- TestInterPodAffinityPriority (interpod_affinity_test.go:44) ------------
+
+
+def build_interpod_priority():
+    rg_china = {"region": "China"}
+    rg_india = {"region": "India"}
+    az1 = {"az": "az1"}
+    az2 = {"az": "az2"}
+    rg_china_az1 = {"region": "China", "az": "az1"}
+    s1 = {"security": "S1"}
+    s2 = {"security": "S2"}
+
+    def ann(d):
+        return {AFFINITY_ANNOTATION: json.dumps(d)}
+
+    stay_s1_region = ann({"podAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S1"]}]},
+                "namespaces": [], "topologyKey": "region"}}]}})
+    stay_s2_region = ann({"podAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 6, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S2"]}]},
+                "namespaces": [], "topologyKey": "region"}}]}})
+    affinity3 = ann({"podAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 8, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "NotIn", "values": ["S1"]},
+                    {"key": "security", "operator": "In", "values": ["S2"]}]},
+                "namespaces": [], "topologyKey": "region"}},
+            {"weight": 2, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "Exists"},
+                    {"key": "wrongkey", "operator": "DoesNotExist"}]},
+                "namespaces": [], "topologyKey": "region"}},
+        ]}})
+    hard_affinity = ann({"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchExpressions": [
+                {"key": "security", "operator": "In", "values": ["S1", "value2"]}]},
+             "namespaces": [], "topologyKey": "region"},
+            {"labelSelector": {"matchExpressions": [
+                {"key": "security", "operator": "Exists"},
+                {"key": "wrongkey", "operator": "DoesNotExist"}]},
+             "namespaces": [], "topologyKey": "region"},
+        ]}})
+    away_s1_az = ann({"podAntiAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S1"]}]},
+                "namespaces": [], "topologyKey": "az"}}]}})
+    away_s2_az = ann({"podAntiAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S2"]}]},
+                "namespaces": [], "topologyKey": "az"}}]}})
+    stay_s1_away_s2 = ann({
+        "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 8, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S1"]}]},
+                "namespaces": [], "topologyKey": "region"}}]},
+        "podAntiAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S2"]}]},
+                "namespaces": [], "topologyKey": "az"}}]}})
+
+    def apod(labels=None, annotations=None, node=""):
+        return Pod(metadata=ObjectMeta(labels=labels or {},
+                                       annotations=annotations or {}),
+                   spec=PodSpec(node_name=node))
+
+    table = [
+        (apod(s1, {}), [],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", az1)],
+         [("machine1", 0), ("machine2", 0), ("machine3", 0)],
+         "all machines are same priority as Affinity is nil"),
+        (apod(s1, stay_s1_region),
+         [apod(s1, node="machine1"), apod(s2, node="machine2"),
+          apod(s1, node="machine3")],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", az1)],
+         [("machine1", 10), ("machine2", 0), ("machine3", 0)],
+         "Affinity: pod that matches topology key & pods in nodes will get high score comparing to others which doesn't match either pods in nodes or in topology key"),
+        (apod(None, stay_s1_region),
+         [apod(s1, node="machine1")],
+         [plain_node("machine1", rg_china),
+          plain_node("machine2", rg_china_az1),
+          plain_node("machine3", rg_india)],
+         [("machine1", 10), ("machine2", 10), ("machine3", 0)],
+         "All the nodes that have the same topology key & label value with one of them has an existing pod that match the affinity rules, have the same score"),
+        (apod(s1, stay_s2_region),
+         [apod(s2, node="machine1"), apod(s2, node="machine1"),
+          apod(s2, node="machine2"), apod(s2, node="machine3"),
+          apod(s2, node="machine4"), apod(s2, node="machine5")],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", rg_china), plain_node("machine4", rg_china),
+          plain_node("machine5", rg_india)],
+         [("machine1", 10), ("machine2", 5), ("machine3", 10),
+          ("machine4", 10), ("machine5", 5)],
+         "Affinity: nodes in one region has more matching pods comparing to other region, so the region which has more matches will get high score"),
+        (apod(s1, affinity3),
+         [apod(s1, node="machine1"), apod(s2, node="machine2"),
+          apod(s1, node="machine3")],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", az1)],
+         [("machine1", 2), ("machine2", 10), ("machine3", 0)],
+         "Affinity: different Label operators and values for pod affinity scheduling preference, including some match failures"),
+        (apod(s2),
+         [apod(s1, stay_s1_region, "machine1"),
+          apod(s2, stay_s2_region, "machine2")],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", az1)],
+         [("machine1", 0), ("machine2", 10), ("machine3", 0)],
+         "Affinity symmetry: considered only the preferredDuringSchedulingIgnoredDuringExecution in pod affinity symmetry"),
+        (apod(s1),
+         [apod(s1, hard_affinity, "machine1"),
+          apod(s2, hard_affinity, "machine2")],
+         [plain_node("machine1", rg_china), plain_node("machine2", rg_india),
+          plain_node("machine3", az1)],
+         [("machine1", 10), ("machine2", 10), ("machine3", 0)],
+         "Affinity symmetry: considered RequiredDuringSchedulingIgnoredDuringExecution in pod affinity symmetry"),
+        (apod(s1, away_s1_az),
+         [apod(s1, node="machine1"), apod(s2, node="machine2")],
+         [plain_node("machine1", az1), plain_node("machine2", rg_china)],
+         [("machine1", 0), ("machine2", 10)],
+         "Anti Affinity: pod that does not match existing pods in node will get high score"),
+        (apod(s1, away_s1_az),
+         [apod(s1, node="machine1"), apod(s1, node="machine2")],
+         [plain_node("machine1", az1), plain_node("machine2", rg_china)],
+         [("machine1", 0), ("machine2", 10)],
+         "Anti Affinity: pod that does not match topology key & matches the pods in nodes will get higher score comparing to others"),
+        (apod(s1, away_s1_az),
+         [apod(s1, node="machine1"), apod(s1, node="machine1"),
+          apod(s2, node="machine2")],
+         [plain_node("machine1", az1), plain_node("machine2", rg_india)],
+         [("machine1", 0), ("machine2", 10)],
+         "Anti Affinity: one node has more matching pods comparing to other node, so the node which has more unmatches will get high score"),
+        (apod(s2),
+         [apod(s1, away_s2_az, "machine1"), apod(s2, away_s1_az, "machine2")],
+         [plain_node("machine1", az1), plain_node("machine2", az2)],
+         [("machine1", 0), ("machine2", 10)],
+         "Anti Affinity symmetry: the existing pods in node which has anti affinity match will get high score"),
+        (apod(s1, stay_s1_away_s2),
+         [apod(s1, node="machine1"), apod(s1, node="machine2")],
+         [plain_node("machine1", rg_china), plain_node("machine2", az1)],
+         [("machine1", 10), ("machine2", 0)],
+         "Affinity and Anti Affinity: considered only preferredDuringSchedulingIgnoredDuringExecution in both pod affinity & anti affinity"),
+        (apod(s1, stay_s1_away_s2),
+         [apod(s1, node="machine1"), apod(s1, node="machine1"),
+          apod(s1, node="machine2"), apod(s1, node="machine3"),
+          apod(s1, node="machine3"), apod(s1, node="machine4"),
+          apod(s1, node="machine5")],
+         [plain_node("machine1", rg_china_az1), plain_node("machine2", rg_india),
+          plain_node("machine3", rg_china), plain_node("machine4", rg_china),
+          plain_node("machine5", rg_india)],
+         [("machine1", 10), ("machine2", 4), ("machine3", 10),
+          ("machine4", 10), ("machine5", 4)],
+         "Affinity and Anti Affinity: considering both affinity and anti-affinity, the pod to schedule and existing pods have the same labels"),
+        (apod(s1, stay_s1_away_s2),
+         [apod(s1, node="machine1"), apod(s2, node="machine2"),
+          apod(None, stay_s1_away_s2, "machine3"),
+          apod(None, away_s1_az, "machine4")],
+         [plain_node("machine1", rg_china), plain_node("machine2", az1),
+          plain_node("machine3", rg_india), plain_node("machine4", az2)],
+         [("machine1", 10), ("machine2", 0), ("machine3", 10), ("machine4", 0)],
+         "Affinity and Anti Affinity and symmetry: considered only preferredDuringSchedulingIgnoredDuringExecution in both pod affinity & anti affinity & symmetry"),
+    ]
+    cases = [{
+        "test": test,
+        "pod": enc(pod),
+        "pods": enc_list(pods),
+        "nodes": enc_list(nodes),
+        "hard_pod_affinity_weight": 1,
+        "expected": expected_map(exp),
+    } for pod, pods, nodes, exp, test in table]
+    write_fixture("interpod_priority", {
+        "source": "interpod_affinity_test.go:44 TestInterPodAffinityPriority",
+        "priority": "InterPodAffinityPriority",
+        "cases": cases,
+    })
+
+    # TestHardPodAffinitySymmetricWeight (interpod_affinity_test.go:517)
+    hard_pod_affinity = ann({"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchExpressions": [
+                {"key": "service", "operator": "In", "values": ["S1"]}]},
+             "namespaces": [], "topologyKey": "region"}]}})
+    service_s1 = {"service": "S1"}
+    hw_cases = []
+    for weight, exp, test in [
+        (1, [("machine1", 10), ("machine2", 10), ("machine3", 0)],
+         "Hard Pod Affinity symmetry: hard pod affinity symmetry weights 1 by default, then nodes that match the hard pod affinity symmetry rules, get a high score"),
+        (0, [("machine1", 0), ("machine2", 0), ("machine3", 0)],
+         "Hard Pod Affinity symmetry: hard pod affinity symmetry is closed(weights 0), then nodes that match the hard pod affinity symmetry rules, get same score with those not match"),
+    ]:
+        hw_cases.append({
+            "test": test,
+            "pod": enc(apod(service_s1)),
+            "pods": enc_list([apod(None, hard_pod_affinity, "machine1"),
+                              apod(None, hard_pod_affinity, "machine2")]),
+            "nodes": enc_list([plain_node("machine1", rg_china),
+                               plain_node("machine2", rg_india),
+                               plain_node("machine3", az1)]),
+            "hard_pod_affinity_weight": weight,
+            "expected": expected_map(exp),
+        })
+    write_fixture("hard_pod_affinity_weight", {
+        "source": "interpod_affinity_test.go:517 TestHardPodAffinitySymmetricWeight",
+        "priority": "InterPodAffinityPriority",
+        "cases": hw_cases,
+    })
+
+    # TestSoftPodAntiAffinityWithFailureDomains (interpod_affinity_test.go:605)
+    anti_empty_topo = ann({"podAntiAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [{
+            "weight": 5, "podAffinityTerm": {
+                "labelSelector": {"matchExpressions": [
+                    {"key": "security", "operator": "In", "values": ["S1"]}]},
+                "namespaces": [], "topologyKey": ""}}]}})
+    fd_cases = [
+        {
+            "test": "Soft Pod Anti Affinity: when the topologyKey is empty, match among topologyKeys indicated by failure domains.",
+            "pod": enc(apod(s1, anti_empty_topo)),
+            "pods": enc_list([apod(s1, node="machine1"),
+                              apod(s1, node="machine2")]),
+            "nodes": enc_list([plain_node("machine1", {ZONE: "az1"}),
+                               plain_node("machine2", az1)]),
+            "failure_domains": "default",
+            "hard_pod_affinity_weight": 1,
+            "expected": expected_map([("machine1", 0), ("machine2", 10)]),
+        },
+        {
+            "test": "Soft Pod Anti Affinity: when the topologyKey is empty, and no failure domains indicated, regard as topologyKey not match.",
+            "pod": enc(apod(s1, anti_empty_topo)),
+            "pods": enc_list([apod(s1, node="machine1"),
+                              apod(s1, node="machine2")]),
+            "nodes": enc_list([plain_node("machine1", {ZONE: "az1"}),
+                               plain_node("machine2", az1)]),
+            "failure_domains": "none",
+            "hard_pod_affinity_weight": 1,
+            "oracle_only": True,
+            "expected": expected_map([("machine1", 0), ("machine2", 0)]),
+        },
+    ]
+    write_fixture("soft_anti_affinity_failure_domains", {
+        "source": "interpod_affinity_test.go:605 TestSoftPodAntiAffinityWithFailureDomains",
+        "priority": "InterPodAffinityPriority",
+        "cases": fd_cases,
+    })
+
+
+if __name__ == "__main__":
+    build_zero_request()
+    build_least_requested()
+    build_balanced_allocation()
+    build_node_label_priority()
+    build_image_locality()
+    build_selector_spread()
+    build_zone_selector_spread()
+    build_zone_spread()
+    build_node_affinity_priority()
+    build_taint_toleration_priority()
+    build_interpod_priority()

@@ -1,0 +1,96 @@
+"""Transcription of generic_scheduler_test.go tables into JSON fixtures.
+
+The fake predicates/priorities ("true"/"false"/"matches"/"nopods",
+"numeric"/"reverseNumeric"/"equal") are named here and implemented by the
+runner (tests/test_corpus.py) exactly as generic_scheduler_test.go:37-104
+defines them. Run `python tests/corpus/builders/build_scheduler.py`.
+"""
+
+from kubernetes_tpu.api.types import ObjectMeta, Pod, PodSpec, PodStatus
+
+from common import enc, write_fixture
+
+
+def build_select_host():
+    # generic_scheduler_test.go:116 TestSelectHost
+    cases = [
+        {"list": [["machine1.1", 1], ["machine2.1", 2]],
+         "possible": ["machine2.1"], "expects_err": False},
+        {"list": [["machine1.1", 1], ["machine1.2", 2], ["machine1.3", 2],
+                  ["machine2.1", 2]],
+         "possible": ["machine1.2", "machine1.3", "machine2.1"],
+         "expects_err": False},
+        {"list": [["machine1.1", 3], ["machine1.2", 3], ["machine2.1", 2],
+                  ["machine3.1", 1], ["machine1.3", 3]],
+         "possible": ["machine1.1", "machine1.2", "machine1.3"],
+         "expects_err": False},
+        {"list": [], "possible": [], "expects_err": True},
+    ]
+    write_fixture("select_host", {
+        "source": "generic_scheduler_test.go:116 TestSelectHost",
+        "cases": cases,
+    })
+
+
+def build_generic_scheduler():
+    # generic_scheduler_test.go:182 TestGenericScheduler
+    pod2 = Pod(metadata=ObjectMeta(name="2", namespace=""))
+    running2 = Pod(metadata=ObjectMeta(name="2", namespace=""),
+                   spec=PodSpec(node_name="2"),
+                   status=PodStatus(phase="Running"))
+    cases = [
+        {"name": "test 1", "predicates": ["false"], "priorities": [["equal", 1]],
+         "nodes": ["machine1", "machine2"], "pod": enc(Pod()), "pods": [],
+         "expects_err": True, "expected": []},
+        {"name": "test 2", "predicates": ["true"], "priorities": [["equal", 1]],
+         "nodes": ["machine1", "machine2"], "pod": enc(Pod()), "pods": [],
+         "expects_err": False, "expected": ["machine1", "machine2"]},
+        {"name": "test 3", "predicates": ["matches"],
+         "priorities": [["equal", 1]], "nodes": ["machine1", "machine2"],
+         "pod": enc(Pod(metadata=ObjectMeta(name="machine2", namespace=""))),
+         "pods": [], "expects_err": False, "expected": ["machine2"]},
+        {"name": "test 4", "predicates": ["true"],
+         "priorities": [["numeric", 1]], "nodes": ["3", "2", "1"],
+         "pod": enc(Pod()), "pods": [], "expects_err": False,
+         "expected": ["3"]},
+        {"name": "test 5", "predicates": ["matches"],
+         "priorities": [["numeric", 1]], "nodes": ["3", "2", "1"],
+         "pod": enc(pod2), "pods": [], "expects_err": False,
+         "expected": ["2"]},
+        {"name": "test 6", "predicates": ["true"],
+         "priorities": [["numeric", 1], ["reverseNumeric", 2]],
+         "nodes": ["3", "2", "1"], "pod": enc(pod2), "pods": [],
+         "expects_err": False, "expected": ["1"]},
+        {"name": "test 7", "predicates": ["true", "false"],
+         "priorities": [["numeric", 1]], "nodes": ["3", "2", "1"],
+         "pod": enc(Pod()), "pods": [], "expects_err": True, "expected": []},
+        {"name": "test 8", "predicates": ["nopods", "matches"],
+         "priorities": [["numeric", 1]], "nodes": ["1", "2"],
+         "pod": enc(pod2), "pods": [enc(running2)], "expects_err": True,
+         "expected": []},
+    ]
+    # TestFindFitAllError / TestFindFitSomeError (:305, :334)
+    find_fit = [
+        {"name": "all error", "predicates": ["true", "false"],
+         "nodes": ["3", "2", "1"], "pod": enc(Pod()), "pods": [],
+         "expect_failed": {"3": "FakePredicateError",
+                           "2": "FakePredicateError",
+                           "1": "FakePredicateError"}},
+        {"name": "some error", "predicates": ["true", "matches"],
+         "nodes": ["3", "2", "1"],
+         "pod": enc(Pod(metadata=ObjectMeta(name="1", namespace=""))),
+         "pods": [enc(Pod(metadata=ObjectMeta(name="1", namespace=""),
+                          spec=PodSpec(node_name="1")))],
+         "expect_failed": {"3": "FakePredicateError",
+                           "2": "FakePredicateError"}},
+    ]
+    write_fixture("generic_scheduler", {
+        "source": "generic_scheduler_test.go:182 TestGenericScheduler + :305 TestFindFit*",
+        "cases": cases,
+        "find_fit": find_fit,
+    })
+
+
+if __name__ == "__main__":
+    build_select_host()
+    build_generic_scheduler()

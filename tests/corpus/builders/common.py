@@ -1,0 +1,146 @@
+"""Shared helpers for the conformance-corpus builders.
+
+The builders transcribe the reference's unit-test scenario tables
+(plugin/pkg/scheduler/algorithm/predicates/predicates_test.go,
+priorities/*_test.go, generic_scheduler_test.go) into JSON fixtures under
+tests/corpus/. The helper names mirror the Go test helpers so the
+transcription can be checked side by side against the Go source.
+
+Fixture objects use this framework's wire format (runtime/scheme.py), not
+the upstream wire format — the corpus is scenario DATA, re-encoded.
+"""
+
+import json
+import os
+
+from kubernetes_tpu.api.types import (
+    AFFINITY_ANNOTATION,
+    TAINTS_ANNOTATION,
+    TOLERATIONS_ANNOTATION,
+    Container,
+    ContainerPort,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from kubernetes_tpu.runtime.scheme import scheme
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def enc(obj):
+    return scheme.encode(obj)
+
+
+def enc_list(objs):
+    return [scheme.encode(o) for o in objs]
+
+
+def write_fixture(name, doc):
+    path = os.path.abspath(os.path.join(CORPUS_DIR, name + ".json"))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+# --- Go test helper equivalents --------------------------------------------
+
+
+def resource_request(milli_cpu=0, memory=0, nvidia_gpu=0):
+    """resourceRequest struct → a container requests dict."""
+    req = {}
+    if milli_cpu:
+        req["cpu"] = f"{milli_cpu}m"
+    if memory:
+        req["memory"] = memory
+    if nvidia_gpu:
+        req["alpha.kubernetes.io/nvidia-gpu"] = nvidia_gpu
+    return req
+
+
+def new_resource_pod(*usage, **meta):
+    """predicates_test.go:94 newResourcePod — one container per request."""
+    return Pod(
+        metadata=ObjectMeta(**meta),
+        spec=PodSpec(
+            containers=[Container(requests=resource_request(*u)) for u in usage]
+        ),
+    )
+
+
+def new_resource_init_pod(pod, *usage):
+    """predicates_test.go:114 newResourceInitPod."""
+    pod.spec.init_containers = [
+        Container(requests=resource_request(*u)) for u in usage
+    ]
+    return pod
+
+
+def make_resources(milli_cpu, memory, nvidia_gpus, pods):
+    """predicates_test.go:74 makeResources (capacity == allocatable here)."""
+    return {
+        "cpu": f"{milli_cpu}m",
+        "memory": memory,
+        "pods": pods,
+        "alpha.kubernetes.io/nvidia-gpu": nvidia_gpus,
+    }
+
+
+def new_port_pod(host, *host_ports):
+    """predicates_test.go:351 newPod(host, hostPorts...)."""
+    return Pod(
+        spec=PodSpec(
+            node_name=host,
+            containers=[
+                Container(ports=[ContainerPort(host_port=p) for p in host_ports])
+            ],
+        )
+    )
+
+
+def node_with(name="", labels=None, annotations=None, allocatable=None,
+              capacity=None, conditions=None):
+    st = NodeStatus(
+        capacity=capacity or {},
+        allocatable=allocatable or {},
+        conditions=[NodeCondition(**c) for c in (conditions or [])],
+    )
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {},
+                            annotations=annotations or {}),
+        status=st,
+    )
+
+
+def affinity_pod(annotation_json, labels=None, node_selector=None, name="",
+                 namespace="default", node_name=""):
+    """A pod carrying the alpha affinity annotation verbatim from the Go
+    table (api.AffinityAnnotationKey)."""
+    meta = ObjectMeta(name=name, namespace=namespace, labels=labels or {})
+    if annotation_json is not None:
+        meta.annotations = {AFFINITY_ANNOTATION: annotation_json}
+    return Pod(
+        metadata=meta,
+        spec=PodSpec(node_selector=node_selector or {}, node_name=node_name),
+    )
+
+
+__all__ = [
+    "AFFINITY_ANNOTATION",
+    "TAINTS_ANNOTATION",
+    "TOLERATIONS_ANNOTATION",
+    "enc",
+    "enc_list",
+    "write_fixture",
+    "resource_request",
+    "new_resource_pod",
+    "new_resource_init_pod",
+    "make_resources",
+    "new_port_pod",
+    "node_with",
+    "affinity_pod",
+]

@@ -1,8 +1,9 @@
 """Unit tests for the core API layer (quantities, selectors, helpers).
 
-Scenario tables are re-derived from the reference's test intent
-(pkg/api/resource/quantity_test.go, pkg/labels/selector_test.go idioms) —
-tables, not code.
+Scenario tables here are re-derived from the reference's test intent
+(pkg/api/resource/quantity_test.go, pkg/labels/selector_test.go idioms).
+The scheduler's own tables are ported verbatim as the independent
+conformance ground truth — see tests/corpus/ + tests/test_corpus.py.
 """
 
 import pytest

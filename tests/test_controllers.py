@@ -489,6 +489,9 @@ def test_hpa_scales_rc(plane):
         )
     )
     assert wait_until(lambda: len(pods_of(client)) == 2)
+    # reconcile_once syncs from the informer view; wait for the watch to
+    # deliver the HPA first (the reference's loop just retries in 30s)
+    assert wait_until(lambda: len(hpa_ctl.hpa_informer.store.list()) == 1)
     hpa_ctl.reconcile_once()
     # 160% of an 80% target -> double the replicas
     assert client.resource("replicationcontrollers", "default").get("web").spec.replicas == 4

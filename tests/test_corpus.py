@@ -1,0 +1,551 @@
+"""Conformance against the reference's own test tables (ported corpus).
+
+The JSON fixtures under tests/corpus/ are mechanical transcriptions of the
+scenario tables in the reference's unit tests
+(plugin/pkg/scheduler/algorithm/predicates/predicates_test.go,
+priorities/*_test.go, generic_scheduler_test.go) — see
+tests/corpus/builders/. Two independent checks run per suite:
+
+1. oracle == Kubernetes: the host oracle predicate/priority evaluated on
+   the exact scenario must reproduce the Go table's expected fit/score and
+   failure reason.
+2. tensor == oracle: the device path (BatchScheduler.debug_evaluate with a
+   config isolating the suite's predicate/priority) must agree on the same
+   scenario. Where the suite's predicate is only expressible inside
+   GeneralPredicates on the device, unrelated resource limits are padded so
+   the other components of GeneralPredicates pass trivially.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    Service,
+)
+from kubernetes_tpu.models.batch import (
+    BatchScheduler,
+    CHECK_NODE_MEMORY_PRESSURE,
+    EQUAL,
+    GENERAL_PREDICATES,
+    MATCH_INTER_POD_AFFINITY,
+    MAX_EBS_VOLUME_COUNT,
+    NODE_LABEL_PREDICATE,
+    NO_DISK_CONFLICT,
+    POD_TOLERATES_NODE_TAINTS,
+    SERVICE_AFFINITY,
+    SchedulerConfig,
+)
+from kubernetes_tpu.oracle import ClusterState
+from kubernetes_tpu.oracle import predicates as opreds
+from kubernetes_tpu.runtime.scheme import scheme
+from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus")
+
+GENEROUS = {"cpu": "1000", "memory": 10**15, "pods": 1000,
+            "alpha.kubernetes.io/nvidia-gpu": 1000}
+
+
+def load(name):
+    with open(os.path.join(CORPUS, name + ".json")) as f:
+        return json.load(f)
+
+
+def dec_pod(d):
+    return scheme.decode(d, Pod)
+
+
+def dec_node(d):
+    return scheme.decode(d, Node)
+
+
+def reason_str(reason):
+    """Fixture reason → the oracle's reason string (error.go semantics)."""
+    if reason is None:
+        return None
+    if reason["kind"] == "insufficient":
+        return opreds.insufficient_resource_error(
+            reason["resource"], reason["requested"], reason["used"],
+            reason["capacity"])
+    return reason["name"]
+
+
+def single_node_state(case, patch_resources=False):
+    """Build ClusterState for a single-node predicate case: the node plus
+    its 'existing' pods (NewNodeInfo(pods...) in the Go tables)."""
+    node = dec_node(case["node"])
+    if not node.metadata.name:
+        node.metadata.name = "node-unnamed"  # keyable; semantics unchanged
+    if patch_resources and "pods" not in node.status.allocatable:
+        node.status.allocatable = dict(GENEROUS)
+    state = ClusterState.build([node])
+    info = state.node_infos[node.metadata.name]
+    for pd in case.get("existing", []):
+        ep = dec_pod(pd)
+        ep.spec.node_name = node.metadata.name
+        info.add_pod(ep)
+    return state, node, info
+
+
+def tensor_fits(state, pod, config):
+    """Device fit vector for one pending pod: {node_name: bool}."""
+    snap, batch = SnapshotEncoder(state, [pod], config=config).encode()
+    fit, _ = BatchScheduler(config).debug_evaluate(snap, batch)
+    return dict(zip(snap.node_names, fit[0].tolist()))
+
+
+def check_single_node_suite(fixture, oracle_fn, config_builder,
+                            patch_resources=False, state_builder=None):
+    doc = load(fixture)
+    for case in doc["cases"]:
+        if state_builder is not None:
+            state, node, info = state_builder(case)
+        else:
+            state, node, info = single_node_state(case, patch_resources)
+        pod = dec_pod(case["pod"])
+        fit, reason = oracle_fn(case)(pod, info, state)
+        assert fit == case["fits"], f"oracle fit: {case['test']}"
+        if not case["fits"] and case["reason"] is not None:
+            assert reason == reason_str(case["reason"]), \
+                f"oracle reason: {case['test']}"
+        # device agreement on the same scenario
+        config = config_builder(case)
+        fits = tensor_fits(state, pod, config)
+        assert fits[node.metadata.name] == case["fits"], \
+            f"tensor fit: {case['test']}"
+
+
+def test_pod_fits_resources_table():
+    check_single_node_suite(
+        "pod_fits_resources",
+        lambda case: opreds.pod_fits_resources,
+        lambda case: SchedulerConfig(predicates=(GENERAL_PREDICATES,),
+                                     priorities=((EQUAL, 1),)),
+    )
+
+
+def test_pod_fits_host_table():
+    check_single_node_suite(
+        "pod_fits_host",
+        lambda case: opreds.pod_fits_host,
+        lambda case: SchedulerConfig(predicates=(GENERAL_PREDICATES,),
+                                     priorities=((EQUAL, 1),)),
+        patch_resources=True,
+    )
+
+
+def test_pod_fits_host_ports_table():
+    check_single_node_suite(
+        "pod_fits_host_ports",
+        lambda case: opreds.pod_fits_host_ports,
+        lambda case: SchedulerConfig(predicates=(GENERAL_PREDICATES,),
+                                     priorities=((EQUAL, 1),)),
+        patch_resources=True,
+    )
+
+
+def test_no_disk_conflict_table():
+    check_single_node_suite(
+        "no_disk_conflict",
+        lambda case: opreds.no_disk_conflict,
+        lambda case: SchedulerConfig(predicates=(NO_DISK_CONFLICT,),
+                                     priorities=((EQUAL, 1),)),
+    )
+
+
+def test_pod_fits_selector_table():
+    check_single_node_suite(
+        "pod_fits_selector",
+        lambda case: opreds.pod_selector_matches,
+        lambda case: SchedulerConfig(predicates=(GENERAL_PREDICATES,),
+                                     priorities=((EQUAL, 1),)),
+        patch_resources=True,
+    )
+
+
+def test_node_label_presence_table():
+    check_single_node_suite(
+        "node_label_presence",
+        lambda case: opreds.node_label_predicate(case["labels"],
+                                                 case["presence"]),
+        lambda case: SchedulerConfig(
+            predicates=((NODE_LABEL_PREDICATE, tuple(case["labels"]),
+                         case["presence"]),),
+            priorities=((EQUAL, 1),)),
+    )
+
+
+def test_pod_tolerates_taints_table():
+    check_single_node_suite(
+        "pod_tolerates_taints",
+        lambda case: opreds.pod_tolerates_node_taints,
+        lambda case: SchedulerConfig(predicates=(POD_TOLERATES_NODE_TAINTS,),
+                                     priorities=((EQUAL, 1),)),
+    )
+
+
+def test_memory_pressure_table():
+    check_single_node_suite(
+        "memory_pressure",
+        lambda case: opreds.check_node_memory_pressure,
+        lambda case: SchedulerConfig(predicates=(CHECK_NODE_MEMORY_PRESSURE,),
+                                     priorities=((EQUAL, 1),)),
+    )
+
+
+def test_general_predicates_table():
+    check_single_node_suite(
+        "general_predicates",
+        lambda case: opreds.general_predicates,
+        lambda case: SchedulerConfig(predicates=(GENERAL_PREDICATES,),
+                                     priorities=((EQUAL, 1),)),
+    )
+
+
+def test_max_pd_volume_count_table():
+    def state_builder(case):
+        state, node, info = single_node_state(case)
+        for pd in case["pvs"]:
+            pv = scheme.decode(pd, PersistentVolume)
+            state.pvs[pv.metadata.name] = pv
+        for pd in case["pvcs"]:
+            pvc = scheme.decode(pd, PersistentVolumeClaim)
+            state.pvcs[(pvc.metadata.namespace, pvc.metadata.name)] = pvc
+        return state, node, info
+
+    check_single_node_suite(
+        "max_pd_volume_count",
+        lambda case: opreds.max_pd_volume_count(case["filter"],
+                                                case["max_vols"]),
+        lambda case: SchedulerConfig(predicates=(MAX_EBS_VOLUME_COUNT,),
+                                     priorities=((EQUAL, 1),),
+                                     max_ebs_volumes=case["max_vols"]),
+        state_builder=state_builder,
+    )
+
+
+def test_service_affinity_table():
+    doc = load("service_affinity")
+    for case in doc["cases"]:
+        nodes = [dec_node(d) for d in case["nodes"]]
+        services = [scheme.decode(d, Service) for d in case["services"]]
+        pods = [dec_pod(d) for d in case["pods"]]
+        state = ClusterState.build(nodes, assigned_pods=pods,
+                                   services=services)
+        pod = dec_pod(case["pod"])
+        pred = opreds.service_affinity_predicate(case["labels"])
+        info = state.node_infos[case["node"]]
+        fit, reason = pred(pod, info, state)
+        assert fit == case["fits"], f"oracle fit: {case['test']}"
+        if not fit:
+            assert reason == reason_str(case["reason"]), \
+                f"oracle reason: {case['test']}"
+        config = SchedulerConfig(
+            predicates=((SERVICE_AFFINITY, tuple(case["labels"])),),
+            priorities=((EQUAL, 1),))
+        fits = tensor_fits(state, pod, config)
+        assert fits[case["node"]] == case["fits"], f"tensor: {case['test']}"
+
+
+@pytest.mark.parametrize("fixture", ["interpod_affinity",
+                                     "interpod_affinity_multi"])
+def test_interpod_affinity_tables(fixture):
+    doc = load(fixture)
+    for case in doc["cases"]:
+        nodes = [dec_node(d) for d in case["nodes"]]
+        known = {n.metadata.name for n in nodes}
+        pods = [dec_pod(d) for d in case["pods"]]
+        state = ClusterState.build(nodes)
+        for ep in pods:
+            # pods on nodes absent from the scenario's node list cannot
+            # contribute topology matches (their node resolves to nothing)
+            state.assign(ep)
+        pod = dec_pod(case["pod"])
+        also_selector = case.get("also_node_selector", False)
+        for name, exp in case["expect"].items():
+            info = state.node_infos[name]
+            fit, reason = opreds.inter_pod_affinity_matches(pod, info, state)
+            if also_selector:
+                # predicates_test.go:2341-2353 ANDs PodSelectorMatches when
+                # the pod carries a node affinity annotation
+                fit2, _ = opreds.pod_selector_matches(pod, info, state)
+                fit = fit and fit2
+            assert fit == exp["fits"], f"oracle {name}: {case['test']}"
+            if not exp["fits"] and exp["reason"] is not None and not also_selector:
+                assert reason == reason_str(exp["reason"]), \
+                    f"oracle reason {name}: {case['test']}"
+        # device agreement (drop pods on unknown nodes for the encoder)
+        tensor_state = ClusterState.build(nodes)
+        for ep in pods:
+            if ep.spec.node_name in known:
+                tensor_state.assign(ep)
+        preds = (GENERAL_PREDICATES, MATCH_INTER_POD_AFFINITY) if also_selector \
+            else (MATCH_INTER_POD_AFFINITY,)
+        for n in nodes:
+            if "pods" not in n.status.allocatable:
+                n.status.allocatable = dict(GENEROUS)
+        config = SchedulerConfig(predicates=preds, priorities=((EQUAL, 1),))
+        fits = tensor_fits(tensor_state, pod, config)
+        for name, exp in case["expect"].items():
+            assert fits[name] == exp["fits"], f"tensor {name}: {case['test']}"
+
+
+# ===========================================================================
+# Priority tables (priorities_test.go, selector_spreading_test.go,
+# node_affinity_test.go, taint_toleration_test.go, interpod_affinity_test.go)
+# ===========================================================================
+
+from kubernetes_tpu.api.types import ReplicaSet, ReplicationController  # noqa: E402
+from kubernetes_tpu.models.batch import (  # noqa: E402
+    BALANCED_ALLOCATION,
+    IMAGE_LOCALITY,
+    INTER_POD_AFFINITY,
+    LEAST_REQUESTED,
+    NODE_AFFINITY,
+    NODE_LABEL_PRIORITY,
+    SELECTOR_SPREAD,
+    SERVICE_ANTI_AFFINITY,
+    TAINT_TOLERATION,
+)
+from kubernetes_tpu.oracle import priorities as oprios  # noqa: E402
+
+
+def priority_state(case):
+    nodes = [dec_node(d) for d in case["nodes"]]
+    pods = [dec_pod(d) for d in case["pods"]]
+    services = [scheme.decode(d, Service) for d in case.get("services", [])]
+    rcs = [scheme.decode(d, ReplicationController) for d in case.get("rcs", [])]
+    rss = [scheme.decode(d, ReplicaSet) for d in case.get("rss", [])]
+    state = ClusterState.build(nodes, assigned_pods=pods, services=services,
+                               controllers=rcs, replica_sets=rss)
+    return state, dec_pod(case["pod"])
+
+
+def tensor_scores(state, pod, priorities, hard_weight=1):
+    """Device per-node score vector for one pod (no predicates)."""
+    config = SchedulerConfig(predicates=(), priorities=tuple(priorities),
+                             hard_pod_affinity_weight=hard_weight)
+    snap, batch = SnapshotEncoder(state, [pod], config=config).encode()
+    _, score = BatchScheduler(config).debug_evaluate(snap, batch)
+    return dict(zip(snap.node_names, score[0].tolist()))
+
+
+def check_priority_suite(fixture, oracle_fn, tensor_priority):
+    doc = load(fixture)
+    for case in doc["cases"]:
+        state, pod = priority_state(case)
+        got = oracle_fn(case)(pod, state)
+        assert got == case["expected"], f"oracle: {case['test']}: {got}"
+        scores = tensor_scores(state, pod, [(tensor_priority(case), 1)])
+        assert scores == case["expected"], f"tensor: {case['test']}: {scores}"
+
+
+def test_least_requested_table():
+    check_priority_suite(
+        "least_requested",
+        lambda case: oprios.least_requested_priority,
+        lambda case: LEAST_REQUESTED,
+    )
+
+
+def test_balanced_allocation_table():
+    check_priority_suite(
+        "balanced_allocation",
+        lambda case: oprios.balanced_resource_allocation,
+        lambda case: BALANCED_ALLOCATION,
+    )
+
+
+def test_node_label_priority_table():
+    check_priority_suite(
+        "node_label_priority",
+        lambda case: oprios.node_label_priority(case["label"], case["presence"]),
+        lambda case: (NODE_LABEL_PRIORITY, case["label"], case["presence"]),
+    )
+
+
+def test_image_locality_table():
+    check_priority_suite(
+        "image_locality",
+        lambda case: oprios.image_locality_priority,
+        lambda case: IMAGE_LOCALITY,
+    )
+
+
+@pytest.mark.parametrize("fixture", ["selector_spread", "zone_selector_spread"])
+def test_selector_spread_tables(fixture):
+    check_priority_suite(
+        fixture,
+        lambda case: oprios.selector_spread_priority,
+        lambda case: SELECTOR_SPREAD,
+    )
+
+
+def test_zone_spread_table():
+    check_priority_suite(
+        "zone_spread",
+        lambda case: oprios.service_anti_affinity_priority(case["label"]),
+        lambda case: (SERVICE_ANTI_AFFINITY, case["label"]),
+    )
+
+
+def test_node_affinity_priority_table():
+    check_priority_suite(
+        "node_affinity_priority",
+        lambda case: oprios.node_affinity_priority,
+        lambda case: NODE_AFFINITY,
+    )
+
+
+def test_taint_toleration_priority_table():
+    check_priority_suite(
+        "taint_toleration_priority",
+        lambda case: oprios.taint_toleration_priority,
+        lambda case: TAINT_TOLERATION,
+    )
+
+
+@pytest.mark.parametrize("fixture", ["interpod_priority",
+                                     "hard_pod_affinity_weight",
+                                     "soft_anti_affinity_failure_domains"])
+def test_interpod_priority_tables(fixture):
+    doc = load(fixture)
+    for case in doc["cases"]:
+        state, pod = priority_state(case)
+        weight = case.get("hard_pod_affinity_weight", 1)
+        fd = None
+        if case.get("failure_domains") == "none":
+            fd = ()
+        got = oprios.inter_pod_affinity_priority(
+            pod, state, hard_pod_affinity_weight=weight, failure_domains=fd)
+        assert got == case["expected"], f"oracle: {case['test']}: {got}"
+        if case.get("oracle_only"):
+            continue
+        scores = tensor_scores(state, pod, [(INTER_POD_AFFINITY, 1)],
+                               hard_weight=weight)
+        assert scores == case["expected"], f"tensor: {case['test']}: {scores}"
+
+
+def test_zero_request_table():
+    """priorities_test.go:53 TestZeroRequest — the default-provider triple
+    (LeastRequested + Balanced + SelectorSpread) must blend nonzero-request
+    defaults so zero-request pods score like default-request pods."""
+    doc = load("zero_request")
+    triple = [(LEAST_REQUESTED, 1), (BALANCED_ALLOCATION, 1),
+              (SELECTOR_SPREAD, 1)]
+    for case in doc["cases"]:
+        state, pod = priority_state(case)
+        totals = {}
+        for fn in (oprios.least_requested_priority,
+                   oprios.balanced_resource_allocation,
+                   oprios.selector_spread_priority):
+            for host, score in fn(pod, state).items():
+                totals[host] = totals.get(host, 0) + score
+        scores = tensor_scores(state, pod, triple)
+        for host in totals:
+            if "expect_all" in case:
+                assert totals[host] == case["expect_all"], \
+                    f"oracle: {case['test']}: {totals}"
+                assert scores[host] == case["expect_all"], \
+                    f"tensor: {case['test']}: {scores}"
+            else:
+                assert totals[host] != case["expect_all_not"], \
+                    f"oracle: {case['test']}: {totals}"
+                assert scores[host] != case["expect_all_not"], \
+                    f"tensor: {case['test']}: {scores}"
+        assert totals == scores, f"tensor!=oracle: {case['test']}"
+
+
+# ===========================================================================
+# generic_scheduler_test.go tables (selectHost + Schedule + findNodesThatFit)
+# ===========================================================================
+
+from kubernetes_tpu.oracle.scheduler import (  # noqa: E402
+    FitError,
+    GenericScheduler,
+    PriorityConfig,
+    select_host,
+)
+
+
+def _fake_predicates(names):
+    """generic_scheduler_test.go:37-61 fake predicates."""
+    impls = {
+        "false": lambda pod, info, state: (False, "FakePredicateError"),
+        "true": lambda pod, info, state: (True, None),
+        "matches": lambda pod, info, state: (
+            (True, None) if info.node is not None
+            and pod.metadata.name == info.node.metadata.name
+            else (False, "FakePredicateError")),
+        "nopods": lambda pod, info, state: (
+            (True, None) if len(info.pods) == 0
+            else (False, "FakePredicateError")),
+    }
+    return [(n, impls[n]) for n in names]
+
+
+def _fake_priorities(entries):
+    """generic_scheduler_test.go:63-104 numeric/reverseNumeric + Equal."""
+    def numeric(pod, state):
+        return {name: int(name) for name in state.node_infos}
+
+    def reverse_numeric(pod, state):
+        scores = numeric(pod, state)
+        hi, lo = max(scores.values()), min(scores.values())
+        return {name: int(hi + lo - s) for name, s in scores.items()}
+
+    from kubernetes_tpu.oracle import priorities as _op
+    impls = {"equal": _op.equal_priority, "numeric": numeric,
+             "reverseNumeric": reverse_numeric}
+    return [PriorityConfig(impls[n], w, n) for n, w in entries]
+
+
+def test_select_host_table():
+    doc = load("select_host")
+    for case in doc["cases"]:
+        plist = [(h, s) for h, s in case["list"]]
+        if case["expects_err"]:
+            with pytest.raises(ValueError):
+                select_host(plist, 0)
+            continue
+        # "increase the randomness" loop: every round-robin offset must
+        # stay inside the max-score tie set
+        for i in range(10):
+            assert select_host(plist, i) in set(case["possible"]), \
+                f"offset {i}: {case}"
+
+
+def test_generic_scheduler_table():
+    doc = load("generic_scheduler")
+    for case in doc["cases"]:
+        nodes = [Node(metadata=type(Node().metadata)(name=n))
+                 for n in case["nodes"]]
+        state = ClusterState.build(nodes,
+                                   assigned_pods=[dec_pod(d) for d in case["pods"]])
+        sched = GenericScheduler(
+            predicates=_fake_predicates(case["predicates"]),
+            priorities=_fake_priorities(case["priorities"]))
+        pod = dec_pod(case["pod"])
+        if case["expects_err"]:
+            with pytest.raises(FitError):
+                sched.schedule(pod, state)
+        else:
+            assert sched.schedule(pod, state) in set(case["expected"]), \
+                case["name"]
+    for case in doc["find_fit"]:
+        nodes = [Node(metadata=type(Node().metadata)(name=n))
+                 for n in case["nodes"]]
+        state = ClusterState.build(nodes,
+                                   assigned_pods=[dec_pod(d) for d in case["pods"]])
+        sched = GenericScheduler(
+            predicates=_fake_predicates(case["predicates"]),
+            priorities=_fake_priorities([["numeric", 1]]))
+        pod = dec_pod(case["pod"])
+        _, failed = sched.find_nodes_that_fit(pod, state)
+        assert failed == case["expect_failed"], case["name"]
