@@ -57,10 +57,13 @@ class EventBroadcaster:
 
         self._queue: "_queue.Queue" = _queue.Queue(maxsize=self.QUEUE_LEN)
         self._worker: Optional[threading.Thread] = None
+        self._shut = False
 
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
             with self._lock:
+                if self._shut:
+                    return
                 if self._worker is None or not self._worker.is_alive():
                     self._worker = threading.Thread(
                         target=self._drain, daemon=True, name="event-broadcaster"
@@ -82,7 +85,11 @@ class EventBroadcaster:
 
     def shutdown(self) -> None:
         """Flush queued events and stop the worker (the reference's
-        watch.Broadcaster.Shutdown)."""
+        watch.Broadcaster.Shutdown). Terminal: events recorded afterwards
+        (e.g. by still-draining bind threads) are dropped instead of
+        resurrecting the worker."""
+        with self._lock:
+            self._shut = True
         worker = self._worker
         if worker is None or not worker.is_alive():
             return
@@ -111,6 +118,8 @@ class EventBroadcaster:
     def _publish(self, ev: t.Event) -> None:
         import queue as _queue
 
+        if self._shut:
+            return
         self._ensure_worker()
         try:
             self._queue.put_nowait(ev)

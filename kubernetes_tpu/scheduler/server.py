@@ -100,15 +100,11 @@ class SchedulerServer:
             def _warm_then_run():
                 algo = config.algorithm
                 if hasattr(algo, "warmup"):
-                    deadline = time.time() + 2.0
-                    n = 0
-                    while time.time() < deadline:
-                        n = len(self.factory.node_lister.list())
-                        if n:
-                            break
-                        time.sleep(0.05)
-                    # no nodes yet: don't compile a made-up shape while
-                    # pods queue; open the loop and compile on demand
+                    # run_components() already waited for informer sync,
+                    # so an empty lister means a genuinely empty cluster:
+                    # open the loop immediately and compile on demand
+                    # rather than stalling queued pods on a made-up shape
+                    n = len(self.factory.node_lister.list())
                     if n:
                         algo.warmup(n)
                 self._thread = self.scheduler.run()

@@ -134,7 +134,23 @@ class TPUScheduleAlgorithm:
         with self._sched_lock:
             saved_last, saved_inc = self._last_node_index, self._inc
             try:
-                self._inc = None  # compile via the full-encode path
+                if saved_inc is not None:
+                    # daemon mode schedules off the incremental view, whose
+                    # static-array shapes (empty-vocab widths) differ from
+                    # the full encoder's padded ones — warming the wrong
+                    # program would leave the cold compile on the first
+                    # real wave. Feed a throwaway encoder the synthetic
+                    # cluster through the same cache-event seam.
+                    from kubernetes_tpu.snapshot.incremental import (
+                        IncrementalEncoder,
+                    )
+
+                    inc = IncrementalEncoder(config=self._wave.config)
+                    for n in nodes:
+                        inc.on_cache_event("node_set", n)
+                    self._inc = inc
+                else:
+                    self._inc = None  # compile via the full-encode path
                 self._schedule_backlog_locked(backlog, state)
             except Exception:
                 log.debug("scheduler warmup failed", exc_info=True)

@@ -690,13 +690,7 @@ class SnapshotEncoder:
         return snap
 
     def _set_table(self) -> np.ndarray:
-        w = self.widths
-        table = np.zeros((max(1, len(self.set_members)), w["LW"]), np.uint32)
-        for idx, fs in enumerate(self.set_members):
-            table[idx] = _pack_bits(
-                [self.kv.ids[kv] for kv in fs], w["LW"]
-            )
-        return table
+        return build_set_table(self.set_members, self.kv.ids, self.widths["LW"])
 
     def _taint_effect_mask(self, effect: str) -> np.ndarray:
         w = self.widths

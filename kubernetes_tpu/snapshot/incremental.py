@@ -421,6 +421,10 @@ class IncrementalEncoder:
             self._node_gone[slot] = True
             self._schedulable[slot] = False
             self._order_dirty = True
+            # the slot's name changed, so name_desc_order (device-resident
+            # between waves) must be re-shipped even though no node event
+            # fired -- wave_view's keep is driven by this flag
+            self._dirty_node_side = True
         return slot
 
     def _apply_pod_add(self, pod: Pod) -> None:

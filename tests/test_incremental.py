@@ -277,3 +277,93 @@ def test_incremental_decisions_match_oracle(seed):
             bound.spec.node_name = host
             cache.add_pod(bound)
             live_pods[bound.metadata.name] = bound
+
+
+def test_pod_on_unsynced_node_invalidates_name_order():
+    """A pod_add for a node the cache hasn't seen materializes a new slot
+    and changes name_desc_order; wave_view must not report it in `keep`
+    (a stale device copy would desync selectHost's tie-breaking)."""
+    cache = SchedulerCache(clock=FakeClock())
+    inc = IncrementalEncoder()
+    cache.add_listener(inc.on_cache_event)
+    rng = random.Random(0)
+    for i in range(4):
+        cache.add_node(rand_node(rng, f"node-{i:03d}"))
+
+    def plain_pod(name, node):
+        # identical class (namespace/labels) and no ports: introduces no
+        # new vocab entries, so no width growth masks the slot's dirt
+        return Pod(
+            metadata=ObjectMeta(name=name, labels={"app": "web"}),
+            spec=PodSpec(node_name=node,
+                         containers=[Container(requests={"cpu": "100m"})]),
+        )
+
+    cache.add_pod(plain_pod("seed", "node-000"))
+    snap1, _, _ = inc.wave_view([plain_pod("pend-0", "")])
+    assert snap1 is not None
+    # informer races: the pod lands before its node object syncs
+    cache.add_pod(plain_pod("racer", "zz-unsynced-node"))
+    # the wave-2 pending pod is shape-identical so no vocab growth
+    # re-dirties the node side by accident
+    snap2, _, keep = inc.wave_view([plain_pod("pend-1", "")])
+    assert snap2 is not None
+    changed = not np.array_equal(snap1.name_desc_order, snap2.name_desc_order)
+    assert changed
+    assert "name_desc_order" not in keep
+
+
+def test_daemon_warmup_compiles_incremental_shapes():
+    """warmup() in daemon mode must compile the programs the incremental
+    wave path will actually run — the full encoder's static shapes differ
+    (padded vocab widths), so warming via it leaves the cold compile on
+    the first real wave."""
+    cache = SchedulerCache(clock=FakeClock())
+    algo = TPUScheduleAlgorithm(cache=cache, service_lister=_Lister(),
+                                controller_lister=_Lister(),
+                                replica_set_lister=_Lister())
+    algo.warmup(6)
+    assert algo._wave.scan._jitted and algo._wave.probe._jitted
+    # now drive a real wave of the same shape through the daemon path
+    rng = random.Random(1)
+    for i in range(6):
+        cache.add_node(Node(
+            metadata=ObjectMeta(name=f"node-{i:03d}",
+                                labels={"app": "warm"}),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    pods = [Pod(metadata=ObjectMeta(name=f"p-{i}", labels={"app": "warm"}),
+                spec=PodSpec(containers=[
+                    Container(image="warm", requests={"cpu": "100m"})]))
+            for i in range(max(algo._wave.min_run, 2))]
+    state = restricted_state(cache)
+    import logging
+
+    import jax
+
+    compiles = []
+
+    class _H(logging.Handler):
+        def emit(self, r):
+            msg = r.getMessage()
+            if "Finished XLA compilation" in msg:
+                compiles.append(msg)
+
+    h = _H()
+    lg = logging.getLogger("jax._src.dispatch")
+    prev_level = lg.level
+    lg.addHandler(h)
+    lg.setLevel(logging.DEBUG)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        got = algo.schedule_backlog(pods, state)
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        lg.removeHandler(h)
+        lg.setLevel(prev_level)
+    assert all(g is not None for g in got)
+    # the wave must hit only programs warmup already compiled
+    assert not compiles, compiles
