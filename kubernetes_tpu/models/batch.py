@@ -47,6 +47,34 @@ NO_DISK_CONFLICT = "NoDiskConflict"
 NO_VOLUME_ZONE_CONFLICT = "NoVolumeZoneConflict"
 MAX_EBS_VOLUME_COUNT = "MaxEBSVolumeCount"
 MAX_GCE_PD_VOLUME_COUNT = "MaxGCEPDVolumeCount"
+# GeneralPredicates components, individually addressable so a Policy file
+# naming them resolves onto the device (plugins.go legacy keys)
+POD_FITS_RESOURCES = "PodFitsResources"
+POD_FITS_HOST_PORTS = "PodFitsHostPorts"
+POD_FITS_PORTS = "PodFitsPorts"  # legacy alias (defaults.go:77)
+HOST_NAME = "HostName"
+MATCH_NODE_SELECTOR = "MatchNodeSelector"
+
+
+def wants_resources(config: "SchedulerConfig") -> bool:
+    return (GENERAL_PREDICATES in config.predicates
+            or POD_FITS_RESOURCES in config.predicates)
+
+
+def wants_host(config: "SchedulerConfig") -> bool:
+    return (GENERAL_PREDICATES in config.predicates
+            or HOST_NAME in config.predicates)
+
+
+def wants_ports(config: "SchedulerConfig") -> bool:
+    return (GENERAL_PREDICATES in config.predicates
+            or POD_FITS_HOST_PORTS in config.predicates
+            or POD_FITS_PORTS in config.predicates)
+
+
+def wants_selector(config: "SchedulerConfig") -> bool:
+    return (GENERAL_PREDICATES in config.predicates
+            or MATCH_NODE_SELECTOR in config.predicates)
 
 LEAST_REQUESTED = "LeastRequestedPriority"
 BALANCED_ALLOCATION = "BalancedResourceAllocation"
@@ -183,24 +211,26 @@ def fit_mask(
             static["gce_bad"],
             config.max_gce_pd_volumes,
         )
-    if GENERAL_PREDICATES in config.predicates:
-        if include_resources:
-            fit = fit & P.pod_fits_resources(
-                pod["req_mcpu"],
-                pod["req_mem"],
-                pod["req_gpu"],
-                pod["zero_req"],
-                static["alloc_mcpu"],
-                static["alloc_mem"],
-                static["alloc_gpu"],
-                static["alloc_pods"],
-                req_mcpu,
-                req_mem,
-                req_gpu,
-                pod_count,
-            )
+    if wants_resources(config) and include_resources:
+        fit = fit & P.pod_fits_resources(
+            pod["req_mcpu"],
+            pod["req_mem"],
+            pod["req_gpu"],
+            pod["zero_req"],
+            static["alloc_mcpu"],
+            static["alloc_mem"],
+            static["alloc_gpu"],
+            static["alloc_pods"],
+            req_mcpu,
+            req_mem,
+            req_gpu,
+            pod_count,
+        )
+    if wants_host(config):
         fit = fit & P.pod_fits_host(pod["host_req"], static["alloc_mcpu"].shape[0])
+    if wants_ports(config):
         fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
+    if wants_selector(config):
         fit = fit & P.match_node_selector(
             pod["ns_ops"],
             pod["ns_key"],

@@ -53,6 +53,8 @@ from kubernetes_tpu.models.batch import (
     SchedulerConfig,
     fit_mask,
     interpod_carry_tables,
+    wants_ports,
+    wants_resources,
 )
 from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
@@ -119,7 +121,7 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
     )
 
     j = jnp.arange(J, dtype=jnp.int64)[:, None]  # (J, 1)
-    if GENERAL_PREDICATES in config.predicates:
+    if wants_resources(config):
         res_fit = P.pod_fits_resources(
             pod["req_mcpu"],
             pod["req_mem"],
@@ -134,12 +136,13 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
             req_gpu[None, :] + j * pod["commit_gpu"],
             pod_count[None, :] + j,
         )
+    else:
+        res_fit = jnp.ones((J, N), bool)
+    if wants_ports(config):
         # host-port self-conflict: once one copy holds the pod's host
         # ports on a node, no further copy fits there (predicates.go:574)
         has_ports = (pod["port_mask"] != 0).any()
         res_fit = res_fit & ((j == 0) | ~has_ports)
-    else:
-        res_fit = jnp.ones((J, N), bool)
 
     nzj_cpu = nz_mcpu[None, :] + j * pod["nz_mcpu"]
     nzj_mem = nz_mem[None, :] + j * pod["nz_mem"]

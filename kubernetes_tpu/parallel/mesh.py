@@ -18,21 +18,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
 from kubernetes_tpu.models.batch import (
+    CHECK_NODE_MEMORY_PRESSURE,
     INTER_POD_AFFINITY,
     MATCH_INTER_POD_AFFINITY,
     MAX_EBS_VOLUME_COUNT,
     MAX_GCE_PD_VOLUME_COUNT,
     NO_DISK_CONFLICT,
     NO_VOLUME_ZONE_CONFLICT,
+    POD_TOLERATES_NODE_TAINTS,
     BatchScheduler,
     SchedulerConfig,
+    wants_host,
+    wants_ports,
+    wants_resources,
+    wants_selector,
 )
 from kubernetes_tpu.ops import interpod as IP
 from kubernetes_tpu.ops import predicates as P
 from kubernetes_tpu.ops import select as S
 from kubernetes_tpu.ops import priorities as R
+from kubernetes_tpu.ops import services as SV
 from kubernetes_tpu.ops import volumes as V
-from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
+from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch, service_config_labels
 
 AXIS = "nodes"
 
@@ -88,7 +95,7 @@ def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
     return dataclasses.replace(snap, **fields)
 
 
-def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
+def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values, static, carry, pod):
     """Per-shard scan body. `static`/`carry` node arrays hold this shard's
     slice; `pod` is replicated. Mirrors models.batch._scan_fn with the
     normalization maxes and selection made global via collectives."""
@@ -153,58 +160,78 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
             pod["vp_gce"], pod["vp_gce_bad"], pod["vp_has_gce"],
             gce_mask, static["gce_bad"], config.max_gce_pd_volumes,
         )
-    fit = fit & P.pod_fits_resources(
-        pod["req_mcpu"],
-        pod["req_mem"],
-        pod["req_gpu"],
-        pod["zero_req"],
-        static["alloc_mcpu"],
-        static["alloc_mem"],
-        static["alloc_gpu"],
-        static["alloc_pods"],
-        req_mcpu,
-        req_mem,
-        req_gpu,
-        pod_count,
-    )
+    if wants_resources(config):
+        fit = fit & P.pod_fits_resources(
+            pod["req_mcpu"],
+            pod["req_mem"],
+            pod["req_gpu"],
+            pod["zero_req"],
+            static["alloc_mcpu"],
+            static["alloc_mem"],
+            static["alloc_gpu"],
+            static["alloc_pods"],
+            req_mcpu,
+            req_mem,
+            req_gpu,
+            pod_count,
+        )
     # host check against GLOBAL node ids
     local_ids = offset + jnp.arange(n_per_shard, dtype=jnp.int32)
-    fit = fit & jnp.where(
-        pod["host_req"] < 0, pod["host_req"] == -1, local_ids == pod["host_req"]
-    )
-    fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
-    fit = fit & P.match_node_selector(
-        pod["ns_ops"],
-        pod["ns_key"],
-        pod["ns_set"],
-        pod["ns_numkey"],
-        pod["ns_num"],
-        pod["aff_has_req"],
-        pod["aff_term_valid"],
-        pod["aff_ops"],
-        pod["aff_key"],
-        pod["aff_set"],
-        pod["aff_numkey"],
-        pod["aff_num"],
-        static["label_kv"],
-        static["label_key"],
-        static["numval"],
-        static["set_table"],
-    )
-    fit = fit & P.pod_tolerates_node_taints(
-        pod["tol_mask"],
-        pod["has_tolerations"],
-        static["taint_mask"],
-        static["has_taints"],
-        static["taint_bad"],
-        static["noschedule_taints"],
-    )
-    fit = fit & P.check_node_memory_pressure(pod["best_effort"], static["mem_pressure"])
+    if wants_host(config):
+        fit = fit & jnp.where(
+            pod["host_req"] < 0, pod["host_req"] == -1, local_ids == pod["host_req"]
+        )
+    if wants_ports(config):
+        fit = fit & P.pod_fits_host_ports(pod["port_mask"], port_mask)
+    if wants_selector(config):
+        fit = fit & P.match_node_selector(
+            pod["ns_ops"],
+            pod["ns_key"],
+            pod["ns_set"],
+            pod["ns_numkey"],
+            pod["ns_num"],
+            pod["aff_has_req"],
+            pod["aff_term_valid"],
+            pod["aff_ops"],
+            pod["aff_key"],
+            pod["aff_set"],
+            pod["aff_numkey"],
+            pod["aff_num"],
+            static["label_kv"],
+            static["label_key"],
+            static["numval"],
+            static["set_table"],
+        )
+    if POD_TOLERATES_NODE_TAINTS in config.predicates:
+        fit = fit & P.pod_tolerates_node_taints(
+            pod["tol_mask"],
+            pod["has_tolerations"],
+            static["taint_mask"],
+            static["has_taints"],
+            static["taint_bad"],
+            static["noschedule_taints"],
+        )
+    if CHECK_NODE_MEMORY_PRESSURE in config.predicates:
+        fit = fit & P.check_node_memory_pressure(pod["best_effort"], static["mem_pressure"])
+    svc_labels = service_config_labels(config)
     for entry in config.predicates:
         if isinstance(entry, tuple) and entry[0] == "CheckNodeLabelPresence":
             for lbl in entry[1]:
                 has = static[f"nl_pred_{lbl}"]
                 fit = fit & (has if entry[2] else ~has)
+        elif isinstance(entry, tuple) and entry[0] == "ServiceAffinity":
+            # svc tables are replicated (small: groups x labels); evaluate
+            # over the GLOBAL node axis and slice this shard's window
+            ok_g = SV.service_affinity(
+                svc_first_peer,
+                static["svc_lbl_val"],
+                static["svc_ord_node"],
+                pod["svc_group"],
+                pod["svc_fixed"],
+                tuple(svc_labels.index(l) for l in entry[1]),
+                n_global,
+            )
+            fit = fit & jax.lax.dynamic_slice_in_dim(ok_g, offset, n_per_shard)
     if want_ip_pred:
         own_lt = IP.gather_lt(
             ip_own_anti, static["ip_u_topo"], topo_local,
@@ -296,6 +323,20 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
             s = R.image_locality(static["img_size"], pod["img_count"])
         elif isinstance(name, tuple) and name[0] == "NodeLabelPriority":
             s = R.node_label(static[f"nl_prio_{name[1]}"], name[2])
+        elif isinstance(name, tuple) and name[0] == "ServiceAntiAffinity":
+            # the spread normalizer counts peers on the global filtered
+            # node list: gather fit, score globally, slice local window
+            fit_g_svc = jax.lax.all_gather(fit, AXIS, tiled=True)
+            s_g = SV.service_anti_affinity(
+                svc_peer_node_count,
+                svc_peer_total,
+                static["svc_lbl_val"][svc_labels.index(name[1])],
+                pod["svc_group"],
+                fit_g_svc,
+                num_values,
+                n_global,
+            )
+            s = jax.lax.dynamic_slice_in_dim(s_g, offset, n_per_shard)
         else:
             raise ValueError(name)
         score = score + jnp.int64(weight) * s
@@ -360,6 +401,17 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, static, carry, pod):
         vol_rw = vol_rw.at[safe].set(vol_rw[safe] | (pod["vp_vol_rw"] & sel))
         ebs_mask = ebs_mask.at[safe].set(ebs_mask[safe] | (pod["vp_ebs"] & sel))
         gce_mask = gce_mask.at[safe].set(gce_mask[safe] | (pod["vp_gce"] & sel))
+
+    if svc_labels:
+        svc_first_peer, svc_peer_node_count, svc_peer_total = SV.service_commit(
+            svc_first_peer,
+            svc_peer_node_count,
+            svc_peer_total,
+            static["svc_node_ord"],
+            pod["svc_member"],
+            chosen,
+            scheduled,
+        )
 
     carry = (
         res, port_mask, class_count, last_idx,
@@ -427,13 +479,6 @@ class MeshBatchScheduler:
     def schedule(
         self, snap: ClusterSnapshot, batch: PodBatch, last_node_index: int = 0
     ):
-        from kubernetes_tpu.snapshot.encode import service_config_labels
-
-        if service_config_labels(self.config):
-            raise NotImplementedError(
-                "ServiceAffinity/ServiceAntiAffinity are not implemented on "
-                "the mesh path yet; use the single-chip BatchScheduler"
-            )
         n_dev = self.mesh.devices.size
         if len(snap.node_names) == 0:
             sched = BatchScheduler(self.config)
@@ -481,16 +526,19 @@ class MeshBatchScheduler:
             # volume masks: node-axis sharded
             PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
             PSpec(AXIS, None),
-            # service-group tables (zero-width on this path)
+            # service-group tables: replicated (small: groups x labels);
+            # every shard applies identical commits with global indices
             PSpec(), PSpec(), PSpec(),
         )
         pod_specs = {k: PSpec() for k in pods}
 
-        key = (n, n_per_shard, batch.num_pods, num_zones)
+        num_values = int(snap.svc_num_values)
+        key = (n, n_per_shard, batch.num_pods, num_zones, num_values)
         run = self._jitted.get(key)
         if run is None:
             body = functools.partial(
-                _mesh_scan_fn, self.config, num_zones, n_per_shard
+                _mesh_scan_fn, self.config, num_zones, n_per_shard, n,
+                num_values,
             )
 
             def spmd(static_, carry_, pods_):

@@ -35,7 +35,11 @@ from kubernetes_tpu.scheduler.core import (
     SchedulerConfig,
 )
 from kubernetes_tpu.scheduler.extender import HTTPExtender
-from kubernetes_tpu.scheduler.policy import Policy, resolve_policy
+from kubernetes_tpu.scheduler.policy import (
+    Policy,
+    resolve_policy,
+    resolve_policy_tpu,
+)
 from kubernetes_tpu.utils.flowcontrol import Backoff
 
 log = logging.getLogger(__name__)
@@ -186,10 +190,33 @@ class ConfigFactory:
         )
 
     def create_from_config(self, policy: Policy) -> SchedulerConfig:
-        """factory.go:266 CreateFromConfig (Policy JSON)."""
+        """factory.go:266 CreateFromConfig (Policy JSON).
+
+        A fully device-expressible policy resolves onto the TPU program
+        (resolve_policy_tpu) so --policy-config-file users keep the
+        batched path; extender-bearing or custom entries — and an
+        explicit provider: DefaultProvider escape hatch — run the host
+        GenericScheduler."""
         if policy.provider and not (policy.predicates or policy.priorities):
             return self.create_from_provider(policy.provider)
         args = self.plugin_args()
+        if policy.provider != "DefaultProvider":
+            device_cfg = resolve_policy_tpu(
+                policy, args.hard_pod_affinity_weight
+            )
+            if device_cfg is not None:
+                from kubernetes_tpu.scheduler.tpu_algorithm import (
+                    TPUScheduleAlgorithm,
+                )
+
+                algorithm = TPUScheduleAlgorithm(
+                    cache=self.scheduler_cache,
+                    service_lister=self.service_lister,
+                    controller_lister=self.controller_lister,
+                    replica_set_lister=self.replica_set_lister,
+                    config=device_cfg,
+                )
+                return self._make_config(algorithm)
         predicates, priorities = resolve_policy(policy, args)
         extenders = [HTTPExtender(e) for e in policy.extenders]
         algorithm = ExtendedGenericScheduler(

@@ -130,6 +130,94 @@ def load_policy(text_or_path: str) -> Policy:
     return policy
 
 
+# Policy names the device program can express directly. Anything else
+# (custom-registered predicates, extenders) falls back to the host path.
+_DEVICE_PREDICATES = frozenset({
+    "GeneralPredicates", "PodFitsResources", "PodFitsHostPorts",
+    "PodFitsPorts", "HostName", "MatchNodeSelector",
+    "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
+    "MatchInterPodAffinity", "NoDiskConflict", "NoVolumeZoneConflict",
+    "MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
+})
+_DEVICE_PRIORITIES = frozenset({
+    "LeastRequestedPriority", "BalancedResourceAllocation",
+    "SelectorSpreadPriority", "ServiceSpreadingPriority",
+    "NodeAffinityPriority", "TaintTolerationPriority",
+    "InterPodAffinityPriority", "EqualPriority", "ImageLocalityPriority",
+})
+
+
+def resolve_policy_tpu(policy: Policy, hard_pod_affinity_weight: int = 1):
+    """Map a Policy onto the device SchedulerConfig (the TPU end of
+    factory.go:266 CreateFromConfig). Every argument form —
+    ServiceAffinity, ServiceAntiAffinity, LabelsPresence/LabelPreference —
+    compiles to a config-parameterized program entry. Returns None when
+    any entry needs the host path (extenders, custom names); the caller
+    then falls back to resolve_policy."""
+    from kubernetes_tpu.models.batch import (
+        NODE_LABEL_PREDICATE,
+        NODE_LABEL_PRIORITY,
+        SELECTOR_SPREAD,
+        SERVICE_AFFINITY,
+        SERVICE_ANTI_AFFINITY,
+        SchedulerConfig as DeviceConfig,
+    )
+    from kubernetes_tpu.scheduler.algorithmprovider import _max_pd_vols
+
+    if policy.extenders:
+        return None
+    # the device programs mask padding dummy nodes (and the incremental
+    # encoder's freed slots) through zeroed allocatable, which only bites
+    # when the resource predicate is active — a policy without one runs
+    # on the host path
+    names = {p.name for p in policy.predicates}
+    if not names & {"GeneralPredicates", "PodFitsResources"}:
+        return None
+    pred_out = []
+    for p in policy.predicates:
+        if p.service_affinity_labels is not None:
+            pred_out.append(
+                (SERVICE_AFFINITY, tuple(p.service_affinity_labels))
+            )
+        elif p.labels_presence is not None:
+            pred_out.append(
+                (NODE_LABEL_PREDICATE, tuple(p.labels_presence),
+                 p.labels_presence_required)
+            )
+        elif p.name in _DEVICE_PREDICATES:
+            pred_out.append(p.name)
+        else:
+            return None
+    prio_out = []
+    for p in policy.priorities:
+        if p.service_anti_affinity_label:
+            prio_out.append(
+                ((SERVICE_ANTI_AFFINITY, p.service_anti_affinity_label),
+                 p.weight)
+            )
+        elif p.label_preference:
+            prio_out.append(
+                ((NODE_LABEL_PRIORITY, p.label_preference,
+                  p.label_preference_presence), p.weight)
+            )
+        elif p.name == "ServiceSpreadingPriority":
+            # legacy alias of the spreading scorer (defaults.go:66)
+            prio_out.append((SELECTOR_SPREAD, p.weight))
+        elif p.name in _DEVICE_PRIORITIES:
+            prio_out.append((p.name, p.weight))
+        else:
+            return None
+    from kubernetes_tpu.oracle import predicates as opreds
+
+    return DeviceConfig(
+        predicates=tuple(pred_out),
+        priorities=tuple(prio_out),
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
+        max_ebs_volumes=_max_pd_vols(opreds.DEFAULT_MAX_EBS_VOLUMES),
+        max_gce_pd_volumes=_max_pd_vols(opreds.DEFAULT_MAX_GCE_PD_VOLUMES),
+    )
+
+
 def resolve_policy(policy: Policy, args: plugins.PluginFactoryArgs):
     """CreateFromConfig (factory.go:266): register custom predicate/
     priority argument forms, then resolve keys -> closures.

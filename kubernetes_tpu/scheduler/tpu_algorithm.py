@@ -33,18 +33,21 @@ def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
 class TPUScheduleAlgorithm:
     def __init__(self, mesh=None, min_run: int = 16, cache=None,
                  service_lister=None, controller_lister=None,
-                 replica_set_lister=None):
+                 replica_set_lister=None, config=None):
+        """config: a models/batch SchedulerConfig overriding the default
+        provider — the device end of a resolved Policy file
+        (factory.go:266 CreateFromConfig)."""
         self._mesh_sched = None
         self._inc = None
         if mesh is not None:
             from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
 
-            self._mesh_sched = MeshBatchScheduler(mesh)
+            self._mesh_sched = MeshBatchScheduler(mesh, config=config)
             self._sched = self._mesh_sched
         else:
             from kubernetes_tpu.models.wave import WaveScheduler
 
-            self._wave = WaveScheduler(min_run=min_run)
+            self._wave = WaveScheduler(config=config, min_run=min_run)
             self._sched = self._wave.scan
             if cache is not None:
                 # daemon mode: maintain the snapshot incrementally from

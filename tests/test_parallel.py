@@ -73,3 +73,76 @@ def test_mesh_volumes_match_oracle(mesh, seed):
     snap, batch = SnapshotEncoder(state, pending).encode()
     sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
     assert sharded == oracle_result
+
+
+def test_mesh_service_affinity_matches_oracle(mesh):
+    """ServiceAffinity on the mesh: replicated svc tables, global-axis
+    evaluation sliced per shard, identical commits on every shard —
+    bit-identical to the serial oracle (incl. 9->16 node padding)."""
+    from kubernetes_tpu.oracle import ClusterState
+    from tests.test_conformance import (
+        _run_both_svc,
+        _svc_affinity_cluster,
+        _svc_pod,
+    )
+
+    nodes, services = _svc_affinity_cluster()
+    state = ClusterState.build(
+        nodes,
+        services=services,
+        assigned_pods=[_svc_pod("web-0", {"app": "web"}, node="node-0")],
+    )
+    pending = [
+        _svc_pod("web-1", {"app": "web"}),
+        _svc_pod("db-1", {"app": "db"}),
+        _svc_pod("web-2", {"app": "web"}),
+        _svc_pod("lone", {"app": "none"}),
+        _svc_pod("db-2", {"app": "db"}),
+    ]
+    oracle_result, single = _run_both_svc(state, pending)
+    assert single == oracle_result  # precondition: single-chip conformance
+
+    cfg = SchedulerConfig(
+        predicates=("GeneralPredicates", ("ServiceAffinity", ("region",))),
+        priorities=(("LeastRequestedPriority", 1),),
+    )
+    snap, batch = SnapshotEncoder(state, pending, config=cfg).encode()
+    sharded = MeshBatchScheduler(mesh, config=cfg).schedule_names(snap, batch)
+    assert sharded == oracle_result
+
+
+def test_mesh_service_anti_affinity_matches_oracle(mesh):
+    """ServiceAntiAffinity spreading on the mesh: the per-value peer
+    normalizer counts over the globally gathered fit mask."""
+    from kubernetes_tpu.oracle import ClusterState
+    from tests.test_conformance import (
+        _run_both_svc,
+        _svc_affinity_cluster,
+        _svc_pod,
+    )
+
+    nodes, services = _svc_affinity_cluster()
+    state = ClusterState.build(
+        nodes,
+        services=services,
+        assigned_pods=[
+            _svc_pod("web-0", {"app": "web"}, node="node-0"),
+            _svc_pod("web-1", {"app": "web"}, node="node-1"),
+        ],
+    )
+    pending = [
+        _svc_pod(f"web-{i}", {"app": "web"}) for i in range(2, 8)
+    ] + [_svc_pod("db-1", {"app": "db"})]
+    oracle_result, single = _run_both_svc(
+        state, pending, labels=("region",), anti_label="rack"
+    )
+    assert single == oracle_result
+
+    cfg = SchedulerConfig(
+        predicates=("GeneralPredicates", ("ServiceAffinity", ("region",))),
+        priorities=(("LeastRequestedPriority", 1),
+                    (("ServiceAntiAffinity", "rack"), 2)),
+    )
+    snap, batch = SnapshotEncoder(state, pending, config=cfg).encode()
+    sharded = MeshBatchScheduler(mesh, config=cfg).schedule_names(snap, batch)
+    assert sharded == oracle_result

@@ -1,0 +1,229 @@
+"""Policy JSON resolving onto the device program (factory.go:266
+CreateFromConfig, TPU path).
+
+A --policy-config-file that names only device-expressible predicates/
+priorities — including the ServiceAffinity / ServiceAntiAffinity /
+LabelsPresence / LabelPreference argument forms (api/types.go:60-94) —
+must schedule through the batched TPU algorithm, not drop to the host
+loop. Extender-bearing policies and an explicit provider escape hatch
+still take the host path.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.models.batch import (
+    NODE_LABEL_PREDICATE,
+    NODE_LABEL_PRIORITY,
+    SERVICE_AFFINITY,
+    SERVICE_ANTI_AFFINITY,
+)
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.oracle import predicates as opreds
+from kubernetes_tpu.oracle import priorities as oprios
+from kubernetes_tpu.oracle.scheduler import PriorityConfig
+from kubernetes_tpu.scheduler.policy import (
+    load_policy,
+    resolve_policy_tpu,
+)
+from kubernetes_tpu.scheduler.server import (
+    SchedulerServer,
+    SchedulerServerOptions,
+)
+from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+
+POLICY = {
+    "kind": "Policy",
+    "apiVersion": "v1",
+    "predicates": [
+        {"name": "GeneralPredicates"},
+        {"name": "PodToleratesNodeTaints"},
+        {"name": "ZoneAffinity",
+         "argument": {"serviceAffinity": {"labels": ["zone"]}}},
+        {"name": "RequireSSD",
+         "argument": {"labelsPresence": {"labels": ["disktype"],
+                                         "presence": True}}},
+    ],
+    "priorities": [
+        {"name": "LeastRequestedPriority", "weight": 1},
+        {"name": "BalancedResourceAllocation", "weight": 1},
+        {"name": "ZoneSpread", "weight": 2,
+         "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+        {"name": "PreferDDR", "weight": 1,
+         "argument": {"labelPreference": {"label": "memtype",
+                                          "presence": True}}},
+    ],
+}
+
+
+def _nodes(n=6):
+    out = []
+    for i in range(n):
+        labels = {
+            "kubernetes.io/hostname": f"n{i}",
+            "zone": f"z{i % 3}",
+            "disktype": "ssd",
+        }
+        if i % 2:
+            labels["memtype"] = "ddr"
+        out.append(t.Node(
+            metadata=t.ObjectMeta(name=f"n{i}", labels=labels),
+            status=t.NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[t.NodeCondition("Ready", "True")],
+            ),
+        ))
+    # one node without the required disktype label: LabelsPresence must
+    # exclude it on the device exactly as on the host
+    out.append(t.Node(
+        metadata=t.ObjectMeta(name=f"n{n}",
+                              labels={"kubernetes.io/hostname": f"n{n}",
+                                      "zone": "z0"}),
+        status=t.NodeStatus(
+            allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            conditions=[t.NodeCondition("Ready", "True")],
+        ),
+    ))
+    return out
+
+
+def _pods(n=30):
+    return [
+        t.Pod(
+            metadata=t.ObjectMeta(name=f"p{i:03d}",
+                                  labels={"app": "web" if i % 2 else "db"}),
+            spec=t.PodSpec(containers=[
+                t.Container(requests={"cpu": "100m", "memory": "200Mi"})
+            ]),
+        )
+        for i in range(n)
+    ]
+
+
+def test_resolve_policy_tpu_maps_every_argument_form():
+    policy = load_policy(json.dumps(POLICY))
+    cfg = resolve_policy_tpu(policy, hard_pod_affinity_weight=3)
+    assert cfg is not None
+    assert "GeneralPredicates" in cfg.predicates
+    assert (SERVICE_AFFINITY, ("zone",)) in cfg.predicates
+    assert (NODE_LABEL_PREDICATE, ("disktype",), True) in cfg.predicates
+    assert ((SERVICE_ANTI_AFFINITY, "zone"), 2) in cfg.priorities
+    assert ((NODE_LABEL_PRIORITY, "memtype", True), 1) in cfg.priorities
+    assert cfg.hard_pod_affinity_weight == 3
+
+
+def test_resolve_policy_tpu_rejects_host_only_entries():
+    ext = dict(POLICY)
+    ext["extenders"] = [{"urlPrefix": "http://x", "filterVerb": "f",
+                         "weight": 1}]
+    assert resolve_policy_tpu(load_policy(json.dumps(ext))) is None
+    custom = {"kind": "Policy",
+              "predicates": [{"name": "SomeCustomPredicate"}],
+              "priorities": []}
+    # unknown name: not registered either, so load alone is fine but the
+    # device mapping must decline
+    assert resolve_policy_tpu(load_policy(json.dumps(custom))) is None
+
+
+def test_policy_file_schedules_through_device():
+    """CreateFromConfig end-to-end: a daemon started with a policy file
+    runs the TPU algorithm and its decisions match the host oracle
+    resolved from the same policy."""
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    nodes = _nodes()
+    for n in nodes:
+        client.nodes().create(n)
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(POLICY, f)
+        path = f.name
+    try:
+        srv = SchedulerServer(
+            client, SchedulerServerOptions(policy_config_file=path)
+        ).start()
+        try:
+            algo = srv.scheduler.config.algorithm
+            assert isinstance(algo, TPUScheduleAlgorithm)
+            pods = _pods()
+            for p in pods:
+                client.pods().create(p)
+
+            def all_assigned():
+                objs, _ = client.pods().list()
+                return all(o.spec.node_name for o in objs)
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all_assigned():
+                time.sleep(0.05)
+            objs, _ = client.pods().list()
+            got = {o.metadata.name: o.spec.node_name for o in objs}
+            assert all(got.values()), got
+            # the LabelsPresence predicate must have excluded n6
+            assert "n6" not in set(got.values())
+        finally:
+            srv.stop()
+    finally:
+        os.unlink(path)
+
+    # host oracle resolved from the same policy, replayed serially
+    state = ClusterState.build(nodes)
+    oracle = GenericScheduler(
+        predicates=[
+            ("GeneralPredicates", opreds.general_predicates),
+            ("PodToleratesNodeTaints", opreds.pod_tolerates_node_taints),
+            ("ZoneAffinity", opreds.service_affinity_predicate(["zone"])),
+            ("RequireSSD", opreds.node_label_predicate(["disktype"], True)),
+        ],
+        priorities=[
+            PriorityConfig(oprios.least_requested_priority, 1,
+                           "LeastRequestedPriority"),
+            PriorityConfig(oprios.balanced_resource_allocation, 1,
+                           "BalancedResourceAllocation"),
+            PriorityConfig(oprios.service_anti_affinity_priority("zone"), 2,
+                           "ZoneSpread"),
+            PriorityConfig(oprios.node_label_priority("memtype", True), 1,
+                           "PreferDDR"),
+        ],
+    )
+    expected = oracle.schedule_backlog(_pods(), state)
+    assert [got[f"p{i:03d}"] for i in range(len(expected))] == expected
+
+
+def test_policy_provider_escape_hatch_uses_host_path():
+    policy = dict(POLICY)
+    policy["provider"] = "DefaultProvider"
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    for n in _nodes():
+        client.nodes().create(n)
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(policy, f)
+        path = f.name
+    try:
+        srv = SchedulerServer(
+            client, SchedulerServerOptions(policy_config_file=path)
+        ).start()
+        try:
+            algo = srv.scheduler.config.algorithm
+            assert not isinstance(algo, TPUScheduleAlgorithm)
+        finally:
+            srv.stop()
+    finally:
+        os.unlink(path)
+
+
+def test_policy_without_resource_predicate_stays_on_host():
+    """Pad-node masking on the device relies on the resource predicate
+    (zeroed allocatable); a policy omitting it must run the host path."""
+    p = {"kind": "Policy",
+         "predicates": [{"name": "PodToleratesNodeTaints"}],
+         "priorities": [{"name": "EqualPriority", "weight": 1}]}
+    assert resolve_policy_tpu(load_policy(json.dumps(p))) is None
