@@ -91,6 +91,16 @@ def start_http_server(api: APIServer, host: str, port: int):
             self.wfile.write(data)
 
         def _stream_watch(self, watch: WatchResponse) -> None:
+            # registered so shutdown can terminate long-running streams:
+            # a "killed" apiserver must not keep zombie watches alive
+            # feeding keepalives to clients that should be reconnecting
+            with self.server._watch_lock:
+                if self.server._watches_closed:
+                    # shutdown raced this stream's registration: end it
+                    # now rather than serve from a "dead" apiserver
+                    watch.stop()
+                else:
+                    self.server._active_watches.append(watch)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
@@ -110,6 +120,11 @@ def start_http_server(api: APIServer, host: str, port: int):
                 pass
             finally:
                 watch.stop()
+                with self.server._watch_lock:
+                    try:
+                        self.server._active_watches.remove(watch)
+                    except ValueError:
+                        pass
 
         def do_GET(self):
             self._dispatch("GET")
@@ -130,7 +145,18 @@ def start_http_server(api: APIServer, host: str, port: int):
         daemon_threads = True
         allow_reuse_address = True
 
+        def stop_watches(self) -> None:
+            with self._watch_lock:
+                self._watches_closed = True
+                watches = list(self._active_watches)
+                del self._active_watches[:]
+            for w in watches:
+                w.stop()
+
     server = Server((host, port), Handler)
+    server._watch_lock = threading.Lock()
+    server._active_watches = []
+    server._watches_closed = False
     thread = threading.Thread(
         target=server.serve_forever, name="apiserver-http", daemon=True
     )

@@ -157,8 +157,19 @@ class APIServer:
         auto_provision_namespaces: bool = True,
         authenticator=None,
         authorizer=None,
+        data_dir: Optional[str] = None,
     ):
-        self.store = store or MemoryStore()
+        """data_dir: persist the store (WAL + snapshot) so a restarted
+        apiserver resumes with full state and RV continuity — the role
+        etcd plays for the reference (storage/durable.py)."""
+        if store is None:
+            if data_dir:
+                from kubernetes_tpu.storage.durable import FileStore
+
+                store = FileStore(data_dir)
+            else:
+                store = MemoryStore()
+        self.store = store
         self.scheme = scheme or default_scheme
         self.resources = default_resources()
         self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
@@ -537,4 +548,11 @@ class APIServer:
     def shutdown_http(self) -> None:
         if self._http_server is not None:
             self._http_server.shutdown()
+            # terminate long-running watch streams (a dead apiserver must
+            # not keep feeding keepalives to clients that should
+            # reconnect) and release the listening socket so a restarted
+            # apiserver can rebind the same port immediately
+            if hasattr(self._http_server, "stop_watches"):
+                self._http_server.stop_watches()
+            self._http_server.server_close()
             self._http_server = None

@@ -35,7 +35,7 @@ def _wait_forever():
 def run_apiserver(args) -> None:
     from kubernetes_tpu.apiserver.server import APIServer
 
-    server = APIServer()
+    server = APIServer(data_dir=args.data_dir or None)
     host, port = server.serve_http(port=args.port)
     print(f"kube-apiserver listening on http://{host}:{port}", flush=True)
     _wait_forever()
@@ -98,7 +98,7 @@ def run_local_up(args) -> None:
         SchedulerServerOptions,
     )
 
-    server = APIServer()
+    server = APIServer(data_dir=args.data_dir or None)
     host, port = server.serve_http(port=args.port)
     client = _client(f"http://{host}:{port}")
     cluster = HollowCluster(client, args.nodes).run()
@@ -125,6 +125,11 @@ def main(argv=None):
 
     p = sub.add_parser("apiserver")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument(
+        "--data-dir", default="",
+        help="persist the store here (WAL + snapshot); restarting with "
+        "the same dir recovers all state with RV continuity",
+    )
 
     for name in ("scheduler", "controller-manager"):
         p = sub.add_parser(name)
@@ -145,6 +150,8 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--nodes", type=int, default=3)
     p.add_argument("--algorithm-provider", default="TPUProvider")
+    p.add_argument("--data-dir", default="",
+                   help="persist the apiserver store (WAL + snapshot)")
 
     args = ap.parse_args(argv)
     {

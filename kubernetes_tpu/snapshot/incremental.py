@@ -521,16 +521,12 @@ class IncrementalEncoder:
     # -- wave view -----------------------------------------------------------
 
     def _config_ok(self) -> bool:
-        from kubernetes_tpu.models.batch import (
-            GENERAL_PREDICATES,
-            SERVICE_AFFINITY,
-            SERVICE_ANTI_AFFINITY,
-        )
+        from kubernetes_tpu.models.batch import wants_resources
 
         cfg = self.config
         if cfg is None:
             return True
-        if GENERAL_PREDICATES not in cfg.predicates:
+        if not wants_resources(cfg):
             return False  # free slots are masked via zeroed allocatable
         if service_config_labels(cfg):
             return False  # SA/SAA programs need the full compiler

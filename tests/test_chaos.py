@@ -174,3 +174,53 @@ def test_assumed_pod_ttl_self_heals():
     cache.cleanup_expired(clock.now())
     state = cache.snapshot()
     assert state.node_infos["n1"].requested_milli_cpu == 0
+
+
+def test_apiserver_restart_mid_backlog(tmp_path):
+    """Kill and restart the apiserver (the one component whose death was
+    previously unrecoverable) mid-backlog: the durable store recovers
+    every object with RV continuity, reflectors relist through the
+    Compacted horizon, and the scheduler drains the rest of the backlog.
+    Matches the role of etcd-as-only-checkpoint (SURVEY 5.4)."""
+    from kubernetes_tpu.client.transport import HTTPTransport
+
+    data_dir = str(tmp_path / "etcd")
+    api1 = APIServer(data_dir=data_dir)
+    host, port = api1.serve_http()
+    client = RESTClient(HTTPTransport(f"http://{host}:{port}", timeout=5.0))
+    for i in range(4):
+        client.nodes().create(ready_node(f"n{i}"))
+    sched = SchedulerServer(
+        client, SchedulerServerOptions(algorithm_provider="TPUProvider")
+    ).start()
+    try:
+        for i in range(30):
+            client.pods().create(pending_pod(f"pre-{i:03d}"))
+        assert wait_until(lambda: n_bound(client) >= 10)
+
+        # --- kill the apiserver process (HTTP down, store dropped) ---
+        api1.shutdown_http()
+        api1.store.close()
+        del api1
+        time.sleep(0.3)
+
+        # --- restart on the same port from the same data_dir ---
+        api2 = APIServer(data_dir=data_dir)
+        api2.serve_http(host=host, port=port)
+        try:
+            objs, _ = client.pods().list()
+            assert len(objs) == 30, "recovered store lost pods"
+            bound_before = sum(1 for p in objs if p.spec.node_name)
+            assert bound_before >= 10, "recovered store lost bindings"
+            # new work + the unfinished backlog drain through the same
+            # scheduler: its reflectors must recover on their own
+            for i in range(10):
+                client.pods().create(pending_pod(f"post-{i:02d}"))
+            assert wait_until(lambda: n_bound(client) == 40, timeout=40), (
+                f"stuck at {n_bound(client)}/40 bound"
+            )
+        finally:
+            api2.shutdown_http()
+            api2.store.close()
+    finally:
+        sched.stop()
