@@ -1,6 +1,7 @@
 """hyperkube (cmd/hyperkube): every component behind one entry point.
 
     python -m kubernetes_tpu.hyperkube apiserver --port 8080
+    python -m kubernetes_tpu.hyperkube extender --port 8090
     python -m kubernetes_tpu.hyperkube scheduler --server http://...
     python -m kubernetes_tpu.hyperkube controller-manager --server http://...
     python -m kubernetes_tpu.hyperkube kubelet --server http://... --node n1
@@ -38,6 +39,21 @@ def run_apiserver(args) -> None:
     server = APIServer(data_dir=args.data_dir or None)
     host, port = server.serve_http(port=args.port)
     print(f"kube-apiserver listening on http://{host}:{port}", flush=True)
+    _wait_forever()
+
+
+def run_extender(args) -> None:
+    """Serve the TPU program as a scheduler-extender HTTP service
+    (Filter/Prioritize + bulk ScheduleBacklog) for external schedulers."""
+    from kubernetes_tpu.scheduler.extender_server import TPUExtenderServer
+
+    server = TPUExtenderServer()
+    host, port = server.serve_http(port=args.port)
+    print(
+        f"tpu-extender serving Filter/Prioritize/ScheduleBacklog on "
+        f"http://{host}:{port}/v1beta1",
+        flush=True,
+    )
     _wait_forever()
 
 
@@ -142,6 +158,9 @@ def main(argv=None):
     p.add_argument("--node", required=True)
     p.add_argument("--fake-runtime", action="store_true", default=True)
 
+    p = sub.add_parser("extender")
+    p.add_argument("--port", type=int, default=8090)
+
     p = sub.add_parser("proxy")
     p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
     p.add_argument("--node", default="")
@@ -156,6 +175,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     {
         "apiserver": run_apiserver,
+        "extender": run_extender,
         "scheduler": run_scheduler,
         "controller-manager": run_controller_manager,
         "kubelet": run_kubelet,

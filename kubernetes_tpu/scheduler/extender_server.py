@@ -1,0 +1,207 @@
+"""Inbound scheduler-extender service backed by the TPU algorithm.
+
+The reference documents one out-of-process extension boundary: an HTTP
+service speaking ExtenderArgs/ExtenderFilterResult
+(plugin/pkg/scheduler/extender.go:96-173, api/types.go:135-151,
+docs/design/scheduler_extender.md). The outbound half (extender.py) lets
+THIS scheduler call external services; this module is the inbound half —
+it exposes the device program AS such a service, so an external
+scheduler (the reference's Go binary, or this framework's oracle path)
+can delegate Filter/Prioritize to the TPU without linking JAX.
+
+Wire surface (POST, JSON):
+  /<apiVersion>/filter      {pod, nodes:{items}, existingPods?}
+                            -> {nodes:{items}, failedNodes:{name:reason}}
+  /<apiVersion>/prioritize  same body -> [{host, score}]
+  /<apiVersion>/scheduleBacklog
+                            {nodes:{items}, existingPods?, pending:{items},
+                             lastNodeIndex?}
+                            -> {assignments:{podName: node|null},
+                                lastNodeIndex}
+
+Filter/Prioritize are per-request pure: they see exactly what the caller
+ships (the extender contract — an extender holds its own state). The
+optional existingPods list carries per-node commitments for callers that
+want resource-aware answers; scheduleBacklog is the bulk entry the
+extender protocol lacks — one POST schedules a whole backlog
+sequential-equivalently on the device.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.oracle.state import ClusterState
+from kubernetes_tpu.runtime import scheme as default_scheme
+
+FAILED_REASON = "TPUExtenderPredicates"
+
+
+class TPUExtenderServer:
+    """Serves the extender wire protocol off the batched device program."""
+
+    def __init__(self, config=None, scheme=None, api_version: str = "v1beta1"):
+        from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+
+        self.config = config or SchedulerConfig()
+        self.scheme = scheme or default_scheme
+        self.api_version = api_version
+        self._sched = BatchScheduler(self.config)
+        self._lock = threading.Lock()  # device dispatch is serialized
+        self._server = None
+
+    # -- request handling ----------------------------------------------------
+
+    def _decode_cluster(self, body: dict) -> ClusterState:
+        nodes = [
+            self.scheme.decode(n, Node)
+            for n in (body.get("nodes") or {}).get("items", [])
+        ]
+        existing = [
+            self.scheme.decode(p, Pod)
+            for p in body.get("existingPods", [])
+        ]
+        from kubernetes_tpu.api.types import Service
+
+        services = [
+            self.scheme.decode(s, Service)
+            for s in (body.get("services") or {}).get("items", [])
+        ]
+        state = ClusterState.build(nodes, services=services)
+        for ep in existing:
+            if ep.spec.node_name in state.node_infos:
+                state.assign(ep)
+        return state
+
+    def _evaluate(self, body: dict):
+        """(node_names, fit[N] bool, score[N] int) for body's pod."""
+        import numpy as np
+
+        from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+        state = self._decode_cluster(body)
+        pod = self.scheme.decode(body["pod"], Pod)
+        if not state.node_infos:
+            return [], np.zeros(0, bool), np.zeros(0, np.int64)
+        snap, batch = SnapshotEncoder(state, [pod], config=self.config).encode()
+        with self._lock:
+            fit, score = self._sched.debug_evaluate(snap, batch)
+        return list(snap.node_names), fit[0], score[0]
+
+    def handle(self, verb: str, body: dict):
+        if verb == "filter":
+            names, fit, _ = self._evaluate(body)
+            items = (body.get("nodes") or {}).get("items", [])
+            by_name = {
+                (n.get("metadata") or {}).get("name", ""): n for n in items
+            }
+            passed, failed = [], {}
+            for name, ok in zip(names, fit):
+                if bool(ok):
+                    passed.append(by_name[name])
+                else:
+                    failed[name] = FAILED_REASON
+            return 200, {
+                "nodes": {"kind": "NodeList", "items": passed},
+                "failedNodes": failed,
+                "error": "",
+            }
+        if verb == "prioritize":
+            names, _, score = self._evaluate(body)
+            return 200, [
+                {"host": name, "score": int(s)}
+                for name, s in zip(names, score)
+            ]
+        if verb == "scheduleBacklog":
+            state = self._decode_cluster(body)
+            pending = [
+                self.scheme.decode(p, Pod)
+                for p in (body.get("pending") or {}).get("items", [])
+            ]
+            last = int(body.get("lastNodeIndex", 0))
+            from kubernetes_tpu.models.batch import BatchScheduler
+            from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+            if not state.node_infos:
+                return 200, {
+                    "assignments": {p.metadata.name: None for p in pending},
+                    "lastNodeIndex": last,
+                }
+            snap, batch = SnapshotEncoder(
+                state, pending, config=self.config
+            ).encode()
+            with self._lock:
+                chosen, final = self._sched.schedule(
+                    snap, batch, last_node_index=last
+                )
+            names = snap.node_names
+            return 200, {
+                "assignments": {
+                    p.metadata.name: (
+                        names[int(c)] if 0 <= int(c) < len(names) else None
+                    )
+                    for p, c in zip(pending, chosen)
+                },
+                "lastNodeIndex": int(final[BatchScheduler.LAST_IDX]),
+            }
+        return 404, {"error": f"unknown verb {verb!r}"}
+
+    # -- HTTP ----------------------------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) != 2 or parts[0] != svc.api_version:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON"})
+                    return
+                try:
+                    code, payload = svc.handle(parts[1], body)
+                except Exception as e:
+                    # non-200 so every verb's client surfaces the failure
+                    # (the prioritize reply shape has no error field)
+                    code, payload = 500, {"error": str(e)}
+                self._send(code, payload)
+
+            def _send(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        threading.Thread(
+            target=self._server.serve_forever,
+            name="tpu-extender",
+            daemon=True,
+        ).start()
+        return host, self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
