@@ -20,7 +20,9 @@ from typing import Dict, List, Optional
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.client.informer import Informer, ResourceEventHandler
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.kubelet.eviction import EvictionManager
 from kubernetes_tpu.kubelet.pleg import PLEG, PodLifecycleEvent
+from kubernetes_tpu.kubelet.prober import ProbeManager
 from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
 from kubernetes_tpu.kubelet.status import StatusManager
 
@@ -45,6 +47,11 @@ class KubeletConfig:
         default_factory=lambda: {"cpu": "4", "memory": "32Gi", "pods": "110"}
     )
     register_node: bool = True
+    # eviction (pkg/kubelet/eviction): memory.available < threshold =>
+    # MemoryPressure + QoS-ranked eviction; 0 disables
+    eviction_memory_threshold: int = 0
+    eviction_sync_period: float = 1.0
+    eviction_pressure_transition_period: float = 5.0
 
 
 class _PodWorker:
@@ -85,13 +92,38 @@ class Kubelet:
         config: KubeletConfig,
         runtime: Optional[ContainerRuntime] = None,
         recorder=None,
+        prober=None,
+        memory_available_fn=None,
     ):
+        """prober: injected ProbeRunner (kubelet/prober.py FakeProber in
+        hollow nodes); memory_available_fn: the cadvisor seam feeding the
+        eviction manager (bytes available on the machine)."""
         self.client = client
         self.config = config
         self.runtime = runtime or FakeRuntime()
         self.recorder = recorder
         self.status_manager = StatusManager(client)
         self.pleg = PLEG(self.runtime, config.pleg_relist_period)
+        self.probe_manager = ProbeManager(
+            runner=prober,
+            on_liveness_failure=self._handle_liveness_failure,
+            on_result_change=self._on_probe_result_change,
+        )
+        self._restarts: Dict[tuple, int] = {}
+        self.eviction_manager: Optional[EvictionManager] = None
+        if config.eviction_memory_threshold > 0:
+            self.eviction_manager = EvictionManager(
+                client,
+                self.runtime,
+                config.node_name,
+                memory_available_fn or (lambda: 1 << 62),
+                config.eviction_memory_threshold,
+                sync_period=config.eviction_sync_period,
+                pressure_transition_period=(
+                    config.eviction_pressure_transition_period
+                ),
+                recorder=recorder,
+            )
         self._workers: Dict[str, _PodWorker] = {}
         self._pods: Dict[str, t.Pod] = {}  # uid -> latest spec from config
         self._lock = threading.Lock()
@@ -162,10 +194,12 @@ class Kubelet:
         except APIStatusError:
             return
         now = _now()
-        ready = None
+        ready = mem = None
         for c in node.status.conditions:
             if c.type == "Ready":
                 ready = c
+            elif c.type == "MemoryPressure":
+                mem = c
         if ready is None:
             ready = t.NodeCondition("Ready", "True")
             node.status.conditions.append(ready)
@@ -174,6 +208,24 @@ class Kubelet:
         ready.status = "True"
         ready.reason = "KubeletReady"
         ready.last_heartbeat_time = now
+        # setNodeMemoryPressureCondition: reported every heartbeat so the
+        # scheduler's CheckNodeMemoryPressure sees transitions promptly
+        pressure = (
+            self.eviction_manager is not None
+            and self.eviction_manager.under_memory_pressure
+        )
+        if mem is None:
+            mem = t.NodeCondition("MemoryPressure", "False")
+            node.status.conditions.append(mem)
+        want = "True" if pressure else "False"
+        if mem.status != want:
+            mem.last_transition_time = now
+        mem.status = want
+        mem.reason = (
+            "KubeletHasInsufficientMemory" if pressure
+            else "KubeletHasSufficientMemory"
+        )
+        mem.last_heartbeat_time = now
         try:
             self.client.nodes().update_status(node)
         except APIStatusError:
@@ -192,15 +244,25 @@ class Kubelet:
         with self._lock:
             self._pods[pod.metadata.uid] = pod
             self._worker_for(pod.metadata.uid).update(pod)
+        self.probe_manager.add_pod(pod)
 
     def _on_pod_delete(self, pod: t.Pod) -> None:
         with self._lock:
             self._pods.pop(pod.metadata.uid, None)
             w = self._workers.pop(pod.metadata.uid, None)
+        self.probe_manager.remove_pod(pod.metadata.uid)
         self.runtime.kill_pod(pod.metadata.uid)
         self.status_manager.forget(pod.metadata.uid)
         self._start_times.pop(pod.metadata.uid, None)
         self._pod_ips.pop(pod.metadata.uid, None)
+        with self._lock:
+            for key in [k for k in self._restarts if k[0] == pod.metadata.uid]:
+                del self._restarts[key]
+        for key in [
+            k for k in getattr(self.runtime, "exits_by_pod", {})
+            if k[0] == pod.metadata.uid
+        ]:
+            del self.runtime.exits_by_pod[key]
         if w is not None:
             w.stop()
 
@@ -218,10 +280,50 @@ class Kubelet:
                 self._pod_ips[uid] = ip
             return ip
 
+    def _on_probe_result_change(self, pod: t.Pod) -> None:
+        """A readiness flip regenerates the pod status now (the
+        reference's results channel -> status manager push)."""
+        with self._lock:
+            cur = self._pods.get(pod.metadata.uid)
+            w = self._workers.get(pod.metadata.uid) if cur is not None else None
+        if w is not None:
+            w.update(cur)
+
+    def _handle_liveness_failure(self, pod: t.Pod, container: str) -> None:
+        """prober/worker.go liveness failure -> kill the container; the
+        pod worker's next sync restarts it under the restart policy."""
+        uid = pod.metadata.uid
+        code = 137
+        if pod.spec.restart_policy == "Never":
+            # stays down: terminal per-pod exit (phase -> Failed)
+            if hasattr(self.runtime, "exits_by_pod"):
+                self.runtime.exits_by_pod[(uid, container)] = code
+        if hasattr(self.runtime, "exit_container"):
+            self.runtime.exit_container(uid, container, code)
+        if self.recorder is not None:
+            self.recorder.eventf(
+                pod, "Warning", "Unhealthy",
+                f"Liveness probe failed: container {container} restarted",
+            )
+        with self._lock:
+            key = (uid, container)
+            if pod.spec.restart_policy != "Never":
+                self._restarts[key] = self._restarts.get(key, 0) + 1
+            w = self._workers.get(uid)
+        if w is not None:
+            # re-sync now (the restart) instead of waiting on PLEG
+            w.update(pod)
+
     def _sync_pod(self, pod: t.Pod) -> None:
         """kubelet.go:1734 syncPod (fake-runtime scale): converge runtime,
         compute API status, queue the status update."""
         if pod.metadata.deletion_timestamp is not None:
+            self.runtime.kill_pod(pod.metadata.uid)
+            return
+        if pod.status.phase in ("Failed", "Succeeded"):
+            # terminal pods (incl. Evicted) never run again: release the
+            # runtime resources and keep the terminal API status
+            # (kubelet.go: terminal phase short-circuits syncPod)
             self.runtime.kill_pod(pod.metadata.uid)
             return
         try:
@@ -247,7 +349,17 @@ class Kubelet:
                 st = "running" if c.state == "running" else "terminated"
                 statuses.append(
                     t.ContainerStatus(
-                        name=c.name, ready=c.state == "running", state=st
+                        name=c.name,
+                        ready=(
+                            c.state == "running"
+                            and self.probe_manager.is_ready(
+                                pod.metadata.uid, c.name
+                            )
+                        ),
+                        restart_count=self._restarts.get(
+                            (pod.metadata.uid, c.name), 0
+                        ),
+                        state=st,
                     )
                 )
                 if c.state == "running":
@@ -271,7 +383,11 @@ class Kubelet:
             phase = "Running"  # restartable containers will come back
         else:
             phase = "Failed" if exited_bad else "Succeeded"
-        ready = phase == "Running" and running == total
+        ready = (
+            phase == "Running"
+            and running == total
+            and all(cs.ready for cs in statuses)
+        )
         # start_time is set once on the first sync and preserved after
         # (generateAPIPodStatus keeps the existing status.startTime)
         start = self._start_times.setdefault(pod.metadata.uid, _now())
@@ -331,6 +447,8 @@ class Kubelet:
             self.register_node()
         self._informer.run()
         self.pleg.run()
+        if self.eviction_manager is not None:
+            self.eviction_manager.run()
         for target, name in [
             (self._sync_loop, "kubelet-syncloop"),
             (self._status_loop, "kubelet-status"),
@@ -344,6 +462,9 @@ class Kubelet:
     def stop(self) -> None:
         self._stop.set()
         self.pleg.stop()
+        self.probe_manager.stop()
+        if self.eviction_manager is not None:
+            self.eviction_manager.stop()
         self._informer.stop()
         for w in self._workers.values():
             w.stop()

@@ -55,6 +55,9 @@ class FakeRuntime(ContainerRuntime):
         # container name -> exit code: syncs mark it exited (a completed
         # or crashed container, driving phase Succeeded/Failed)
         self.exits: Dict[str, int] = {}
+        # (pod_uid, container) -> exit code: per-pod terminal containers
+        # (a liveness kill under restartPolicy Never stays down)
+        self.exits_by_pod: Dict[Tuple[str, str], int] = {}
 
     def list_pods(self) -> List[RuntimePod]:
         with self._lock:
@@ -71,6 +74,8 @@ class FakeRuntime(ContainerRuntime):
             containers = []
             for c in pod.spec.containers:
                 ec = self.exits.get(c.name)
+                if ec is None:
+                    ec = self.exits_by_pod.get((pod.metadata.uid, c.name))
                 containers.append(
                     RuntimeContainer(
                         name=c.name,

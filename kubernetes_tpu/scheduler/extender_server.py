@@ -128,7 +128,9 @@ class TPUExtenderServer:
 
             if not state.node_infos:
                 return 200, {
-                    "assignments": {p.metadata.name: None for p in pending},
+                    "assignments": {
+                        p.metadata.full_name: None for p in pending
+                    },
                     "lastNodeIndex": last,
                 }
             snap, batch = SnapshotEncoder(
@@ -140,8 +142,10 @@ class TPUExtenderServer:
                 )
             names = snap.node_names
             return 200, {
+                # keyed namespace/name: bare names collide across
+                # namespaces
                 "assignments": {
-                    p.metadata.name: (
+                    p.metadata.full_name: (
                         names[int(c)] if 0 <= int(c) < len(names) else None
                     )
                     for p, c in zip(pending, chosen)

@@ -132,7 +132,8 @@ def test_schedule_backlog_bulk_endpoint(svc):
                                    "LeastRequestedPriority")],
     )
     expected = oracle.schedule_backlog(pending, ClusterState.build(nodes))
-    assert [out["assignments"][f"p{i:02d}"] for i in range(12)] == expected
+    # assignments are keyed namespace/name (bare names collide)
+    assert [out["assignments"][f"default/p{i:02d}"] for i in range(12)] == expected
     assert out["lastNodeIndex"] > 0
 
 

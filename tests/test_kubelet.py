@@ -200,3 +200,196 @@ def test_status_writes_settle(plane):
     time.sleep(1.0)  # many sync periods
     rv2 = client.pods().get("steady").metadata.resource_version
     assert rv1 == rv2, "pod status kept churning at steady state"
+
+
+# --- probes (pkg/kubelet/prober) --------------------------------------------
+
+
+def probed_pod(name, node, kind, restart_policy="Always", period=0.05):
+    from kubernetes_tpu.api.types import Probe
+
+    probe = Probe(period_seconds=period, failure_threshold=2,
+                  success_threshold=1)
+    kw = {"liveness_probe" if kind == "liveness" else "readiness_probe": probe}
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            node_name=node,
+            restart_policy=restart_policy,
+            containers=[Container(name="main", requests={"cpu": "100m"},
+                                  **kw)],
+        ),
+    )
+
+
+def _probe_plane(node_name="n1", **kubelet_kw):
+    from kubernetes_tpu.kubelet.prober import FakeProber
+
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    prober = FakeProber()
+    cfg = KubeletConfig(
+        node_name=node_name,
+        pleg_relist_period=0.05,
+        status_sync_period=0.05,
+        housekeeping_interval=0.2,
+        node_status_update_frequency=0.1,
+    )
+    runtime = FakeRuntime()
+    kl = Kubelet(client, cfg, runtime, prober=prober, **kubelet_kw).run()
+    return server, client, kl, runtime, prober
+
+
+def test_failing_liveness_probe_restarts_container():
+    """prober/worker.go: failureThreshold consecutive liveness failures
+    kill the container; the pod worker restarts it (restartPolicy Always)
+    and restartCount climbs while the pod returns to Running."""
+    server, client, kl, runtime, prober = _probe_plane()
+    try:
+        client.pods().create(probed_pod("sick", "n1", "liveness"))
+        assert wait_until(
+            lambda: client.pods().get("sick").status.phase == "Running"
+        )
+        prober.set_result("sick", "main", "liveness", False)
+
+        def restarted():
+            st = client.pods().get("sick").status
+            return any(cs.restart_count >= 1 for cs in st.container_statuses)
+
+        assert wait_until(restarted)
+        # back to Running after the restart (fresh probe history)
+        prober.set_result("sick", "main", "liveness", True)
+        assert wait_until(
+            lambda: client.pods().get("sick").status.phase == "Running"
+            and all(cs.state == "running"
+                    for cs in client.pods().get("sick").status.container_statuses)
+        )
+    finally:
+        kl.stop()
+
+
+def test_liveness_failure_with_restart_never_fails_pod():
+    server, client, kl, runtime, prober = _probe_plane()
+    try:
+        client.pods().create(
+            probed_pod("doomed", "n1", "liveness", restart_policy="Never")
+        )
+        assert wait_until(
+            lambda: client.pods().get("doomed").status.phase == "Running"
+        )
+        prober.set_result("doomed", "main", "liveness", False)
+        assert wait_until(
+            lambda: client.pods().get("doomed").status.phase == "Failed"
+        )
+        st = client.pods().get("doomed").status
+        assert all(cs.restart_count == 0 for cs in st.container_statuses)
+    finally:
+        kl.stop()
+
+
+def test_readiness_probe_gates_pod_ready_condition():
+    """A failing readiness probe keeps phase Running but flips the pod
+    Ready condition False (endpoints drop it; status stays Running)."""
+    server, client, kl, runtime, prober = _probe_plane()
+    try:
+        prober.set_result("web", "main", "readiness", True)
+        client.pods().create(probed_pod("web", "n1", "readiness"))
+
+        def ready_is(v):
+            st = client.pods().get("web").status
+            return st.phase == "Running" and any(
+                c.type == "Ready" and c.status == v for c in st.conditions
+            )
+
+        assert wait_until(lambda: ready_is("True"))
+        prober.set_result("web", "main", "readiness", False)
+        assert wait_until(lambda: ready_is("False"))
+        assert client.pods().get("web").status.phase == "Running"
+        prober.set_result("web", "main", "readiness", True)
+        assert wait_until(lambda: ready_is("True"))
+    finally:
+        kl.stop()
+
+
+# --- eviction (pkg/kubelet/eviction) ----------------------------------------
+
+
+def qos_pod(name, node, qos):
+    if qos == "BestEffort":
+        containers = [Container(name="main")]
+    elif qos == "Guaranteed":
+        containers = [Container(name="main",
+                                requests={"cpu": "100m", "memory": "100Mi"},
+                                limits={"cpu": "100m", "memory": "100Mi"})]
+    else:
+        containers = [Container(name="main", requests={"cpu": "100m"})]
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(node_name=node, containers=containers))
+
+
+def test_memory_pressure_evicts_best_effort_first():
+    """eviction/helpers.go rankMemoryPressure: under pressure the node
+    reports MemoryPressure (feeding CheckNodeMemoryPressure) and evicts
+    BestEffort before Burstable before Guaranteed."""
+    available = [8 << 30]
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    cfg = KubeletConfig(
+        node_name="n2",
+        pleg_relist_period=0.05,
+        status_sync_period=0.05,
+        node_status_update_frequency=0.05,
+        eviction_memory_threshold=1 << 30,
+        eviction_sync_period=0.1,
+        eviction_pressure_transition_period=0.5,
+    )
+    runtime2 = FakeRuntime()
+    kl2 = Kubelet(client, cfg, runtime2,
+                  memory_available_fn=lambda: available[0]).run()
+    try:
+        for qos in ("Guaranteed", "BestEffort", "Burstable"):
+            client.pods().create(qos_pod(f"p-{qos.lower()}", "n2", qos))
+        assert wait_until(lambda: all(
+            client.pods().get(f"p-{q.lower()}").status.phase == "Running"
+            for q in ("Guaranteed", "BestEffort", "Burstable")
+        ))
+        available[0] = 256 << 20  # under the 1Gi threshold
+
+        def phase(name):
+            return client.pods().get(name).status.phase
+
+        assert wait_until(lambda: phase("p-besteffort") == "Failed")
+        assert client.pods().get("p-besteffort").status.reason == "Evicted"
+        # the node now advertises MemoryPressure for the scheduler
+        def mem_pressure():
+            n = client.nodes().get("n2")
+            return any(c.type == "MemoryPressure" and c.status == "True"
+                       for c in n.status.conditions)
+
+        assert wait_until(mem_pressure)
+        # CheckNodeMemoryPressure end-to-end: a BestEffort pod no longer
+        # fits this node while a Burstable one still does
+        from kubernetes_tpu.oracle import ClusterState
+        from kubernetes_tpu.oracle import predicates as opreds
+
+        state = ClusterState.build([client.nodes().get("n2")])
+        info = state.node_infos["n2"]
+        fit, reason = opreds.check_node_memory_pressure(
+            qos_pod("probe-be", "", "BestEffort"), info, state)
+        assert not fit and reason == "NodeUnderMemoryPressure"
+        fit, _ = opreds.check_node_memory_pressure(
+            qos_pod("probe-bu", "", "Burstable"), info, state)
+        assert fit
+        # next ranked eviction: Burstable before Guaranteed
+        assert wait_until(lambda: phase("p-burstable") == "Failed")
+        assert phase("p-guaranteed") != "Failed"
+        # pressure clears after the transition period
+        available[0] = 8 << 30
+        def mem_clear():
+            n = client.nodes().get("n2")
+            return any(c.type == "MemoryPressure" and c.status == "False"
+                       for c in n.status.conditions)
+
+        assert wait_until(mem_clear, timeout=15)
+    finally:
+        kl2.stop()
