@@ -146,3 +146,137 @@ def test_mesh_service_anti_affinity_matches_oracle(mesh):
     snap, batch = SnapshotEncoder(state, pending, config=cfg).encode()
     sharded = MeshBatchScheduler(mesh, config=cfg).schedule_names(snap, batch)
     assert sharded == oracle_result
+
+
+def test_mesh_image_locality_and_node_label_match_oracle(mesh):
+    """Mesh coverage for the two config-parameterized scorers the round-1
+    suite never ran sharded: ImageLocality (per-node static, unnormalized)
+    and NodeLabel predicate+priority (config-resolved static masks)."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        ContainerImage,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+    from kubernetes_tpu.oracle import predicates as opreds
+    from kubernetes_tpu.oracle import priorities as oprios
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+
+    rng = random.Random(11)
+    mb = 1024 * 1024
+    nodes = []
+    for i in range(13):  # non-divisible: pads to 16
+        labels = {"kubernetes.io/hostname": f"node-{i:02d}"}
+        if i % 3 != 0:
+            labels["disktype"] = "ssd"
+        images = []
+        if i % 2:
+            images.append(ContainerImage(names=("registry/app:v1",),
+                                         size_bytes=(40 + i * 13) * mb))
+        if i % 5 == 0:
+            images.append(ContainerImage(names=("registry/db:v2",),
+                                         size_bytes=300 * mb))
+        nodes.append(Node(
+            metadata=ObjectMeta(name=f"node-{i:02d}", labels=labels),
+            status=NodeStatus(
+                allocatable={"cpu": "8", "memory": "32Gi", "pods": "110"},
+                images=images,
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    pending = [
+        Pod(metadata=ObjectMeta(name=f"p{i:02d}"),
+            spec=PodSpec(containers=[Container(
+                image=rng.choice(["registry/app:v1", "registry/db:v2",
+                                  "registry/other:v9"]),
+                requests={"cpu": "200m"},
+            )]))
+        for i in range(10)
+    ]
+    state = ClusterState.build(nodes)
+    oracle = GenericScheduler(
+        predicates=[
+            ("GeneralPredicates", opreds.general_predicates),
+            ("RequireSSD", opreds.node_label_predicate(["disktype"], True)),
+        ],
+        priorities=[
+            PriorityConfig(oprios.image_locality_priority, 2,
+                           "ImageLocalityPriority"),
+            PriorityConfig(oprios.node_label_priority("disktype", True), 1,
+                           "NodeLabelPriority"),
+            PriorityConfig(oprios.least_requested_priority, 1,
+                           "LeastRequestedPriority"),
+        ],
+    )
+    expected = oracle.schedule_backlog(pending, state.clone())
+    cfg = SchedulerConfig(
+        predicates=("GeneralPredicates",
+                    ("CheckNodeLabelPresence", ("disktype",), True)),
+        priorities=(("ImageLocalityPriority", 2),
+                    (("NodeLabelPriority", "disktype", True), 1),
+                    ("LeastRequestedPriority", 1)),
+    )
+    snap, batch = SnapshotEncoder(state, pending, config=cfg).encode()
+    single = BatchScheduler(cfg).schedule_names(snap, batch)
+    assert single == expected
+    sharded = MeshBatchScheduler(mesh, config=cfg).schedule_names(snap, batch)
+    assert sharded == expected
+
+
+def test_mesh_scale_1k_nodes_matches_single_chip(mesh):
+    """Kubemark-scale mesh check: ~1k nodes (1000 -> 1024 padded, 128 per
+    shard on the 8-device CPU mesh) with the full default provider; the
+    sharded program must agree with the single-chip scan exactly."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from kubernetes_tpu.oracle import ClusterState
+
+    rng = random.Random(12)
+    zones = ["a", "b", "c"]
+    nodes = []
+    for i in range(1000):
+        labels = {
+            "kubernetes.io/hostname": f"node-{i:04d}",
+            "failure-domain.beta.kubernetes.io/zone": zones[i % 3],
+        }
+        nodes.append(Node(
+            metadata=ObjectMeta(name=f"node-{i:04d}", labels=labels),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    existing = [
+        Pod(metadata=ObjectMeta(name=f"run-{i:04d}",
+                                labels={"app": rng.choice(["web", "db"])}),
+            spec=PodSpec(node_name=f"node-{rng.randrange(1000):04d}",
+                         containers=[Container(requests={
+                             "cpu": f"{rng.choice([100, 500])}m",
+                             "memory": "500Mi"})]))
+        for i in range(300)
+    ]
+    pending = [
+        Pod(metadata=ObjectMeta(name=f"p-{i:03d}",
+                                labels={"app": "web"}),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": "100m", "memory": "500Mi"})]))
+        for i in range(48)
+    ]
+    state = ClusterState.build(nodes, assigned_pods=existing)
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    single = BatchScheduler(SchedulerConfig()).schedule_names(snap, batch)
+    sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
+    assert sharded == single
+    assert all(s is not None for s in sharded)
