@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Tuple
 
-from kubernetes_tpu.runtime.scheme import encode_value
+from kubernetes_tpu.runtime.scheme import encode_value, to_snake
 
 
 def parse_field_selector(text: str) -> List[Tuple[str, str, str]]:
@@ -48,19 +48,32 @@ def _lookup(wire: Dict[str, Any], path: str) -> str:
     return str(cur)
 
 
-def matches_fields(obj: Any, clauses: List[Tuple[str, str, str]]) -> bool:
-    if not clauses:
-        return True
-    return matches_fields_wire(encode_value(obj), clauses)
+def _lookup_obj(obj: Any, path: str) -> str:
+    """Resolve a wire-style camelCase dotted path directly against the
+    dataclass graph — same result as encoding first, without paying a
+    full-object encode per watch event."""
+    cur: Any = obj
+    for seg in path.split("."):
+        if isinstance(cur, dict):
+            if seg in cur:
+                cur = cur[seg]
+            else:
+                return ""
+        else:
+            attr = to_snake(seg)
+            if not hasattr(cur, attr):
+                return ""
+            cur = getattr(cur, attr)
+        if cur is None:
+            return ""
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    return str(cur)
 
 
-def matches_fields_wire(
-    wire: Dict[str, Any], clauses: List[Tuple[str, str, str]]
-) -> bool:
-    """Evaluate clauses against an already-encoded wire dict (lets LIST
-    encode each object exactly once)."""
+def _matches(target: Any, clauses, lookup) -> bool:
     for path, op, want in clauses:
-        got = _lookup(wire, path)
+        got = lookup(target, path)
         # strip optional quoting: spec.nodeName=="" arrives as value '""'
         if len(want) >= 2 and want[0] == want[-1] == '"':
             want = want[1:-1]
@@ -70,3 +83,19 @@ def matches_fields_wire(
         if not ok:
             return False
     return True
+
+
+def matches_fields(obj: Any, clauses: List[Tuple[str, str, str]]) -> bool:
+    """Evaluate clauses directly against the dataclass graph — same
+    semantics as the wire evaluator, without paying an encode."""
+    if not clauses:
+        return True
+    return _matches(obj, clauses, _lookup_obj)
+
+
+def matches_fields_wire(
+    wire: Dict[str, Any], clauses: List[Tuple[str, str, str]]
+) -> bool:
+    """Evaluate clauses against an already-encoded wire dict (lets LIST
+    encode each object exactly once)."""
+    return _matches(wire, clauses, _lookup)

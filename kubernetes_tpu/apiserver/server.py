@@ -77,6 +77,10 @@ class WatchResponse:
     label_selector: labelpkg.Selector
     field_clauses: List[Tuple[str, str, str]]
     scheme: Any
+    # object protocol (LocalTransport): yield API objects instead of wire
+    # dicts — the in-process analogue of the reference's protobuf content
+    # type (kubemark runs protobuf for exactly this codec cost)
+    obj_mode: bool = False
 
     def events(self, idle_timeout: Optional[float] = None):
         """Yield wire-format {"type", "object"} dicts, applying the
@@ -124,7 +128,13 @@ class WatchResponse:
                 out_type = "DELETED"
             else:
                 continue
-            yield {"type": out_type, "object": self.scheme.encode(ev.object)}
+            yield {
+                "type": out_type,
+                "object": (
+                    ev.object if self.obj_mode
+                    else self.scheme.encode(ev.object)
+                ),
+            }
 
     def _pull(self, idle_timeout: Optional[float]):
         if idle_timeout is None:
@@ -212,11 +222,19 @@ class APIServer:
         path: str,
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
+        obj_mode: bool = False,
     ):
-        """Returns (status_code, payload_dict) or (200, WatchResponse)."""
+        """Returns (status_code, payload_dict) or (200, WatchResponse).
+
+        obj_mode is the in-process object protocol (LocalTransport): the
+        body may be an API object and responses carry API objects — the
+        reflective wire codec stays off the hot path, the way the
+        reference switches to protobuf at kubemark scale. Isolation is
+        preserved: object bodies are copied in, responses are the store's
+        own copies."""
         query = query or {}
         try:
-            return self._handle(method.upper(), path, query, body)
+            return self._handle(method.upper(), path, query, body, obj_mode)
         except ValueError as e:
             return 400, APIError(400, str(e)).status()
         except APIError as e:
@@ -234,7 +252,7 @@ class APIServer:
         except Compacted as e:
             return 410, APIError(410, str(e), reason="Expired").status()
 
-    def _handle(self, method, path, query, body):
+    def _handle(self, method, path, query, body, obj_mode=False):
         if path == "/healthz":
             return 200, {"ok": True}
         if path == "/metrics":
@@ -261,28 +279,28 @@ class APIServer:
 
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
-                return 200, self._watch(info, ns, query, name)
+                return 200, self._watch(info, ns, query, name, obj_mode)
             if name:
-                return 200, self._get(info, ns, name)
-            return 200, self._list(info, ns, query)
+                return 200, self._get(info, ns, name, obj_mode)
+            return 200, self._list(info, ns, query, obj_mode)
         if method == "POST":
             if subresource == "binding" or (not name and info.resource == "bindings"):
                 return self._bind(ns, name, body)
             if name:
                 raise APIError(400, "POST to a named resource")
-            return self._create(info, ns, body)
+            return self._create(info, ns, body, obj_mode)
         if method == "PUT":
             if not name:
                 raise APIError(400, "PUT requires a resource name")
-            return self._update(info, ns, name, body, subresource)
+            return self._update(info, ns, name, body, subresource, obj_mode)
         if method == "PATCH":
             if not name:
                 raise APIError(400, "PATCH requires a resource name")
-            return self._patch(info, ns, name, body, subresource)
+            return self._patch(info, ns, name, body, subresource, obj_mode)
         if method == "DELETE":
             if not name:
                 raise APIError(400, "DELETE requires a resource name")
-            return self._delete(info, ns, name)
+            return self._delete(info, ns, name, obj_mode)
         raise APIError(400, f"unsupported method {method}")
 
     def _route(
@@ -327,17 +345,23 @@ class APIServer:
 
     # -- verbs ---------------------------------------------------------------
 
-    def _get(self, info: ResourceInfo, ns: str, name: str):
+    def _get(self, info: ResourceInfo, ns: str, name: str,
+             obj_mode: bool = False):
         obj, _ = self.store.get(info.key(ns, name))
-        return self.scheme.encode(obj)
+        return obj if obj_mode else self.scheme.encode(obj)
 
-    def _list(self, info: ResourceInfo, ns: str, query):
+    def _list(self, info: ResourceInfo, ns: str, query,
+              obj_mode: bool = False):
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
         objs, rv = self.store.list(info.list_prefix(ns))
         items = []
         for o in objs:
             if not sel.matches(o.metadata.labels):
+                continue
+            if obj_mode:
+                if matches_fields(o, clauses):
+                    items.append(o)
                 continue
             wire = self.scheme.encode(o)
             if matches_fields_wire(wire, clauses):
@@ -350,7 +374,8 @@ class APIServer:
         }
 
     def _watch(
-        self, info: ResourceInfo, ns: str, query, name: str = ""
+        self, info: ResourceInfo, ns: str, query, name: str = "",
+        obj_mode: bool = False,
     ) -> WatchResponse:
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
@@ -359,22 +384,37 @@ class APIServer:
             clauses.append(("metadata.name", "=", name))
         from_rv = int(query.get("resourceVersion", "0") or "0")
         stream = self.store.watch(info.list_prefix(ns), from_rv=from_rv)
-        return WatchResponse(stream, sel, clauses, self.scheme)
+        return WatchResponse(stream, sel, clauses, self.scheme, obj_mode)
 
     def _decode_body(self, info: ResourceInfo, body) -> Any:
         if body is None:
             raise APIError(400, "request body required")
+        if not isinstance(body, dict):
+            # object protocol: copy in (the caller keeps its object; the
+            # server must be free to default/mutate)
+            from kubernetes_tpu.storage.store import deep_copy
+
+            if not isinstance(body, info.cls):
+                raise APIError(
+                    400,
+                    f"expected {info.cls.__name__}, got "
+                    f"{type(body).__name__}",
+                )
+            return deep_copy(body)
         try:
             return self.scheme.decode(body, info.cls)
         except Exception as e:
             raise APIError(400, f"decode error: {e}")
 
-    def _create(self, info: ResourceInfo, ns: str, body):
+    def _create(self, info: ResourceInfo, ns: str, body, obj_mode=False):
         obj = self._decode_body(info, body)
         if info.namespaced:
             # only an EXPLICIT body namespace can conflict with the URL;
             # decode fills the dataclass default ("default") when absent
-            body_ns = (body.get("metadata") or {}).get("namespace", "")
+            if isinstance(body, dict):
+                body_ns = (body.get("metadata") or {}).get("namespace", "")
+            else:
+                body_ns = body.metadata.namespace
             if body_ns and ns and body_ns != ns:
                 raise APIError(
                     400,
@@ -394,12 +434,19 @@ class APIServer:
         self.admission.admit(
             adm.CREATE, info.resource, obj.metadata.namespace, obj
         )
-        self.store.create(info.key(obj.metadata.namespace, obj.metadata.name), obj)
-        return 201, self.scheme.encode(self.store.get(
+        # obj is the server's decode/copy-boundary object: ownership
+        # transfers to the store (no second write copy)
+        self.store.create(
+            info.key(obj.metadata.namespace, obj.metadata.name), obj,
+            owned=True,
+        )
+        stored = self.store.get(
             info.key(obj.metadata.namespace, obj.metadata.name)
-        )[0])
+        )[0]
+        return 201, stored if obj_mode else self.scheme.encode(stored)
 
-    def _update(self, info: ResourceInfo, ns: str, name: str, body, subresource):
+    def _update(self, info: ResourceInfo, ns: str, name: str, body,
+                subresource, obj_mode=False):
         new = self._decode_body(info, body)
         key = info.key(ns, name)
         cur, cur_rv = self.store.get(key)
@@ -444,10 +491,13 @@ class APIServer:
                 new.status = cur.status
         self.admission.admit(adm.UPDATE, info.resource, ns, new)
         self.store.update(key, new, expect_rv=cur_rv if
-                          new.metadata.resource_version else None)
-        return 200, self.scheme.encode(self.store.get(key)[0])
+                          new.metadata.resource_version else None,
+                          owned=True)
+        stored = self.store.get(key)[0]
+        return 200, stored if obj_mode else self.scheme.encode(stored)
 
-    def _patch(self, info: ResourceInfo, ns: str, name: str, body, subresource):
+    def _patch(self, info: ResourceInfo, ns: str, name: str, body,
+               subresource, obj_mode=False):
         """Strategic-merge-lite: JSON merge patch over the wire form
         (resthandler.go:445 PatchResource)."""
         if body is None:
@@ -476,10 +526,12 @@ class APIServer:
         new.metadata.name = cur.metadata.name
         new.metadata.uid = cur.metadata.uid
         self.admission.admit(adm.UPDATE, info.resource, ns, new)
-        self.store.update(key, new, expect_rv=cur_rv)
-        return 200, self.scheme.encode(self.store.get(key)[0])
+        self.store.update(key, new, expect_rv=cur_rv, owned=True)
+        stored = self.store.get(key)[0]
+        return 200, stored if obj_mode else self.scheme.encode(stored)
 
-    def _delete(self, info: ResourceInfo, ns: str, name: str):
+    def _delete(self, info: ResourceInfo, ns: str, name: str,
+                obj_mode=False):
         self.admission.admit(adm.DELETE, info.resource, ns, None)
         key = info.key(ns, name)
         if info.resource == "namespaces":
@@ -496,16 +548,35 @@ class APIServer:
                     return obj
 
                 self.store.guaranteed_update(key, stamp)
-                return 200, self.scheme.encode(self.store.get(key)[0])
+                stored = self.store.get(key)[0]
+                return 200, stored if obj_mode else self.scheme.encode(stored)
         obj = self.store.delete(key)
-        return 200, self.scheme.encode(obj)
+        return 200, obj if obj_mode else self.scheme.encode(obj)
 
     def _bind(self, ns: str, pod_name: str, body):
         """POST pods/{name}/binding: assign spec.nodeName under CAS
         (registry/pod/rest.go assignPod; the scheduler's Bind target,
-        factory.go:537-543)."""
+        factory.go:537-543). A BindingList body commits a whole wave's
+        bindings in one request — the wave scheduler's bulk form (per-pod
+        semantics preserved: each item succeeds or fails independently)."""
         if body is None:
             raise APIError(400, "binding body required")
+        if body.get("kind") == "BindingList" or "items" in body:
+            results = []
+            for item in body.get("items", []):
+                item_ns = (
+                    (item.get("metadata") or {}).get("namespace") or ns
+                )
+                try:
+                    code, _ = self._bind(item_ns, "", item)
+                    results.append({"status": "Success"})
+                except (APIError, Conflict, KeyNotFound) as e:
+                    results.append({
+                        "status": "Failure",
+                        "message": str(e),
+                    })
+            return 201, {"kind": "Status", "status": "Success",
+                         "items": results}
         target = (body.get("target") or {}).get("name") or body.get(
             "targetNode"
         )

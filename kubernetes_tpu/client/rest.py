@@ -71,18 +71,29 @@ class ResourceClient:
         if field_selector:
             query["fieldSelector"] = field_selector
         payload = self.client.do_raw("GET", self._path(), query=query)
-        items = [self.client.scheme.decode(i) for i in payload.get("items", [])]
+        items = [
+            i if not isinstance(i, dict) else self.client.scheme.decode(i)
+            for i in payload.get("items", [])
+        ]
         rv = payload.get("metadata", {}).get("resourceVersion", "0")
         return items, rv
 
     def create(self, obj):
-        return self.client.do("POST", self._path(), body=self.client.scheme.encode(obj))
+        body = (
+            obj if self.client.object_protocol
+            else self.client.scheme.encode(obj)
+        )
+        return self.client.do("POST", self._path(), body=body)
 
     def update(self, obj, subresource: str = ""):
+        body = (
+            obj if self.client.object_protocol
+            else self.client.scheme.encode(obj)
+        )
         return self.client.do(
             "PUT",
             self._path(obj.metadata.name, subresource),
-            body=self.client.scheme.encode(obj),
+            body=body,
         )
 
     def update_status(self, obj):
@@ -131,6 +142,26 @@ class ResourceClient:
         path = f"/api/v1/namespaces/{ns}/pods/{pod_name}/binding"
         return self.client.do_raw("POST", path, body=body)
 
+    def bind_many(self, bindings, namespace: str = ""):
+        """Bulk bindings: [(pod_name, node_name, ns)] in ONE request.
+        Returns the per-item result list (Success/Failure)."""
+        ns = namespace or self.namespace or "default"
+        body = {
+            "kind": "BindingList",
+            "items": [
+                {
+                    "kind": "Binding",
+                    "metadata": {"name": pn, "namespace": pns or ns},
+                    "target": {"kind": "Node", "name": nn},
+                }
+                for pn, nn, pns in bindings
+            ],
+        }
+        out = self.client.do_raw(
+            "POST", f"/api/v1/namespaces/{ns}/bindings", body=body
+        )
+        return out.get("items", [])
+
 
 class WatchExpired(Exception):
     """410: the requested resourceVersion is compacted; relist."""
@@ -148,7 +179,10 @@ class _DecodedWatch:
                 if obj.get("code") == 410 or obj.get("reason") == "Expired":
                     raise WatchExpired(obj.get("message", "watch expired"))
                 raise APIStatusError(obj.get("code", 500), obj)
-            yield frame["type"], self._scheme.decode(frame["object"])
+            obj = frame["object"]
+            if isinstance(obj, dict):
+                obj = self._scheme.decode(obj)
+            yield frame["type"], obj
 
     def stop(self) -> None:
         self._raw.stop()
@@ -164,6 +198,10 @@ class RESTClient:
     ):
         self.transport = transport
         self.scheme = scheme or default_scheme
+        # object protocol (LocalTransport): skip the wire codec entirely
+        self.object_protocol = bool(
+            getattr(transport, "object_protocol", False)
+        )
         self._limiter = (
             TokenBucketRateLimiter(qps, burst) if qps > 0 and burst > 0 else None
         )
@@ -188,6 +226,8 @@ class RESTClient:
     def do(self, method: str, path: str, query=None, body=None):
         """Request + decode into an API object."""
         payload = self.do_raw(method, path, query=query, body=body)
+        if not isinstance(payload, dict):
+            return payload  # object protocol: already an API object
         if payload.get("kind") == "Status":
             return payload
         return self.scheme.decode(payload)

@@ -18,8 +18,14 @@ from urllib import request as urlrequest
 
 
 class LocalTransport:
-    def __init__(self, server):
+    def __init__(self, server, object_protocol: bool = True):
+        # object protocol: bodies/responses are API objects (copied at
+        # the server boundary), skipping the reflective wire codec — the
+        # in-process analogue of the reference's protobuf content type
+        # (kubemark defaults to protobuf for the same codec cost,
+        # hollow-node.go:65)
         self.server = server
+        self.object_protocol = object_protocol
 
     def request(
         self,
@@ -28,14 +34,18 @@ class LocalTransport:
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
     ) -> Tuple[int, Any]:
-        return self.server.handle(method, path, query, body)
+        return self.server.handle(
+            method, path, query, body, obj_mode=self.object_protocol
+        )
 
     def watch(
         self, path: str, query: Optional[Dict[str, str]] = None
     ) -> Iterator[Dict[str, Any]]:
         query = dict(query or {})
         query["watch"] = "true"
-        code, resp = self.server.handle("GET", path, query, None)
+        code, resp = self.server.handle(
+            "GET", path, query, None, obj_mode=self.object_protocol
+        )
         if code != 200:
             raise WatchError(code, resp)
         return _StoppableEvents(resp)

@@ -98,7 +98,11 @@ class SchedulerConfig:
     # full XLA compile on a tunneled chip. Runs of identical pods bypass
     # the scan entirely (models/wave.py), so large waves are cheap for
     # template-created backlogs.
-    max_batch: int = 1024
+    max_batch: int = 8192
+    # bulk binder for wave commits: one API request per wave instead of a
+    # per-pod round-trip flood (the per-pod shell was the daemon's
+    # throughput ceiling); None falls back to per-pod binder
+    binder_many: Callable = None
     # schedulable-node filter (factory.go:412 getNodeConditionPredicate
     # applied through the NodeLister, generic_scheduler.go:81)
     node_lister: object = None
@@ -222,11 +226,14 @@ class Scheduler:
             return
         scheduler_algorithm_latency.observe(DEFAULT_CLOCK.now() - start)
 
+        successes: List[Tuple[Pod, str]] = []
         for i, (p, host) in enumerate(zip(wave, hosts)):
             if host is None:
                 self._handle_failure(p, errors.get(i) or FitError(p, {}))
                 continue
-            self._assume_and_bind(p, host, start)
+            successes.append((p, host))
+        if successes:
+            self._assume_and_bind_wave(successes, start)
 
     def _schedule_wave(
         self, wave: Sequence[Pod], state: ClusterState
@@ -249,33 +256,38 @@ class Scheduler:
         except Exception as e:  # pragma: no cover
             return e
 
-    def _assume_and_bind(self, pod: Pod, host: str, cycle_start: float) -> None:
+    def _assume_and_bind_wave(
+        self, pairs: List[Tuple[Pod, str]], cycle_start: float
+    ) -> None:
+        """Wave commit (scheduler.go:112-152 AssumePod + async bind, wave
+        form): assume every pod, then bind — ONE bulk request when the
+        binder supports it, else per-pod. Per-pod semantics hold: each
+        item succeeds or fails independently; a failure forgets its
+        assume and re-queues through the error handler."""
         cfg = self.config
-        # optimistic local commit (scheduler.go:122 AssumePod)
         import copy
 
-        assumed = copy.copy(pod)
-        assumed.spec = copy.copy(pod.spec)
-        assumed.spec.node_name = host
-        try:
-            cfg.scheduler_cache.assume_pod(assumed)
-        except Exception:
-            log.exception("assume failed for %s", pod.metadata.name)
-
-        def bind() -> None:
-            bind_start = DEFAULT_CLOCK.now()
+        assumed_list = []
+        for pod, host in pairs:
+            assumed = copy.copy(pod)
+            assumed.spec = copy.copy(pod.spec)
+            assumed.spec.node_name = host
             try:
-                cfg.binder(pod, host)
-            except Exception as e:
-                # bind failed: undo the assume (scheduler.go:148-151)
-                try:
-                    cfg.scheduler_cache.forget_pod(assumed)
-                except Exception:
-                    pass
-                self._handle_failure(pod, e, reason="FailedBinding")
-                return
-            scheduler_binding_latency.observe(DEFAULT_CLOCK.now() - bind_start)
-            scheduler_e2e_latency.observe(DEFAULT_CLOCK.now() - cycle_start)
+                cfg.scheduler_cache.assume_pod(assumed)
+            except Exception:
+                log.exception("assume failed for %s", pod.metadata.name)
+            assumed_list.append(assumed)
+
+        def fail(pod, assumed, err):
+            try:
+                cfg.scheduler_cache.forget_pod(assumed)
+            except Exception:
+                pass
+            self._handle_failure(pod, err, reason="FailedBinding")
+
+        def succeed(pod, host, per_bind, now):
+            scheduler_binding_latency.observe(per_bind)
+            scheduler_e2e_latency.observe(now - cycle_start)
             if cfg.recorder is not None:
                 cfg.recorder.eventf(
                     pod,
@@ -286,13 +298,48 @@ class Scheduler:
                     host,
                 )
 
+        def bind_all() -> None:
+            bind_start = DEFAULT_CLOCK.now()
+            if cfg.binder_many is not None and len(pairs) > 1:
+                try:
+                    results = cfg.binder_many(pairs)
+                except Exception as e:
+                    for (pod, _h), assumed in zip(pairs, assumed_list):
+                        fail(pod, assumed, e)
+                    return
+                now = DEFAULT_CLOCK.now()
+                per = (now - bind_start) / len(pairs)
+                for i, ((pod, host), assumed) in enumerate(
+                    zip(pairs, assumed_list)
+                ):
+                    res = results[i] if i < len(results) else {
+                        "status": "Failure",
+                        "message": "missing bind result",
+                    }
+                    if res.get("status") == "Success":
+                        succeed(pod, host, per, now)
+                    else:
+                        fail(pod, assumed, RuntimeError(
+                            res.get("message", "bind failed")
+                        ))
+                return
+            for (pod, host), assumed in zip(pairs, assumed_list):
+                t0 = DEFAULT_CLOCK.now()
+                try:
+                    cfg.binder(pod, host)
+                except Exception as e:
+                    fail(pod, assumed, e)
+                    continue
+                now = DEFAULT_CLOCK.now()
+                succeed(pod, host, now - t0, now)
+
         # async bind (scheduler.go:124-152), on the shared pool
         try:
-            self._bind_pool.submit(bind)
+            self._bind_pool.submit(bind_all)
         except RuntimeError:
             # stop() shut the pool down mid-cycle: bind inline so the
-            # assumed pod isn't orphaned until TTL expiry
-            bind()
+            # assumed pods aren't orphaned until TTL expiry
+            bind_all()
 
     def _handle_failure(
         self, pod: Pod, err: Exception, reason: str = "FailedScheduling"

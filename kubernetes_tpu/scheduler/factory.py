@@ -248,6 +248,7 @@ class ConfigFactory:
             scheduler_cache=self.scheduler_cache,
             algorithm=algorithm,
             binder=self._bind,
+            binder_many=self._bind_many,
             pod_condition_updater=self._update_pod_condition,
             next_pod=self._next_pod,
             drain_waiting=self._drain_waiting,
@@ -295,6 +296,17 @@ class ConfigFactory:
         """factory.go:532 binder — POST pods/<name>/binding."""
         self.client.pods(pod.metadata.namespace).bind(
             pod.metadata.name, host, pod.metadata.namespace
+        )
+
+    def _bind_many(self, pairs) -> list:
+        """Bulk binder for wave commits: [(pod, host)] -> per-item
+        results. One API request replaces a wave's worth of per-pod
+        round-trips."""
+        return self.client.pods().bind_many(
+            [
+                (p.metadata.name, host, p.metadata.namespace)
+                for p, host in pairs
+            ]
         )
 
     def _update_pod_condition(self, pod: Pod, status: str, reason: str) -> None:

@@ -103,9 +103,30 @@ class SchedulerServer:
                     # run_components() already waited for informer sync,
                     # so an empty lister means a genuinely empty cluster:
                     # open the loop immediately and compile on demand
-                    # rather than stalling queued pods on a made-up shape
+                    # rather than stalling queued pods on a made-up shape.
+                    # Same when a backlog is ALREADY waiting: the first
+                    # real wave compiles exactly the shapes it needs, so
+                    # a synthetic warmup would only delay it (a tunneled
+                    # chip compile is tens of seconds)
+                    # the queue check must see the reflector's initial
+                    # list, not race it
+                    self.factory.unassigned_reflector.wait_for_sync(
+                        timeout=10
+                    )
                     n = len(self.factory.node_lister.list())
+                    # warmup only pays off for a genuinely idle daemon:
+                    # if work arrives within the grace window, the first
+                    # real wave compiles exactly the shapes it needs and
+                    # a synthetic warmup would just delay it
+                    idle = True
                     if n:
+                        deadline = time.time() + 2.0
+                        while time.time() < deadline:
+                            if len(self.factory.pod_queue) > 0:
+                                idle = False
+                                break
+                            time.sleep(0.1)
+                    if n and idle:
                         algo.warmup(n)
                 self._thread = self.scheduler.run()
 

@@ -28,15 +28,19 @@ ERROR = "ERROR"
 
 
 
-def _dc(obj):
+def deep_copy(obj):
     """Deep copy via pickle: ~3x faster than copy.deepcopy for the
     dataclass object graphs stored here, and every store write/read
     makes one (the decode-fresh-bytes-from-etcd illusion). Falls back
-    for anything unpicklable."""
+    for anything unpicklable. Shared isolation-copy helper (the
+    apiserver's object-protocol boundary uses it too)."""
     try:
         return pickle.loads(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL))
     except Exception:
         return copy.deepcopy(obj)
+
+
+_dc = deep_copy
 
 
 
@@ -60,6 +64,35 @@ class Compacted(StorageError):
     """Requested watch window is older than the retained history."""
 
 
+class _LazyEvent:
+    """A delivered watch event whose (object, prev_object) unpickle on
+    first access. The store serializes each committed event ONCE and
+    every watcher deserializes its own private copy on receipt — halving
+    the per-watcher deep-copy cost of fan-out while keeping the
+    decode-fresh-bytes isolation (no two watchers share an object)."""
+
+    __slots__ = ("type", "resource_version", "_blob", "_pair")
+
+    def __init__(self, ev_type: str, rv: int, blob: bytes):
+        self.type = ev_type
+        self.resource_version = rv
+        self._blob = blob
+        self._pair = None
+
+    def _unpack(self):
+        if self._pair is None:
+            self._pair = pickle.loads(self._blob)
+        return self._pair
+
+    @property
+    def object(self):
+        return self._unpack()[0]
+
+    @property
+    def prev_object(self):
+        return self._unpack()[1]
+
+
 @dataclass
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED | ERROR
@@ -78,7 +111,12 @@ class WatchStream:
     terminates the watch with ERROR, and the client relists — exactly the
     cacher.go "terminate blocked watchers" strategy (cacher.go:terminate)."""
 
-    def __init__(self, store: "MemoryStore", capacity: int = 4096):
+    # capacity sizes the burst a slow watcher may lag behind before the
+    # store terminates it into a relist. Wave-bulk binding commits tens
+    # of thousands of writes in one burst; queue entries are tiny (shared
+    # lazy blobs), so a deep queue is far cheaper than the relist storm
+    # an overflow triggers.
+    def __init__(self, store: "MemoryStore", capacity: int = 65536):
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=capacity)
         self._store = store
         self._stopped = threading.Event()
@@ -175,29 +213,48 @@ class MemoryStore:
             drop = len(self._history) - self._history_size
             self._compacted_rv = self._history[drop - 1][1].resource_version
             del self._history[:drop]
+        blob = None
         for prefix, stream in list(self._watchers):
             if key.startswith(prefix):
-                stream._deliver(
-                    WatchEvent(
-                        ev.type,
-                        _dc(ev.object),
-                        ev.resource_version,
-                        _dc(ev.prev_object),
+                if blob is None:
+                    try:
+                        blob = pickle.dumps(
+                            (ev.object, ev.prev_object),
+                            pickle.HIGHEST_PROTOCOL,
+                        )
+                    except Exception:
+                        blob = b""
+                if blob:
+                    stream._deliver(
+                        _LazyEvent(ev.type, ev.resource_version, blob)
                     )
-                )
+                else:  # unpicklable object: fall back to deep copies
+                    stream._deliver(
+                        WatchEvent(
+                            ev.type,
+                            _dc(ev.object),
+                            ev.resource_version,
+                            _dc(ev.prev_object),
+                        )
+                    )
 
-    def create(self, key: str, obj: Any) -> int:
+    def create(self, key: str, obj: Any, owned: bool = False) -> int:
+        """owned=True: the caller transfers ownership of obj (it already
+        made an isolation copy and keeps no reference) so the store can
+        skip its write copy — the apiserver's decode/copy boundary
+        qualifies."""
         with self._lock:
             if key in self._data:
                 raise KeyExists(key)
             rv = self._next_rv()
-            stored = _dc(obj)
+            stored = obj if owned else _dc(obj)
             self._set_rv(stored, rv)
             self._data[key] = (stored, rv)
             self._record(key, WatchEvent(ADDED, stored, rv))
             return rv
 
-    def update(self, key: str, obj: Any, expect_rv: Optional[int] = None) -> int:
+    def update(self, key: str, obj: Any, expect_rv: Optional[int] = None,
+               owned: bool = False) -> int:
         with self._lock:
             if key not in self._data:
                 raise KeyNotFound(key)
@@ -205,7 +262,7 @@ class MemoryStore:
             if expect_rv is not None and expect_rv != cur:
                 raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
             rv = self._next_rv()
-            stored = _dc(obj)
+            stored = obj if owned else _dc(obj)
             self._set_rv(stored, rv)
             self._data[key] = (stored, rv)
             self._record(key, WatchEvent(MODIFIED, stored, rv, prev))
@@ -231,9 +288,13 @@ class MemoryStore:
             new = fn(cur)
             if new is None:
                 return self._rv
+            # fn returning the copy it was handed (the normal in-place
+            # mutate) transfers ownership; any other object may still be
+            # referenced by the caller and gets the defensive copy
+            owned = new is cur
             if key in self._data:
-                return self.update(key, new)
-            return self.create(key, new)
+                return self.update(key, new, owned=owned)
+            return self.create(key, new, owned=owned)
 
     def delete(self, key: str, expect_rv: Optional[int] = None) -> Any:
         with self._lock:
