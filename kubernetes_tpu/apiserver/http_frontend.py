@@ -17,7 +17,33 @@ from urllib.parse import parse_qs, urlparse
 from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
 
 
-def start_http_server(api: APIServer, host: str, port: int):
+def _is_long_running(path: str, query: dict) -> bool:
+    """pkg/apiserver/handlers.go longRunningRE: watches (and the legacy
+    /watch/ prefix) are exempt from the in-flight limit — they hold a
+    connection for minutes by design. The prefix check mirrors
+    server._route: the segment right after the API group, not any path
+    segment that happens to be named "watch"."""
+    if query.get("watch") in ("true", "1"):
+        return True
+    parts = [p for p in path.split("/") if p]
+    if parts[:1] == ["api"]:
+        parts = parts[2:]
+    elif parts[:1] == ["apis"]:
+        parts = parts[3:]
+    else:
+        return False
+    return parts[:1] == ["watch"]
+
+
+def start_http_server(api: APIServer, host: str, port: int,
+                      tls_cert: str = "", tls_key: str = "",
+                      max_in_flight: int = 0):
+    """tls_cert/tls_key enable HTTPS (genericapiserver serves TLS by
+    default); max_in_flight > 0 bounds concurrent non-long-running
+    requests (handlers.go MaxInFlightLimit — excess returns 429)."""
+    in_flight = (
+        threading.Semaphore(max_in_flight) if max_in_flight > 0 else None
+    )
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -26,6 +52,38 @@ def start_http_server(api: APIServer, host: str, port: int):
 
         def _dispatch(self, method: str):
             parsed = urlparse(self.path)
+            query = {
+                k: v[0] for k, v in parse_qs(parsed.query).items() if v
+            }
+            limited = (
+                in_flight is not None
+                and not _is_long_running(parsed.path, query)
+            )
+            if limited and not in_flight.acquire(blocking=False):
+                # handlers.go MaxInFlightLimit: shed load instead of
+                # queueing unboundedly. Drain the request body first or
+                # the unread bytes corrupt the next keep-alive request.
+                length = int(self.headers.get("Content-Length") or 0)
+                while length > 0:
+                    chunk = self.rfile.read(min(length, 65536))
+                    if not chunk:
+                        break
+                    length -= len(chunk)
+                self._send_json(429, {
+                    "kind": "Status",
+                    "status": "Failure",
+                    "message": "too many requests, please try again later",
+                    "reason": "TooManyRequests",
+                    "code": 429,
+                })
+                return
+            try:
+                self._dispatch_inner(method, parsed, query)
+            finally:
+                if limited:
+                    in_flight.release()
+
+        def _dispatch_inner(self, method: str, parsed, query):
             # authn/authz when the server is configured with them
             # (handlers.go WithAuthentication/WithAuthorization shape)
             if getattr(api, "authenticator", None) is not None:
@@ -56,9 +114,6 @@ def start_http_server(api: APIServer, host: str, port: int):
                              f"{method} {attrs.resource or parsed.path}"},
                         )
                         return
-            query = {
-                k: v[0] for k, v in parse_qs(parsed.query).items() if v
-            }
             body = None
             length = int(self.headers.get("Content-Length") or 0)
             if length:
@@ -154,6 +209,17 @@ def start_http_server(api: APIServer, host: str, port: int):
                 w.stop()
 
     server = Server((host, port), Handler)
+    if tls_cert and tls_key:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key)
+        # handshake lazily in the per-connection handler thread — with
+        # do_handshake_on_connect a silent client would block accept()
+        # and wedge the whole server
+        server.socket = ctx.wrap_socket(
+            server.socket, server_side=True, do_handshake_on_connect=False
+        )
     server._watch_lock = threading.Lock()
     server._active_watches = []
     server._watches_closed = False

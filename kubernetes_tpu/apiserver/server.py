@@ -609,11 +609,19 @@ class APIServer:
 
     # -- HTTP frontend -------------------------------------------------------
 
-    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
-        """Start a threaded HTTP frontend; returns (host, actual_port)."""
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0,
+                   tls_cert: str = "", tls_key: str = "",
+                   max_in_flight: int = 0):
+        """Start a threaded HTTP(S) frontend; returns (host, actual_port).
+        tls_cert/tls_key serve TLS (genericapiserver default posture);
+        max_in_flight bounds concurrent non-watch requests
+        (handlers.go MaxInFlightLimit; excess gets 429)."""
         from kubernetes_tpu.apiserver.http_frontend import start_http_server
 
-        self._http_server, actual_port = start_http_server(self, host, port)
+        self._http_server, actual_port = start_http_server(
+            self, host, port, tls_cert=tls_cert, tls_key=tls_key,
+            max_in_flight=max_in_flight,
+        )
         return host, actual_port
 
     def shutdown_http(self) -> None:

@@ -73,11 +73,29 @@ class _StoppableEvents:
 
 
 class HTTPTransport:
-    """Minimal stdlib HTTP transport (chunked watch streaming)."""
+    """Minimal stdlib HTTP(S) transport (chunked watch streaming).
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    tls_ca pins the server certificate (the kubeconfig
+    certificate-authority idiom); insecure skips verification
+    (insecure-skip-tls-verify)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 tls_ca: str = "", insecure: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._ssl_ctx = None
+        if base_url.startswith("https"):
+            import ssl
+
+            if tls_ca:
+                # the kubeconfig certificate-authority idiom: pin the CA
+                # and KEEP hostname verification (anything signed by the
+                # CA for a different host must still be rejected)
+                self._ssl_ctx = ssl.create_default_context(cafile=tls_ca)
+            elif insecure:
+                self._ssl_ctx = ssl._create_unverified_context()
+            else:
+                self._ssl_ctx = ssl.create_default_context()
 
     def _url(self, path: str, query: Optional[Dict[str, str]]) -> str:
         url = self.base_url + path
@@ -92,7 +110,9 @@ class HTTPTransport:
         )
         req.add_header("Content-Type", "application/json")
         try:
-            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+            with urlrequest.urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as resp:
                 payload = resp.read()
                 return resp.status, json.loads(payload) if payload else {}
         except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
@@ -107,7 +127,7 @@ class HTTPTransport:
         query["watch"] = "true"
         req = urlrequest.Request(self._url(path, query))
         try:
-            resp = urlrequest.urlopen(req, timeout=None)
+            resp = urlrequest.urlopen(req, timeout=None, context=self._ssl_ctx)
         except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
             payload = e.read()
             try:

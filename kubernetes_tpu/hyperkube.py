@@ -37,8 +37,15 @@ def run_apiserver(args) -> None:
     from kubernetes_tpu.apiserver.server import APIServer
 
     server = APIServer(data_dir=args.data_dir or None)
-    host, port = server.serve_http(port=args.port)
-    print(f"kube-apiserver listening on http://{host}:{port}", flush=True)
+    host, port = server.serve_http(
+        port=args.port,
+        tls_cert=args.tls_cert_file,
+        tls_key=args.tls_private_key_file,
+        max_in_flight=args.max_requests_inflight,
+    )
+    scheme_str = "https" if args.tls_cert_file else "http"
+    print(f"kube-apiserver listening on {scheme_str}://{host}:{port}",
+          flush=True)
     _wait_forever()
 
 
@@ -145,6 +152,13 @@ def main(argv=None):
         "--data-dir", default="",
         help="persist the store here (WAL + snapshot); restarting with "
         "the same dir recovers all state with RV continuity",
+    )
+    p.add_argument("--tls-cert-file", default="")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument(
+        "--max-requests-inflight", type=int, default=0,
+        help="bound concurrent non-watch requests; excess gets 429 "
+        "(0 = unlimited)",
     )
 
     for name in ("scheduler", "controller-manager"):
