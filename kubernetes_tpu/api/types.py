@@ -341,6 +341,9 @@ class NodeStatus:
     images: List["ContainerImage"] = field(default_factory=list)
     addresses: List["NodeAddress"] = field(default_factory=list)
     phase: str = ""
+    # status.daemonEndpoints.kubeletEndpoint.Port flattened: where this
+    # node's kubelet API (logs/exec/stats) listens; 0 = not serving
+    kubelet_port: int = 0
 
 
 @dataclass

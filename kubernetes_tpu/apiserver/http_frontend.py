@@ -32,7 +32,12 @@ def _is_long_running(path: str, query: dict) -> bool:
         parts = parts[3:]
     else:
         return False
-    return parts[:1] == ["watch"]
+    if parts[:1] == ["watch"]:
+        return True
+    if parts[:1] == ["namespaces"] and len(parts) >= 3:
+        parts = parts[2:]
+    # the named-object subresource form: /{resource}/{name}/watch
+    return len(parts) >= 3 and parts[2] == "watch"
 
 
 def start_http_server(api: APIServer, host: str, port: int,

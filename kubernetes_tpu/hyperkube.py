@@ -18,11 +18,19 @@ import sys
 import time
 
 
-def _client(server: str):
+def _client(server: str, tls_ca: str = "", insecure: bool = False):
     from kubernetes_tpu.client.rest import RESTClient
     from kubernetes_tpu.client.transport import HTTPTransport
 
-    return RESTClient(HTTPTransport(server))
+    return RESTClient(HTTPTransport(server, tls_ca=tls_ca, insecure=insecure))
+
+
+def _client_from(args):
+    return _client(
+        args.server,
+        tls_ca=getattr(args, "certificate_authority", ""),
+        insecure=getattr(args, "insecure_skip_tls_verify", False),
+    )
 
 
 def _wait_forever():
@@ -71,7 +79,7 @@ def run_scheduler(args) -> None:
     )
 
     sched = SchedulerServer(
-        _client(args.server),
+        _client_from(args),
         SchedulerServerOptions(algorithm_provider=args.algorithm_provider),
     ).start()
     print("kube-scheduler running", flush=True)
@@ -82,7 +90,7 @@ def run_scheduler(args) -> None:
 def run_controller_manager(args) -> None:
     from kubernetes_tpu.controller.manager import ControllerManager
 
-    mgr = ControllerManager(_client(args.server)).start()
+    mgr = ControllerManager(_client_from(args)).start()
     print("kube-controller-manager running", flush=True)
     _wait_forever()
     mgr.stop()
@@ -92,8 +100,8 @@ def run_kubelet(args) -> None:
     from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
 
     kl = Kubelet(
-        _client(args.server),
-        KubeletConfig(node_name=args.node),
+        _client_from(args),
+        KubeletConfig(node_name=args.node, serve_api=args.serve_api),
         FakeRuntime() if args.fake_runtime else None,
     ).run()
     print(f"kubelet {args.node} running", flush=True)
@@ -104,7 +112,7 @@ def run_kubelet(args) -> None:
 def run_proxy(args) -> None:
     from kubernetes_tpu.proxy import Proxier
 
-    p = Proxier(_client(args.server), args.node).run()
+    p = Proxier(_client_from(args), args.node).run()
     print(f"kube-proxy {args.node} running", flush=True)
     _wait_forever()
     p.stop()
@@ -161,22 +169,35 @@ def main(argv=None):
         "(0 = unlimited)",
     )
 
+    def add_client_flags(p):
+        p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+        p.add_argument(
+            "--certificate-authority", default="",
+            help="CA file pinning a TLS apiserver (kubeconfig idiom)",
+        )
+        p.add_argument("--insecure-skip-tls-verify", action="store_true")
+
     for name in ("scheduler", "controller-manager"):
         p = sub.add_parser(name)
-        p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+        add_client_flags(p)
         if name == "scheduler":
             p.add_argument("--algorithm-provider", default="TPUProvider")
 
     p = sub.add_parser("kubelet")
-    p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    add_client_flags(p)
     p.add_argument("--node", required=True)
     p.add_argument("--fake-runtime", action="store_true", default=True)
+    p.add_argument(
+        "--serve-api", action="store_true",
+        help="serve the node API (logs/exec/stats) and register its "
+        "endpoint on the Node status",
+    )
 
     p = sub.add_parser("extender")
     p.add_argument("--port", type=int, default=8090)
 
     p = sub.add_parser("proxy")
-    p.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    add_client_flags(p)
     p.add_argument("--node", default="")
 
     p = sub.add_parser("local-up")

@@ -385,9 +385,76 @@ class Kubectl:
         return f'{resource} "{name}" successfully rolled out'
 
 
+    # -- node-backed verbs (kubelet API) -------------------------------------
+
+    def _kubelet_base(self, pod) -> str:
+        """Resolve the pod's node -> kubelet API endpoint (the reference
+        proxies via the apiserver; here the client dials the address the
+        kubelet registered on its Node status)."""
+        node = self.client.nodes().get(pod.spec.node_name)
+        port = node.status.kubelet_port
+        if not port:
+            raise RuntimeError(
+                f"node {node.metadata.name!r} does not serve the kubelet API"
+            )
+        host = next(
+            (a.address for a in node.status.addresses
+             if a.type == "InternalIP"),
+            "127.0.0.1",
+        )
+        return f"http://{host}:{port}"
+
+    def logs(self, name: str, container: str = "", tail: int = 0) -> str:
+        """kubectl logs (cmd/logs.go): fetch container logs through the
+        kubelet's /containerLogs endpoint."""
+        import urllib.request
+
+        pod = self._rc("pods").get(name)
+        if not pod.spec.node_name:
+            raise RuntimeError(f"pod {name!r} is not scheduled yet")
+        container = container or (
+            pod.spec.containers[0].name if pod.spec.containers else ""
+        )
+        url = (
+            f"{self._kubelet_base(pod)}/containerLogs/"
+            f"{pod.metadata.namespace}/{pod.metadata.name}/{container}"
+        )
+        if tail:
+            url += f"?tailLines={tail}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    def exec(self, name: str, command: Sequence[str],
+             container: str = "") -> str:
+        """kubectl exec (cmd/exec.go): run a command through the
+        kubelet's /exec endpoint."""
+        import urllib.parse
+        import urllib.request
+
+        pod = self._rc("pods").get(name)
+        if not pod.spec.node_name:
+            raise RuntimeError(f"pod {name!r} is not scheduled yet")
+        container = container or (
+            pod.spec.containers[0].name if pod.spec.containers else ""
+        )
+        q = urllib.parse.urlencode(
+            [("command", c) for c in command], doseq=False
+        )
+        url = (
+            f"{self._kubelet_base(pod)}/exec/"
+            f"{pod.metadata.namespace}/{pod.metadata.name}/{container}?{q}"
+        )
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read().decode()
+
+
 def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = None):
     parser = argparse.ArgumentParser(prog="kubectl")
     parser.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    parser.add_argument("--certificate-authority", default="",
+                        help="CA file pinning a TLS apiserver")
+    parser.add_argument("--insecure-skip-tls-verify", action="store_true")
     parser.add_argument("--namespace", "-n", default="default")
     sub = parser.add_subparsers(dest="verb", required=True)
 
@@ -437,6 +504,16 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--target-port", type=int, default=0)
 
+    p = sub.add_parser("logs")
+    p.add_argument("name")
+    p.add_argument("--container", "-c", default="")
+    p.add_argument("--tail", type=int, default=0)
+
+    p = sub.add_parser("exec")
+    p.add_argument("name")
+    p.add_argument("command", nargs="+")
+    p.add_argument("--container", "-c", default="")
+
     p = sub.add_parser("rollout")
     p.add_argument("subverb", choices=["status"])
     p.add_argument("target")
@@ -445,7 +522,11 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
 
     args = parser.parse_args(argv)
     if client is None:
-        client = RESTClient(HTTPTransport(args.server))
+        client = RESTClient(HTTPTransport(
+            args.server,
+            tls_ca=args.certificate_authority,
+            insecure=args.insecure_skip_tls_verify,
+        ))
     k = Kubectl(client, args.namespace)
 
     if args.verb == "get":
@@ -477,6 +558,10 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     elif args.verb == "expose":
         resource, name = args.target.split("/", 1)
         out = k.expose(resource, name, args.port, args.target_port)
+    elif args.verb == "logs":
+        out = k.logs(args.name, container=args.container, tail=args.tail)
+    elif args.verb == "exec":
+        out = k.exec(args.name, args.command, container=args.container)
     elif args.verb == "rollout":
         resource, name = args.target.split("/", 1)
         out = k.rollout_status(resource, name)

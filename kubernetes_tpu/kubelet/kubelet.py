@@ -52,6 +52,11 @@ class KubeletConfig:
     eviction_memory_threshold: int = 0
     eviction_sync_period: float = 1.0
     eviction_pressure_transition_period: float = 5.0
+    # node-local API (pkg/kubelet/server, the :10250 surface): serves
+    # /containerLogs, /exec, /stats/summary; port registers on the node
+    # status so kubectl logs/exec can resolve it
+    serve_api: bool = False
+    api_host: str = "127.0.0.1"
 
 
 class _PodWorker:
@@ -142,6 +147,8 @@ class Kubelet:
 
             h = int(_hl.sha1(config.node_name.encode()).hexdigest(), 16)
             self._ip_base = ("10", str(43 + h % 200))
+        self.api_server = None
+        self._api_addr = ("", 0)
         # config source: watch pods bound to this node (kubelet/config/
         # apiserver.go NewSourceApiserver field selector)
         self._informer = Informer(
@@ -160,24 +167,35 @@ class Kubelet:
     # -- node registration + heartbeats --------------------------------------
 
     def _node_object(self) -> t.Node:
+        status = t.NodeStatus(
+            capacity=dict(self.config.allocatable),
+            allocatable=dict(self.config.allocatable),
+            conditions=[
+                t.NodeCondition(
+                    "Ready",
+                    "True",
+                    last_heartbeat_time=_now(),
+                    reason="KubeletReady",
+                )
+            ],
+        )
+        self._apply_api_endpoint(status)
         return t.Node(
             metadata=t.ObjectMeta(
                 name=self.config.node_name,
                 labels={"kubernetes.io/hostname": self.config.node_name},
             ),
-            status=t.NodeStatus(
-                capacity=dict(self.config.allocatable),
-                allocatable=dict(self.config.allocatable),
-                conditions=[
-                    t.NodeCondition(
-                        "Ready",
-                        "True",
-                        last_heartbeat_time=_now(),
-                        reason="KubeletReady",
-                    )
-                ],
-            ),
+            status=status,
         )
+
+    def _apply_api_endpoint(self, status: t.NodeStatus) -> None:
+        """Register where this kubelet's node API listens
+        (status.daemonEndpoints.kubeletEndpoint in the reference)."""
+        if self._api_addr[1]:
+            status.addresses = [
+                t.NodeAddress("InternalIP", self._api_addr[0])
+            ]
+            status.kubelet_port = self._api_addr[1]
 
     def register_node(self) -> None:
         """kubelet.go registerWithApiserver."""
@@ -226,6 +244,7 @@ class Kubelet:
             else "KubeletHasSufficientMemory"
         )
         mem.last_heartbeat_time = now
+        self._apply_api_endpoint(node.status)
         try:
             self.client.nodes().update_status(node)
         except APIStatusError:
@@ -443,6 +462,11 @@ class Kubelet:
 
     def run(self) -> "Kubelet":
         """kubelet.go:957 Run."""
+        if self.config.serve_api:
+            from kubernetes_tpu.kubelet.server import KubeletServer
+
+            self.api_server = KubeletServer(self)
+            self._api_addr = self.api_server.serve(host=self.config.api_host)
         if self.config.register_node:
             self.register_node()
         self._informer.run()
@@ -461,6 +485,8 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.api_server is not None:
+            self.api_server.shutdown()
         self.pleg.stop()
         self.probe_manager.stop()
         if self.eviction_manager is not None:

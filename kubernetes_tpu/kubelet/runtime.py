@@ -44,6 +44,14 @@ class ContainerRuntime:
     def kill_pod(self, uid: str) -> None:
         raise NotImplementedError
 
+    def get_logs(self, uid: str, container: str, tail=None) -> List[str]:
+        """Container log lines (GetContainerLogs)."""
+        raise NotImplementedError
+
+    def exec_in(self, uid: str, container: str, command) -> str:
+        """Run a command in the container (ExecInContainer)."""
+        raise NotImplementedError
+
 
 class FakeRuntime(ContainerRuntime):
     def __init__(self):
@@ -58,6 +66,9 @@ class FakeRuntime(ContainerRuntime):
         # (pod_uid, container) -> exit code: per-pod terminal containers
         # (a liveness kill under restartPolicy Never stays down)
         self.exits_by_pod: Dict[Tuple[str, str], int] = {}
+        # node-API seams: recorded log lines and injectable exec replies
+        self._logs: Dict[Tuple[str, str], List[str]] = {}
+        self.exec_replies: Dict[Tuple[str, str], str] = {}
 
     def list_pods(self) -> List[RuntimePod]:
         with self._lock:
@@ -95,7 +106,27 @@ class FakeRuntime(ContainerRuntime):
             self.calls.append(("kill", uid))
             self._pods.pop(uid, None)
 
+    def get_logs(self, uid: str, container: str, tail=None) -> List[str]:
+        with self._lock:
+            lines = list(self._logs.get((uid, container), []))
+        return lines[-tail:] if tail else lines
+
+    def exec_in(self, uid: str, container: str, command) -> str:
+        with self._lock:
+            self.calls.append(("exec", uid))
+            reply = self.exec_replies.get((uid, container))
+        if reply is not None:
+            return reply
+        return " ".join(command) + "\n"  # echo shape (fake shell)
+
     # test helpers -----------------------------------------------------------
+
+    def write_log(self, uid: str, container: str, line: str) -> None:
+        """Append a container log line (the hollow-node seam for logs)."""
+        with self._lock:
+            self._logs.setdefault((uid, container), []).append(
+                line if line.endswith("\n") else line + "\n"
+            )
 
     def exit_container(self, uid: str, container: str, code: int = 0) -> None:
         """Simulate a container terminating on its own (PLEG will notice)."""

@@ -1,0 +1,158 @@
+"""The kubelet's node-local API (pkg/kubelet/server: the :10250 surface).
+
+Serves the debugging endpoints kubectl needs a node for:
+
+    GET  /healthz
+    GET  /pods                                   (this node's pod specs)
+    GET  /containerLogs/{ns}/{pod}/{container}   (?tailLines=N)
+    POST /exec/{ns}/{pod}/{container}?command=...
+    GET  /stats/summary                          (cadvisor-lite node stats)
+
+Log/exec content comes from the container runtime seam — FakeRuntime
+records written log lines and replies to exec with injectable output, the
+hollow-node idiom. The kubelet registers the serving address and port on
+its Node status (status.daemonEndpoints.kubeletEndpoint in the
+reference; addresses + kubelet_port here) so clients can resolve it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class KubeletServer:
+    def __init__(self, kubelet):
+        self.kubelet = kubelet
+        self._server = None
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        kl = self.kubelet
+
+        def find_pod(ns: str, name: str):
+            with kl._lock:
+                for p in kl._pods.values():
+                    if p.metadata.namespace == ns and p.metadata.name == name:
+                        return p
+            return None
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload, content_type="application/json"):
+                data = (
+                    payload.encode()
+                    if isinstance(payload, str)
+                    else json.dumps(payload).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    self._get(urlparse(self.path))
+                except ValueError as e:
+                    self._send(400, {"message": str(e)})
+                except Exception as e:
+                    self._send(500, {"message": str(e)})
+
+            def _get(self, parsed):
+                parts = [p for p in parsed.path.split("/") if p]
+                if parts == ["healthz"]:
+                    self._send(200, "ok", "text/plain")
+                    return
+                if parts == ["pods"]:
+                    from kubernetes_tpu.runtime import scheme
+
+                    with kl._lock:
+                        pods = list(kl._pods.values())
+                    self._send(200, {
+                        "kind": "PodList",
+                        "items": [scheme.encode(p) for p in pods],
+                    })
+                    return
+                if parts[:1] == ["containerLogs"] and len(parts) == 4:
+                    _, ns, name, container = parts
+                    pod = find_pod(ns, name)
+                    if pod is None:
+                        self._send(404, {"message": f"pod {ns}/{name} not found"})
+                        return
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    lines = kl.runtime.get_logs(
+                        pod.metadata.uid, container,
+                        tail=int(q["tailLines"]) if "tailLines" in q else None,
+                    )
+                    self._send(200, "".join(lines), "text/plain")
+                    return
+                if parts == ["stats", "summary"]:
+                    # cadvisor-lite: node memory availability (the signal
+                    # the eviction manager consumes) + per-pod presence
+                    mem_avail = None
+                    if kl.eviction_manager is not None:
+                        mem_avail = kl.eviction_manager.memory_available()
+                    with kl._lock:
+                        pods = list(kl._pods.values())
+                    self._send(200, {
+                        "node": {
+                            "nodeName": kl.config.node_name,
+                            "memory": {"availableBytes": mem_avail},
+                        },
+                        "pods": [
+                            {"podRef": {"namespace": p.metadata.namespace,
+                                        "name": p.metadata.name}}
+                            for p in pods
+                        ],
+                    })
+                    return
+                self._send(404, {"message": f"unknown path {parsed.path}"})
+
+            def do_POST(self):
+                try:
+                    self._post(urlparse(self.path))
+                except ValueError as e:
+                    self._send(400, {"message": str(e)})
+                except Exception as e:
+                    self._send(500, {"message": str(e)})
+
+            def _post(self, parsed):
+                parts = [p for p in parsed.path.split("/") if p]
+                if parts[:1] == ["exec"] and len(parts) == 4:
+                    _, ns, name, container = parts
+                    pod = find_pod(ns, name)
+                    if pod is None:
+                        self._send(404, {"message": f"pod {ns}/{name} not found"})
+                        return
+                    q = parse_qs(parsed.query)
+                    command = q.get("command", [])
+                    out = kl.runtime.exec_in(
+                        pod.metadata.uid, container, command
+                    )
+                    self._send(200, out, "text/plain")
+                    return
+                self._send(404, {"message": f"unknown path {parsed.path}"})
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        threading.Thread(
+            target=self._server.serve_forever,
+            name=f"kubelet-server-{kl.config.node_name}",
+            daemon=True,
+        ).start()
+        return host, self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

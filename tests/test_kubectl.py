@@ -166,3 +166,53 @@ def test_get_nodes_and_events(kubectl):
     assert "n1" in out and "Ready" in out
     # version is a cheap sanity verb
     assert "kubernetes-tpu" in Kubectl(client).get("nodes") or True
+
+
+def test_logs_and_exec_via_kubelet_api():
+    """kubectl logs/exec resolve the pod's node to its kubelet API
+    (pkg/kubelet/server) and fetch through /containerLogs and /exec."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import RESTClient
+    from kubernetes_tpu.client.transport import LocalTransport
+    from kubernetes_tpu.kubectl.cmd import Kubectl
+    from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
+    from kubernetes_tpu.api.types import Container, ObjectMeta, Pod, PodSpec
+    import time
+
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    runtime = FakeRuntime()
+    kl = Kubelet(client, KubeletConfig(
+        node_name="n1", serve_api=True,
+        pleg_relist_period=0.05, status_sync_period=0.05,
+        node_status_update_frequency=0.05,
+    ), runtime).run()
+    try:
+        client.pods().create(Pod(
+            metadata=ObjectMeta(name="web"),
+            spec=PodSpec(node_name="n1",
+                         containers=[Container(name="main")]),
+        ))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            p = client.pods().get("web")
+            n = client.nodes().get("n1")
+            if p.status.phase == "Running" and n.status.kubelet_port:
+                break
+            time.sleep(0.05)
+        pod = client.pods().get("web")
+        runtime.write_log(pod.metadata.uid, "main", "hello from main")
+        runtime.write_log(pod.metadata.uid, "main", "second line")
+
+        k = Kubectl(client)
+        out = k.logs("web")
+        assert out == "hello from main\nsecond line\n"
+        assert k.logs("web", tail=1) == "second line\n"
+
+        runtime.exec_replies[(pod.metadata.uid, "main")] = "root\n"
+        assert k.exec("web", ["whoami"]) == "root\n"
+        # default echo shape without an injected reply
+        del runtime.exec_replies[(pod.metadata.uid, "main")]
+        assert k.exec("web", ["echo", "hi"]) == "echo hi\n"
+    finally:
+        kl.stop()
