@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
+from kubernetes_tpu.runtime import binary
 
 
 def _is_long_running(path: str, query: dict) -> bool:
@@ -42,10 +43,14 @@ def _is_long_running(path: str, query: dict) -> bool:
 
 def start_http_server(api: APIServer, host: str, port: int,
                       tls_cert: str = "", tls_key: str = "",
-                      max_in_flight: int = 0):
+                      max_in_flight: int = 0,
+                      enable_binary: bool = False):
     """tls_cert/tls_key enable HTTPS (genericapiserver serves TLS by
     default); max_in_flight > 0 bounds concurrent non-long-running
-    requests (handlers.go MaxInFlightLimit — excess returns 429)."""
+    requests (handlers.go MaxInFlightLimit — excess returns 429);
+    enable_binary opts the listener into the code-bearing binary content
+    type (runtime/binary.py trust model) — off, binary bodies get 415
+    and Accept negotiation is ignored."""
     in_flight = (
         threading.Semaphore(max_in_flight) if max_in_flight > 0 else None
     )
@@ -119,18 +124,49 @@ def start_http_server(api: APIServer, host: str, port: int,
                              f"{method} {attrs.resource or parsed.path}"},
                         )
                         return
+            # content negotiation (protobuf-content-type analogue):
+            # binary bodies decode to API objects, binary Accept answers
+            # with the object-protocol payload in a binary envelope
+            wants_binary = enable_binary and binary.CONTENT_TYPE in (
+                self.headers.get("Accept") or ""
+            )
             body = None
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 raw = self.rfile.read(length)
-                try:
-                    body = json.loads(raw)
-                except json.JSONDecodeError:
-                    self._send_json(400, {"message": "invalid JSON body"})
-                    return
-            code, payload = api.handle(method, parsed.path, query, body)
+                if (self.headers.get("Content-Type") or "").startswith(
+                    binary.CONTENT_TYPE
+                ):
+                    if not enable_binary:
+                        self._send_json(415, {
+                            "message": "binary wire format is not enabled "
+                            "on this listener",
+                        })
+                        return
+                    try:
+                        body = binary.decode(raw)
+                    except binary.BinaryDecodeError as e:
+                        self._send_json(400, {"message": str(e)})
+                        return
+                else:
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        self._send_json(400, {"message": "invalid JSON body"})
+                        return
+            code, payload = api.handle(
+                method, parsed.path, query, body, obj_mode=wants_binary
+            )
             if isinstance(payload, WatchResponse):
                 self._stream_watch(payload)
+                return
+            if wants_binary:
+                data = binary.encode(payload)
+                self.send_response(code)
+                self.send_header("Content-Type", binary.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
                 return
             if parsed.path == "/metrics" and code == 200:
                 text = payload.get("text", "").encode()
@@ -161,8 +197,12 @@ def start_http_server(api: APIServer, host: str, port: int,
                     watch.stop()
                 else:
                     self.server._active_watches.append(watch)
+            binary_stream = watch.obj_mode
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header(
+                "Content-Type",
+                binary.CONTENT_TYPE if binary_stream else "application/json",
+            )
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             try:
@@ -170,7 +210,13 @@ def start_http_server(api: APIServer, host: str, port: int,
                 # quiet watches don't pin a thread + store watcher forever
                 for event in watch.events(idle_timeout=3.0):
                     if event is None:
-                        frame = b"\n"  # keepalive; clients skip blank lines
+                        # keepalive: blank NDJSON line / zero-length frame
+                        frame = (
+                            binary.encode_frame(None) if binary_stream
+                            else b"\n"
+                        )
+                    elif binary_stream:
+                        frame = binary.encode_frame(event)
                     else:
                         frame = json.dumps(event).encode() + b"\n"
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))

@@ -611,7 +611,7 @@ class APIServer:
 
     def serve_http(self, host: str = "127.0.0.1", port: int = 0,
                    tls_cert: str = "", tls_key: str = "",
-                   max_in_flight: int = 0):
+                   max_in_flight: int = 0, enable_binary: bool = False):
         """Start a threaded HTTP(S) frontend; returns (host, actual_port).
         tls_cert/tls_key serve TLS (genericapiserver default posture);
         max_in_flight bounds concurrent non-watch requests
@@ -620,7 +620,7 @@ class APIServer:
 
         self._http_server, actual_port = start_http_server(
             self, host, port, tls_cert=tls_cert, tls_key=tls_key,
-            max_in_flight=max_in_flight,
+            max_in_flight=max_in_flight, enable_binary=enable_binary,
         )
         return host, actual_port
 

@@ -12,6 +12,8 @@ an event iterator for watches.
 from __future__ import annotations
 
 import json
+
+from kubernetes_tpu.runtime import binary as bin_codec
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib import parse as urlparse
 from urllib import request as urlrequest
@@ -80,9 +82,16 @@ class HTTPTransport:
     (insecure-skip-tls-verify)."""
 
     def __init__(self, base_url: str, timeout: float = 30.0,
-                 tls_ca: str = "", insecure: bool = False):
+                 tls_ca: str = "", insecure: bool = False,
+                 binary: bool = False):
+        """binary=True negotiates the binary content type
+        (runtime/binary.py) — the protobuf-at-scale analogue kubemark
+        components default to. Implies the object protocol client-side
+        (no reflective codec on either end)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.binary = binary
+        self.object_protocol = binary
         self._ssl_ctx = None
         if base_url.startswith("https"):
             import ssl
@@ -104,38 +113,92 @@ class HTTPTransport:
         return url
 
     def request(self, method, path, query=None, body=None):
-        data = json.dumps(body).encode() if body is not None else None
+        if self.binary:
+            data = bin_codec.encode(body) if body is not None else None
+            content_type = bin_codec.CONTENT_TYPE
+        else:
+            data = json.dumps(body).encode() if body is not None else None
+            content_type = "application/json"
         req = urlrequest.Request(
             self._url(path, query), data=data, method=method.upper()
         )
-        req.add_header("Content-Type", "application/json")
+        req.add_header("Content-Type", content_type)
+        if self.binary:
+            req.add_header("Accept", content_type)
         try:
             with urlrequest.urlopen(
                 req, timeout=self.timeout, context=self._ssl_ctx
             ) as resp:
                 payload = resp.read()
-                return resp.status, json.loads(payload) if payload else {}
+                return resp.status, self._decode_payload(resp, payload)
         except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
             payload = e.read()
             try:
-                return e.code, json.loads(payload)
+                return e.code, self._decode_payload(e, payload)
             except Exception:
                 return e.code, {"message": payload.decode(errors="replace")}
+
+    def _decode_payload(self, resp, payload):
+        if not payload:
+            return {}
+        # only a client that OPTED INTO the binary protocol unpickles:
+        # a JSON client must never deserialize code-bearing payloads on a
+        # server's say-so (runtime/binary.py trust model)
+        if self.binary:
+            ctype = resp.headers.get("Content-Type", "") if hasattr(
+                resp, "headers"
+            ) else ""
+            if ctype.startswith(bin_codec.CONTENT_TYPE):
+                return bin_codec.decode(payload)
+        return json.loads(payload)
 
     def watch(self, path, query=None):
         query = dict(query or {})
         query["watch"] = "true"
         req = urlrequest.Request(self._url(path, query))
+        if self.binary:
+            req.add_header("Accept", bin_codec.CONTENT_TYPE)
         try:
             resp = urlrequest.urlopen(req, timeout=None, context=self._ssl_ctx)
         except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
             payload = e.read()
             try:
-                status = json.loads(payload)
+                status = self._decode_payload(e, payload)
             except Exception:
                 status = {"message": payload.decode(errors="replace")}
             raise WatchError(e.code, status)
+        if self.binary:
+            return _BinaryEvents(resp)
         return _HTTPEvents(resp)
+
+
+class _BinaryEvents:
+    """Length-prefixed binary watch frames (runtime/binary.py)."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self._stopped = False
+
+    def __iter__(self):
+        try:
+            for frame in bin_codec.read_frames(self._resp):
+                if self._stopped:
+                    return
+                if frame is None:
+                    continue  # keepalive
+                yield frame
+        except Exception:
+            if not self._stopped:
+                raise
+        finally:
+            self._resp.close()
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._resp.close()
+        except Exception:
+            pass
 
 
 class _HTTPEvents:

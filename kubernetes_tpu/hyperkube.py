@@ -50,6 +50,7 @@ def run_apiserver(args) -> None:
         tls_cert=args.tls_cert_file,
         tls_key=args.tls_private_key_file,
         max_in_flight=args.max_requests_inflight,
+        enable_binary=args.enable_binary_wire,
     )
     scheme_str = "https" if args.tls_cert_file else "http"
     print(f"kube-apiserver listening on {scheme_str}://{host}:{port}",
@@ -167,6 +168,12 @@ def main(argv=None):
         "--max-requests-inflight", type=int, default=0,
         help="bound concurrent non-watch requests; excess gets 429 "
         "(0 = unlimited)",
+    )
+    p.add_argument(
+        "--enable-binary-wire", action="store_true",
+        help="accept/serve the binary content type for cluster-internal "
+        "clients (kubemark-style protobuf analogue); keep off for "
+        "untrusted callers",
     )
 
     def add_client_flags(p):

@@ -1,0 +1,66 @@
+"""Binary wire format (the protobuf content-type analogue).
+
+The reference serves JSON and protobuf; kubemark runs protobuf because
+reflective JSON codec cost dominates control-plane CPU at 1000-node
+scale (hollow-node.go:65, runtime/serializer/protobuf/protobuf.go). This
+framework's equivalent binary serializer is a magic-prefixed pickle
+envelope: both ends share the dataclass schema, so pickle IS the
+generated-marshaller analogue — no reflective field walk, C-speed
+encode/decode.
+
+Negotiation mirrors the reference: clients send Content-Type/Accept
+`application/vnd.kubernetes-tpu.binary` and the HTTP frontend answers in
+kind; JSON remains the default and the interop format. Watch streams
+frame events as length-prefixed envelopes instead of NDJSON.
+
+Trust model: like the reference's protobuf listener, this wire is for
+cluster-internal components on a trusted network (pickle payloads are
+code-bearing by nature; never expose this content type to untrusted
+callers — the JSON surface exists for them).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+CONTENT_TYPE = "application/vnd.kubernetes-tpu.binary"
+# protobuf.go:17-33 magic-prefixed envelope idea
+MAGIC = b"k8s-tpu\x00"
+_LEN = struct.Struct("<I")
+
+
+class BinaryDecodeError(Exception):
+    pass
+
+
+def encode(payload: Any) -> bytes:
+    """Envelope any handler payload (API object, list dict carrying
+    objects, Status dict)."""
+    return MAGIC + pickle.dumps(payload, pickle.HIGHEST_PROTOCOL)
+
+
+def decode(data: bytes) -> Any:
+    if not data.startswith(MAGIC):
+        raise BinaryDecodeError("missing binary envelope magic")
+    return pickle.loads(data[len(MAGIC):])
+
+
+def encode_frame(payload: Any) -> bytes:
+    """One length-prefixed watch frame."""
+    body = encode(payload)
+    return _LEN.pack(len(body)) + body
+
+
+def read_frames(fp):
+    """Yield decoded frames from a binary watch stream until EOF."""
+    while True:
+        header = fp.read(_LEN.size)
+        if len(header) < _LEN.size:
+            return
+        (n,) = _LEN.unpack(header)
+        body = fp.read(n)
+        if len(body) < n:
+            return
+        yield decode(body)
