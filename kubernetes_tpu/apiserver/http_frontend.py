@@ -168,13 +168,16 @@ def start_http_server(api: APIServer, host: str, port: int,
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            if parsed.path == "/metrics" and code == 200:
-                text = payload.get("text", "").encode()
+            if code == 200 and isinstance(payload, dict) and "_raw" in payload:
+                raw_body = payload["_raw"]
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(text)))
+                self.send_header(
+                    "Content-Type",
+                    payload.get("_content_type", "text/plain"),
+                )
+                self.send_header("Content-Length", str(len(raw_body)))
                 self.end_headers()
-                self.wfile.write(text)
+                self.wfile.write(raw_body)
                 return
             self._send_json(code, payload)
 

@@ -255,10 +255,21 @@ class APIServer:
     def _handle(self, method, path, query, body, obj_mode=False):
         if path == "/healthz":
             return 200, {"ok": True}
+        if path in ("/ui", "/ui/"):
+            from kubernetes_tpu.apiserver.ui import UI_HTML
+
+            # raw-content marker: frontends serve _raw bytes verbatim
+            return 200, {"_raw": UI_HTML.encode(),
+                         "_content_type": "text/html; charset=utf-8"}
         if path == "/metrics":
             from kubernetes_tpu.metrics import registry as metrics_registry
 
-            return 200, {"text": metrics_registry.render()}
+            return 200, {
+                "_raw": metrics_registry.render().encode(),
+                "_content_type": "text/plain; version=0.0.4",
+                # kept for in-process callers reading the text directly
+                "text": metrics_registry.render(),
+            }
         if path == "/configz":
             from kubernetes_tpu.utils import configz
 

@@ -628,3 +628,19 @@ class TestBinaryWireFormat:
                 srv.stop()
         finally:
             api.shutdown_http()
+
+
+def test_ui_dashboard_served(api):
+    """The www/ dashboard analogue: /ui serves the static cluster view,
+    whose data calls ride the ordinary JSON list endpoints."""
+    host, port = api.serve_http()
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/ui") as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/html")
+            body = r.read().decode()
+        assert "kubernetes-tpu" in body
+        for resource in ("nodes", "pods", "services", "events"):
+            assert resource in body
+    finally:
+        api.shutdown_http()
