@@ -91,6 +91,12 @@ def run_config(num_nodes, num_pods):
 
 
 def main():
+    # Self-provision the C replay engine (cached by mtime): without it the
+    # wave fast path degrades ~10x to the Python spec replay, and the
+    # recorded number stops containing the work (round-2 VERDICT #1).
+    from kubernetes_tpu.native.build import ensure_all
+
+    ensure_all()
     dt, _ = run_config(NUM_NODES, NUM_PODS)
     pods_per_sec = NUM_PODS / dt
     print(
@@ -100,6 +106,8 @@ def main():
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+                "baseline_kind": "assumed (published v1.3-era ~100 pods/s; "
+                "no Go toolchain in this image to measure the reference)",
             }
         )
     )

@@ -21,7 +21,6 @@ differentially tested against this one.
 from __future__ import annotations
 
 import ctypes
-import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -136,9 +135,15 @@ _LIB_FAILED = False
 def _load_lib():
     global _LIB, _LIB_FAILED
     if _LIB is None and not _LIB_FAILED:
-        path = os.path.join(
-            os.path.dirname(__file__), "..", "native", "_replay.so"
-        )
+        # Build on demand (cached by mtime): the driver environment runs
+        # bench/tests with no manual `make` step, and the Python fallback
+        # is ~10x slower — the fast path must be self-provisioning.
+        from kubernetes_tpu.native.build import ensure_replay
+
+        path = ensure_replay()
+        if path is None:
+            _LIB_FAILED = True
+            return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:

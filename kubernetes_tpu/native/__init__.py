@@ -1,12 +1,26 @@
 """Native (C) components.
 
-- `_kquantity`: resource-quantity parser fast path (built from
-  _kquantity.c via `make -C kubernetes_tpu/native` or
-  `python setup.py build_ext --inplace` at the repo root). Importing this
-  package without the built extension raises ImportError; callers
-  (api/resource.py) degrade to the pure-Python parser.
+- `_replay.so`: the wave-replay engine (pure C, loaded via ctypes from
+  models/replay.py).
+- `_kquantity`: resource-quantity parser fast path (CPython extension).
 - `pause.c` (under build/pause/): the pod sandbox placeholder binary,
   mirroring the reference's only C file (build/pause/pause.c).
+
+Both libraries are self-provisioning: `build.ensure_all()` compiles them
+on demand (cached by source mtime) whenever a C compiler is present, so
+no manual `make -C kubernetes_tpu/native` step is needed. Importing this
+package without a built `_kquantity` and without a compiler raises
+ImportError; callers (api/resource.py) degrade to the pure-Python parser.
 """
 
-from kubernetes_tpu.native import _kquantity  # noqa: F401
+from kubernetes_tpu.native import build as _build
+
+_build.ensure_kquantity()
+
+try:
+    from kubernetes_tpu.native import _kquantity  # noqa: E402,F401
+except ImportError:
+    # No compiler / no Python headers: the package itself must stay
+    # importable (build.ensure_replay is reached through it), and
+    # api/resource.py degrades to the pure-Python parser.
+    pass

@@ -21,3 +21,10 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# Build the native engines up front (cached by mtime) so the C-replay
+# differential fuzz tests exercise replay.c instead of silently skipping
+# (the round-2 failure: the driver's test run never executed the C path).
+from kubernetes_tpu.native.build import ensure_all
+
+ensure_all()
