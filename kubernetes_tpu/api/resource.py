@@ -55,14 +55,27 @@ class Quantity:
     value_frac: Fraction
 
     def value(self) -> int:
-        """Base-unit int64 value, rounded away from zero (Quantity.Value)."""
-        f = self.value_frac
-        return math.ceil(f) if f >= 0 else math.floor(f)
+        """Base-unit int64 value, rounded away from zero (Quantity.Value).
+
+        Memoized per instance: parse_quantity's string cache shares
+        Quantity objects across the whole snapshot, so the Fraction
+        ceil/floor runs once per distinct string, not once per node/pod
+        (the encode hot path at 5k-node scale)."""
+        v = self.__dict__.get("_value")
+        if v is None:
+            f = self.value_frac
+            v = math.ceil(f) if f >= 0 else math.floor(f)
+            object.__setattr__(self, "_value", v)
+        return v
 
     def milli_value(self) -> int:
         """Milli-unit int64 value, rounded away from zero (Quantity.MilliValue)."""
-        f = self.value_frac * 1000
-        return math.ceil(f) if f >= 0 else math.floor(f)
+        v = self.__dict__.get("_milli")
+        if v is None:
+            f = self.value_frac * 1000
+            v = math.ceil(f) if f >= 0 else math.floor(f)
+            object.__setattr__(self, "_milli", v)
+        return v
 
     def is_zero(self) -> bool:
         return self.value_frac == 0

@@ -148,7 +148,10 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
     nzj_mem = nz_mem[None, :] + j * pod["nz_mem"]
     tab = jnp.zeros((J, N), jnp.int64)
     static_add = jnp.zeros((N,), jnp.int64)
-    out = {"fit_static": fit_static, "res_fit": res_fit}
+    out = {}
+    zeros = jnp.zeros((N,), jnp.int64)
+    stk_rows = {"spread_base": zeros, "spread_selfmatch": zeros,
+                "na_counts": zeros, "tt_counts": zeros, "ip_totals": zeros}
     for name, weight in config.priorities:
         if name == LEAST_REQUESTED:
             tab = tab + jnp.int64(weight) * R.least_requested(
@@ -163,24 +166,27 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
         elif name == SELECTOR_SPREAD:
             # unmasked base counts; the replay applies the fit mask and
             # maxCount normalization per pick (ops/priorities.py:62)
-            out["spread_base"] = (
+            stk_rows["spread_base"] = (
                 class_count.astype(jnp.int32)
                 @ pod["spread_match"].astype(jnp.int32)
             ).astype(jnp.int64)
-            out["spread_selfmatch"] = pod["spread_match"][pod["class_id"]] > 0
+            stk_rows["spread_selfmatch"] = jnp.broadcast_to(
+                (pod["spread_match"][pod["class_id"]] > 0).astype(jnp.int64),
+                (N,),
+            )
         elif name == NODE_AFFINITY:
-            out["na_counts"] = R.node_affinity_counts(
+            stk_rows["na_counts"] = R.node_affinity_counts(
                 pod["pref_valid"], pod["pref_weight"], pod["pref_ops"],
                 pod["pref_key"], pod["pref_set"], pod["pref_numkey"],
                 pod["pref_num"], static["label_kv"], static["label_key"],
                 static["numval"], static["set_table"],
             )
         elif name == TAINT_TOLERATION:
-            out["tt_counts"] = (
+            stk_rows["tt_counts"] = (
                 static["taint_count"] @ pod["intolerable_prefer"]
             ).astype(jnp.int64)
         elif name == INTER_POD_AFFINITY:
-            out["ip_totals"] = IP.interpod_totals(
+            stk_rows["ip_totals"] = IP.interpod_totals(
                 cnt_lt,
                 IP.gather_lt(ip_rev_hard, static["ip_u_topo"],
                              static["ip_topo_dom"], static["ip_lt_u"],
@@ -209,11 +215,46 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
             raise ValueError("ServiceAntiAffinity is not wave-eligible")
         else:
             raise ValueError(f"unknown priority {name!r}")
-    # scores are small (weights are range-guarded in models/wave.py);
-    # i32 halves the device->host table transfer
-    out["tab"] = tab.astype(jnp.int32)
-    out["static_add"] = static_add
+    # The device->host shipment is LATENCY bound on a tunneled chip
+    # (~75-120ms per dispatch/transfer round trip, measured), so the
+    # probe's entire product ships as ONE i64 array:
+    #   rows 0-7: the 1-D tables (fit_static, fit frontier, static_add,
+    #     spread/na/tt/ip), and
+    #   rows 8+: the [J, N] j-table in the narrowest safe dtype (scores
+    #     are bounded by 10 * the summed LR/BA weights), bitcast-packed
+    #     into i64 words along the j axis.
+    # res_fit itself never ships: per-node resource fit is monotone
+    # non-increasing in j (commits only consume capacity, and the
+    # host-port self-conflict kills j>0 outright), so its sum over j —
+    # the fit frontier — reconstructs it host-side as j < frontier[n].
+    frontier = res_fit.sum(0, dtype=jnp.int64)
+    stk = jnp.stack([
+        fit_static.astype(jnp.int64),
+        frontier,
+        static_add,
+        stk_rows["spread_base"],
+        stk_rows["spread_selfmatch"],
+        stk_rows["na_counts"],
+        stk_rows["tt_counts"],
+        stk_rows["ip_totals"],
+    ])
+    dt = _tab_dtype(config)
+    k = 8 // np.dtype(dt).itemsize  # J is pow2 >= 16, always divisible
+    tabp = tab.astype(dt).reshape(J // k, k, N).swapaxes(1, 2)
+    tabw = jax.lax.bitcast_convert_type(tabp, jnp.int64)  # (J//k, N)
+    out["packed"] = jnp.concatenate([stk, tabw], axis=0)
     return out
+
+
+def _tab_dtype(config: SchedulerConfig):
+    """Narrowest dtype holding every possible j-table score: each
+    configured LR/BA priority contributes weight * [0, 10]."""
+    bound = 10 * sum(
+        abs(w) for n, w in config.priorities
+        if n in (LEAST_REQUESTED, BALANCED_ALLOCATION)
+    )
+    return (np.int8 if bound <= 127
+            else np.int16 if bound <= 32767 else np.int32)
 
 
 class WaveProbe:
@@ -236,28 +277,52 @@ class WaveProbe:
         return fn
 
     def probe(self, static, carry, pod, num_zones: int, num_values: int,
-              J: int) -> RunTables:
+              J: int, rows: Optional[int] = None,
+              has_selectors: Optional[bool] = None) -> RunTables:
+        """rows (<= J) bounds the j-depth the replay can need (the
+        capacity bound from wave._pick_j, +2 so a node's fit observably
+        reaches False before the table horizon). The full packed array
+        still crosses the device->host boundary in ONE transfer (the
+        tunnel is latency-bound, so one fat transfer beats a slice
+        dispatch + thin transfer); the clip to `rows` happens host-side
+        and keeps the replay tables small."""
+        if rows is None:
+            rows = J
+        rows = max(1, min(rows, J))
         raw = self._compiled(num_zones, num_values, J)(static, carry, pod)
-        raw = jax.device_get(raw)
+        # ONE device->host transfer for the whole probe product
+        arr = np.ascontiguousarray(jax.device_get(raw["packed"]))
+        stk = arr[:8]
+        dt = _tab_dtype(self.config)
+        k = 8 // np.dtype(dt).itemsize
+        N = arr.shape[1]
+        tab = (
+            arr[8:].view(dt).reshape(J // k, N, k)
+            .transpose(0, 2, 1).reshape(J, N)[:rows]
+        )
+        fit_static = stk[0].astype(bool)
+        frontier = stk[1]
+        res_fit = np.arange(rows, dtype=np.int64)[:, None] < frontier[None, :]
         weights = {n if isinstance(n, str) else n[0]: w
                    for n, w in self.config.priorities}
+        w_spread = int(weights.get(SELECTOR_SPREAD, 0))
+        w_na = int(weights.get(NODE_AFFINITY, 0))
+        w_tt = int(weights.get(TAINT_TOLERATION, 0))
+        w_ip = int(weights.get(INTER_POD_AFFINITY, 0))
         return RunTables(
-            fit_static=np.asarray(raw["fit_static"]),
-            res_fit=np.asarray(raw["res_fit"]),
-            tab=np.asarray(raw["tab"]).astype(np.int64),
-            static_add=np.asarray(raw["static_add"]),
-            w_spread=int(weights.get(SELECTOR_SPREAD, 0)),
-            spread_base=(np.asarray(raw["spread_base"])
-                         if "spread_base" in raw else None),
-            spread_selfmatch=bool(raw.get("spread_selfmatch", False)),
-            has_selectors=bool(np.asarray(pod["has_selectors"])),
-            w_na=int(weights.get(NODE_AFFINITY, 0)),
-            na_counts=(np.asarray(raw["na_counts"])
-                       if "na_counts" in raw else None),
-            w_tt=int(weights.get(TAINT_TOLERATION, 0)),
-            tt_counts=(np.asarray(raw["tt_counts"])
-                       if "tt_counts" in raw else None),
-            w_ip=int(weights.get(INTER_POD_AFFINITY, 0)),
-            ip_totals=(np.asarray(raw["ip_totals"])
-                       if "ip_totals" in raw else None),
+            fit_static=fit_static,
+            res_fit=res_fit,
+            tab=np.asarray(tab).astype(np.int64),
+            static_add=stk[2],
+            w_spread=w_spread,
+            spread_base=stk[3] if w_spread else None,
+            spread_selfmatch=bool(stk[4][0]) if w_spread else False,
+            has_selectors=(bool(np.asarray(pod["has_selectors"]))
+                           if has_selectors is None else has_selectors),
+            w_na=w_na,
+            na_counts=stk[5] if w_na else None,
+            w_tt=w_tt,
+            tt_counts=stk[6] if w_tt else None,
+            w_ip=w_ip,
+            ip_totals=stk[7] if w_ip else None,
         )

@@ -48,6 +48,7 @@ from kubernetes_tpu.models.batch import (
     TAINT_TOLERATION,
     BatchScheduler,
     SchedulerConfig,
+    wants_resources,
 )
 from kubernetes_tpu.models.probe import RunTables, WaveProbe
 from kubernetes_tpu.models.replay import ReplayResult, replay_fast
@@ -290,15 +291,28 @@ class WaveScheduler:
         }
 
     def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
-                K: int) -> int:
-        """Table depth: enough j rows to cover the deepest possible
-        per-node commit count, bucketed for compile reuse. Computed
-        from the run-start snapshot only — commits monotonically shrink
-        every node's remaining capacity, so this stays an upper bound
-        for the whole backlog (no device sync needed)."""
+                K: int) -> Tuple[int, int]:
+        """-> (J, rows). J is the compiled table depth (pow2-bucketed
+        for compile reuse); rows <= J is the replay's table horizon —
+        the capacity bound +2, so the most capacious node's fit
+        observably goes False inside the table instead of tripping the
+        horizon bail (which would force a full re-probe of the
+        remaining run). The probe ships the full packed J-table in one
+        transfer and clips to `rows` host-side (transfer is latency-
+        bound, not bandwidth-bound); `rows` exists to bound the replay
+        and keep the host tables small. Computed from the run-start
+        snapshot only — commits monotonically shrink every node's
+        remaining capacity, so this stays an upper bound for the whole
+        backlog (no device sync)."""
         alloc_pods = np.asarray(snap.alloc_pods)
         if not alloc_pods.size:
-            return 16
+            return 16, 16
+        if not wants_resources(self.config):
+            # no PodFitsResources: nothing enforces the capacity bound,
+            # res_fit never goes False, and clipping rows below J would
+            # horizon-bail (and re-probe) every `rows` picks
+            J = next_pow2(min(K + 1, self.max_j), floor=128)
+            return J, J
         cap = np.maximum(alloc_pods - np.asarray(snap.pod_count), 0)
         # the commit vector shrinks cpu/mem headroom too (a fit at j
         # implies j*commit + request <= alloc); use whichever bound is
@@ -310,10 +324,11 @@ class WaveScheduler:
             if commit > 0:
                 room = np.maximum(np.asarray(alloc) - np.asarray(used), 0)
                 cap = np.minimum(cap, room // commit + 1)
-        J = min(K, int(cap.max())) + 1
+        depth = min(K, int(cap.max()) + 1) + 1
         # floor 128: one probe program serves every wave size (a small
         # K would otherwise compile J=16/32/64 variants for nothing)
-        return next_pow2(min(J, self.max_j), floor=128)
+        J = next_pow2(min(depth, self.max_j), floor=128)
+        return J, min(depth, J)
 
     def schedule_backlog(
         self,
@@ -397,9 +412,10 @@ class WaveScheduler:
             done = 0
             while done < length:
                 K = length - done
-                J = self._pick_j(snap, batch, rep, K)
+                J, rows = self._pick_j(snap, batch, rep, K)
                 tables = self.probe.probe(
-                    static, carry, pod, num_zones, num_values, J
+                    static, carry, pod, num_zones, num_values, J, rows,
+                    has_selectors=bool(batch.has_selectors[rep]),
                 )
                 res: ReplayResult = self._replay(
                     _permute_tables(tables, perm), K, L_host

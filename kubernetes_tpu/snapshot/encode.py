@@ -93,26 +93,47 @@ def pod_feature_key(pod: Pod) -> tuple:
     compilers) read. The name is deliberately absent: predicates,
     priorities and selectHost never consult it for the pending pod."""
 
+    # This runs once per backlog pod (50k+ at the north-star config), so
+    # the implementation avoids generator/sort overhead for the common
+    # shapes: 0-2 entry dicts, string-valued resource requests.
+
+    def _d(d: dict) -> tuple:
+        if not d:
+            return ()
+        items = list(d.items())
+        if len(items) > 1:
+            items.sort()
+        return tuple(items)
+
+    def _rq(d: dict) -> tuple:
+        if not d:
+            return ()
+        items = [(k, v if type(v) is str else str(v)) for k, v in d.items()]
+        if len(items) > 1:
+            items.sort()
+        return tuple(items)
+
     def _cont(c: Container) -> tuple:
         return (
             c.image,
-            tuple(sorted((k, str(v)) for k, v in c.requests.items())),
-            tuple(sorted((k, str(v)) for k, v in c.limits.items()))
-            if c.limits else (),
+            _rq(c.requests),
+            _rq(c.limits) if c.limits else (),
             tuple((p.host_port, p.container_port, p.protocol) for p in c.ports)
             if c.ports else (),
         )
 
     m = pod.metadata
     spec = pod.spec
+    conts = spec.containers
     return (
         pod.namespace,
-        tuple(sorted(m.labels.items())) if m.labels else (),
-        tuple(sorted(m.annotations.items())) if m.annotations else (),
+        _d(m.labels) if m.labels else (),
+        _d(m.annotations) if m.annotations else (),
         m.deletion_timestamp is not None,
         spec.node_name,
-        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
-        tuple(_cont(c) for c in spec.containers),
+        _d(spec.node_selector) if spec.node_selector else (),
+        (_cont(conts[0]),) if len(conts) == 1
+        else tuple(_cont(c) for c in conts),
         tuple(_cont(c) for c in spec.init_containers)
         if spec.init_containers else (),
         repr(spec.affinity) if spec.affinity is not None else None,
