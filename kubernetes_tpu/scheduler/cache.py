@@ -111,6 +111,19 @@ class SchedulerCache:
             )
             self._assumed.add(key)
 
+    def has_pod(self, pod: Pod) -> bool:
+        """True when the pod is already assumed or watch-confirmed — a
+        FIFO pop of such a pod is a duplicate delivery (at-least-once
+        watch semantics) and scheduling it again is always wrong."""
+        with self._lock:
+            return _key(pod) in self._pod_states
+
+    def pod_keys(self) -> set:
+        """Copy of every known pod key (assumed + confirmed) under one
+        lock acquisition — the wave filter's bulk form of has_pod."""
+        with self._lock:
+            return set(self._pod_states)
+
     def forget_pod(self, pod: Pod) -> None:
         """cache.go ForgetPod: undo an assume whose bind failed."""
         key = _key(pod)

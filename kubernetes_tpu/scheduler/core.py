@@ -210,6 +210,26 @@ class Scheduler:
             cfg.algorithm, "schedule_backlog"
         ):
             wave += cfg.drain_waiting(cfg.max_batch - 1)
+        cache = cfg.scheduler_cache
+        if cache is not None and hasattr(cache, "pod_keys"):
+            # duplicate watch deliveries (relist after a broken pipe)
+            # re-enqueue pods already decided; scheduling them again
+            # would phantom-commit capacity inside the wave. One locked
+            # key-set copy, not one lock round-trip per pod.
+            known = cache.pod_keys()
+            fresh = [
+                p for p in wave
+                if f"{p.metadata.namespace}/{p.metadata.name}" not in known
+            ]
+            if len(fresh) != len(wave):
+                log.debug(
+                    "dropped %d duplicate-delivery pods from the wave",
+                    len(wave) - len(fresh),
+                )
+                wave = fresh
+            if not wave:
+                return
+            pod = wave[0]  # the popped pod itself may have been dropped
         start = DEFAULT_CLOCK.now()
         state = self._snapshot()
         try:
@@ -268,15 +288,33 @@ class Scheduler:
         import copy
 
         assumed_list = []
+        bind_pairs: List[Tuple[Pod, str]] = []
         for pod, host in pairs:
             assumed = copy.copy(pod)
             assumed.spec = copy.copy(pod.spec)
             assumed.spec.node_name = host
             try:
                 cfg.scheduler_cache.assume_pod(assumed)
-            except Exception:
-                log.exception("assume failed for %s", pod.metadata.name)
+            except Exception as e:
+                # Assume races happen: a duplicate FIFO delivery (broken
+                # watch -> relist) pops a pod whose earlier decision is
+                # already in the cache. Never bind on top of it — route
+                # through the error handler, which refetches and
+                # re-queues only if the pod is genuinely still
+                # unassigned (factory.go:476-512), so true duplicates
+                # drop out cleanly.
+                log.warning(
+                    "assume failed for %s: %s; re-queueing",
+                    pod.metadata.name, e,
+                )
+                if cfg.error is not None:
+                    cfg.error(pod, e)
+                continue
             assumed_list.append(assumed)
+            bind_pairs.append((pod, host))
+        if not bind_pairs:
+            return
+        pairs = bind_pairs
 
         def fail(pod, assumed, err):
             try:
