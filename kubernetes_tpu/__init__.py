@@ -57,7 +57,10 @@ if not os.environ.get("KUBERNETES_TPU_NO_XLA_CACHE"):
             ),
         )
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # persist even fast compiles: the small pack/unpack and apply
+        # programs each cost ~0.5-2s on a tunneled chip per process
+        # start, which is exactly the daemon cold-start we are cutting
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:  # older jax without the knobs: run uncached
         pass
 

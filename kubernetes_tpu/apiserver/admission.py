@@ -47,6 +47,8 @@ class NamespaceLifecycle(AdmissionPlugin):
     def admit(self, operation, resource, namespace, obj) -> None:
         if operation != CREATE or not namespace or resource == "namespaces":
             return
+        if self._server.namespace_active(namespace):
+            return  # memoized exists-and-not-terminating fast path
         ns = self._server.get_namespace(namespace)
         if ns is not None and ns.status.phase == "Terminating":
             raise AdmissionDenied(

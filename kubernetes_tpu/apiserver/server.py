@@ -104,15 +104,21 @@ class WatchResponse:
                     },
                 }
                 return
-            cur_match = ev.object is not None and self._match(ev.object)
+            # filter on the store's shared read-only refs when present:
+            # a filtered-out event must not pay an unpickle per watcher
+            mobj = getattr(ev, "match_object", None)
+            if mobj is None:
+                mobj = ev.object
+            mprev = getattr(ev, "match_prev", None)
+            if mprev is None and ev.type != "ADDED":
+                mprev = ev.prev_object
+            cur_match = mobj is not None and self._match(mobj)
             if ev.type == "ADDED":
                 if not cur_match:
                     continue
                 out_type = "ADDED"
             elif ev.type == "MODIFIED":
-                prev_match = ev.prev_object is not None and self._match(
-                    ev.prev_object
-                )
+                prev_match = mprev is not None and self._match(mprev)
                 if cur_match and prev_match:
                     out_type = "MODIFIED"
                 elif cur_match:
@@ -122,7 +128,7 @@ class WatchResponse:
                 else:
                     continue
             elif ev.type == "DELETED":
-                ref = ev.prev_object if ev.prev_object is not None else ev.object
+                ref = mprev if mprev is not None else mobj
                 if ref is None or not self._match(ref):
                     continue
                 out_type = "DELETED"
@@ -130,9 +136,15 @@ class WatchResponse:
                 continue
             yield {
                 "type": out_type,
+                # obj_mode consumers own the object: give them the
+                # isolated unpickled copy. Wire consumers only need the
+                # encoding — a read-only traversal the shared ref can
+                # serve without paying the unpickle.
                 "object": (
                     ev.object if self.obj_mode
-                    else self.scheme.encode(ev.object)
+                    else self.scheme.encode(
+                        mobj if mobj is not None else ev.object
+                    )
                 ),
             }
 
@@ -184,6 +196,7 @@ class APIServer:
         self.resources = default_resources()
         self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
         self._auto_ns = auto_provision_namespaces
+        self._ns_active: set = set()  # memoized active namespaces
         self._http_server = None
         # HTTP-path auth (genericapiserver authn/authz); in-process
         # transports bypass auth like the reference's integration masters
@@ -199,10 +212,27 @@ class APIServer:
         except KeyNotFound:
             return None
 
+    def namespace_active(self, name: str) -> bool:
+        """Existence + not-Terminating, memoized: every object write
+        consults the namespace (auto-provision + lifecycle admission),
+        and a store.get deep-copies — two copies per create on the hot
+        path for an object that almost never changes. Any namespace
+        write invalidates (see _handle)."""
+        if name in self._ns_active:
+            return True
+        ns = self.get_namespace(name)
+        if ns is not None and ns.status.phase != "Terminating":
+            self._ns_active.add(name)
+            return True
+        return False
+
     def _ensure_namespace(self, name: str) -> None:
         if not self._auto_ns or not name:
             return
-        if self.get_namespace(name) is None:
+        if name in self._ns_active:
+            return
+        existing = self.get_namespace(name)
+        if existing is None:
             from kubernetes_tpu.apiserver.registry import prepare_namespace
 
             ns = t.Namespace(metadata=t.ObjectMeta(name=name, namespace=""))
@@ -213,6 +243,9 @@ class APIServer:
                 self.store.create(f"/namespaces/{name}", ns)
             except KeyExists:
                 pass
+            self._ns_active.add(name)
+        elif existing.status.phase != "Terminating":
+            self._ns_active.add(name)
 
     # -- request routing -----------------------------------------------------
 
@@ -288,6 +321,25 @@ class APIServer:
         if info is None:
             raise APIError(404, f"unknown path {path!r}")
 
+        if method != "GET" and info.resource == "namespaces" and name:
+            # any namespace write may change existence/phase: drop the
+            # fast-path entry AFTER the write commits (a pre-write
+            # invalidation lets a concurrent reader re-cache the stale
+            # pre-write state forever)
+            try:
+                return self._dispatch(
+                    method, path, query, body, ns, info, name,
+                    subresource, obj_mode,
+                )
+            finally:
+                self._ns_active.discard(name)
+        return self._dispatch(
+            method, path, query, body, ns, info, name, subresource,
+            obj_mode,
+        )
+
+    def _dispatch(self, method, path, query, body, ns, info, name,
+                  subresource, obj_mode):
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
                 return 200, self._watch(info, ns, query, name, obj_mode)
@@ -418,6 +470,43 @@ class APIServer:
             raise APIError(400, f"decode error: {e}")
 
     def _create(self, info: ResourceInfo, ns: str, body, obj_mode=False):
+        if isinstance(body, dict) and "items" in body and str(
+            body.get("kind", "")
+        ).endswith("List"):
+            # Bulk create: one request commits the whole list, item
+            # semantics independent (the collection analogue of the
+            # BindingList wave commit). Per-item per-request overhead is
+            # what caps density-harness pod creation otherwise.
+            results = []
+            for item in body["items"]:
+                try:
+                    obj = self._create_obj(info, ns, item)
+                    results.append({
+                        "status": "Success",
+                        "name": obj.metadata.name,
+                        "resourceVersion": obj.metadata.resource_version,
+                    })
+                except KeyExists as e:
+                    # same wording as the single-create 409 mapping so
+                    # callers' collision handling works on either path
+                    results.append({
+                        "status": "Failure",
+                        "message": f"already exists: {e}",
+                    })
+                except Exception as e:
+                    # independent per-item semantics: admission and
+                    # validation failures (not APIError subclasses) must
+                    # not abort the remainder of the list
+                    results.append({"status": "Failure", "message": str(e)})
+            return 201, {"kind": "Status", "status": "Success",
+                         "items": results}
+        obj = self._create_obj(info, ns, body)
+        stored = self.store.get(
+            info.key(obj.metadata.namespace, obj.metadata.name)
+        )[0]
+        return 201, stored if obj_mode else self.scheme.encode(stored)
+
+    def _create_obj(self, info: ResourceInfo, ns: str, body):
         obj = self._decode_body(info, body)
         if info.namespaced:
             # only an EXPLICIT body namespace can conflict with the URL;
@@ -446,15 +535,14 @@ class APIServer:
             adm.CREATE, info.resource, obj.metadata.namespace, obj
         )
         # obj is the server's decode/copy-boundary object: ownership
-        # transfers to the store (no second write copy)
+        # transfers to the store (no second write copy). Reading its
+        # meta right after is fine (the store stamps rv in place);
+        # callers must not hand this reference out.
         self.store.create(
             info.key(obj.metadata.namespace, obj.metadata.name), obj,
             owned=True,
         )
-        stored = self.store.get(
-            info.key(obj.metadata.namespace, obj.metadata.name)
-        )[0]
-        return 201, stored if obj_mode else self.scheme.encode(stored)
+        return obj  # rv already stamped in place by the store
 
     def _update(self, info: ResourceInfo, ns: str, name: str, body,
                 subresource, obj_mode=False):

@@ -85,6 +85,19 @@ class ResourceClient:
         )
         return self.client.do("POST", self._path(), body=body)
 
+    def create_many(self, objs) -> list:
+        """Bulk create: one POST of a List body commits every item with
+        independent per-item semantics; returns the per-item status
+        dicts ({"status": "Success", "name", "resourceVersion"} or
+        {"status": "Failure", "message"})."""
+        enc = (
+            (lambda o: o) if self.client.object_protocol
+            else self.client.scheme.encode
+        )
+        body = {"kind": "List", "items": [enc(o) for o in objs]}
+        payload = self.client.do_raw("POST", self._path(), body=body)
+        return payload.get("items", [])
+
     def update(self, obj, subresource: str = ""):
         body = (
             obj if self.client.object_protocol

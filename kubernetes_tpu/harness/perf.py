@@ -49,39 +49,50 @@ def make_nodes(client: RESTClient, n: int) -> None:
         )
 
 
-def make_pods(client: RESTClient, p: int, creators: int = 30) -> None:
-    """perf/util.go:143-175 makePodsFromRC: pause pods, 30-way parallel
-    creation."""
-
-    def create(i: int) -> None:
-        # generateName suffixes can collide (the reference's RC manager
-        # self-heals by re-creating on the next sync); retry like it
-        for _ in range(5):
-            try:
-                client.pods().create(
-                    Pod(
-                        metadata=ObjectMeta(
-                            generate_name="sched-perf-pod-",
-                            labels={"name": "sched-perf"},
-                        ),
-                        spec=PodSpec(
-                            containers=[
-                                Container(
-                                    name="pause",
-                                    image="kubernetes/pause:go",
-                                    requests={"cpu": "100m", "memory": "500Mi"},
-                                )
-                            ]
-                        ),
-                    )
+def _perf_pod() -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            generate_name="sched-perf-pod-",
+            labels={"name": "sched-perf"},
+        ),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="pause",
+                    image="kubernetes/pause:go",
+                    requests={"cpu": "100m", "memory": "500Mi"},
                 )
+            ]
+        ),
+    )
+
+
+def make_pods(client: RESTClient, p: int, creators: int = 30,
+              chunk: int = 500) -> None:
+    """perf/util.go:143-175 makePodsFromRC: pause pods, parallel
+    creation. Batches flow through the bulk-create endpoint (an RC
+    manager burst-creates its whole replica delta too); generateName
+    collisions retry like the reference's RC manager self-heal."""
+    chunks = [min(chunk, p - i) for i in range(0, p, chunk)]
+
+    def create(ci: int) -> None:
+        want = chunks[ci]
+        for _ in range(5):
+            res = client.pods().create_many([_perf_pod() for _ in range(want)])
+            want = 0
+            for r in res:
+                if r.get("status") == "Success":
+                    continue
+                msg = r.get("message", "")
+                if "already exists" in msg:
+                    want += 1  # generateName collision: retry that one
+                else:
+                    raise RuntimeError(f"pod create failed: {msg}")
+            if want == 0:
                 return
-            except Exception as e:
-                if "already exists" not in str(e):
-                    raise
         raise RuntimeError("pod create kept colliding")
 
-    parallelize(creators, p, create)
+    parallelize(min(creators, len(chunks)), len(chunks), create)
 
 
 def schedule_pods(
@@ -98,40 +109,14 @@ def schedule_pods(
         client, SchedulerServerOptions(algorithm_provider=provider)
     ).start()
 
-    # count bindings from a pod watch (the reference counts from its
-    # informer, scheduler_test.go:48): a per-second full LIST would
-    # decode every pod object each tick and steal a large slice of the
-    # interpreter from the scheduler under measurement
-    bound: set = set()
-    bound_lock = threading.Lock()
-    stop_watch = threading.Event()
+    # count bindings from the scheduler's own assigned-pod informer —
+    # exactly the reference's ScheduledPodLister poll
+    # (scheduler_test.go:48-61). A dedicated watch stream would decode
+    # every pod object a second time and steal a large slice of the
+    # interpreter from the scheduler under measurement.
+    def count_scheduled() -> int:
+        return len(sched.factory.assigned_informer.store.list_keys())
 
-    def relist():
-        pods, rv = client.pods().list()
-        with bound_lock:
-            for p in pods:
-                if p.spec.node_name:
-                    bound.add(p.metadata.name)
-        return rv
-
-    def watch_bindings():
-        rv = relist()
-        while not stop_watch.is_set():
-            try:
-                for etype, obj in client.pods().watch(resource_version=rv):
-                    rv = obj.metadata.resource_version or rv
-                    if etype in ("ADDED", "MODIFIED") and obj.spec.node_name:
-                        with bound_lock:
-                            bound.add(obj.metadata.name)
-                    if stop_watch.is_set():
-                        return
-            except Exception:
-                # watch gap: the fresh list re-captures anything bound
-                # while the stream was down
-                rv = relist()
-
-    watcher = threading.Thread(target=watch_bindings, daemon=True)
-    watcher.start()
     try:
         t0 = time.time()
         make_pods(client, num_pods)
@@ -142,8 +127,7 @@ def schedule_pods(
         prev, start = 0, time.time()
         while True:
             time.sleep(1)
-            with bound_lock:
-                scheduled = len(bound)
+            scheduled = count_scheduled()
             rate = scheduled - prev
             print(
                 f"{time.strftime('%H:%M:%S')} Rate: {rate:5d} Total: {scheduled}",
@@ -160,7 +144,6 @@ def schedule_pods(
                 return throughput
             prev = scheduled
     finally:
-        stop_watch.set()
         sched.stop()
 
 

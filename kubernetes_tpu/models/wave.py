@@ -179,6 +179,9 @@ class WaveScheduler:
         self.pod_floor = pod_floor
         self._replay = replay or replay_fast
         self._apply = jax.jit(self._apply_fn)
+        from kubernetes_tpu.models.pack import Packer
+
+        self._packer = Packer()
         # device-resident snapshot fields across waves: field ->
         # (shape, dtype, device array). The caller's `keep` set says which
         # host fields are unchanged since the previous wave. `_dev_source`
@@ -188,19 +191,37 @@ class WaveScheduler:
         self._dev: dict = {}
         self._dev_source: Optional[str] = None
 
-    def _to_dev(self, snap, field: str, keep: frozenset):
-        host = getattr(snap, field)
-        ent = self._dev.get(field)
-        if (
-            ent is not None
-            and field in keep
-            and ent[0] == host.shape
-            and ent[1] == host.dtype
-        ):
-            return ent[2]
-        arr = jnp.asarray(host)
-        self._dev[field] = (host.shape, host.dtype, arr)
-        return arr
+    def _to_dev_many(self, snap, fields, keep: frozenset, extra=None):
+        """Device copies for `fields` (+ `extra` host arrays), shipping
+        every miss in ONE batched device_put: on a tunneled chip each
+        individual transfer costs a full dispatch round trip (~40ms
+        measured), so per-field puts dominate a cold wave."""
+        out = {}
+        missing = {}
+        for f in fields:
+            host = getattr(snap, f)
+            ent = self._dev.get(f)
+            if (
+                ent is not None
+                and f in keep
+                and ent[0] == host.shape
+                and ent[1] == host.dtype
+            ):
+                out[f] = ent[2]
+            else:
+                missing[f] = np.asarray(host)
+        if extra:
+            missing.update(extra)
+        if missing:
+            put = self._packer.ship(missing)
+            for f, arr in put.items():
+                if extra and f in extra:
+                    out[f] = arr
+                    continue
+                host = missing[f]
+                self._dev[f] = (host.shape, host.dtype, arr)
+                out[f] = arr
+        return out
 
     # -- carry commit of a whole run -----------------------------------------
 
@@ -252,43 +273,31 @@ class WaveScheduler:
             svc_first_peer, svc_peer_node_count, svc_peer_total,
         )
 
-    def _initial_carry(self, snap: ClusterSnapshot, last_node_index: int,
-                       keep: frozenset):
-        """BatchScheduler.initial_carry with device reuse: the resource
-        block ships as ONE stacked transfer and the (usually empty)
-        ip/vol/svc blocks reuse their device copies when unchanged."""
-        res_host = np.stack([
-            np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
-            np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
-            np.asarray(snap.nz_mem), np.asarray(snap.pod_count),
-        ])
-        return (
-            jnp.asarray(res_host),
-            self._to_dev(snap, "port_mask", keep),
-            self._to_dev(snap, "class_count", keep),
-            jnp.int64(last_node_index),
-            self._to_dev(snap, "ip_term_count", keep),
-            self._to_dev(snap, "ip_own_anti", keep),
-            self._to_dev(snap, "ip_rev_hard", keep),
-            self._to_dev(snap, "ip_rev_pref", keep),
-            self._to_dev(snap, "ip_rev_anti", keep),
-            self._to_dev(snap, "ip_spec_total", keep),
-            self._to_dev(snap, "vol_any", keep),
-            self._to_dev(snap, "vol_rw", keep),
-            self._to_dev(snap, "ebs_mask", keep),
-            self._to_dev(snap, "gce_mask", keep),
-            self._to_dev(snap, "svc_first_peer", keep),
-            self._to_dev(snap, "svc_peer_node_count", keep),
-            self._to_dev(snap, "svc_peer_total", keep),
+    _CARRY_FIELDS = (
+        "port_mask", "class_count", "ip_term_count", "ip_own_anti",
+        "ip_rev_hard", "ip_rev_pref", "ip_rev_anti", "ip_spec_total",
+        "vol_any", "vol_rw", "ebs_mask", "gce_mask",
+        "svc_first_peer", "svc_peer_node_count", "svc_peer_total",
+    )
+
+    def _carry_from(self, dev: dict):
+        """BatchScheduler.initial_carry from the batched device dict:
+        the resource block ships as ONE stacked array and the (usually
+        empty) ip/vol/svc blocks reuse their device copies when
+        unchanged."""
+        return (dev["__res__"], dev["port_mask"], dev["class_count"],
+                dev["__lidx__"]) + tuple(
+            dev[f] for f in self._CARRY_FIELDS[2:]
         )
 
     # -- backlog -------------------------------------------------------------
 
     def _pod_row(self, batch: PodBatch, i: int):
-        return {
-            f: jnp.asarray(getattr(batch, f)[i])
+        # one packed transfer, not one ~40ms round trip per field
+        return self._packer.ship({
+            f: np.asarray(getattr(batch, f)[i])
             for f in BatchScheduler.POD_FIELDS
-        }
+        })
 
     def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
                 K: int) -> Tuple[int, int]:
@@ -351,16 +360,25 @@ class WaveScheduler:
             self._dev.clear()
             self._dev_source = source
         P = len(rep_idx)
-        static = {
-            f: self._to_dev(snap, f, keep)
-            for f in BatchScheduler.STATIC_FIELDS
-        }
+        res_host = np.stack([
+            np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
+            np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
+            np.asarray(snap.nz_mem), np.asarray(snap.pod_count),
+        ])
+        dev = self._to_dev_many(
+            snap,
+            tuple(BatchScheduler.STATIC_FIELDS) + self._CARRY_FIELDS,
+            keep,
+            extra={"__res__": res_host,
+                   "__lidx__": np.int64(last_node_index)},
+        )
+        static = {f: dev[f] for f in BatchScheduler.STATIC_FIELDS}
         static.update(BatchScheduler.config_static(self.config, snap))
         num_zones = max(
             int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
         )
         num_values = int(snap.svc_num_values)
-        carry = self._initial_carry(snap, last_node_index, keep)
+        carry = self._carry_from(dev)
         out = np.full(P, -1, np.int32)
         perm = np.asarray(snap.name_desc_order).astype(np.int64)
         N = snap.num_nodes
@@ -387,10 +405,10 @@ class WaveScheduler:
             rows = np.asarray(pending, np.int64)
             seg = gather_batch(batch, rep_idx[rows])
             seg = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
-            pods = {
-                f: jnp.asarray(getattr(seg, f))
+            pods = self._packer.ship({
+                f: np.asarray(getattr(seg, f))
                 for f in BatchScheduler.POD_FIELDS
-            }
+            })
             run = self.scan._compiled(num_zones, num_values)
             new_carry, chosen = run(static, carry, pods)
             out[rows] = np.asarray(chosen)[: len(rows)]

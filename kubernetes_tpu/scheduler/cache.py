@@ -111,6 +111,27 @@ class SchedulerCache:
             )
             self._assumed.add(key)
 
+    def assume_pods(self, pods, now: Optional[float] = None):
+        """Bulk AssumePod for a scheduling wave: one lock acquisition
+        instead of one per pod (the per-pod form cost ~160us each at
+        30k-pod waves, serial in the scheduling thread). Returns a
+        CacheError-or-None per pod, aligned with the input."""
+        t = (now if now is not None else self.clock.now()) + self.ttl
+        out = []
+        with self._lock:
+            for pod in pods:
+                key = _key(pod)
+                if key in self._pod_states:
+                    out.append(CacheError(
+                        f"pod {key} is in the cache, so can't be assumed"
+                    ))
+                    continue
+                self._add_pod_locked(pod)
+                self._pod_states[key] = _PodState(pod, t)
+                self._assumed.add(key)
+                out.append(None)
+        return out
+
     def has_pod(self, pod: Pod) -> bool:
         """True when the pod is already assumed or watch-confirmed — a
         FIFO pop of such a pod is a duplicate delivery (at-least-once

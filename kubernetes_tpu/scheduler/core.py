@@ -287,15 +287,26 @@ class Scheduler:
         cfg = self.config
         import copy
 
-        assumed_list = []
-        bind_pairs: List[Tuple[Pod, str]] = []
+        assumed_all = []
         for pod, host in pairs:
             assumed = copy.copy(pod)
             assumed.spec = copy.copy(pod.spec)
             assumed.spec.node_name = host
-            try:
-                cfg.scheduler_cache.assume_pod(assumed)
-            except Exception as e:
+            assumed_all.append(assumed)
+        if hasattr(cfg.scheduler_cache, "assume_pods"):
+            results = cfg.scheduler_cache.assume_pods(assumed_all)
+        else:
+            results = []
+            for assumed in assumed_all:
+                try:
+                    cfg.scheduler_cache.assume_pod(assumed)
+                    results.append(None)
+                except Exception as e:
+                    results.append(e)
+        assumed_list = []
+        bind_pairs: List[Tuple[Pod, str]] = []
+        for (pod, host), assumed, err in zip(pairs, assumed_all, results):
+            if err is not None:
                 # Assume races happen: a duplicate FIFO delivery (broken
                 # watch -> relist) pops a pod whose earlier decision is
                 # already in the cache. Never bind on top of it — route
@@ -305,10 +316,10 @@ class Scheduler:
                 # drop out cleanly.
                 log.warning(
                     "assume failed for %s: %s; re-queueing",
-                    pod.metadata.name, e,
+                    pod.metadata.name, err,
                 )
                 if cfg.error is not None:
-                    cfg.error(pod, e)
+                    cfg.error(pod, err)
                 continue
             assumed_list.append(assumed)
             bind_pairs.append((pod, host))

@@ -116,8 +116,10 @@ class SchedulerServer:
                     n = len(self.factory.node_lister.list())
                     # warmup only pays off for a genuinely idle daemon:
                     # if work arrives within the grace window, the first
-                    # real wave compiles exactly the shapes it needs and
-                    # a synthetic warmup would just delay it
+                    # real wave compiles/loads exactly the shapes it
+                    # needs (persistently cached across restarts) and a
+                    # synthetic warmup would just delay it while
+                    # competing for the interpreter
                     idle = True
                     if n:
                         deadline = time.time() + 2.0

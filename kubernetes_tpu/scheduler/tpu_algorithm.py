@@ -193,7 +193,11 @@ class TPUScheduleAlgorithm:
                 replica_sets=ls(self._replica_set_lister),
             )
             if snap is not None:
-                source = "inc"
+                # identify the ENCODER INSTANCE, not just the kind: a
+                # warmup's throwaway incremental encoder and the real
+                # one must never satisfy each other's `keep` (their
+                # vocab bit/slot assignments are encoder-local)
+                source = self._inc.source_token
         if snap is None:
             # from-scratch encode (no daemon cache, or a scope gate hit:
             # inter-pod affinity / volumes / SA-SAA config)

@@ -36,6 +36,7 @@ snapshot-after-deltas == snapshot-from-scratch, both semantically
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -110,11 +111,19 @@ def _grow_cols(a: np.ndarray, cols: int) -> np.ndarray:
     return out
 
 
+_SOURCE_COUNTER = itertools.count()
+
+
 class IncrementalEncoder:
     """Maintains node-axis snapshot arrays from cache events."""
 
     def __init__(self, config=None, initial_slots: int = 64):
         self.config = config
+        # unique device-cache provenance token: vocab bit/slot
+        # assignments are encoder-local, so a consumer's cached device
+        # arrays must never outlive the encoder that produced them
+        # (a monotonic counter — id() reuses freed addresses)
+        self.source_token = f"inc:{next(_SOURCE_COUNTER)}"
         self.vocabs = VocabBundle()
         self._lock = threading.Lock()
         self._events: List[Tuple[str, object]] = []

@@ -69,15 +69,24 @@ class _LazyEvent:
     first access. The store serializes each committed event ONCE and
     every watcher deserializes its own private copy on receipt — halving
     the per-watcher deep-copy cost of fan-out while keeping the
-    decode-fresh-bytes isolation (no two watchers share an object)."""
+    decode-fresh-bytes isolation (no two watchers share an object).
 
-    __slots__ = ("type", "resource_version", "_blob", "_pair")
+    match_object/match_prev are READ-ONLY references to the store's own
+    (immutable-after-write) objects, for selector filtering without an
+    unpickle: a filtered-out event then costs the fan-out queue put and
+    nothing else. They must never be handed to a consumer."""
 
-    def __init__(self, ev_type: str, rv: int, blob: bytes):
+    __slots__ = ("type", "resource_version", "_blob", "_pair",
+                 "match_object", "match_prev")
+
+    def __init__(self, ev_type: str, rv: int, blob: bytes,
+                 match_object=None, match_prev=None):
         self.type = ev_type
         self.resource_version = rv
         self._blob = blob
         self._pair = None
+        self.match_object = match_object
+        self.match_prev = match_prev
 
     def _unpack(self):
         if self._pair is None:
@@ -226,7 +235,8 @@ class MemoryStore:
                         blob = b""
                 if blob:
                     stream._deliver(
-                        _LazyEvent(ev.type, ev.resource_version, blob)
+                        _LazyEvent(ev.type, ev.resource_version, blob,
+                                   ev.object, ev.prev_object)
                     )
                 else:  # unpicklable object: fall back to deep copies
                     stream._deliver(
