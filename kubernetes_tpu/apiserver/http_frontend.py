@@ -48,9 +48,9 @@ def start_http_server(api: APIServer, host: str, port: int,
     """tls_cert/tls_key enable HTTPS (genericapiserver serves TLS by
     default); max_in_flight > 0 bounds concurrent non-long-running
     requests (handlers.go MaxInFlightLimit — excess returns 429);
-    enable_binary opts the listener into the code-bearing binary content
-    type (runtime/binary.py trust model) — off, binary bodies get 415
-    and Accept negotiation is ignored."""
+    enable_binary opts the listener into the TLV binary content type
+    (runtime/binary.py; data-only, safe for untrusted callers) — off,
+    binary bodies get 415 and Accept negotiation is ignored."""
     in_flight = (
         threading.Semaphore(max_in_flight) if max_in_flight > 0 else None
     )

@@ -141,9 +141,9 @@ class HTTPTransport:
     def _decode_payload(self, resp, payload):
         if not payload:
             return {}
-        # only a client that OPTED INTO the binary protocol unpickles:
-        # a JSON client must never deserialize code-bearing payloads on a
-        # server's say-so (runtime/binary.py trust model)
+        # only a client that OPTED INTO the binary protocol decodes it:
+        # a JSON client shouldn't switch codecs on a server's say-so
+        # (the TLV wire is data-only either way, runtime/binary.py)
         if self.binary:
             ctype = resp.headers.get("Content-Type", "") if hasattr(
                 resp, "headers"

@@ -2,32 +2,32 @@
 
 The reference serves JSON and protobuf; kubemark runs protobuf because
 reflective JSON codec cost dominates control-plane CPU at 1000-node
-scale (hollow-node.go:65, runtime/serializer/protobuf/protobuf.go). This
-framework's equivalent binary serializer is a magic-prefixed pickle
-envelope: both ends share the dataclass schema, so pickle IS the
-generated-marshaller analogue — no reflective field walk, C-speed
-encode/decode.
+scale (hollow-node.go:65, runtime/serializer/protobuf/protobuf.go). The
+equivalent binary serializer here is a magic-prefixed TLV envelope
+(runtime/tlv.py) whose per-class marshalling plan is generated from the
+dataclass fields — the generated-marshaller analogue, data-only.
 
 Negotiation mirrors the reference: clients send Content-Type/Accept
 `application/vnd.kubernetes-tpu.binary` and the HTTP frontend answers in
 kind; JSON remains the default and the interop format. Watch streams
 frame events as length-prefixed envelopes instead of NDJSON.
 
-Trust model: like the reference's protobuf listener, this wire is for
-cluster-internal components on a trusted network (pickle payloads are
-code-bearing by nature; never expose this content type to untrusted
-callers — the JSON surface exists for them).
+Decoding only ever yields registered API dataclasses, dicts, lists and
+scalars — no code execution paths — so, like the reference's protobuf,
+this content type is safe to serve to untrusted callers.
 """
 
 from __future__ import annotations
 
-import pickle
 import struct
 from typing import Any
 
+from kubernetes_tpu.runtime import tlv
+
 CONTENT_TYPE = "application/vnd.kubernetes-tpu.binary"
-# protobuf.go:17-33 magic-prefixed envelope idea
-MAGIC = b"k8s-tpu\x00"
+# protobuf.go:17-33 magic-prefixed envelope idea; the trailing byte is a
+# format version (0 was the retired pickle envelope)
+MAGIC = b"k8s-tpu\x01"
 _LEN = struct.Struct("<I")
 
 
@@ -38,13 +38,16 @@ class BinaryDecodeError(Exception):
 def encode(payload: Any) -> bytes:
     """Envelope any handler payload (API object, list dict carrying
     objects, Status dict)."""
-    return MAGIC + pickle.dumps(payload, pickle.HIGHEST_PROTOCOL)
+    return MAGIC + tlv.dumps(payload)
 
 
 def decode(data: bytes) -> Any:
     if not data.startswith(MAGIC):
         raise BinaryDecodeError("missing binary envelope magic")
-    return pickle.loads(data[len(MAGIC):])
+    try:
+        return tlv.loads(data[len(MAGIC):])
+    except tlv.TLVError as e:
+        raise BinaryDecodeError(str(e)) from e
 
 
 def encode_frame(payload: Any) -> bytes:

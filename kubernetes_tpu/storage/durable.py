@@ -20,20 +20,34 @@ Watch history is NOT persisted: recovery sets the compaction horizon to
 the recovered RV, so any watcher resuming from a pre-crash version gets
 Compacted and relists — precisely the reflector's crash-recovery
 contract (reflector.go ListAndWatch).
+
+On-disk format: every WAL record and the snapshot body are TLV
+(runtime/tlv.py — data-only, no code execution on load) with a CRC32
+per record, so a corrupt file surfaces as a clear CorruptStoreError
+instead of arbitrary deserialization behavior. data_dir should still be
+private to the apiserver: the CRC detects corruption, not tampering
+(an attacker with write access can forge valid records — exactly as
+with etcd's data directory).
 """
 
 from __future__ import annotations
 
 import os
-import pickle
 import struct
+import zlib
 
-
+from kubernetes_tpu.runtime import tlv
 from kubernetes_tpu.storage.store import MemoryStore, WatchEvent
 
 _LEN = struct.Struct("<I")
-_SNAP_MAGIC = b"KTSNAP01"
-_WAL_MAGIC = b"KTWAL001"
+_CRC = struct.Struct("<I")
+_SNAP_MAGIC = b"KTSNAP02"
+_WAL_MAGIC = b"KTWAL002"
+
+
+class CorruptStoreError(Exception):
+    """Persisted state failed integrity/format checks (not a torn tail:
+    torn tails are expected after a crash and silently discarded)."""
 
 
 class FileStore(MemoryStore):
@@ -64,11 +78,10 @@ class FileStore(MemoryStore):
         # called under self._lock by every mutation, after the in-memory
         # commit and before watcher delivery
         if self._wal is not None:
-            rec = pickle.dumps(
-                (ev.type, key, ev.resource_version, ev.object),
-                pickle.HIGHEST_PROTOCOL,
+            rec = tlv.dumps([ev.type, key, ev.resource_version, ev.object])
+            self._wal.write(
+                _LEN.pack(len(rec)) + _CRC.pack(zlib.crc32(rec)) + rec
             )
-            self._wal.write(_LEN.pack(len(rec)) + rec)
             self._wal.flush()
             if self._fsync:
                 os.fsync(self._wal.fileno())
@@ -93,10 +106,14 @@ class FileStore(MemoryStore):
     # -- internals -----------------------------------------------------------
 
     def _open_wal(self) -> None:
-        if not os.path.exists(self._wal_path):
-            self._wal = open(self._wal_path, "ab")
+        if not os.path.exists(self._wal_path) or self._wal_rewrite_header:
+            # fresh log, or a torn creation whose magic never fully hit
+            # disk: (re)write the header and fsync it so a crash right
+            # after this point leaves a recoverable file
+            self._wal = open(self._wal_path, "wb")
             self._wal.write(_WAL_MAGIC)
             self._wal.flush()
+            os.fsync(self._wal.fileno())
             return
         # truncate any torn tail recovery discarded: appending committed
         # records BEHIND torn bytes would lose them on the next replay
@@ -110,9 +127,13 @@ class FileStore(MemoryStore):
 
     def _snapshot_locked(self) -> None:
         tmp = self._snap_path + ".tmp"
+        body = tlv.dumps(
+            [self._rv, {k: [o, rv_] for k, (o, rv_) in self._data.items()}]
+        )
         with open(tmp, "wb") as f:
             f.write(_SNAP_MAGIC)
-            pickle.dump((self._data, self._rv), f, pickle.HIGHEST_PROTOCOL)
+            f.write(_LEN.pack(len(body)) + _CRC.pack(zlib.crc32(body)))
+            f.write(body)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)
@@ -130,31 +151,88 @@ class FileStore(MemoryStore):
         data: dict = {}
         rv = 0
         self._wal_valid_end = 0
+        self._wal_rewrite_header = False
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
                 magic = f.read(len(_SNAP_MAGIC))
-                if magic == _SNAP_MAGIC:
-                    data, rv = pickle.load(f)
+                header = f.read(_LEN.size + _CRC.size)
+                body = f.read()
+            if magic != _SNAP_MAGIC:
+                raise CorruptStoreError(
+                    f"{self._snap_path}: bad or unsupported snapshot "
+                    f"magic {magic!r} (expected {_SNAP_MAGIC!r})"
+                )
+            if len(header) < _LEN.size + _CRC.size:
+                raise CorruptStoreError(
+                    f"{self._snap_path}: truncated snapshot header"
+                )
+            (n,) = _LEN.unpack_from(header, 0)
+            (crc,) = _CRC.unpack_from(header, _LEN.size)
+            if len(body) != n or zlib.crc32(body) != crc:
+                raise CorruptStoreError(
+                    f"{self._snap_path}: snapshot failed integrity check "
+                    "(length or CRC mismatch)"
+                )
+            try:
+                rv, raw_data = tlv.loads(body)
+            except tlv.TLVError as e:
+                raise CorruptStoreError(
+                    f"{self._snap_path}: undecodable snapshot: {e}"
+                ) from e
+            data = {k: (o, orv) for k, (o, orv) in raw_data.items()}
         if os.path.exists(self._wal_path):
             with open(self._wal_path, "rb") as f:
                 raw = f.read()
-            pos = len(_WAL_MAGIC) if raw.startswith(_WAL_MAGIC) else 0
-            while pos + _LEN.size <= len(raw):
-                (n,) = _LEN.unpack_from(raw, pos)
-                if pos + _LEN.size + n > len(raw):
-                    break  # torn tail: crash mid-append; discard
-                try:
-                    ev_type, key, ev_rv, obj = pickle.loads(
-                        raw[pos + _LEN.size : pos + _LEN.size + n]
+            if not raw:
+                # crash between file creation and the magic hitting
+                # disk: no record can exist; rewrite the header
+                self._wal_rewrite_header = True
+            if raw and not raw.startswith(_WAL_MAGIC):
+                if _WAL_MAGIC.startswith(raw[: len(_WAL_MAGIC)]):
+                    # torn creation: the crash hit between creating the
+                    # file and its magic reaching disk — no record can
+                    # exist yet; rewrite the header and carry on
+                    raw = b""
+                    self._wal_rewrite_header = True
+                else:
+                    raise CorruptStoreError(
+                        f"{self._wal_path}: bad or unsupported WAL magic "
+                        f"(expected {_WAL_MAGIC!r})"
                     )
-                except Exception:
-                    break  # corrupt tail record
+            pos = len(_WAL_MAGIC) if raw.startswith(_WAL_MAGIC) else 0
+            hdr = _LEN.size + _CRC.size
+            while pos + hdr <= len(raw):
+                (n,) = _LEN.unpack_from(raw, pos)
+                (crc,) = _CRC.unpack_from(raw, pos + _LEN.size)
+                if pos + hdr + n > len(raw):
+                    break  # torn tail: crash mid-append; discard
+                rec = raw[pos + hdr : pos + hdr + n]
+                ok = zlib.crc32(rec) == crc
+                decoded = None
+                if ok:
+                    try:
+                        decoded = tlv.loads(rec)
+                    except tlv.TLVError:
+                        ok = False
+                if not ok:
+                    # A torn write can only be the FINAL append. If more
+                    # bytes follow this record's claimed extent, this is
+                    # mid-file corruption — refusing loudly beats
+                    # silently truncating later committed records.
+                    if pos + hdr + n < len(raw):
+                        raise CorruptStoreError(
+                            f"{self._wal_path}: record at byte {pos} "
+                            "failed integrity check with committed "
+                            "records after it (mid-file corruption)"
+                        )
+                    break  # torn/overwritten tail record: discard
+                ev_type, key, ev_rv, obj = decoded
                 if ev_type == "DELETED":
                     data.pop(key, None)
                 else:
                     data[key] = (obj, ev_rv)
                 rv = max(rv, ev_rv)
-                pos += _LEN.size + n
+                pos += hdr + n
             self._wal_valid_end = pos
         self._data = data
         self._rv = rv

@@ -285,6 +285,88 @@ class TestFileStore:
         new_rv = s2.create("/pods/default/c", Pod(metadata=ObjectMeta(name="c")))
         assert new_rv == old_rv + 1 and new_rv > rv_b
 
+    def test_corrupt_snapshot_raises_clear_error(self, tmp_path):
+        from kubernetes_tpu.api.types import ObjectMeta, Pod
+        from kubernetes_tpu.storage.durable import CorruptStoreError
+
+        s = self._mk(tmp_path)
+        s.create("/k/a", Pod(metadata=ObjectMeta(name="a")))
+        s.close()  # close snapshots
+        snap = tmp_path / "etcd" / "snapshot.db"
+        raw = bytearray(snap.read_bytes())
+        raw[-3] ^= 0xFF  # flip a body byte: CRC must catch it
+        snap.write_bytes(bytes(raw))
+        with pytest.raises(CorruptStoreError):
+            self._mk(tmp_path)
+
+    def test_corrupted_wal_record_discards_from_there(self, tmp_path):
+        from kubernetes_tpu.api.types import ObjectMeta, Pod
+
+        s = self._mk(tmp_path)
+        s.create("/k/a", Pod(metadata=ObjectMeta(name="a")))
+        s.create("/k/b", Pod(metadata=ObjectMeta(name="b")))
+        s._wal.flush()
+        wal = tmp_path / "etcd" / "wal.log"
+        raw = bytearray(wal.read_bytes())
+        raw[-3] ^= 0xFF  # corrupt the LAST record's body mid-bytes
+        del s
+        wal.write_bytes(bytes(raw))
+        s2 = self._mk(tmp_path)
+        objs, _ = s2.list("/k/")
+        # the corrupted trailing record is dropped, the intact one kept
+        assert [o.metadata.name for o in objs] == ["a"]
+
+    def test_midfile_wal_corruption_raises(self, tmp_path):
+        """A bad record WITH committed records after it is disk
+        corruption, not a torn tail — refusing loudly beats silently
+        truncating the later records (r3 review finding)."""
+        from kubernetes_tpu.api.types import ObjectMeta, Pod
+        from kubernetes_tpu.storage.durable import CorruptStoreError
+
+        s = self._mk(tmp_path)
+        s.create("/k/a", Pod(metadata=ObjectMeta(name="a")))
+        s.create("/k/b", Pod(metadata=ObjectMeta(name="b")))
+        s.create("/k/c", Pod(metadata=ObjectMeta(name="c")))
+        s._wal.flush()
+        wal = tmp_path / "etcd" / "wal.log"
+        raw = bytearray(wal.read_bytes())
+        raw[20] ^= 0xFF  # flip a bit inside the FIRST record
+        del s
+        wal.write_bytes(bytes(raw))
+        with pytest.raises(CorruptStoreError):
+            self._mk(tmp_path)
+
+    def test_empty_wal_file_selfheals(self, tmp_path):
+        """Crash between WAL creation and the magic reaching disk: the
+        empty file must be re-headered, and the following restart must
+        recover every record written after the heal."""
+        from kubernetes_tpu.api.types import ObjectMeta, Pod
+
+        d = tmp_path / "etcd"
+        d.mkdir()
+        (d / "wal.log").write_bytes(b"")  # torn creation
+        s = self._mk(tmp_path)
+        s.create("/k/a", Pod(metadata=ObjectMeta(name="a")))
+        s._wal.flush()
+        del s
+        s2 = self._mk(tmp_path)
+        objs, _ = s2.list("/k/")
+        assert [o.metadata.name for o in objs] == ["a"]
+
+    def test_partial_wal_magic_selfheals(self, tmp_path):
+        from kubernetes_tpu.api.types import ObjectMeta, Pod
+
+        d = tmp_path / "etcd"
+        d.mkdir()
+        (d / "wal.log").write_bytes(b"KTW")  # torn magic write
+        s = self._mk(tmp_path)
+        s.create("/k/a", Pod(metadata=ObjectMeta(name="a")))
+        s._wal.flush()
+        del s
+        s2 = self._mk(tmp_path)
+        objs, _ = s2.list("/k/")
+        assert [o.metadata.name for o in objs] == ["a"]
+
     def test_torn_wal_tail_discarded(self, tmp_path):
         from kubernetes_tpu.api.types import ObjectMeta, Pod
 

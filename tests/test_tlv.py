@@ -1,0 +1,246 @@
+"""TLV binary wire codec (runtime/tlv.py + runtime/binary.py).
+
+The wire must round-trip every payload shape the apiserver serves
+(objects, List dicts, Status dicts, watch frames), reject malformed and
+hostile input without executing anything, and hold its own against the
+retired pickle envelope on throughput (the VERDICT r2 #7 bar).
+
+Reference analogue: pkg/runtime/serializer/protobuf/protobuf.go — a
+schema'd, data-only, magic-prefixed binary codec.
+"""
+
+import dataclasses
+import io
+import pickle
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.runtime import binary, tlv
+
+
+def sample_pod(i: int = 0) -> t.Pod:
+    return t.Pod(
+        metadata=t.ObjectMeta(
+            name=f"pod-{i}",
+            namespace="default",
+            labels={"app": "web", "tier": "frontend"},
+            annotations={"scheduler.alpha.kubernetes.io/name": "tpu"},
+        ),
+        spec=t.PodSpec(
+            node_name="",
+            node_selector={"disktype": "ssd"},
+            containers=[
+                t.Container(
+                    name="c1",
+                    image="nginx:1.9",
+                    requests={"cpu": "100m", "memory": "500Mi"},
+                    limits={"cpu": "200m"},
+                    ports=[t.ContainerPort(host_port=0, container_port=80)],
+                )
+            ],
+            tolerations=[
+                t.Toleration(key="dedicated", operator="Equal",
+                             value="infra", effect="NoSchedule")
+            ],
+        ),
+        status=t.PodStatus(phase="Pending"),
+    )
+
+
+class TestRoundTrip:
+    def test_pod(self):
+        p = sample_pod()
+        q = tlv.loads(tlv.dumps(p))
+        assert q == p
+        assert type(q) is t.Pod
+        assert q.spec.containers[0].requests["cpu"] == "100m"
+
+    def test_node(self):
+        n = t.Node(
+            metadata=t.ObjectMeta(name="n1", namespace=""),
+            status=t.NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[t.NodeCondition("Ready", "True")],
+            ),
+        )
+        assert tlv.loads(tlv.dumps(n)) == n
+
+    def test_wire_payload_shapes(self):
+        # the apiserver's three payload shapes: object, List, Status
+        pods = [sample_pod(i) for i in range(5)]
+        lst = {"kind": "PodList", "items": pods,
+               "metadata": {"resourceVersion": "17"}}
+        out = tlv.loads(tlv.dumps(lst))
+        assert out["items"] == pods
+        status = {"kind": "Status", "status": "Failure", "code": 404,
+                  "message": "not found"}
+        assert tlv.loads(tlv.dumps(status)) == status
+
+    def test_scalars_and_collections(self):
+        vals = [None, True, False, 0, -1, 1, 2**62, -(2**62), 3.25, "",
+                "héllo", b"\x00\xff", [], {}, [1, [2, [3]]],
+                {"a": {"b": [None, False]}}]
+        for v in vals:
+            assert tlv.loads(tlv.dumps(v)) == v
+
+    def test_class_table_reuse(self):
+        # 100 pods: the class table defines each class once, so the
+        # per-item cost is field values only
+        pods = [sample_pod(i) for i in range(100)]
+        one = len(tlv.dumps(pods[:1]))
+        hundred = len(tlv.dumps(pods))
+        assert hundred < one * 100  # sublinear envelope growth
+
+    def test_envelope(self):
+        p = sample_pod()
+        data = binary.encode(p)
+        assert data.startswith(binary.MAGIC)
+        assert binary.decode(data) == p
+
+    def test_watch_frames(self):
+        frames = [
+            {"type": "ADDED", "object": sample_pod(1)},
+            {"type": "MODIFIED", "object": sample_pod(2)},
+        ]
+        buf = b"".join(binary.encode_frame(f) for f in frames)
+        got = list(binary.read_frames(io.BytesIO(buf)))
+        assert got == frames
+
+
+class TestHostileInput:
+    def test_rejects_pickle(self):
+        # the retired pickle envelope (magic v0) must not decode
+        evil = b"k8s-tpu\x00" + pickle.dumps({"boom": 1})
+        with pytest.raises(binary.BinaryDecodeError):
+            binary.decode(evil)
+
+    def test_unknown_class(self):
+        data = tlv.dumps(sample_pod()).replace(b"Pod", b"Pwn", 1)
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(data)
+
+    def test_unregistered_class_rejected(self):
+        @dataclasses.dataclass
+        class Sneaky:
+            x: int = 0
+
+        # encode-side late registration exists, but a fresh decode-side
+        # registry must refuse names it never registered
+        blob = tlv.dumps(Sneaky(x=1))
+        saved_by_name = dict(tlv._BY_NAME)
+        saved_fields = dict(tlv._FIELDS)
+        try:
+            del tlv._BY_NAME["Sneaky"]
+            del tlv._FIELDS[Sneaky]
+            with pytest.raises(tlv.TLVError):
+                tlv.loads(blob)
+        finally:
+            tlv._BY_NAME.clear()
+            tlv._BY_NAME.update(saved_by_name)
+            tlv._FIELDS.clear()
+            tlv._FIELDS.update(saved_fields)
+
+    def test_truncation_everywhere(self):
+        data = tlv.dumps([sample_pod(i) for i in range(3)])
+        for cut in range(len(data) - 1):
+            with pytest.raises(tlv.TLVError):
+                tlv.loads(data[:cut])
+
+    def test_invalid_utf8_is_tlv_error(self):
+        # bad utf-8 in STR must surface as TLVError, not
+        # UnicodeDecodeError, so the HTTP 400 mapping holds
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(bytes([tlv.STR, 2]) + b"\xff\xfe")
+
+    def test_unhashable_dict_key_is_tlv_error(self):
+        evil = bytes([tlv.DICT, 1, tlv.LIST, 0, tlv.NONE])
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(evil)
+
+    def test_hostile_bytes_never_escape_binary_error(self):
+        import os
+        import random
+
+        rng = random.Random(7)
+        good = binary.encode(sample_pod())
+        for _ in range(300):
+            data = bytearray(good)
+            for _ in range(rng.randrange(1, 4)):
+                data[rng.randrange(len(binary.MAGIC), len(data))] = (
+                    rng.randrange(256)
+                )
+            try:
+                binary.decode(bytes(data))
+            except binary.BinaryDecodeError:
+                pass  # the ONLY acceptable failure mode
+        for _ in range(200):
+            blob = binary.MAGIC + os.urandom(rng.randrange(0, 60))
+            try:
+                binary.decode(blob)
+            except binary.BinaryDecodeError:
+                pass
+
+    def test_trailing_garbage(self):
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(tlv.dumps({"a": 1}) + b"\x00")
+
+    def test_huge_length_does_not_allocate(self):
+        # LIST claiming 2^40 elements with a 3-byte body
+        evil = bytes([tlv.LIST]) + b"\x80\x80\x80\x80\x80\x20" + b"\x00"
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(evil)
+
+    def test_depth_bomb(self):
+        evil = bytes([tlv.LIST, 1]) * 500 + bytes([tlv.NONE])
+        with pytest.raises(tlv.TLVError):
+            tlv.loads(evil)
+
+    def test_no_init_side_effects(self):
+        # decode builds objects without running __init__/__post_init__
+        calls = []
+        orig = t.Pod.__init__
+
+        def spy(self, *a, **k):
+            calls.append(1)
+            return orig(self, *a, **k)
+
+        t.Pod.__init__ = spy
+        try:
+            blob = tlv.dumps(sample_pod())  # one __init__ in sample_pod
+            calls.clear()
+            tlv.loads(blob)
+            assert calls == []
+        finally:
+            t.Pod.__init__ = orig
+
+
+class TestPerf:
+    def test_throughput_vs_pickle(self):
+        """The schema'd codec must stay within a small factor of the
+        C pickle it replaced on the dominant wire shape (a pod list);
+        the hard 'safe for untrusted callers' property is what pickle
+        could never offer at any speed."""
+        pods = [sample_pod(i) for i in range(200)]
+        payload = {"kind": "PodList", "items": pods,
+                   "metadata": {"resourceVersion": "1"}}
+
+        def rate(enc, dec):
+            blob = enc(payload)
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 0.3:
+                dec(enc(payload))
+                n += 1
+            return n / (time.perf_counter() - t0), len(blob)
+
+        tlv_rate, tlv_size = rate(tlv.dumps, tlv.loads)
+        pk_rate, pk_size = rate(
+            lambda p: pickle.dumps(p, pickle.HIGHEST_PROTOCOL), pickle.loads
+        )
+        # wire size must be competitive (TLV drops field names entirely)
+        assert tlv_size < pk_size * 1.2, (tlv_size, pk_size)
+        # throughput within 8x of C pickle keeps the codec off the
+        # daemon's critical path (HTTP+dispatch dominate per request)
+        assert tlv_rate * 8 > pk_rate, (tlv_rate, pk_rate)
