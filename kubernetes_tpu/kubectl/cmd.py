@@ -1,7 +1,9 @@
 """kubectl verbs (pkg/kubectl/cmd/*.go).
 
 Supported: get, describe, create -f, apply -f, delete, scale, label,
-annotate, cordon, uncordon, drain, run, expose, rollout-status, version.
+annotate, cordon, uncordon, drain, run, expose, rollout-status, logs,
+exec, attach, port-forward, patch, edit, rolling-update, proxy, top,
+autoscale, explain, config, version.
 Resource name aliases follow kubectl shortcuts (po, no, svc, rc, rs,
 deploy, ds, ns, ev, hpa...)."""
 
@@ -448,6 +450,535 @@ class Kubectl:
         with urllib.request.urlopen(req, timeout=10) as r:
             return r.read().decode()
 
+    def attach(self, name: str, container: str = "",
+               timeout: float = 2.0) -> str:
+        """kubectl attach (cmd/attach.go): follow a running container's
+        output through the kubelet's /attach stream; returns what the
+        container wrote within `timeout` seconds (or until it stopped)."""
+        import urllib.request
+
+        pod = self._rc("pods").get(name)
+        if not pod.spec.node_name:
+            raise RuntimeError(f"pod {name!r} is not scheduled yet")
+        container = container or (
+            pod.spec.containers[0].name if pod.spec.containers else ""
+        )
+        url = (
+            f"{self._kubelet_base(pod)}/attach/"
+            f"{pod.metadata.namespace}/{pod.metadata.name}/{container}"
+        )
+        out = []
+        deadline = time.monotonic() + timeout
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                while time.monotonic() < deadline:
+                    chunk = r.read1(65536)
+                    if not chunk:
+                        break
+                    out.append(chunk.decode(errors="replace"))
+        except TimeoutError:
+            pass
+        except OSError as e:  # stream timeout surfaces as URLError too
+            if out or "timed out" in str(e):
+                pass
+            else:
+                raise
+        return "".join(out)
+
+    def port_forward(self, name: str, local_port: int, remote_port: int):
+        """kubectl port-forward (cmd/portforward.go): listen on
+        127.0.0.1:local_port and relay each connection to the pod's
+        remote_port through the kubelet's /portForward endpoint. Returns
+        a handle with .local_port and .stop()."""
+        import socket as socketlib
+        import threading
+
+        pod = self._rc("pods").get(name)
+        if not pod.spec.node_name:
+            raise RuntimeError(f"pod {name!r} is not scheduled yet")
+        base = self._kubelet_base(pod)
+        host, port = base.replace("http://", "").rsplit(":", 1)
+        path = (
+            f"/portForward/{pod.metadata.namespace}/{pod.metadata.name}"
+            f"?port={remote_port}"
+        )
+
+        listener = socketlib.socket()
+        listener.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", local_port))
+        listener.listen(8)
+        stop = threading.Event()
+
+        def tunnel(conn):
+            from kubernetes_tpu.kubelet.server import _relay
+
+            try:
+                up = socketlib.create_connection((host, int(port)), timeout=10)
+                req = (
+                    f"POST {path} HTTP/1.1\r\nHost: {host}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                up.sendall(req)
+                # consume the response headers; the raw relay follows
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    data = up.recv(4096)
+                    if not data:
+                        conn.close()
+                        return
+                    buf += data
+                head, rest = buf.split(b"\r\n\r\n", 1)
+                if b" 200 " not in head.split(b"\r\n", 1)[0]:
+                    conn.close()
+                    up.close()
+                    return
+                if rest:
+                    conn.sendall(rest)
+                _relay(conn, up)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        def accept_loop():
+            while not stop.is_set():
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return
+                threading.Thread(
+                    target=tunnel, args=(conn,), daemon=True
+                ).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+
+        class Handle:
+            local_port = listener.getsockname()[1]
+
+            @staticmethod
+            def stop():
+                stop.set()
+                listener.close()
+
+        return Handle
+
+    # -- mutation verbs (patch.go / edit.go / rollingupdate.go) ---------------
+
+    def patch(self, resource: str, name: str, patch: str,
+              subresource: str = "") -> str:
+        """kubectl patch (cmd/patch.go): strategic-merge/merge patch from
+        a JSON string."""
+        resource = resolve(resource)
+        body = json.loads(patch)
+        self._rc(resource).patch(name, body, subresource=subresource)
+        return f"{resource}/{name} patched"
+
+    def edit(self, resource: str, name: str, editor: str = "") -> str:
+        """kubectl edit (cmd/edit.go): dump the object to a temp file,
+        run $KUBE_EDITOR/$EDITOR on it, and update with the result."""
+        import os
+        import subprocess
+        import tempfile
+
+        import yaml
+
+        resource = resolve(resource)
+        rc = self._rc(resource)
+        obj = rc.get(name)
+        doc = scheme.encode(obj)
+        editor = editor or os.environ.get("KUBE_EDITOR") or os.environ.get(
+            "EDITOR", "vi"
+        )
+        with tempfile.NamedTemporaryFile(
+            "w+", suffix=".yaml", delete=False
+        ) as f:
+            yaml.safe_dump(doc, f, sort_keys=True)
+            path = f.name
+        try:
+            subprocess.run(f"{editor} {path}", shell=True, check=True)
+            with open(path) as f:
+                edited = yaml.safe_load(f)
+        finally:
+            os.unlink(path)
+        if edited == doc:
+            return "Edit cancelled, no changes made."
+        new = scheme.decode(edited)
+        new.metadata.resource_version = obj.metadata.resource_version
+        rc.update(new)
+        return f"{resource}/{name} edited"
+
+    def rolling_update(self, old_name: str, image: str = "",
+                       new_name: str = "", interval: float = 0.1,
+                       timeout: float = 30.0) -> str:
+        """kubectl rolling-update (cmd/rollingupdate.go +
+        pkg/kubectl/rolling_updater.go): create a new RC alongside the
+        old one, scale +1/-1 until the new RC owns every replica, then
+        delete the old RC."""
+        rc_api = self._rc("replicationcontrollers")
+        old = rc_api.get(old_name)
+        desired = old.spec.replicas
+        new_name = new_name or f"{old_name}-next"
+        deploy_key = "deployment"
+        import copy as copymod
+
+        # Disambiguate ownership before the new RC exists: without a
+        # deployment-key dimension the old selector would match the new
+        # RC's pods too and fight it for them
+        # (rolling_updater.go AddDeploymentKeyToReplicationController).
+        old_token = f"{old_name}-orig"
+        if old.spec.selector.get(deploy_key) != old_token:
+            sel = ",".join(f"{k}={v}" for k, v in old.spec.selector.items())
+            for p in self.client.pods(old.metadata.namespace).list(
+                label_selector=sel
+            )[0]:
+                self.label("pods", p.metadata.name,
+                           f"{deploy_key}={old_token}")
+            def add_key(rc_obj):
+                rc_obj.spec.template.metadata.labels[deploy_key] = old_token
+                rc_obj.spec.selector[deploy_key] = old_token
+
+            self._edit_meta("replicationcontrollers", old_name, add_key)
+            old = rc_api.get(old_name)
+
+        new = copymod.deepcopy(old)
+        new.metadata = t.ObjectMeta(
+            name=new_name, namespace=old.metadata.namespace,
+            labels=dict(old.metadata.labels),
+        )
+        # a distinct selector dimension so the two RCs never fight over
+        # pods (rolling_updater.go AddDeploymentKeyToReplicationController)
+        new.spec.selector = dict(old.spec.selector)
+        new.spec.selector[deploy_key] = new_name
+        tmeta = new.spec.template.metadata
+        tmeta.labels = dict(tmeta.labels)
+        tmeta.labels[deploy_key] = new_name
+        if image:
+            for c in new.spec.template.spec.containers:
+                c.image = image
+        new.spec.replicas = 0
+        rc_api.create(new)
+
+        def ready(rc_obj) -> int:
+            return rc_obj.status.replicas
+
+        deadline = time.monotonic() + timeout
+        lines = [f"Created {new_name}"]
+        while True:
+            new_obj = rc_api.get(new_name)
+            old_obj = rc_api.get(old_name)
+            if new_obj.spec.replicas >= desired and old_obj.spec.replicas == 0:
+                if ready(new_obj) >= desired:
+                    break
+            elif ready(new_obj) >= new_obj.spec.replicas:
+                # the new RC converged at this size: take one
+                # INTERLEAVED +1/-1 step (rolling_updater.go Update) so
+                # the peak pod count stays at desired+1, never 2x
+                if new_obj.spec.replicas <= desired - old_obj.spec.replicas:
+                    new_obj.spec.replicas += 1
+                    rc_api.update(new_obj)
+                    lines.append(
+                        f"Scaling {new_name} up to {new_obj.spec.replicas}"
+                    )
+                elif old_obj.spec.replicas > 0:
+                    old_obj.spec.replicas -= 1
+                    rc_api.update(old_obj)
+                    lines.append(
+                        f"Scaling {old_name} down to {old_obj.spec.replicas}"
+                    )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rolling update stalled: {new_name} at "
+                    f"{ready(new_obj)}/{new_obj.spec.replicas}"
+                )
+            time.sleep(interval)
+        rc_api.delete(old_name)
+        lines.append(f"Update succeeded. Deleting {old_name}")
+        lines.append(f"replicationcontroller/{new_name} rolling updated")
+        return "\n".join(lines)
+
+    # -- observability verbs (top.go / autoscale.go) --------------------------
+
+    def top(self, what: str) -> str:
+        """kubectl top node|pod: usage from each node's kubelet
+        /stats/summary endpoint (the heapster-lite path)."""
+        import urllib.request
+
+        what = resolve(what)
+        nodes, _ = self.client.nodes().list()
+        stats = {}
+        for n in nodes:
+            port = n.status.kubelet_port
+            if not port:
+                continue
+            host = next(
+                (a.address for a in n.status.addresses
+                 if a.type == "InternalIP"), "127.0.0.1",
+            )
+            try:
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/stats/summary", timeout=5
+                ) as r:
+                    stats[n.metadata.name] = json.loads(r.read())
+            except OSError:
+                continue
+        if what == "nodes":
+            rows = [["NAME", "MEMORY(bytes available)", "PODS"]]
+            for name in sorted(stats):
+                s = stats[name]
+                mem = s.get("node", {}).get("memory", {}).get("availableBytes")
+                rows.append([
+                    name,
+                    "<unknown>" if mem is None else str(mem),
+                    str(len(s.get("pods", []))),
+                ])
+        elif what == "pods":
+            rows = [["NAMESPACE", "NAME", "NODE"]]
+            for name in sorted(stats):
+                for p in stats[name].get("pods", []):
+                    ref = p.get("podRef", {})
+                    rows.append([ref.get("namespace", ""),
+                                 ref.get("name", ""), name])
+            rows[1:] = sorted(rows[1:])
+        else:
+            raise ValueError(f"top supports nodes|pods, not {what!r}")
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        return "\n".join(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+            for r in rows
+        )
+
+    def autoscale(self, resource: str, name: str, min_replicas: int,
+                  max_replicas: int, cpu_percent: int = 80) -> str:
+        """kubectl autoscale (cmd/autoscale.go): create an HPA targeting
+        the scalable resource."""
+        resource = resolve(resource)
+        if resource not in SCALABLE:
+            raise ValueError(f"{resource} is not scalable")
+        hpa = t.HorizontalPodAutoscaler(
+            metadata=t.ObjectMeta(name=name, namespace=self.namespace),
+            spec=t.HorizontalPodAutoscalerSpec(
+                scale_target_kind=SCALABLE[resource],
+                scale_target_name=name,
+                min_replicas=min_replicas,
+                max_replicas=max_replicas,
+                target_cpu_utilization_percentage=cpu_percent,
+            ),
+        )
+        self._rc("horizontalpodautoscalers").create(hpa)
+        return f"horizontalpodautoscaler/{name} autoscaled"
+
+    # -- proxy / explain / config --------------------------------------------
+
+    def proxy(self, port: int = 0):
+        """kubectl proxy (cmd/proxy.go): a localhost HTTP server relaying
+        every request to the apiserver through this client's transport
+        (and therefore its auth). Returns a handle with .port/.stop()."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qsl, urlparse
+
+        client = self.client
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _do(self, method):
+                parsed = urlparse(self.path)
+                query = dict(parse_qsl(parsed.query))
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n)) if n else None
+                try:
+                    code, payload = client.transport.request(
+                        method, parsed.path, query or None, body
+                    )
+                except Exception as e:
+                    code, payload = 502, {"message": str(e)}
+                data = json.dumps(
+                    payload, default=lambda o: scheme.encode(o)
+                ).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._do("GET")
+
+            def do_POST(self):
+                self._do("POST")
+
+            def do_PUT(self):
+                self._do("PUT")
+
+            def do_PATCH(self):
+                self._do("PATCH")
+
+            def do_DELETE(self):
+                self._do("DELETE")
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        srv = Server(("127.0.0.1", port), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+        class Handle:
+            port = srv.server_address[1]
+
+            @staticmethod
+            def stop():
+                srv.shutdown()
+                srv.server_close()
+
+        return Handle
+
+    def explain(self, path: str) -> str:
+        """kubectl explain (cmd/explain.go): describe a resource's
+        fields from the dataclass schema, dotted paths supported
+        (e.g. pods.spec.containers)."""
+        import dataclasses
+        import typing
+
+        segs = path.split(".")
+        resource = resolve(segs[0])
+        kind = next(
+            (k for k, r in _KIND_TO_RESOURCE.items() if r == resource), None
+        )
+        if kind is None:
+            raise ValueError(f"unknown resource {segs[0]!r}")
+        cls = scheme.type_for(kind)
+
+        def field_type(tp):
+            origin = typing.get_origin(tp)
+            if origin in (list, List):
+                return f"[]{field_type(typing.get_args(tp)[0])}"
+            if origin is dict:
+                return "map[string]string"
+            if origin is typing.Union:
+                args = [a for a in typing.get_args(tp) if a is not type(None)]
+                return field_type(args[0]) if args else "Object"
+            return getattr(tp, "__name__", str(tp))
+
+        def resolve_path(cls, segs):
+            for seg in segs:
+                hints = typing.get_type_hints(cls)
+                camel = {to_camel_local(f.name): f
+                         for f in dataclasses.fields(cls)}
+                f = camel.get(seg) or next(
+                    (ff for ff in dataclasses.fields(cls)
+                     if ff.name == seg), None,
+                )
+                if f is None:
+                    raise ValueError(f"field {seg!r} does not exist in "
+                                     f"{cls.__name__}")
+                tp = hints[f.name]
+                origin = typing.get_origin(tp)
+                if origin in (list, List):
+                    tp = typing.get_args(tp)[0]
+                elif origin is typing.Union:
+                    tp = next(a for a in typing.get_args(tp)
+                              if a is not type(None))
+                cls = tp
+            return cls
+
+        from kubernetes_tpu.runtime.scheme import to_camel as to_camel_local
+
+        cls = resolve_path(cls, segs[1:])
+        lines = [f"KIND:     {kind}", f"RESOURCE: {'.'.join(segs)}", "",
+                 "FIELDS:"]
+        if dataclasses.is_dataclass(cls):
+            hints = typing.get_type_hints(cls)
+            for f in sorted(dataclasses.fields(cls), key=lambda f: f.name):
+                lines.append(
+                    f"   {to_camel_local(f.name)}\t<{field_type(hints[f.name])}>"
+                )
+        else:
+            lines.append(f"   <{getattr(cls, '__name__', cls)}>")
+        return "\n".join(lines)
+
+    # -- kubeconfig (cmd/config.go) ------------------------------------------
+
+    @staticmethod
+    def config(kubeconfig: str, args: Sequence[str]) -> str:
+        """kubectl config view|current-context|use-context|set-cluster|
+        set-context against a kubeconfig YAML file."""
+        import os
+
+        import yaml
+
+        def load():
+            if os.path.exists(kubeconfig):
+                with open(kubeconfig) as f:
+                    return yaml.safe_load(f) or {}
+            return {"apiVersion": "v1", "kind": "Config", "clusters": [],
+                    "contexts": [], "current-context": ""}
+
+        def save(cfg):
+            os.makedirs(os.path.dirname(kubeconfig) or ".", exist_ok=True)
+            with open(kubeconfig, "w") as f:
+                yaml.safe_dump(cfg, f, sort_keys=True)
+
+        if not args:
+            raise ValueError("config requires a subcommand")
+        sub, rest = args[0], list(args[1:])
+        if sub in ("use-context", "set-cluster", "set-context") and not rest:
+            raise ValueError(f"config {sub} requires a name")
+        cfg = load()
+        if sub == "view":
+            return yaml.safe_dump(cfg, sort_keys=True)
+        if sub == "current-context":
+            return cfg.get("current-context", "")
+        if sub == "use-context":
+            names = [c["name"] for c in cfg.get("contexts", [])]
+            if rest[0] not in names:
+                raise ValueError(f"no context exists with the name {rest[0]!r}")
+            cfg["current-context"] = rest[0]
+            save(cfg)
+            return f'Switched to context "{rest[0]}".'
+        if sub == "set-cluster":
+            name = rest[0]
+            server = next(
+                (a.split("=", 1)[1] for a in rest[1:]
+                 if a.startswith("--server=")), "",
+            )
+            clusters = [c for c in cfg.get("clusters", [])
+                        if c["name"] != name]
+            clusters.append({"name": name, "cluster": {"server": server}})
+            cfg["clusters"] = clusters
+            save(cfg)
+            return f'Cluster "{name}" set.'
+        if sub == "set-context":
+            name = rest[0]
+            cluster = next(
+                (a.split("=", 1)[1] for a in rest[1:]
+                 if a.startswith("--cluster=")), "",
+            )
+            namespace = next(
+                (a.split("=", 1)[1] for a in rest[1:]
+                 if a.startswith("--namespace=")), "",
+            )
+            existed = any(
+                c["name"] == name for c in cfg.get("contexts", [])
+            )
+            contexts = [c for c in cfg.get("contexts", [])
+                        if c["name"] != name]
+            ctx = {"cluster": cluster}
+            if namespace:
+                ctx["namespace"] = namespace
+            contexts.append({"name": name, "context": ctx})
+            cfg["contexts"] = contexts
+            save(cfg)
+            return f'Context "{name}" {"modified" if existed else "created"}.'
+        raise ValueError(f"unknown config subcommand {sub!r}")
+
 
 def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = None):
     parser = argparse.ArgumentParser(prog="kubectl")
@@ -518,6 +1049,54 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p.add_argument("subverb", choices=["status"])
     p.add_argument("target")
 
+    p = sub.add_parser("patch")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--patch", "-p", required=True)
+    p.add_argument("--subresource", default="")
+
+    p = sub.add_parser("edit")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--editor", default="")
+
+    p = sub.add_parser("rolling-update")
+    p.add_argument("old_name")
+    p.add_argument("new_name", nargs="?", default="")
+    p.add_argument("--image", default="")
+    p.add_argument("--update-period", type=float, default=0.1)
+    p.add_argument("--timeout", type=float, default=30.0)
+
+    p = sub.add_parser("attach")
+    p.add_argument("name")
+    p.add_argument("--container", "-c", default="")
+    p.add_argument("--timeout", type=float, default=2.0)
+
+    p = sub.add_parser("port-forward")
+    p.add_argument("name")
+    p.add_argument("ports")  # LOCAL:REMOTE or PORT
+
+    p = sub.add_parser("proxy")
+    p.add_argument("--port", "-p", type=int, default=8001)
+
+    p = sub.add_parser("top")
+    p.add_argument("what", choices=["node", "nodes", "pod", "pods"])
+
+    p = sub.add_parser("autoscale")
+    p.add_argument("target")  # resource/name
+    p.add_argument("--min", type=int, required=True)
+    p.add_argument("--max", type=int, required=True)
+    p.add_argument("--cpu-percent", type=int, default=80)
+
+    p = sub.add_parser("explain")
+    p.add_argument("path")
+
+    p = sub.add_parser("config")
+    p.add_argument("--kubeconfig", default="")
+    # REMAINDER: --server=/--cluster=/--namespace= tokens belong to the
+    # config subcommand's own parser, not argparse
+    p.add_argument("config_args", nargs=argparse.REMAINDER)
+
     sub.add_parser("version")
 
     args = parser.parse_args(argv)
@@ -565,6 +1144,58 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     elif args.verb == "rollout":
         resource, name = args.target.split("/", 1)
         out = k.rollout_status(resource, name)
+    elif args.verb == "patch":
+        out = k.patch(args.resource, args.name, args.patch, args.subresource)
+    elif args.verb == "edit":
+        out = k.edit(args.resource, args.name, editor=args.editor)
+    elif args.verb == "rolling-update":
+        out = k.rolling_update(args.old_name, image=args.image,
+                               new_name=args.new_name,
+                               interval=args.update_period,
+                               timeout=args.timeout)
+    elif args.verb == "attach":
+        out = k.attach(args.name, container=args.container,
+                       timeout=args.timeout)
+    elif args.verb == "port-forward":
+        if ":" in args.ports:
+            local_s, remote_s = args.ports.split(":", 1)
+        else:
+            local_s = remote_s = args.ports
+        handle = k.port_forward(args.name, int(local_s), int(remote_s))
+        out = (f"Forwarding from 127.0.0.1:{handle.local_port} -> "
+               f"{remote_s}")
+        print(out)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            handle.stop()
+        return out
+    elif args.verb == "proxy":
+        handle = k.proxy(args.port)
+        out = f"Starting to serve on 127.0.0.1:{handle.port}"
+        print(out)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            handle.stop()
+        return out
+    elif args.verb == "top":
+        out = k.top(args.what)
+    elif args.verb == "autoscale":
+        resource, name = args.target.split("/", 1)
+        out = k.autoscale(resource, name, args.min, args.max,
+                          args.cpu_percent)
+    elif args.verb == "explain":
+        out = k.explain(args.path)
+    elif args.verb == "config":
+        import os
+
+        kubeconfig = args.kubeconfig or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        out = Kubectl.config(kubeconfig, args.config_args)
     elif args.verb == "version":
         out = "kubernetes-tpu v0 (reference parity: kubernetes v1.3-dev)"
     else:  # pragma: no cover

@@ -52,6 +52,17 @@ class ContainerRuntime:
         """Run a command in the container (ExecInContainer)."""
         raise NotImplementedError
 
+    def attach(self, uid: str, container: str):
+        """Attach to a running container: an iterator of output chunks
+        that yields what the container writes AFTER attachment, ending
+        when the container stops (AttachContainer)."""
+        raise NotImplementedError
+
+    def port_socket(self, uid: str, port: int):
+        """A connected socket to the pod's port (the PortForward
+        target). Raises KeyError if nothing listens there."""
+        raise NotImplementedError
+
 
 class FakeRuntime(ContainerRuntime):
     def __init__(self):
@@ -69,6 +80,11 @@ class FakeRuntime(ContainerRuntime):
         # node-API seams: recorded log lines and injectable exec replies
         self._logs: Dict[Tuple[str, str], List[str]] = {}
         self.exec_replies: Dict[Tuple[str, str], str] = {}
+        # attach followers: write_log wakes them (kubelet /attach seam)
+        self._log_cv = threading.Condition(self._lock)
+        # (pod_uid, port) -> (host, real_port): where port_socket dials
+        # (the hollow-node stand-in for a container's listening socket)
+        self._ports: Dict[Tuple[str, int], Tuple[str, int]] = {}
 
     def list_pods(self) -> List[RuntimePod]:
         with self._lock:
@@ -105,6 +121,7 @@ class FakeRuntime(ContainerRuntime):
         with self._lock:
             self.calls.append(("kill", uid))
             self._pods.pop(uid, None)
+            self._log_cv.notify_all()  # wake attach followers to exit
 
     def get_logs(self, uid: str, container: str, tail=None) -> List[str]:
         with self._lock:
@@ -119,14 +136,63 @@ class FakeRuntime(ContainerRuntime):
             return reply
         return " ".join(command) + "\n"  # echo shape (fake shell)
 
+    def attach(self, uid: str, container: str):
+        """Follow the container's output from the point of attachment:
+        yields chunks as write_log appends them; ends when the pod is
+        killed or the container exits."""
+        with self._lock:
+            start = len(self._logs.get((uid, container), []))
+
+        def _running() -> bool:
+            p = self._pods.get(uid)
+            if p is None:
+                return False
+            c = next((c for c in p.containers if c.name == container), None)
+            return c is not None and c.state == "running"
+
+        idx = start
+        while True:
+            chunk = None
+            with self._log_cv:
+                lines = self._logs.get((uid, container), [])
+                if idx < len(lines):
+                    chunk = "".join(lines[idx:])
+                    idx = len(lines)
+                elif not _running():
+                    return
+                else:
+                    self._log_cv.wait(timeout=0.2)
+            if chunk is not None:
+                # yield OUTSIDE the lock: the consumer writes this chunk
+                # to a client socket, and a slow client must not stall
+                # the whole runtime (PLEG, status sync, kills)
+                yield chunk
+
+    def port_socket(self, uid: str, port: int):
+        import socket
+
+        with self._lock:
+            addr = self._ports.get((uid, port))
+        if addr is None:
+            raise KeyError(f"pod {uid!r} has nothing listening on {port}")
+        return socket.create_connection(addr, timeout=10)
+
     # test helpers -----------------------------------------------------------
 
     def write_log(self, uid: str, container: str, line: str) -> None:
         """Append a container log line (the hollow-node seam for logs)."""
-        with self._lock:
+        with self._log_cv:
             self._logs.setdefault((uid, container), []).append(
                 line if line.endswith("\n") else line + "\n"
             )
+            self._log_cv.notify_all()
+
+    def expose_port(self, uid: str, port: int, host: str,
+                    real_port: int) -> None:
+        """Declare that the pod serves `port` at (host, real_port) — the
+        hollow-node seam PortForward bridges to."""
+        with self._lock:
+            self._ports[(uid, port)] = (host, real_port)
 
     def exit_container(self, uid: str, container: str, code: int = 0) -> None:
         """Simulate a container terminating on its own (PLEG will notice)."""
@@ -138,3 +204,4 @@ class FakeRuntime(ContainerRuntime):
                 if c.name == container:
                     c.state = "exited"
                     c.exit_code = code
+            self._log_cv.notify_all()  # wake attach followers to exit

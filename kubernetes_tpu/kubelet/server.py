@@ -6,6 +6,8 @@ Serves the debugging endpoints kubectl needs a node for:
     GET  /pods                                   (this node's pod specs)
     GET  /containerLogs/{ns}/{pod}/{container}   (?tailLines=N)
     POST /exec/{ns}/{pod}/{container}?command=...
+    GET  /attach/{ns}/{pod}/{container}          (chunked follow stream)
+    POST /portForward/{ns}/{pod}?port=N          (raw byte relay after 200)
     GET  /stats/summary                          (cadvisor-lite node stats)
 
 Log/exec content comes from the container runtime seam — FakeRuntime
@@ -18,9 +20,40 @@ reference; addresses + kubelet_port here) so clients can resolve it.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+
+def _relay(a: socket.socket, b: socket.socket) -> None:
+    """Bidirectional byte copy until either side closes (the
+    port-forward data plane). Runs on the caller's thread plus one
+    helper; returns when both directions drain."""
+
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=pump, args=(b, a), daemon=True)
+    t.start()
+    pump(a, b)
+    t.join(timeout=10)
+    try:
+        b.close()
+    except OSError:
+        pass
 
 
 class KubeletServer:
@@ -92,6 +125,32 @@ class KubeletServer:
                     )
                     self._send(200, "".join(lines), "text/plain")
                     return
+                if parts[:1] == ["attach"] and len(parts) == 4:
+                    # server/server.go:63 getAttach — a follow stream of
+                    # the container's output, chunked so the client sees
+                    # writes as they happen
+                    _, ns, name, container = parts
+                    pod = find_pod(ns, name)
+                    if pod is None:
+                        self._send(404, {"message": f"pod {ns}/{name} not found"})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        for chunk in kl.runtime.attach(
+                            pod.metadata.uid, container
+                        ):
+                            data = chunk.encode()
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                            )
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # client hung up: detach
+                    return
                 if parts == ["stats", "summary"]:
                     # cadvisor-lite: node memory availability (the signal
                     # the eviction manager consumes) + per-pod presence
@@ -136,6 +195,51 @@ class KubeletServer:
                         pod.metadata.uid, container, command
                     )
                     self._send(200, out, "text/plain")
+                    return
+                if parts[:1] == ["portForward"] and len(parts) == 3:
+                    # server/server.go:63 getPortForward — after the 200
+                    # the HTTP connection becomes a raw bidirectional
+                    # byte relay to the pod's port (the SPDY-upgrade
+                    # analogue, without the SPDY)
+                    _, ns, name = parts
+                    pod = find_pod(ns, name)
+                    if pod is None:
+                        self._send(404, {"message": f"pod {ns}/{name} not found"})
+                        return
+                    q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    try:
+                        port = int(q.get("port", ""))
+                    except ValueError:
+                        self._send(400, {"message": "port required"})
+                        return
+                    try:
+                        upstream = kl.runtime.port_socket(
+                            pod.metadata.uid, port
+                        )
+                    except KeyError as e:
+                        self._send(400, {"message": str(e)})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.flush()
+                    self.close_connection = True
+                    # a client may pipeline payload bytes in the same
+                    # TCP segment as the headers: they sit in rfile's
+                    # buffer, which the raw-socket relay cannot see.
+                    # Non-blocking drain: only already-buffered bytes,
+                    # never a blocking read.
+                    self.connection.setblocking(False)
+                    try:
+                        buffered = self.rfile.read1(65536) or b""
+                    except (BlockingIOError, OSError):
+                        buffered = b""
+                    finally:
+                        self.connection.setblocking(True)
+                    if buffered:
+                        upstream.sendall(buffered)
+                    _relay(self.connection, upstream)
                     return
                 self._send(404, {"message": f"unknown path {parsed.path}"})
 

@@ -216,3 +216,207 @@ def test_logs_and_exec_via_kubelet_api():
         assert k.exec("web", ["echo", "hi"]) == "echo hi\n"
     finally:
         kl.stop()
+
+
+def test_patch_and_edit(kubectl, tmp_path):
+    k, client = kubectl
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="web-1", labels={"app": "web"}),
+            spec=PodSpec(containers=[Container(name="c")]))
+    )
+    out = k.patch("pod", "web-1",
+                  '{"metadata": {"labels": {"tier": "frontend"}}}')
+    assert out == "pods/web-1 patched"
+    p = client.pods().get("web-1")
+    assert p.metadata.labels["tier"] == "frontend"
+    assert p.metadata.labels["app"] == "web"  # merge, not replace
+
+    # edit: a scripted "editor" rewrites a label in the YAML
+    editor = tmp_path / "ed.sh"
+    editor.write_text("#!/bin/sh\nsed -i 's/frontend/backend/' \"$1\"\n")
+    editor.chmod(0o755)
+    out = k.edit("pod", "web-1", editor=str(editor))
+    assert out == "pods/web-1 edited"
+    assert client.pods().get("web-1").metadata.labels["tier"] == "backend"
+
+    # a no-op edit changes nothing
+    noop = tmp_path / "noop.sh"
+    noop.write_text("#!/bin/sh\ntrue\n")
+    noop.chmod(0o755)
+    assert "no changes" in k.edit("pod", "web-1", editor=str(noop))
+
+
+def test_autoscale_and_explain(kubectl):
+    k, client = kubectl
+    k.run("web", image="nginx", replicas=2)
+    out = k.autoscale("rc", "web", 2, 10, cpu_percent=70)
+    assert out == "horizontalpodautoscaler/web autoscaled"
+    hpa = client.resource("horizontalpodautoscalers", "default").get("web")
+    assert hpa.spec.min_replicas == 2 and hpa.spec.max_replicas == 10
+    assert hpa.spec.target_cpu_utilization_percentage == 70
+    assert hpa.spec.scale_target_kind == "ReplicationController"
+
+    out = k.explain("pods")
+    assert "KIND:     Pod" in out and "spec" in out and "metadata" in out
+    out = k.explain("pods.spec")
+    assert "nodeName" in out and "containers" in out
+    out = k.explain("pods.spec.containers")
+    assert "image" in out
+    with pytest.raises(ValueError):
+        k.explain("pods.spec.nosuchfield")
+
+
+def test_rolling_update(kubectl):
+    import threading
+
+    from kubernetes_tpu.controller.manager import ControllerManager
+
+    k, client = kubectl
+    cm = ControllerManager(client).start()
+    try:
+        k.run("web", image="nginx:1.0", replicas=3)
+        out = k.rolling_update("web", image="nginx:2.0", timeout=30)
+        assert "rolling updated" in out
+        rcs, _ = client.resource(
+            "replicationcontrollers", "default"
+        ).list()
+        assert [r.metadata.name for r in rcs] == ["web-next"]
+        new = rcs[0]
+        assert new.spec.replicas == 3
+        assert new.spec.template.spec.containers[0].image == "nginx:2.0"
+        # every surviving pod is the new RC's
+        assert wait_until(lambda: sum(
+            1 for p in client.pods().list()[0]
+            if p.metadata.labels.get("deployment") == "web-next"
+            and not p.metadata.deletion_timestamp
+        ) == 3)
+    finally:
+        cm.stop()
+
+
+def test_proxy_and_config(kubectl, tmp_path):
+    import json as jsonlib
+    import urllib.request
+
+    k, client = kubectl
+    client.pods().create(
+        Pod(metadata=ObjectMeta(name="via-proxy"),
+            spec=PodSpec(containers=[Container(name="c")]))
+    )
+    handle = k.proxy(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.port}/api/v1/namespaces/default/pods"
+        ) as r:
+            payload = jsonlib.loads(r.read())
+        names = [i["metadata"]["name"] for i in payload["items"]]
+        assert "via-proxy" in names
+    finally:
+        handle.stop()
+
+    cfg = tmp_path / "kubeconfig"
+    assert "set" in Kubectl.config(
+        str(cfg), ["set-cluster", "tpu", "--server=http://127.0.0.1:8080"])
+    Kubectl.config(str(cfg), ["set-context", "dev", "--cluster=tpu",
+                              "--namespace=default"])
+    assert "Switched" in Kubectl.config(str(cfg), ["use-context", "dev"])
+    assert Kubectl.config(str(cfg), ["current-context"]) == "dev"
+    view = Kubectl.config(str(cfg), ["view"])
+    assert "http://127.0.0.1:8080" in view
+    with pytest.raises(ValueError):
+        Kubectl.config(str(cfg), ["use-context", "nope"])
+
+
+def test_attach_portforward_top_via_kubelet_api():
+    """kubectl attach streams post-attach writes; port-forward relays
+    raw TCP to the pod's port; top reads kubelet stats."""
+    import socket
+    import threading
+    import time
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import RESTClient
+    from kubernetes_tpu.client.transport import LocalTransport
+    from kubernetes_tpu.kubectl.cmd import Kubectl
+    from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
+
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    runtime = FakeRuntime()
+    kl = Kubelet(client, KubeletConfig(
+        node_name="n1", serve_api=True,
+        pleg_relist_period=0.05, status_sync_period=0.05,
+        node_status_update_frequency=0.05,
+    ), runtime).run()
+    try:
+        client.pods().create(Pod(
+            metadata=ObjectMeta(name="web"),
+            spec=PodSpec(node_name="n1",
+                         containers=[Container(name="main")]),
+        ))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            p = client.pods().get("web")
+            n = client.nodes().get("n1")
+            if p.status.phase == "Running" and n.status.kubelet_port:
+                break
+            time.sleep(0.05)
+        pod = client.pods().get("web")
+        k = Kubectl(client)
+
+        # attach sees what the container writes AFTER attachment
+        runtime.write_log(pod.metadata.uid, "main", "before attach")
+        got = {}
+
+        def do_attach():
+            got["out"] = k.attach("web", timeout=2.0)
+
+        th = threading.Thread(target=do_attach)
+        th.start()
+        time.sleep(0.4)
+        runtime.write_log(pod.metadata.uid, "main", "during attach")
+        th.join(timeout=5)
+        assert "during attach" in got["out"]
+        assert "before attach" not in got["out"]
+
+        # port-forward: an in-process echo server stands in for the
+        # container's listening socket (the hollow-node seam)
+        echo = socket.socket()
+        echo.bind(("127.0.0.1", 0))
+        echo.listen(1)
+
+        def echo_once():
+            conn, _ = echo.accept()
+            data = conn.recv(1024)
+            conn.sendall(b"echo:" + data)
+            conn.close()
+
+        threading.Thread(target=echo_once, daemon=True).start()
+        runtime.expose_port(pod.metadata.uid, 80, "127.0.0.1",
+                            echo.getsockname()[1])
+        handle = k.port_forward("web", 0, 80)
+        try:
+            c = socket.create_connection(
+                ("127.0.0.1", handle.local_port), timeout=5
+            )
+            c.sendall(b"ping")
+            c.shutdown(socket.SHUT_WR)
+            reply = b""
+            while True:
+                chunk = c.recv(1024)
+                if not chunk:
+                    break
+                reply += chunk
+            assert reply == b"echo:ping"
+            c.close()
+        finally:
+            handle.stop()
+            echo.close()
+
+        # top surfaces the kubelet's stats summary
+        out = k.top("nodes")
+        assert "n1" in out and "NAME" in out
+        out = k.top("pods")
+        assert "web" in out and "n1" in out
+    finally:
+        kl.stop()
