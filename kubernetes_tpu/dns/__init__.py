@@ -9,5 +9,6 @@ watches:
 """
 
 from kubernetes_tpu.dns.server import DNSRecords
+from kubernetes_tpu.dns.wire import DNSServer
 
-__all__ = ["DNSRecords"]
+__all__ = ["DNSRecords", "DNSServer"]

@@ -139,12 +139,19 @@ def run_local_up(args) -> None:
         client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
     ).start()
     dns = DNSRecords(client).run()
+    from kubernetes_tpu.dns import DNSServer
+
+    dns_srv = DNSServer(dns)
+    dns_host, dns_port = dns_srv.serve(port=args.dns_port)
     print(
         f"local cluster up: http://{host}:{port} ({args.nodes} hollow nodes)\n"
+        f"kube-dns on {dns_host}:{dns_port}/udp+tcp "
+        f"(dig @{dns_host} -p {dns_port} <svc>.<ns>.svc.cluster.local)\n"
         f"try: python -m kubernetes_tpu.kubectl -s http://{host}:{port} get nodes",
         flush=True,
     )
     _wait_forever()
+    dns_srv.shutdown()
     dns.stop()
     sched.stop()
     mgr.stop()
@@ -213,6 +220,8 @@ def main(argv=None):
     p.add_argument("--algorithm-provider", default="TPUProvider")
     p.add_argument("--data-dir", default="",
                    help="persist the apiserver store (WAL + snapshot)")
+    p.add_argument("--dns-port", type=int, default=0,
+                   help="kube-dns UDP+TCP port (0 = ephemeral; 53 needs root)")
 
     args = ap.parse_args(argv)
     {

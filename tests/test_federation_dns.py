@@ -156,3 +156,147 @@ def test_dns_records():
         assert srv[0].target == "web.default.svc.cluster.local"
     finally:
         dns.stop()
+
+
+def _dns_query(name: str, qtype: int, txn: int = 0x1234) -> bytes:
+    """A dig-equivalent raw query packet (RFC1035, RD set)."""
+    import struct
+
+    out = bytearray(struct.pack("!HHHHHH", txn, 0x0100, 1, 0, 0, 0))
+    for label in name.rstrip(".").split("."):
+        out.append(len(label))
+        out += label.encode()
+    out.append(0)
+    out += struct.pack("!HH", qtype, 1)
+    return bytes(out)
+
+
+def _parse_answers(data: bytes, txn: int = 0x1234):
+    """-> (rcode, [(type, rdata-bytes)]). Minimal independent parser."""
+    import struct
+
+    tid, flags, qd, an, _, _ = struct.unpack_from("!HHHHHH", data, 0)
+    assert tid == txn and flags & 0x8000  # a response to our txn
+    pos = 12
+    while data[pos]:  # skip question name
+        pos += 1 + data[pos]
+    pos += 1 + 4
+    out = []
+    for _ in range(an):
+        assert data[pos:pos + 2] == b"\xc0\x0c"  # name -> question
+        rtype, _cls, _ttl, rdlen = struct.unpack_from("!HHIH", data, pos + 2)
+        rdata = data[pos + 12:pos + 12 + rdlen]
+        out.append((rtype, rdata))
+        pos += 12 + rdlen
+    return flags & 0xF, out
+
+
+def test_dns_wire_protocol():
+    """dig-style A and SRV queries over real UDP and TCP sockets resolve
+    a service, a headless service, and a pet hostname (cmd/kube-dns)."""
+    import socket
+    import struct
+
+    from kubernetes_tpu.dns import DNSServer
+
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    dns = DNSRecords(client).run()
+    wire = DNSServer(dns)
+    host, port = wire.serve()
+    try:
+        client.resource("services", "default").create(
+            Service(
+                metadata=ObjectMeta(name="web"),
+                spec=ServiceSpec(
+                    selector={"app": "web"},
+                    cluster_ip="10.0.0.10",
+                    ports=[ServicePort(name="http", port=80)],
+                ),
+            )
+        )
+        client.resource("services", "default").create(
+            Service(
+                metadata=ObjectMeta(name="db"),
+                spec=ServiceSpec(selector={"app": "db"}, cluster_ip="None"),
+            )
+        )
+        client.resource("endpoints", "default").create(
+            Endpoints(
+                metadata=ObjectMeta(name="db"),
+                subsets=[EndpointSubset(
+                    addresses=[
+                        EndpointAddress(ip="10.1.0.5", target_ref="default/db-0"),
+                        EndpointAddress(ip="10.1.0.6", target_ref="default/db-1"),
+                    ],
+                    ports=[EndpointPort(port=5432)],
+                )],
+            )
+        )
+        assert wait_until(
+            lambda: dns.resolve("web.default.svc.cluster.local") == ["10.0.0.10"]
+        )
+        assert wait_until(
+            lambda: len(dns.resolve("db.default.svc.cluster.local")) == 2
+        )
+
+        def udp_ask(name, qtype):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.settimeout(5)
+            s.sendto(_dns_query(name, qtype), (host, port))
+            data, _ = s.recvfrom(4096)
+            s.close()
+            return _parse_answers(data)
+
+        # A: cluster IP
+        rcode, ans = udp_ask("web.default.svc.cluster.local", 1)
+        assert rcode == 0
+        assert [socket.inet_ntoa(r) for t, r in ans if t == 1] == ["10.0.0.10"]
+        # A: headless -> both endpoint IPs
+        rcode, ans = udp_ask("db.default.svc.cluster.local", 1)
+        assert sorted(socket.inet_ntoa(r) for _t, r in ans) == [
+            "10.1.0.5", "10.1.0.6"]
+        # A: pet hostname
+        rcode, ans = udp_ask("db-1.db.default.svc.cluster.local", 1)
+        assert [socket.inet_ntoa(r) for _t, r in ans] == ["10.1.0.6"]
+        # SRV: named port
+        rcode, ans = udp_ask("_http._tcp.web.default.svc.cluster.local", 33)
+        assert rcode == 0 and len(ans) == 1
+        prio, weight, sport = struct.unpack_from("!HHH", ans[0][1], 0)
+        assert sport == 80
+        # NXDOMAIN
+        rcode, ans = udp_ask("nope.default.svc.cluster.local", 1)
+        assert rcode == 3 and ans == []
+
+        # TCP path (2-byte length prefix)
+        c = socket.create_connection((host, port), timeout=5)
+        q = _dns_query("web.default.svc.cluster.local", 1)
+        c.sendall(struct.pack("!H", len(q)) + q)
+        hdr = c.recv(2)
+        (n,) = struct.unpack("!H", hdr)
+        data = b""
+        while len(data) < n:
+            data += c.recv(n - len(data))
+        c.close()
+        rcode, ans = _parse_answers(data)
+        assert [socket.inet_ntoa(r) for _t, r in ans] == ["10.0.0.10"]
+
+        # hostile input: garbage and a compression-pointer loop are
+        # dropped without killing the server
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(0.3)
+        s.sendto(b"\x00" * 5, (host, port))
+        loop = bytearray(_dns_query("a.b", 1))
+        loop[12] = 0xC0
+        loop[13] = 0x0C  # name points at itself
+        s.sendto(bytes(loop), (host, port))
+        import pytest as _pytest
+
+        with _pytest.raises(socket.timeout):
+            s.recvfrom(4096)
+        s.close()
+        rcode, ans = udp_ask("web.default.svc.cluster.local", 1)
+        assert rcode == 0  # still serving
+    finally:
+        wire.shutdown()
+        dns.stop()
