@@ -83,13 +83,16 @@ class HTTPTransport:
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  tls_ca: str = "", insecure: bool = False,
-                 binary: bool = False):
+                 binary: bool = False, bearer_token: str = ""):
         """binary=True negotiates the binary content type
         (runtime/binary.py) — the protobuf-at-scale analogue kubemark
         components default to. Implies the object protocol client-side
-        (no reflective codec on either end)."""
+        (no reflective codec on either end). bearer_token attaches
+        `Authorization: Bearer ...` to every request (the kubeconfig
+        user.token idiom — restclient.Config.BearerToken)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.bearer_token = bearer_token
         self.binary = binary
         self.object_protocol = binary
         self._ssl_ctx = None
@@ -125,6 +128,8 @@ class HTTPTransport:
         req.add_header("Content-Type", content_type)
         if self.binary:
             req.add_header("Accept", content_type)
+        if self.bearer_token:
+            req.add_header("Authorization", f"Bearer {self.bearer_token}")
         try:
             with urlrequest.urlopen(
                 req, timeout=self.timeout, context=self._ssl_ctx
@@ -158,6 +163,8 @@ class HTTPTransport:
         req = urlrequest.Request(self._url(path, query))
         if self.binary:
             req.add_header("Accept", bin_codec.CONTENT_TYPE)
+        if self.bearer_token:
+            req.add_header("Authorization", f"Bearer {self.bearer_token}")
         try:
             resp = urlrequest.urlopen(req, timeout=None, context=self._ssl_ctx)
         except urlrequest.HTTPError as e:  # type: ignore[attr-defined]

@@ -58,6 +58,15 @@ class SharedInformerFactory:
     def nodes(self) -> Informer:
         return self.informer("nodes")
 
+    def namespaces(self) -> Informer:
+        return self.informer("namespaces")
+
+    def service_accounts(self) -> Informer:
+        return self.informer("serviceaccounts")
+
+    def secrets(self) -> Informer:
+        return self.informer("secrets")
+
     def start(self) -> "SharedInformerFactory":
         with self._lock:
             self._started = True

@@ -34,6 +34,10 @@ from kubernetes_tpu.controller.gc import NamespaceController, PodGCController
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
 from kubernetes_tpu.controller.petset import PetSetController
+from kubernetes_tpu.controller.serviceaccount import (
+    ServiceAccountsController,
+    TokensController,
+)
 from kubernetes_tpu.controller.pv_binder import PersistentVolumeClaimBinder
 from kubernetes_tpu.controller.replication import (
     ReplicationManager,
@@ -68,7 +72,13 @@ class ControllerManagerOptions:
         "petset",
         "resourcequota",
         "pv-binder",
+        "serviceaccount",
+        "serviceaccount-token",
     )  # hpa omitted by default: it needs a metrics client
+    # the --service-account-private-key-file analogue: the tokens
+    # controller only runs with a signing key
+    # (controllermanager.go ServiceAccountTokenController gating)
+    service_account_private_key: object = None
 
 
 class ControllerManager:
@@ -119,6 +129,11 @@ class ControllerManager:
             client, self.informers))
         add("pv-binder", lambda: PersistentVolumeClaimBinder(
             client, self.informers))
+        add("serviceaccount", lambda: ServiceAccountsController(
+            client, self.informers))
+        if o.service_account_private_key is not None:
+            add("serviceaccount-token", lambda: TokensController(
+                client, self.informers, o.service_account_private_key))
         if cloud is not None:
             # cloud-facing loops only run with a provider configured
             # (controllermanager.go:239-258 gates on cloudprovider too)

@@ -986,6 +986,8 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     parser.add_argument("--certificate-authority", default="",
                         help="CA file pinning a TLS apiserver")
     parser.add_argument("--insecure-skip-tls-verify", action="store_true")
+    parser.add_argument("--token", default="",
+                        help="bearer token (e.g. a service-account JWT)")
     parser.add_argument("--namespace", "-n", default="default")
     sub = parser.add_subparsers(dest="verb", required=True)
 
@@ -1105,6 +1107,7 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
             args.server,
             tls_ca=args.certificate_authority,
             insecure=args.insecure_skip_tls_verify,
+            bearer_token=args.token,
         ))
     k = Kubectl(client, args.namespace)
 
