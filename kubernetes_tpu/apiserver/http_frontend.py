@@ -110,7 +110,9 @@ def start_http_server(api: APIServer, host: str, port: int,
                     return
                 authorizer = getattr(api, "authorizer", None)
                 if authorizer is not None:
-                    ns, info, _name, _sub = api._route(parsed.path)
+                    ns, info, _name, _sub, _grp, _ver = api._route(
+                        parsed.path
+                    )
                     attrs = Attributes(
                         user=user,
                         verb=method,

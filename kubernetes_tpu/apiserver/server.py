@@ -35,6 +35,11 @@ from kubernetes_tpu.apiserver.registry import (
     validate_meta,
 )
 from kubernetes_tpu.runtime import scheme as default_scheme
+from kubernetes_tpu.runtime.versioning import (
+    ConversionError,
+    codec_for,
+    group_versions,
+)
 from kubernetes_tpu.storage import (
     Compacted,
     Conflict,
@@ -308,18 +313,40 @@ class APIServer:
 
             return 200, configz.snapshot()
         if path in ("/api", "/api/v1", "/apis"):
-            return 200, {"resources": sorted(self.resources)}
+            return 200, {"resources": sorted(self.resources),
+                         "groups": group_versions()}
 
         # POST /api/v1/namespaces/{ns}/bindings — the collection form the
         # reference's binder uses (factory.go:537-543)
         if method == "POST" and path.rstrip("/").endswith("/bindings"):
             parts = [p for p in path.split("/") if p]
+            # the collection shortcut still validates the wire version
+            if parts[:1] == ["api"]:
+                g, v = "", parts[1] if len(parts) > 1 else ""
+            elif parts[:1] == ["apis"]:
+                g = parts[1] if len(parts) > 1 else ""
+                v = parts[2] if len(parts) > 2 else ""
+            else:
+                g = v = ""
+            if codec_for(self.scheme, g, v) is None:
+                raise APIError(
+                    404,
+                    f"the server does not serve version {v!r} of "
+                    f"group {g or 'core'!r}",
+                )
             ns = parts[parts.index("namespaces") + 1] if "namespaces" in parts else ""
             return self._bind(ns, "", body)
 
-        ns, info, name, subresource = self._route(path)
+        ns, info, name, subresource, group, version = self._route(path)
         if info is None:
             raise APIError(404, f"unknown path {path!r}")
+        codec = codec_for(self.scheme, group, version)
+        if codec is None:
+            raise APIError(
+                404,
+                f"the server does not serve version {version!r} of "
+                f"group {group or 'core'!r}",
+            )
 
         if method != "GET" and info.resource == "namespaces" and name:
             # any namespace write may change existence/phase: drop the
@@ -329,55 +356,65 @@ class APIServer:
             try:
                 return self._dispatch(
                     method, path, query, body, ns, info, name,
-                    subresource, obj_mode,
+                    subresource, obj_mode, codec,
                 )
             finally:
                 self._ns_active.discard(name)
         return self._dispatch(
             method, path, query, body, ns, info, name, subresource,
-            obj_mode,
+            obj_mode, codec,
         )
 
     def _dispatch(self, method, path, query, body, ns, info, name,
-                  subresource, obj_mode):
+                  subresource, obj_mode, codec=None):
+        codec = codec or self.scheme
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
-                return 200, self._watch(info, ns, query, name, obj_mode)
+                return 200, self._watch(info, ns, query, name, obj_mode,
+                                        codec)
             if name:
-                return 200, self._get(info, ns, name, obj_mode)
-            return 200, self._list(info, ns, query, obj_mode)
+                return 200, self._get(info, ns, name, obj_mode, codec)
+            return 200, self._list(info, ns, query, obj_mode, codec)
         if method == "POST":
             if subresource == "binding" or (not name and info.resource == "bindings"):
                 return self._bind(ns, name, body)
             if name:
                 raise APIError(400, "POST to a named resource")
-            return self._create(info, ns, body, obj_mode)
+            return self._create(info, ns, body, obj_mode, codec)
         if method == "PUT":
             if not name:
                 raise APIError(400, "PUT requires a resource name")
-            return self._update(info, ns, name, body, subresource, obj_mode)
+            return self._update(info, ns, name, body, subresource, obj_mode,
+                                codec)
         if method == "PATCH":
             if not name:
                 raise APIError(400, "PATCH requires a resource name")
-            return self._patch(info, ns, name, body, subresource, obj_mode)
+            return self._patch(info, ns, name, body, subresource, obj_mode,
+                               codec)
         if method == "DELETE":
             if not name:
                 raise APIError(400, "DELETE requires a resource name")
-            return self._delete(info, ns, name, obj_mode)
+            return self._delete(info, ns, name, obj_mode, codec)
         raise APIError(400, f"unsupported method {method}")
 
     def _route(
         self, path: str
-    ) -> Tuple[str, Optional[ResourceInfo], str, str]:
-        """-> (namespace, resource info, name, subresource)."""
+    ):
+        """-> (namespace, resource info, name, subresource,
+        group, version)."""
         parts = [p for p in path.split("/") if p]
-        # strip the API group prefix: api/v1 | apis/<group>/<version>
+        # the API group prefix names the wire version:
+        # api/<version> (core) | apis/<group>/<version>
+        group = version = ""
         if parts[:1] == ["api"]:
+            version = parts[1] if len(parts) > 1 else ""
             parts = parts[2:]
         elif parts[:1] == ["apis"]:
+            group = parts[1] if len(parts) > 1 else ""
+            version = parts[2] if len(parts) > 2 else ""
             parts = parts[3:]
         else:
-            return "", None, "", ""
+            return "", None, "", "", group, version
         # optional 1.2-style watch prefix: /api/v1/watch/...
         watch_prefix = False
         if parts[:1] == ["watch"]:
@@ -395,26 +432,27 @@ class APIServer:
         # else /namespaces[/{name}[/status]] — the namespaces resource
         # itself (parts[2], if present, is its subresource)
         if not parts:
-            return ns, None, "", ""
+            return ns, None, "", "", group, version
         resource, rest = parts[0], parts[1:]
         info = self.resources.get(resource)
         if info is None:
-            return ns, None, "", ""
+            return ns, None, "", "", group, version
         name = rest[0] if rest else ""
         sub = rest[1] if len(rest) > 1 else ""
         if watch_prefix:
             sub = "watch"
-        return ns, info, name, sub
+        return ns, info, name, sub, group, version
 
     # -- verbs ---------------------------------------------------------------
 
     def _get(self, info: ResourceInfo, ns: str, name: str,
-             obj_mode: bool = False):
+             obj_mode: bool = False, codec=None):
         obj, _ = self.store.get(info.key(ns, name))
-        return obj if obj_mode else self.scheme.encode(obj)
+        return obj if obj_mode else (codec or self.scheme).encode(obj)
 
     def _list(self, info: ResourceInfo, ns: str, query,
-              obj_mode: bool = False):
+              obj_mode: bool = False, codec=None):
+        codec = codec or self.scheme
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
         objs, rv = self.store.list(info.list_prefix(ns))
@@ -426,19 +464,20 @@ class APIServer:
                 if matches_fields(o, clauses):
                     items.append(o)
                 continue
-            wire = self.scheme.encode(o)
+            wire = codec.encode(o)
             if matches_fields_wire(wire, clauses):
                 items.append(wire)
+        gv = getattr(codec, "gv", None)
         return {
             "kind": f"{info.kind}List",
-            "apiVersion": "v1",
+            "apiVersion": gv.name if gv is not None else "v1",
             "metadata": {"resourceVersion": str(rv)},
             "items": items,
         }
 
     def _watch(
         self, info: ResourceInfo, ns: str, query, name: str = "",
-        obj_mode: bool = False,
+        obj_mode: bool = False, codec=None,
     ) -> WatchResponse:
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
@@ -447,9 +486,11 @@ class APIServer:
             clauses.append(("metadata.name", "=", name))
         from_rv = int(query.get("resourceVersion", "0") or "0")
         stream = self.store.watch(info.list_prefix(ns), from_rv=from_rv)
-        return WatchResponse(stream, sel, clauses, self.scheme, obj_mode)
+        return WatchResponse(
+            stream, sel, clauses, codec or self.scheme, obj_mode
+        )
 
-    def _decode_body(self, info: ResourceInfo, body) -> Any:
+    def _decode_body(self, info: ResourceInfo, body, codec=None) -> Any:
         if body is None:
             raise APIError(400, "request body required")
         if not isinstance(body, dict):
@@ -465,11 +506,14 @@ class APIServer:
                 )
             return deep_copy(body)
         try:
-            return self.scheme.decode(body, info.cls)
+            return (codec or self.scheme).decode(body, info.cls)
+        except ConversionError:
+            raise
         except Exception as e:
             raise APIError(400, f"decode error: {e}")
 
-    def _create(self, info: ResourceInfo, ns: str, body, obj_mode=False):
+    def _create(self, info: ResourceInfo, ns: str, body, obj_mode=False,
+                codec=None):
         if isinstance(body, dict) and "items" in body and str(
             body.get("kind", "")
         ).endswith("List"):
@@ -480,7 +524,7 @@ class APIServer:
             results = []
             for item in body["items"]:
                 try:
-                    obj = self._create_obj(info, ns, item)
+                    obj = self._create_obj(info, ns, item, codec)
                     results.append({
                         "status": "Success",
                         "name": obj.metadata.name,
@@ -500,14 +544,16 @@ class APIServer:
                     results.append({"status": "Failure", "message": str(e)})
             return 201, {"kind": "Status", "status": "Success",
                          "items": results}
-        obj = self._create_obj(info, ns, body)
+        obj = self._create_obj(info, ns, body, codec)
         stored = self.store.get(
             info.key(obj.metadata.namespace, obj.metadata.name)
         )[0]
-        return 201, stored if obj_mode else self.scheme.encode(stored)
+        return 201, stored if obj_mode else (
+            codec or self.scheme
+        ).encode(stored)
 
-    def _create_obj(self, info: ResourceInfo, ns: str, body):
-        obj = self._decode_body(info, body)
+    def _create_obj(self, info: ResourceInfo, ns: str, body, codec=None):
+        obj = self._decode_body(info, body, codec)
         if info.namespaced:
             # only an EXPLICIT body namespace can conflict with the URL;
             # decode fills the dataclass default ("default") when absent
@@ -545,8 +591,8 @@ class APIServer:
         return obj  # rv already stamped in place by the store
 
     def _update(self, info: ResourceInfo, ns: str, name: str, body,
-                subresource, obj_mode=False):
-        new = self._decode_body(info, body)
+                subresource, obj_mode=False, codec=None):
+        new = self._decode_body(info, body, codec)
         key = info.key(ns, name)
         cur, cur_rv = self.store.get(key)
         if new.metadata.resource_version:
@@ -593,12 +639,15 @@ class APIServer:
                           new.metadata.resource_version else None,
                           owned=True)
         stored = self.store.get(key)[0]
-        return 200, stored if obj_mode else self.scheme.encode(stored)
+        return 200, stored if obj_mode else (
+            codec or self.scheme
+        ).encode(stored)
 
     def _patch(self, info: ResourceInfo, ns: str, name: str, body,
-               subresource, obj_mode=False):
+               subresource, obj_mode=False, codec=None):
         """Strategic-merge-lite: JSON merge patch over the wire form
         (resthandler.go:445 PatchResource)."""
+        codec = codec or self.scheme
         if body is None:
             raise APIError(400, "patch body required")
         # the status/main separation holds for PATCH too
@@ -608,7 +657,7 @@ class APIServer:
             body = {k: v for k, v in body.items() if k != "status"}
         key = info.key(ns, name)
         cur, cur_rv = self.store.get(key)
-        wire = self.scheme.encode(cur)
+        wire = codec.encode(cur)
 
         def merge(dst, patch):
             for k, v in patch.items():
@@ -620,17 +669,18 @@ class APIServer:
                     dst[k] = v
 
         merge(wire, body)
-        new = self.scheme.decode(wire, info.cls)
+        new = codec.decode(wire, info.cls)
         new.metadata.namespace = cur.metadata.namespace
         new.metadata.name = cur.metadata.name
         new.metadata.uid = cur.metadata.uid
         self.admission.admit(adm.UPDATE, info.resource, ns, new)
         self.store.update(key, new, expect_rv=cur_rv, owned=True)
         stored = self.store.get(key)[0]
-        return 200, stored if obj_mode else self.scheme.encode(stored)
+        return 200, stored if obj_mode else codec.encode(stored)
 
     def _delete(self, info: ResourceInfo, ns: str, name: str,
-                obj_mode=False):
+                obj_mode=False, codec=None):
+        codec = codec or self.scheme
         self.admission.admit(adm.DELETE, info.resource, ns, None)
         key = info.key(ns, name)
         if info.resource == "namespaces":
@@ -648,9 +698,9 @@ class APIServer:
 
                 self.store.guaranteed_update(key, stamp)
                 stored = self.store.get(key)[0]
-                return 200, stored if obj_mode else self.scheme.encode(stored)
+                return 200, stored if obj_mode else codec.encode(stored)
         obj = self.store.delete(key)
-        return 200, obj if obj_mode else self.scheme.encode(obj)
+        return 200, obj if obj_mode else codec.encode(obj)
 
     def _bind(self, ns: str, pod_name: str, body):
         """POST pods/{name}/binding: assign spec.nodeName under CAS
