@@ -328,25 +328,14 @@ class APIServer:
                 v = parts[2] if len(parts) > 2 else ""
             else:
                 g = v = ""
-            if codec_for(self.scheme, g, v) is None:
-                raise APIError(
-                    404,
-                    f"the server does not serve version {v!r} of "
-                    f"group {g or 'core'!r}",
-                )
+            self._resolve_codec(g, v)
             ns = parts[parts.index("namespaces") + 1] if "namespaces" in parts else ""
             return self._bind(ns, "", body)
 
         ns, info, name, subresource, group, version = self._route(path)
         if info is None:
             raise APIError(404, f"unknown path {path!r}")
-        codec = codec_for(self.scheme, group, version)
-        if codec is None:
-            raise APIError(
-                404,
-                f"the server does not serve version {version!r} of "
-                f"group {group or 'core'!r}",
-            )
+        codec = self._resolve_codec(group, version)
 
         if method != "GET" and info.resource == "namespaces" and name:
             # any namespace write may change existence/phase: drop the
@@ -365,9 +354,20 @@ class APIServer:
             obj_mode, codec,
         )
 
+    def _resolve_codec(self, group: str, version: str):
+        """The wire codec for /apis/{group}/{version} (or /api/{version});
+        404 for anything the server does not serve."""
+        codec = codec_for(self.scheme, group, version)
+        if codec is None:
+            raise APIError(
+                404,
+                f"the server does not serve version {version!r} of "
+                f"group {group or 'core'!r}",
+            )
+        return codec
+
     def _dispatch(self, method, path, query, body, ns, info, name,
-                  subresource, obj_mode, codec=None):
-        codec = codec or self.scheme
+                  subresource, obj_mode, codec):
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
                 return 200, self._watch(info, ns, query, name, obj_mode,
@@ -446,13 +446,12 @@ class APIServer:
     # -- verbs ---------------------------------------------------------------
 
     def _get(self, info: ResourceInfo, ns: str, name: str,
-             obj_mode: bool = False, codec=None):
+             obj_mode: bool, codec):
         obj, _ = self.store.get(info.key(ns, name))
-        return obj if obj_mode else (codec or self.scheme).encode(obj)
+        return obj if obj_mode else codec.encode(obj)
 
     def _list(self, info: ResourceInfo, ns: str, query,
-              obj_mode: bool = False, codec=None):
-        codec = codec or self.scheme
+              obj_mode: bool, codec):
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
         objs, rv = self.store.list(info.list_prefix(ns))
@@ -479,6 +478,7 @@ class APIServer:
         self, info: ResourceInfo, ns: str, query, name: str = "",
         obj_mode: bool = False, codec=None,
     ) -> WatchResponse:
+        codec = codec or self.scheme  # named-watch helpers call directly
         sel = labelpkg.parse(query.get("labelSelector", ""))
         clauses = parse_field_selector(query.get("fieldSelector", ""))
         if name:
@@ -486,11 +486,9 @@ class APIServer:
             clauses.append(("metadata.name", "=", name))
         from_rv = int(query.get("resourceVersion", "0") or "0")
         stream = self.store.watch(info.list_prefix(ns), from_rv=from_rv)
-        return WatchResponse(
-            stream, sel, clauses, codec or self.scheme, obj_mode
-        )
+        return WatchResponse(stream, sel, clauses, codec, obj_mode)
 
-    def _decode_body(self, info: ResourceInfo, body, codec=None) -> Any:
+    def _decode_body(self, info: ResourceInfo, body, codec) -> Any:
         if body is None:
             raise APIError(400, "request body required")
         if not isinstance(body, dict):
@@ -506,14 +504,14 @@ class APIServer:
                 )
             return deep_copy(body)
         try:
-            return (codec or self.scheme).decode(body, info.cls)
+            return codec.decode(body, info.cls)
         except ConversionError:
             raise
         except Exception as e:
             raise APIError(400, f"decode error: {e}")
 
-    def _create(self, info: ResourceInfo, ns: str, body, obj_mode=False,
-                codec=None):
+    def _create(self, info: ResourceInfo, ns: str, body, obj_mode,
+                codec):
         if isinstance(body, dict) and "items" in body and str(
             body.get("kind", "")
         ).endswith("List"):
@@ -548,11 +546,9 @@ class APIServer:
         stored = self.store.get(
             info.key(obj.metadata.namespace, obj.metadata.name)
         )[0]
-        return 201, stored if obj_mode else (
-            codec or self.scheme
-        ).encode(stored)
+        return 201, stored if obj_mode else codec.encode(stored)
 
-    def _create_obj(self, info: ResourceInfo, ns: str, body, codec=None):
+    def _create_obj(self, info: ResourceInfo, ns: str, body, codec):
         obj = self._decode_body(info, body, codec)
         if info.namespaced:
             # only an EXPLICIT body namespace can conflict with the URL;
@@ -591,7 +587,7 @@ class APIServer:
         return obj  # rv already stamped in place by the store
 
     def _update(self, info: ResourceInfo, ns: str, name: str, body,
-                subresource, obj_mode=False, codec=None):
+                subresource, obj_mode, codec):
         new = self._decode_body(info, body, codec)
         key = info.key(ns, name)
         cur, cur_rv = self.store.get(key)
@@ -639,15 +635,12 @@ class APIServer:
                           new.metadata.resource_version else None,
                           owned=True)
         stored = self.store.get(key)[0]
-        return 200, stored if obj_mode else (
-            codec or self.scheme
-        ).encode(stored)
+        return 200, stored if obj_mode else codec.encode(stored)
 
     def _patch(self, info: ResourceInfo, ns: str, name: str, body,
-               subresource, obj_mode=False, codec=None):
+               subresource, obj_mode, codec):
         """Strategic-merge-lite: JSON merge patch over the wire form
         (resthandler.go:445 PatchResource)."""
-        codec = codec or self.scheme
         if body is None:
             raise APIError(400, "patch body required")
         # the status/main separation holds for PATCH too
@@ -679,8 +672,7 @@ class APIServer:
         return 200, stored if obj_mode else codec.encode(stored)
 
     def _delete(self, info: ResourceInfo, ns: str, name: str,
-                obj_mode=False, codec=None):
-        codec = codec or self.scheme
+                obj_mode, codec):
         self.admission.admit(adm.DELETE, info.resource, ns, None)
         key = info.key(ns, name)
         if info.resource == "namespaces":

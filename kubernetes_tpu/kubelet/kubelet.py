@@ -57,6 +57,9 @@ class KubeletConfig:
     # status so kubectl logs/exec can resolve it
     serve_api: bool = False
     api_host: str = "127.0.0.1"
+    # image manager (pkg/kubelet/image_manager.go): disk capacity the
+    # LRU garbage collector budgets against
+    image_capacity_bytes: int = 20 * 1024 ** 3
 
 
 class _PodWorker:
@@ -115,6 +118,17 @@ class Kubelet:
             on_result_change=self._on_probe_result_change,
         )
         self._restarts: Dict[tuple, int] = {}
+        from kubernetes_tpu.kubelet.images import ImageManager
+        from kubernetes_tpu.kubelet.volumes import VolumeManager
+
+        # image presence + LRU GC feeding node status (and therefore
+        # the scheduler's ImageLocality priority); the runtime may
+        # report real sizes via an image_size(name) hook
+        self.image_manager = ImageManager(
+            capacity_bytes=config.image_capacity_bytes,
+            size_of=getattr(self.runtime, "image_size", None),
+        )
+        self.volume_manager = VolumeManager(node_name=config.node_name)
         self.eviction_manager: Optional[EvictionManager] = None
         if config.eviction_memory_threshold > 0:
             self.eviction_manager = EvictionManager(
@@ -244,6 +258,9 @@ class Kubelet:
             else "KubeletHasSufficientMemory"
         )
         mem.last_heartbeat_time = now
+        # setNodeStatusImages: the present-image set rides every
+        # heartbeat, so ImageLocality scores track real node state
+        node.status.images = self.image_manager.image_list()
         self._apply_api_endpoint(node.status)
         try:
             self.client.nodes().update_status(node)
@@ -338,14 +355,23 @@ class Kubelet:
         compute API status, queue the status update."""
         if pod.metadata.deletion_timestamp is not None:
             self.runtime.kill_pod(pod.metadata.uid)
+            self.volume_manager.unmount_pod_volumes(pod.metadata.uid)
             return
         if pod.status.phase in ("Failed", "Succeeded"):
             # terminal pods (incl. Evicted) never run again: release the
             # runtime resources and keep the terminal API status
             # (kubelet.go: terminal phase short-circuits syncPod)
             self.runtime.kill_pod(pod.metadata.uid)
+            self.volume_manager.unmount_pod_volumes(pod.metadata.uid)
             return
         try:
+            # volumes mount and images pull BEFORE containers start
+            # (kubelet.go syncPod: WaitForAttachAndMount, EnsureImageExists)
+            self.volume_manager.mount_pod_volumes(pod)
+            for c in (pod.spec.containers or []) + (
+                pod.spec.init_containers or []
+            ):
+                self.image_manager.ensure(c.image)
             self.runtime.sync_pod(pod)
         except Exception:
             status = t.PodStatus(
@@ -442,12 +468,22 @@ class Kubelet:
                 self._housekeeping()
 
     def _housekeeping(self) -> None:
-        """HandlePodCleanups: kill runtime pods with no config."""
+        """HandlePodCleanups: kill runtime pods with no config, tear
+        down orphaned volume mounts, GC unused images."""
         with self._lock:
             known = set(self._pods)
+            in_use = {
+                c.image
+                for p in self._pods.values()
+                for c in (p.spec.containers or [])
+                + (p.spec.init_containers or [])
+                if c.image
+            }
         for rp in self.runtime.list_pods():
             if rp.uid not in known:
                 self.runtime.kill_pod(rp.uid)
+        self.volume_manager.reconcile(known)
+        self.image_manager.garbage_collect(in_use=in_use)
 
     def _status_loop(self) -> None:
         while not self._stop.wait(self.config.status_sync_period):

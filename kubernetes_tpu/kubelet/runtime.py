@@ -82,6 +82,9 @@ class FakeRuntime(ContainerRuntime):
         self.exec_replies: Dict[Tuple[str, str], str] = {}
         # attach followers: write_log wakes them (kubelet /attach seam)
         self._log_cv = threading.Condition(self._lock)
+        # injectable image sizes for the image manager (docker images
+        # inspect seam); absent names get the manager's default sizing
+        self.image_sizes: Dict[str, int] = {}
         # (pod_uid, port) -> (host, real_port): where port_socket dials
         # (the hollow-node stand-in for a container's listening socket)
         self._ports: Dict[Tuple[str, int], Tuple[str, int]] = {}
@@ -186,6 +189,10 @@ class FakeRuntime(ContainerRuntime):
                 line if line.endswith("\n") else line + "\n"
             )
             self._log_cv.notify_all()
+
+    def image_size(self, image: str):
+        """Injected size, or None to let the image manager default."""
+        return self.image_sizes.get(image)
 
     def expose_port(self, uid: str, port: int, host: str,
                     real_port: int) -> None:
