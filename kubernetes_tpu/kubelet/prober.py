@@ -81,14 +81,16 @@ class _Worker:
         self._stop.set()
 
     def _loop(self) -> None:
+        # the initial result registers BEFORE the initial-delay wait:
+        # a probed container must not report Ready during
+        # initialDelaySeconds just because no result exists yet
+        # (worker.go:88,170 sets readiness to Failure immediately)
+        healthy = self.kind == "liveness"
+        self.manager._set_result(self.pod, self.container, self.kind, healthy)
         if self.probe.initial_delay_seconds:
             if self._stop.wait(self.probe.initial_delay_seconds):
                 return
         failures = successes = 0
-        # readiness starts False until the first success
-        # (worker.go:onHold initial result), liveness starts healthy
-        healthy = self.kind == "liveness"
-        self.manager._set_result(self.pod, self.container, self.kind, healthy)
         period = max(self.probe.period_seconds, self.manager.min_period)
         while not self._stop.wait(period):
             try:

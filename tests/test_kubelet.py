@@ -287,6 +287,37 @@ def test_liveness_failure_with_restart_never_fails_pod():
         kl.stop()
 
 
+def test_readiness_starts_false_during_initial_delay():
+    """A probed container must report unready from the moment the
+    worker exists — not default-Ready during initialDelaySeconds
+    (worker.go:88,170; ADVICE r2 medium)."""
+    import time as _time
+
+    from kubernetes_tpu.api.types import Probe
+    from kubernetes_tpu.kubelet.prober import ProbeManager
+
+    mgr = ProbeManager(runner=lambda pod, container, probe: True)
+    pod = Pod(
+        metadata=ObjectMeta(name="slow", uid="u-slow"),
+        spec=PodSpec(containers=[Container(
+            name="main",
+            readiness_probe=Probe(initial_delay_seconds=1,
+                                  period_seconds=1),
+        )]),
+    )
+    mgr.add_pod(pod)
+    try:
+        assert wait_until(
+            lambda: mgr.is_ready("u-slow", "main") is False, timeout=2
+        )
+        # still within the initial delay: must remain unready
+        assert mgr.is_ready("u-slow", "main") is False
+        # after the delay, the succeeding probe flips it ready
+        assert wait_until(lambda: mgr.is_ready("u-slow", "main"), timeout=10)
+    finally:
+        mgr.remove_pod("u-slow")
+
+
 def test_readiness_probe_gates_pod_ready_condition():
     """A failing readiness probe keeps phase Running but flips the pod
     Ready condition False (endpoints drop it; status stays Running)."""
