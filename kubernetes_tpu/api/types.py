@@ -123,6 +123,88 @@ class HostPathVolumeSource:
 
 
 @dataclass
+class NFSVolumeSource:
+    server: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class ISCSIVolumeSource:
+    target_portal: str = ""
+    iqn: str = ""
+    lun: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class GlusterfsVolumeSource:
+    endpoints_name: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class CephFSVolumeSource:
+    monitors: Tuple[str, ...] = ()
+    path: str = "/"
+    read_only: bool = False
+
+
+@dataclass
+class CinderVolumeSource:
+    volume_id: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class FCVolumeSource:
+    target_wwns: Tuple[str, ...] = ()
+    lun: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class AzureFileVolumeSource:
+    secret_name: str = ""
+    share_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class FlockerVolumeSource:
+    dataset_name: str = ""
+
+
+@dataclass
+class VsphereVirtualDiskVolumeSource:
+    volume_path: str = ""
+    fs_type: str = ""
+
+
+@dataclass
+class SecretVolumeSource:
+    secret_name: str = ""
+
+
+@dataclass
+class ConfigMapVolumeSource:
+    name: str = ""
+
+
+@dataclass
+class DownwardAPIVolumeSource:
+    # [(file path, fieldRef field path)] — metadata projected as files
+    items: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass
+class GitRepoVolumeSource:
+    repository: str = ""
+    revision: str = ""
+
+
+@dataclass
 class Volume:
     name: str = ""
     gce_persistent_disk: Optional[GCEPersistentDisk] = None
@@ -130,6 +212,19 @@ class Volume:
     rbd: Optional[RBDVolume] = None
     persistent_volume_claim: Optional[PersistentVolumeClaimSource] = None
     host_path: Optional["HostPathVolumeSource"] = None
+    nfs: Optional[NFSVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    cephfs: Optional[CephFSVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    fc: Optional[FCVolumeSource] = None
+    azure_file: Optional[AzureFileVolumeSource] = None
+    flocker: Optional[FlockerVolumeSource] = None
+    vsphere_volume: Optional[VsphereVirtualDiskVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+    config_map: Optional[ConfigMapVolumeSource] = None
+    downward_api: Optional[DownwardAPIVolumeSource] = None
+    git_repo: Optional[GitRepoVolumeSource] = None
 
 
 @dataclass
@@ -137,6 +232,17 @@ class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     gce_persistent_disk: Optional[GCEPersistentDisk] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStore] = None
+    nfs: Optional[NFSVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    cephfs: Optional[CephFSVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    fc: Optional[FCVolumeSource] = None
+    azure_file: Optional[AzureFileVolumeSource] = None
+    flocker: Optional[FlockerVolumeSource] = None
+    vsphere_volume: Optional[VsphereVirtualDiskVolumeSource] = None
+    rbd: Optional[RBDVolume] = None
+    host_path: Optional[HostPathVolumeSource] = None
     # spec.capacity ("storage" quantity) + spec.accessModes + claimRef
     # ("namespace/name" of the bound claim), flattened
     capacity: Dict[str, object] = field(default_factory=dict)
@@ -344,6 +450,17 @@ class NodeStatus:
     # status.daemonEndpoints.kubeletEndpoint.Port flattened: where this
     # node's kubelet API (logs/exec/stats) listens; 0 = not serving
     kubelet_port: int = 0
+    # attach/detach controller state (NodeStatus.VolumesAttached /
+    # VolumesInUse): devices the controller attached to this node and
+    # devices the kubelet reports mounted
+    volumes_attached: List["AttachedVolume"] = field(default_factory=list)
+    volumes_in_use: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AttachedVolume:
+    name: str = ""  # the plugin device id (e.g. "gce-pd/disk-1")
+    device_path: str = ""
 
 
 @dataclass

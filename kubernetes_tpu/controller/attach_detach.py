@@ -1,0 +1,130 @@
+"""Attach/detach controller
+(cmd/kube-controller-manager/app/controllermanager.go:394,
+pkg/controller/volume/attach_detach_controller.go).
+
+Reconciles which attachable volumes are attached to which nodes:
+
+- desired state: every scheduled, non-terminal pod's attachable volume
+  specs (inline sources, or PVC -> bound PV resolution), keyed by the
+  plugin's stable device id;
+- actual state: node.status.volumesAttached;
+- attach what is desired and absent, detach what is attached and no
+  longer desired — each step committed through the node status so the
+  kubelet (WaitForAttachAndMount) and any observer see the same truth.
+
+The reference performs the actual attach through the cloud provider;
+here the plugin's attach/detach hooks are the (fake-able) actuation
+seam and the API status is the system of record.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.controller.framework import PeriodicRunner
+from kubernetes_tpu.volume.plugins import (
+    VolumePluginMgr,
+    VolumeSpec,
+    default_plugin_mgr,
+)
+
+
+class AttachDetachController(PeriodicRunner):
+    SYNC_PERIOD = 1.0
+    THREAD_NAME = "attachdetach"
+
+    def __init__(self, client: RESTClient, informers,
+                 plugins: VolumePluginMgr = None):
+        self.client = client
+        self.plugins = plugins or default_plugin_mgr()
+        self.pod_informer = informers.pods()
+        self.node_informer = informers.nodes()
+        self.pv_informer = informers.informer("persistentvolumes")
+        self.pvc_informer = informers.informer("persistentvolumeclaims")
+
+    # -- state derivation ----------------------------------------------------
+
+    def _resolve_specs(self, pod: t.Pod, pvs, pvcs) -> List[VolumeSpec]:
+        out = []
+        for vol in pod.spec.volumes or []:
+            if vol.persistent_volume_claim is not None:
+                claim = pvcs.get(
+                    f"{pod.metadata.namespace}/"
+                    f"{vol.persistent_volume_claim.claim_name}"
+                )
+                pv = pvs.get(claim.volume_name) if claim is not None else None
+                if pv is not None:
+                    out.append(VolumeSpec(pv=pv))
+                continue
+            out.append(VolumeSpec(volume=vol))
+        return out
+
+    def desired_state(self) -> Dict[str, Set[str]]:
+        """node name -> device ids that must be attached."""
+        want: Dict[str, Set[str]] = {}
+        # one snapshot of the PV/PVC universe per pass, not per pod
+        pvs = {
+            pv.metadata.name: pv for pv in self.pv_informer.store.list()
+        }
+        pvcs = {
+            f"{c.metadata.namespace}/{c.metadata.name}": c
+            for c in self.pvc_informer.store.list()
+        }
+        for pod in self.pod_informer.store.list():
+            if not pod.spec.node_name:
+                continue
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            for spec in self._resolve_specs(pod, pvs, pvcs):
+                try:
+                    plugin = self.plugins.find_plugin_by_spec(spec)
+                except LookupError:
+                    continue
+                if not getattr(plugin, "attachable", False):
+                    continue
+                want.setdefault(pod.spec.node_name, set()).add(
+                    plugin.device_of(spec)
+                )
+        return want
+
+    # -- reconcile -----------------------------------------------------------
+
+    def sync_once(self) -> Tuple[int, int]:
+        want = self.desired_state()
+        attached = detached = 0
+        for node in self.node_informer.store.list():
+            name = node.metadata.name
+            have = {v.name for v in node.status.volumes_attached}
+            need = want.get(name, set())
+            if have == need:
+                continue
+            try:
+                fresh = self.client.nodes().get(name)
+            except APIStatusError:
+                continue
+            # the volumesInUse handshake (reconciler.go): never detach a
+            # device the kubelet still reports mounted — defer until its
+            # heartbeat drops it from volumesInUse
+            in_use = set(fresh.status.volumes_in_use)
+            keep = need | (have & in_use)
+            fresh.status.volumes_attached = [
+                v for v in fresh.status.volumes_attached if v.name in keep
+            ]
+            present = {v.name for v in fresh.status.volumes_attached}
+            detached += len(have - keep)
+            for device in sorted(need - present):
+                fresh.status.volumes_attached.append(
+                    t.AttachedVolume(
+                        name=device, device_path=f"/dev/disk/by-id/{device}"
+                    )
+                )
+                attached += 1
+            try:
+                self.client.nodes().update_status(fresh)
+            except APIStatusError:
+                continue
+        return attached, detached

@@ -34,6 +34,7 @@ from kubernetes_tpu.controller.gc import NamespaceController, PodGCController
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
 from kubernetes_tpu.controller.petset import PetSetController
+from kubernetes_tpu.controller.attach_detach import AttachDetachController
 from kubernetes_tpu.controller.serviceaccount import (
     ServiceAccountsController,
     TokensController,
@@ -74,6 +75,7 @@ class ControllerManagerOptions:
         "pv-binder",
         "serviceaccount",
         "serviceaccount-token",
+        "attachdetach",
     )  # hpa omitted by default: it needs a metrics client
     # the --service-account-private-key-file analogue: the tokens
     # controller only runs with a signing key
@@ -130,6 +132,8 @@ class ControllerManager:
         add("pv-binder", lambda: PersistentVolumeClaimBinder(
             client, self.informers))
         add("serviceaccount", lambda: ServiceAccountsController(
+            client, self.informers))
+        add("attachdetach", lambda: AttachDetachController(
             client, self.informers))
         if o.service_account_private_key is not None:
             add("serviceaccount-token", lambda: TokensController(

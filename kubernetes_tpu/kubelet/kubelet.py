@@ -261,6 +261,9 @@ class Kubelet:
         # setNodeStatusImages: the present-image set rides every
         # heartbeat, so ImageLocality scores track real node state
         node.status.images = self.image_manager.image_list()
+        # setNodeStatusVolumesInUse: the attach/detach controller defers
+        # detach while a device is still mounted here
+        node.status.volumes_in_use = self.volume_manager.in_use_devices()
         self._apply_api_endpoint(node.status)
         try:
             self.client.nodes().update_status(node)

@@ -90,6 +90,17 @@ class VolumeManager:
             n += self.unmount_pod_volumes(uid)
         return n
 
+    def in_use_devices(self) -> List[str]:
+        """Device ids of mounted ATTACHABLE volumes — what the kubelet
+        reports as node.status.volumesInUse so the attach/detach
+        controller defers detaching devices still mounted here."""
+        with self._lock:
+            return sorted({
+                plugin.device_of(spec)
+                for (plugin, spec, _path) in self._mounted.values()
+                if getattr(plugin, "attachable", False)
+            })
+
     def mounted_for(self, pod_uid: str) -> List[str]:
         with self._lock:
             return sorted(

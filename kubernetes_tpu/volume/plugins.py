@@ -73,29 +73,61 @@ class VolumePlugin:
         mounter.unmount(path)
 
 
+def _source(spec: VolumeSpec, field_name: str):
+    """The named volume source from an inline volume or a PV (the
+    plugins below route on exactly one source field each)."""
+    if spec.volume is not None:
+        return getattr(spec.volume, field_name, None)
+    if spec.pv is not None:
+        return getattr(spec.pv, field_name, None)
+    return None
+
+
+def _any_source(v) -> bool:
+    import dataclasses as _dc
+
+    return any(
+        getattr(v, f.name) is not None
+        for f in _dc.fields(v)
+        if f.name != "name"
+    )
+
+
+class _SourcePlugin(VolumePlugin):
+    """A plugin keyed on one volume-source field; device_fn renders the
+    stable device id the attach/detach controller and mount paths use."""
+
+    field_name = ""
+
+    def can_support(self, spec):
+        return _source(spec, self.field_name) is not None
+
+    def device_of(self, spec):
+        return self.render(_source(spec, self.field_name))
+
+    def render(self, src) -> str:  # pragma: no cover - overridden
+        return self.name
+
+
 class EmptyDirPlugin(VolumePlugin):
     name = "kubernetes.io/empty-dir"
 
     def can_support(self, spec):
-        # the fallback medium: an inline volume with no other source
+        # the fallback medium: an inline volume with NO source field
+        # set (any new Volume source automatically excludes emptyDir)
         v = spec.volume
-        return v is not None and not any(
-            (v.gce_persistent_disk, v.aws_elastic_block_store, v.rbd,
-             v.persistent_volume_claim, v.host_path)
-        )
+        return v is not None and not _any_source(v)
 
     def device_of(self, spec):
         return "tmpfs"
 
 
-class HostPathPlugin(VolumePlugin):
+class HostPathPlugin(_SourcePlugin):
     name = "kubernetes.io/host-path"
+    field_name = "host_path"
 
-    def can_support(self, spec):
-        return spec.volume is not None and spec.volume.host_path is not None
-
-    def device_of(self, spec):
-        return spec.volume.host_path.path
+    def render(self, s):
+        return s.path
 
 
 class GCEPDPlugin(VolumePlugin):
@@ -134,15 +166,122 @@ class AWSEBSPlugin(VolumePlugin):
         return f"aws-ebs/{src.volume_id}"
 
 
-class RBDPlugin(VolumePlugin):
+class RBDPlugin(_SourcePlugin):
     name = "kubernetes.io/rbd"
+    field_name = "rbd"
 
-    def can_support(self, spec):
-        return spec.volume is not None and spec.volume.rbd is not None
-
-    def device_of(self, spec):
-        r = spec.volume.rbd
+    def render(self, r):
         return f"rbd/{r.pool}/{r.image}"
+
+
+class NFSPlugin(_SourcePlugin):
+    name = "kubernetes.io/nfs"
+    field_name = "nfs"
+
+    def render(self, s):
+        return f"nfs/{s.server}{s.path}"
+
+
+class ISCSIPlugin(_SourcePlugin):
+    name = "kubernetes.io/iscsi"
+    field_name = "iscsi"
+
+    def render(self, s):
+        return f"iscsi/{s.target_portal}/{s.iqn}/lun-{s.lun}"
+
+
+class GlusterfsPlugin(_SourcePlugin):
+    name = "kubernetes.io/glusterfs"
+    field_name = "glusterfs"
+
+    def render(self, s):
+        return f"glusterfs/{s.endpoints_name}/{s.path}"
+
+
+class CephFSPlugin(_SourcePlugin):
+    name = "kubernetes.io/cephfs"
+    field_name = "cephfs"
+
+    def render(self, s):
+        return f"cephfs/{','.join(s.monitors)}{s.path}"
+
+
+class CinderPlugin(_SourcePlugin):
+    name = "kubernetes.io/cinder"
+    field_name = "cinder"
+    attachable = True
+
+    def render(self, s):
+        return f"cinder/{s.volume_id}"
+
+
+class FCPlugin(_SourcePlugin):
+    name = "kubernetes.io/fc"
+    field_name = "fc"
+    attachable = True
+
+    def render(self, s):
+        return f"fc/{','.join(s.target_wwns)}/lun-{s.lun}"
+
+
+class AzureFilePlugin(_SourcePlugin):
+    name = "kubernetes.io/azure-file"
+    field_name = "azure_file"
+
+    def render(self, s):
+        return f"azure-file/{s.share_name}"
+
+
+class FlockerPlugin(_SourcePlugin):
+    name = "kubernetes.io/flocker"
+    field_name = "flocker"
+
+    def render(self, s):
+        return f"flocker/{s.dataset_name}"
+
+
+class VspherePlugin(_SourcePlugin):
+    name = "kubernetes.io/vsphere-volume"
+    field_name = "vsphere_volume"
+    attachable = True
+
+    def render(self, s):
+        return f"vsphere/{s.volume_path}"
+
+
+class SecretPlugin(_SourcePlugin):
+    """pkg/volume/secret: API-object-backed (inline-only in practice —
+    PersistentVolume has no secret source, so the base routing holds)."""
+
+    name = "kubernetes.io/secret"
+    field_name = "secret"
+
+    def render(self, s):
+        return f"secret/{s.secret_name}"
+
+
+class ConfigMapPlugin(_SourcePlugin):
+    name = "kubernetes.io/configmap"
+    field_name = "config_map"
+
+    def render(self, s):
+        return f"configmap/{s.name}"
+
+
+class DownwardAPIPlugin(_SourcePlugin):
+    name = "kubernetes.io/downward-api"
+    field_name = "downward_api"
+
+    def render(self, s):
+        return "downward-api"
+
+
+class GitRepoPlugin(_SourcePlugin):
+    name = "kubernetes.io/git-repo"
+    field_name = "git_repo"
+
+    def render(self, s):
+        return f"git/{s.repository}@{s.revision or 'HEAD'}"
 
 
 class VolumePluginMgr:
@@ -179,5 +318,18 @@ def default_plugin_mgr() -> VolumePluginMgr:
             RBDPlugin(),
             HostPathPlugin(),
             EmptyDirPlugin(),
+            NFSPlugin(),
+            ISCSIPlugin(),
+            GlusterfsPlugin(),
+            CephFSPlugin(),
+            CinderPlugin(),
+            FCPlugin(),
+            AzureFilePlugin(),
+            FlockerPlugin(),
+            VspherePlugin(),
+            SecretPlugin(),
+            ConfigMapPlugin(),
+            DownwardAPIPlugin(),
+            GitRepoPlugin(),
         ]
     )

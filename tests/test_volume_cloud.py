@@ -134,3 +134,153 @@ def test_pv_binder_no_double_bind():
     bound = [pvc_client.get(n).volume_name for n in ("a", "b")]
     assert sorted(bound) == ["", "only"]
     informers.stop()
+
+
+class TestVolumePluginBreadth:
+    """Every reference volume family routes to exactly one plugin
+    (pkg/volume/plugins.go FindPluginBySpec)."""
+
+    def test_all_sources_route(self):
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.volume.plugins import (
+            VolumeSpec,
+            default_plugin_mgr,
+        )
+
+        mgr = default_plugin_mgr()
+        cases = [
+            (t.Volume(name="v", gce_persistent_disk=t.GCEPersistentDisk(
+                pd_name="d")), "kubernetes.io/gce-pd", "gce-pd/d"),
+            (t.Volume(name="v", aws_elastic_block_store=t.AWSElasticBlockStore(
+                volume_id="i")), "kubernetes.io/aws-ebs", "aws-ebs/i"),
+            (t.Volume(name="v", rbd=t.RBDVolume(pool="p", image="im")),
+             "kubernetes.io/rbd", "rbd/p/im"),
+            (t.Volume(name="v", host_path=t.HostPathVolumeSource(path="/x")),
+             "kubernetes.io/host-path", "/x"),
+            (t.Volume(name="v"), "kubernetes.io/empty-dir", "tmpfs"),
+            (t.Volume(name="v", nfs=t.NFSVolumeSource(server="s",
+                                                      path="/e")),
+             "kubernetes.io/nfs", "nfs/s/e"),
+            (t.Volume(name="v", iscsi=t.ISCSIVolumeSource(
+                target_portal="tp", iqn="iqn.x", lun=2)),
+             "kubernetes.io/iscsi", "iscsi/tp/iqn.x/lun-2"),
+            (t.Volume(name="v", glusterfs=t.GlusterfsVolumeSource(
+                endpoints_name="ep", path="vol")),
+             "kubernetes.io/glusterfs", "glusterfs/ep/vol"),
+            (t.Volume(name="v", cephfs=t.CephFSVolumeSource(
+                monitors=("m1", "m2"))), "kubernetes.io/cephfs",
+             "cephfs/m1,m2/"),
+            (t.Volume(name="v", cinder=t.CinderVolumeSource(
+                volume_id="c1")), "kubernetes.io/cinder", "cinder/c1"),
+            (t.Volume(name="v", fc=t.FCVolumeSource(
+                target_wwns=("w1",), lun=1)), "kubernetes.io/fc",
+             "fc/w1/lun-1"),
+            (t.Volume(name="v", azure_file=t.AzureFileVolumeSource(
+                share_name="sh")), "kubernetes.io/azure-file",
+             "azure-file/sh"),
+            (t.Volume(name="v", flocker=t.FlockerVolumeSource(
+                dataset_name="ds")), "kubernetes.io/flocker", "flocker/ds"),
+            (t.Volume(name="v", vsphere_volume=(
+                t.VsphereVirtualDiskVolumeSource(volume_path="[ds] x"))),
+             "kubernetes.io/vsphere-volume", "vsphere/[ds] x"),
+            (t.Volume(name="v", secret=t.SecretVolumeSource(
+                secret_name="tok")), "kubernetes.io/secret", "secret/tok"),
+            (t.Volume(name="v", config_map=t.ConfigMapVolumeSource(
+                name="cm")), "kubernetes.io/configmap", "configmap/cm"),
+            (t.Volume(name="v", downward_api=t.DownwardAPIVolumeSource()),
+             "kubernetes.io/downward-api", "downward-api"),
+            (t.Volume(name="v", git_repo=t.GitRepoVolumeSource(
+                repository="r")), "kubernetes.io/git-repo", "git/r@HEAD"),
+        ]
+        for vol, plugin_name, device in cases:
+            spec = VolumeSpec(volume=vol)
+            p = mgr.find_plugin_by_spec(spec)
+            assert p.name == plugin_name, (vol, p.name)
+            assert p.device_of(spec) == device
+
+    def test_pv_sources_route(self):
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.volume.plugins import (
+            VolumeSpec,
+            default_plugin_mgr,
+        )
+
+        mgr = default_plugin_mgr()
+        pv = t.PersistentVolume(
+            metadata=t.ObjectMeta(name="pv1"),
+            nfs=t.NFSVolumeSource(server="s", path="/e"),
+        )
+        p = mgr.find_plugin_by_spec(VolumeSpec(pv=pv))
+        assert p.name == "kubernetes.io/nfs"
+
+
+class TestAttachDetachController:
+    def test_attach_then_detach_follows_pods(self):
+        import time
+
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+        from kubernetes_tpu.controller.attach_detach import (
+            AttachDetachController,
+        )
+        from kubernetes_tpu.controller.framework import SharedInformerFactory
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        client.nodes().create(t.Node(metadata=t.ObjectMeta(name="n1")))
+        informers = SharedInformerFactory(client)
+        ctrl = AttachDetachController(client, informers)
+        informers.start()
+        informers.wait_for_sync()
+        # a scheduled pod with an attachable inline volume
+        client.pods().create(t.Pod(
+            metadata=t.ObjectMeta(name="p1"),
+            spec=t.PodSpec(node_name="n1", containers=[
+                t.Container(name="c")],
+                volumes=[t.Volume(name="disk",
+                                  gce_persistent_disk=t.GCEPersistentDisk(
+                                      pd_name="data-1"))]),
+        ))
+        # and one with a PVC -> bound PV (attachable)
+        client.resource("persistentvolumes", "").create(t.PersistentVolume(
+            metadata=t.ObjectMeta(name="pv9", namespace=""),
+            cinder=t.CinderVolumeSource(volume_id="vol-9"),
+        ))
+        client.resource("persistentvolumeclaims", "default").create(
+            t.PersistentVolumeClaim(
+                metadata=t.ObjectMeta(name="claim9"),
+                volume_name="pv9",
+            )
+        )
+        client.pods().create(t.Pod(
+            metadata=t.ObjectMeta(name="p2"),
+            spec=t.PodSpec(node_name="n1", containers=[
+                t.Container(name="c")],
+                volumes=[t.Volume(
+                    name="pvc",
+                    persistent_volume_claim=t.PersistentVolumeClaimSource(
+                        claim_name="claim9"))]),
+        ))
+
+        def attached():
+            return {v.name
+                    for v in client.nodes().get("n1").status.volumes_attached}
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            if attached() == {"gce-pd/data-1", "cinder/vol-9"}:
+                break
+            time.sleep(0.05)
+        assert attached() == {"gce-pd/data-1", "cinder/vol-9"}
+        # delete p1: its disk detaches, the PVC-backed one stays
+        client.pods().delete("p1")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            if attached() == {"cinder/vol-9"}:
+                break
+            time.sleep(0.05)
+        assert attached() == {"cinder/vol-9"}
