@@ -825,6 +825,17 @@ class Secret:
 
 
 @dataclass
+class ThirdPartyResource:
+    """extensions ThirdPartyResource (pkg/apis/extensions types.go +
+    master.go:610 dynamic installation). name = <kebab-kind>.<domain>;
+    versions flattened to their names."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    description: str = ""
+    versions: Tuple[str, ...] = ()
+
+
+@dataclass
 class ConfigMap:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     data: Dict[str, str] = field(default_factory=dict)

@@ -153,5 +153,10 @@ def default_resources() -> Dict[str, ResourceInfo]:
         ),
         ResourceInfo("secrets", "Secret", t.Secret, "/secrets"),
         ResourceInfo("configmaps", "ConfigMap", t.ConfigMap, "/configmaps"),
+        ResourceInfo(
+            "thirdpartyresources", "ThirdPartyResource",
+            t.ThirdPartyResource, "/thirdpartyresources",
+            namespaced=False, group="extensions",
+        ),
     ]
     return {info.resource: info for info in infos}

@@ -27,6 +27,7 @@ from kubernetes_tpu.apiserver.fields import (
     matches_fields_wire,
     parse_field_selector,
 )
+from kubernetes_tpu.apiserver.thirdparty import ThirdPartyInstaller
 from kubernetes_tpu.apiserver.registry import (
     ResourceInfo,
     ValidationError,
@@ -207,6 +208,14 @@ class APIServer:
         # transports bypass auth like the reference's integration masters
         self.authenticator = authenticator
         self.authorizer = authorizer
+        # dynamic third-party resources (master.go:610-766); re-install
+        # any persisted ThirdPartyResource objects on startup
+        self.thirdparty = ThirdPartyInstaller(self)
+        for tpr in self.store.list("/thirdpartyresources/")[0]:
+            try:
+                self.thirdparty.install(tpr)
+            except Exception:
+                pass  # a broken persisted TPR must not block startup
 
     # -- namespace helpers ---------------------------------------------------
 
@@ -580,10 +589,17 @@ class APIServer:
         # transfers to the store (no second write copy). Reading its
         # meta right after is fine (the store stamps rv in place);
         # callers must not hand this reference out.
+        if info.resource == "thirdpartyresources":
+            # reject invalid TPRs BEFORE persisting: a 400'd object must
+            # not land in the store and re-fail install on every restart
+            self.thirdparty.precheck(obj)
         self.store.create(
             info.key(obj.metadata.namespace, obj.metadata.name), obj,
             owned=True,
         )
+        if info.resource == "thirdpartyresources":
+            # dynamic installation (master.go InstallThirdPartyResource)
+            self.thirdparty.install(obj)
         return obj  # rv already stamped in place by the store
 
     def _update(self, info: ResourceInfo, ns: str, name: str, body,
@@ -692,6 +708,8 @@ class APIServer:
                 stored = self.store.get(key)[0]
                 return 200, stored if obj_mode else codec.encode(stored)
         obj = self.store.delete(key)
+        if info.resource == "thirdpartyresources":
+            self.thirdparty.uninstall(name)
         return 200, obj if obj_mode else codec.encode(obj)
 
     def _bind(self, ns: str, pod_name: str, body):

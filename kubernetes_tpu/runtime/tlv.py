@@ -55,14 +55,44 @@ class TLVError(Exception):
 _BY_NAME: Dict[str, type] = {}
 _FIELDS: Dict[type, Tuple[str, ...]] = {}
 
+# Optional factory for unknown class names (set by the third-party
+# resource layer): fn(name, nfields) -> registered class or None. Lets a
+# fresh process recover persisted dynamic kinds whose classes are
+# synthesized at runtime. The factory only fires inside an explicit
+# allow_dynamic() scope (durable-store recovery — a TRUSTED decode
+# context); untrusted wire input can never register classes.
+import contextlib as _contextlib
+import threading as _threading
 
-def register(cls: type) -> None:
-    """Allow cls on the wire. Names must be unique across the registry."""
+_DYNAMIC_FACTORY = None
+_DYNAMIC_OK = _threading.local()
+
+
+def set_dynamic_factory(fn) -> None:
+    global _DYNAMIC_FACTORY
+    _DYNAMIC_FACTORY = fn
+
+
+@_contextlib.contextmanager
+def allow_dynamic():
+    """Enable the unknown-class factory for decodes on this thread."""
+    prev = getattr(_DYNAMIC_OK, "on", False)
+    _DYNAMIC_OK.on = True
+    try:
+        yield
+    finally:
+        _DYNAMIC_OK.on = prev
+
+
+def register(cls: type, replace: bool = False) -> None:
+    """Allow cls on the wire. Names must be unique across the registry
+    (replace=True rebinds a name — the dynamic third-party kinds
+    synthesize a fresh class per install)."""
     if not dataclasses.is_dataclass(cls):
         raise TypeError(f"{cls!r} is not a dataclass")
     name = cls.__name__
     cur = _BY_NAME.get(name)
-    if cur is not None and cur is not cls:
+    if cur is not None and cur is not cls and not replace:
         raise ValueError(f"wire name {name!r} already registered to {cur!r}")
     _BY_NAME[name] = cls
     _FIELDS[cls] = tuple(f.name for f in dataclasses.fields(cls))
@@ -292,12 +322,15 @@ def loads(data: bytes) -> Any:
                 raise TLVError("truncated payload")
             name = b[i:j].decode("utf-8")
             i = j
+            nf = varint()
             _ensure_registry()
             cls = _BY_NAME.get(name)
+            if (cls is None and _DYNAMIC_FACTORY is not None
+                    and getattr(_DYNAMIC_OK, "on", False)):
+                cls = _DYNAMIC_FACTORY(name, nf)
             if cls is None:
                 raise TLVError(f"unknown wire class {name!r}")
             ftup = _FIELDS[cls]
-            nf = varint()
             if nf != len(ftup):
                 raise TLVError(
                     f"schema drift for {name}: peer has {nf} fields, "

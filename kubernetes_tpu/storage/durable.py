@@ -174,7 +174,8 @@ class FileStore(MemoryStore):
                     "(length or CRC mismatch)"
                 )
             try:
-                rv, raw_data = tlv.loads(body)
+                with tlv.allow_dynamic():
+                    rv, raw_data = tlv.loads(body)
             except tlv.TLVError as e:
                 raise CorruptStoreError(
                     f"{self._snap_path}: undecodable snapshot: {e}"
@@ -211,7 +212,8 @@ class FileStore(MemoryStore):
                 decoded = None
                 if ok:
                     try:
-                        decoded = tlv.loads(rec)
+                        with tlv.allow_dynamic():
+                            decoded = tlv.loads(rec)
                     except tlv.TLVError:
                         ok = False
                 if not ok:
