@@ -2,8 +2,9 @@
 
 The 1.3-era federation ("ubernetes") runs a federated apiserver whose
 object universe is Clusters + federated workloads, and a federation
-controller manager that health-checks member clusters and spreads
-replicas across the healthy ones."""
+controller manager that health-checks member clusters and propagates
+services/replicas across the healthy ones. join_cluster/unjoin_cluster
+are the kubefed registration flow."""
 
 from kubernetes_tpu.federation.federation import (
     Cluster,
@@ -12,6 +13,12 @@ from kubernetes_tpu.federation.federation import (
     ClusterStatus,
     FederatedAPIServer,
     FederatedReplicationManager,
+    FederatedServiceController,
+    FederationControllerManager,
+    default_member_client_factory,
+    join_cluster,
+    spread_replicas,
+    unjoin_cluster,
 )
 
 __all__ = [
@@ -21,4 +28,10 @@ __all__ = [
     "ClusterStatus",
     "FederatedAPIServer",
     "FederatedReplicationManager",
+    "FederatedServiceController",
+    "FederationControllerManager",
+    "default_member_client_factory",
+    "join_cluster",
+    "spread_replicas",
+    "unjoin_cluster",
 ]

@@ -110,6 +110,53 @@ def spread_replicas(total: int, n_clusters: int) -> List[int]:
     return [base + (1 if i < rem else 0) for i in range(n_clusters)]
 
 
+class FederatedServiceController(PeriodicRunner):
+    """federation-controller/service: propagate federated Services to
+    every Ready member cluster (servicecontroller.go reconciliation,
+    create-or-converge per member)."""
+
+    SYNC_PERIOD = 5.0
+    THREAD_NAME = "federation-service"
+
+    def __init__(self, fed_client, member_client_factory):
+        self.fed_client = fed_client
+        self.member_client_factory = member_client_factory
+
+    def sync_once(self) -> None:
+        services, _rv = self.fed_client.resource("services", "").list()
+        clusters, _rv = self.fed_client.resource("clusters").list()
+        ready = [
+            c for c in clusters
+            if any(cond.type == "Ready" and cond.status == "True"
+                   for cond in c.status.conditions)
+        ]
+        for svc in services:
+            for cluster in ready:
+                member = self.member_client_factory(cluster)
+                if member is None:
+                    continue
+                mc = member.resource("services", svc.metadata.namespace)
+                want = t.Service(
+                    metadata=t.ObjectMeta(
+                        name=svc.metadata.name,
+                        namespace=svc.metadata.namespace,
+                        labels=dict(svc.metadata.labels),
+                    ),
+                    spec=t.ServiceSpec(
+                        selector=dict(svc.spec.selector),
+                        ports=list(svc.spec.ports),
+                    ),
+                )
+                try:
+                    mc.get(svc.metadata.name)
+                except APIStatusError as e:
+                    if e.code == 404:
+                        try:
+                            mc.create(want)
+                        except APIStatusError:
+                            pass
+
+
 class FederatedReplicationManager(PeriodicRunner):
     """Distribute federated RCs over Ready member clusters."""
 
@@ -172,3 +219,68 @@ class FederatedReplicationManager(PeriodicRunner):
                     if e.code == 404:
                         mc.create(want)
 
+
+def default_member_client_factory(cluster: Cluster) -> Optional[RESTClient]:
+    """Dial the member by its registered endpoint (the reference reads
+    a kubeconfig secret named by the Cluster; the endpoint is the
+    flattened equivalent here)."""
+    from kubernetes_tpu.client.transport import HTTPTransport
+
+    addr = cluster.spec.server_address
+    if not addr:
+        return None
+    return RESTClient(HTTPTransport(addr))
+
+
+def join_cluster(fed_client: RESTClient, name: str,
+                 server_address: str) -> Cluster:
+    """The kubefed-join flow (federation/cluster/clustercontroller.go
+    registration): record the member's endpoint as a Cluster object; the
+    cluster controller then probes it and flips Ready."""
+    cluster = Cluster(
+        metadata=t.ObjectMeta(name=name, namespace=""),
+        spec=ClusterSpec(server_address=server_address),
+    )
+    return fed_client.resource("clusters").create(cluster)
+
+
+def unjoin_cluster(fed_client: RESTClient, name: str) -> None:
+    fed_client.resource("clusters").delete(name)
+
+
+class FederationControllerManager:
+    """federation/cmd/federation-controller-manager: one process running
+    the federation loops (cluster health, service propagation, replica
+    spreading) over the federated apiserver."""
+
+    def __init__(self, fed_client: RESTClient,
+                 member_client_factory=None,
+                 cluster_sync_period: float = 5.0,
+                 workload_sync_period: float = 5.0):
+        factory = member_client_factory or default_member_client_factory
+        self._memo: Dict[str, Optional[RESTClient]] = {}
+        self._memo_lock = threading.Lock()
+
+        def memoized(cluster: Cluster) -> Optional[RESTClient]:
+            key = f"{cluster.metadata.name}|{cluster.spec.server_address}"
+            with self._memo_lock:
+                if key not in self._memo:
+                    self._memo[key] = factory(cluster)
+                return self._memo[key]
+
+        self.controllers = [
+            ClusterController(fed_client, memoized),
+            FederatedServiceController(fed_client, memoized),
+            FederatedReplicationManager(fed_client, memoized),
+        ]
+        self._periods = [cluster_sync_period, workload_sync_period,
+                         workload_sync_period]
+
+    def start(self) -> "FederationControllerManager":
+        for ctrl, period in zip(self.controllers, self._periods):
+            ctrl.run(period)
+        return self
+
+    def stop(self) -> None:
+        for ctrl in self.controllers:
+            ctrl.stop()

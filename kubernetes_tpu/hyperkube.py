@@ -119,6 +119,27 @@ def run_proxy(args) -> None:
     p.stop()
 
 
+def run_federation_apiserver(args) -> None:
+    """federation/cmd/federated-apiserver."""
+    from kubernetes_tpu.federation import FederatedAPIServer
+
+    server = FederatedAPIServer()
+    host, port = server.serve_http(port=args.port)
+    print(f"federation-apiserver on http://{host}:{port}", flush=True)
+    _wait_forever()
+    server.shutdown_http()
+
+
+def run_federation_controller_manager(args) -> None:
+    """federation/cmd/federation-controller-manager."""
+    from kubernetes_tpu.federation import FederationControllerManager
+
+    mgr = FederationControllerManager(_client(args.server)).start()
+    print(f"federation-controller-manager against {args.server}", flush=True)
+    _wait_forever()
+    mgr.stop()
+
+
 def run_local_up(args) -> None:
     """hack/local-up-cluster.sh: a full cluster in one process."""
     from kubernetes_tpu.apiserver.server import APIServer
@@ -178,9 +199,8 @@ def main(argv=None):
     )
     p.add_argument(
         "--enable-binary-wire", action="store_true",
-        help="accept/serve the binary content type for cluster-internal "
-        "clients (kubemark-style protobuf analogue); keep off for "
-        "untrusted callers",
+        help="accept/serve the TLV binary content type (kubemark-style "
+        "protobuf analogue; data-only, safe for untrusted callers)",
     )
 
     def add_client_flags(p):
@@ -214,6 +234,12 @@ def main(argv=None):
     add_client_flags(p)
     p.add_argument("--node", default="")
 
+    p = sub.add_parser("federation-apiserver")
+    p.add_argument("--port", type=int, default=8180)
+
+    p = sub.add_parser("federation-controller-manager")
+    p.add_argument("--server", "-s", default="http://127.0.0.1:8180")
+
     p = sub.add_parser("local-up")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--nodes", type=int, default=3)
@@ -226,6 +252,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     {
         "apiserver": run_apiserver,
+        "federation-apiserver": run_federation_apiserver,
+        "federation-controller-manager": run_federation_controller_manager,
         "extender": run_extender,
         "scheduler": run_scheduler,
         "controller-manager": run_controller_manager,

@@ -21,7 +21,7 @@ from kubernetes_tpu.api.types import (
 )
 from kubernetes_tpu.apiserver.server import APIServer
 from kubernetes_tpu.client.rest import RESTClient
-from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.client.transport import HTTPTransport, LocalTransport
 from kubernetes_tpu.dns import DNSRecords
 from kubernetes_tpu.federation import (
     Cluster,
@@ -300,3 +300,103 @@ def test_dns_wire_protocol():
     finally:
         wire.shutdown()
         dns.stop()
+
+
+def test_federation_controller_manager_join_flow():
+    """The kubefed-join flow through the federation-controller-manager
+    process: join two member clusters by endpoint, watch health flip
+    Ready, services and replicas propagate; unjoin stops propagation."""
+    from kubernetes_tpu.federation import (
+        FederatedAPIServer,
+        FederationControllerManager,
+        join_cluster,
+        unjoin_cluster,
+    )
+
+    fed_server = FederatedAPIServer()
+    fed = RESTClient(LocalTransport(fed_server))
+    members = {}
+    for name in ("east", "west"):
+        srv = APIServer()
+        host, port = srv.serve_http(port=0)
+        members[name] = (srv, f"http://{host}:{port}")
+    try:
+        for name, (_srv, url) in members.items():
+            join_cluster(fed, name, url)
+        mgr = FederationControllerManager(
+            fed, cluster_sync_period=0.1, workload_sync_period=0.1
+        ).start()
+        try:
+            def ready_count():
+                clusters, _ = fed.resource("clusters").list()
+                return sum(
+                    1 for c in clusters
+                    if any(cond.type == "Ready" and cond.status == "True"
+                           for cond in c.status.conditions)
+                )
+
+            assert wait_until(lambda: ready_count() == 2)
+            # a federated service propagates to every member
+            fed.resource("services", "default").create(Service(
+                metadata=ObjectMeta(name="web"),
+                spec=ServiceSpec(selector={"app": "web"},
+                                 ports=[ServicePort(port=80)]),
+            ))
+            east = RESTClient(HTTPTransport(members["east"][1]))
+            west = RESTClient(HTTPTransport(members["west"][1]))
+            assert wait_until(lambda: all(
+                _has_service(c, "web") for c in (east, west)
+            ))
+            # a federated RC spreads 5 replicas 3/2 across members
+            from kubernetes_tpu.api.types import (
+                Container,
+                Pod,
+                PodSpec,
+                PodTemplateSpec,
+                ReplicationController,
+                ReplicationControllerSpec,
+            )
+
+            fed.resource("replicationcontrollers", "default").create(
+                ReplicationController(
+                    metadata=ObjectMeta(name="app"),
+                    spec=ReplicationControllerSpec(
+                        replicas=5, selector={"run": "app"},
+                        template=PodTemplateSpec(
+                            metadata=ObjectMeta(labels={"run": "app"}),
+                            spec=PodSpec(containers=[Container(name="c")]),
+                        ),
+                    ),
+                )
+            )
+
+            def shares():
+                out = []
+                for c in (east, west):
+                    try:
+                        rc = c.resource(
+                            "replicationcontrollers", "default").get("app")
+                        out.append(rc.spec.replicas)
+                    except Exception:
+                        out.append(None)
+                return out
+
+            assert wait_until(lambda: shares() == [3, 2])
+            # unjoin west: the next reconcile concentrates on east
+            unjoin_cluster(fed, "west")
+            assert wait_until(
+                lambda: shares()[0] == 5
+            )
+        finally:
+            mgr.stop()
+    finally:
+        for srv, _url in members.values():
+            srv.shutdown_http()
+
+
+def _has_service(client, name):
+    try:
+        client.resource("services", "default").get(name)
+        return True
+    except Exception:
+        return False
