@@ -148,13 +148,20 @@ class FederatedServiceController(PeriodicRunner):
                     ),
                 )
                 try:
-                    mc.get(svc.metadata.name)
+                    existing = mc.get(svc.metadata.name)
+                    # converge drift: federated spec changes propagate
+                    if (existing.spec.selector != want.spec.selector
+                            or existing.spec.ports != want.spec.ports):
+                        existing.spec.selector = dict(want.spec.selector)
+                        existing.spec.ports = list(want.spec.ports)
+                        mc.update(existing)
                 except APIStatusError as e:
                     if e.code == 404:
                         try:
                             mc.create(want)
-                        except APIStatusError:
-                            pass
+                        except APIStatusError as ce:
+                            if ce.code != 409:  # lost create race only
+                                raise
 
 
 class FederatedReplicationManager(PeriodicRunner):
@@ -244,7 +251,31 @@ def join_cluster(fed_client: RESTClient, name: str,
     return fed_client.resource("clusters").create(cluster)
 
 
-def unjoin_cluster(fed_client: RESTClient, name: str) -> None:
+def unjoin_cluster(fed_client: RESTClient, name: str,
+                   member_client_factory=None) -> None:
+    """kubefed unjoin: remove the federation's workloads from the
+    departing member WHILE its endpoint is still known, then delete the
+    Cluster object — otherwise the member keeps running its share
+    forever and federated totals are silently exceeded."""
+    factory = member_client_factory or default_member_client_factory
+    try:
+        cluster = fed_client.resource("clusters").get(name)
+        member = factory(cluster)
+    except Exception:
+        member = None
+    if member is not None:
+        for resource in ("replicationcontrollers", "services"):
+            try:
+                fed_objs, _rv = fed_client.resource(resource, "").list()
+            except APIStatusError:
+                continue
+            for obj in fed_objs:
+                try:
+                    member.resource(
+                        resource, obj.metadata.namespace
+                    ).delete(obj.metadata.name)
+                except Exception:
+                    pass  # not propagated there / member unreachable
     fed_client.resource("clusters").delete(name)
 
 
@@ -268,13 +299,35 @@ class FederationControllerManager:
                     self._memo[key] = factory(cluster)
                 return self._memo[key]
 
+        manager = self
+
+        class _MemoPruner(PeriodicRunner):
+            """Evict member clients for unjoined/re-addressed clusters
+            so long-lived managers don't leak one transport per
+            historical (name, address) pair."""
+
+            THREAD_NAME = "federation-memo-pruner"
+
+            def sync_once(self) -> int:
+                clusters, _rv = fed_client.resource("clusters").list()
+                live = {
+                    f"{c.metadata.name}|{c.spec.server_address}"
+                    for c in clusters
+                }
+                with manager._memo_lock:
+                    stale = [k for k in manager._memo if k not in live]
+                    for k in stale:
+                        del manager._memo[k]
+                return len(stale)
+
         self.controllers = [
             ClusterController(fed_client, memoized),
             FederatedServiceController(fed_client, memoized),
             FederatedReplicationManager(fed_client, memoized),
+            _MemoPruner(),
         ]
         self._periods = [cluster_sync_period, workload_sync_period,
-                         workload_sync_period]
+                         workload_sync_period, cluster_sync_period]
 
     def start(self) -> "FederationControllerManager":
         for ctrl, period in zip(self.controllers, self._periods):

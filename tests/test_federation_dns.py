@@ -382,11 +382,12 @@ def test_federation_controller_manager_join_flow():
                 return out
 
             assert wait_until(lambda: shares() == [3, 2])
-            # unjoin west: the next reconcile concentrates on east
+            # unjoin west: its propagated workloads are DELETED (the
+            # kubefed cleanup) and reconcile concentrates on east
             unjoin_cluster(fed, "west")
-            assert wait_until(
-                lambda: shares()[0] == 5
-            )
+            assert wait_until(lambda: shares() == [5, None])
+            assert not _has_service(west, "web")
+            assert _has_service(east, "web")
         finally:
             mgr.stop()
     finally:
