@@ -140,19 +140,25 @@ class WatchResponse:
                 out_type = "DELETED"
             else:
                 continue
-            yield {
-                "type": out_type,
-                # obj_mode consumers own the object: give them the
-                # isolated unpickled copy. Wire consumers only need the
-                # encoding — a read-only traversal the shared ref can
-                # serve without paying the unpickle.
-                "object": (
-                    ev.object if self.obj_mode
-                    else self.scheme.encode(
+            if self.obj_mode:
+                # obj_mode consumers own the object: the isolated copy
+                payload = ev.object
+            else:
+                # Wire consumers only need the encoding — a read-only
+                # traversal of the shared ref, computed ONCE per event
+                # and memoized across watchers (N watchers used to pay
+                # N reflective encodes per event; racing writers write
+                # the same value, so the memo needs no lock).
+                cache = getattr(ev, "wire_cache", None)
+                key = id(self.scheme)
+                payload = cache.get(key) if cache is not None else None
+                if payload is None:
+                    payload = self.scheme.encode(
                         mobj if mobj is not None else ev.object
                     )
-                ),
-            }
+                    if cache is not None:
+                        cache[key] = payload
+            yield {"type": out_type, "object": payload}
 
     def _pull(self, idle_timeout: Optional[float]):
         if idle_timeout is None:

@@ -95,13 +95,36 @@ def make_pods(client: RESTClient, p: int, creators: int = 30,
     parallelize(min(creators, len(chunks)), len(chunks), create)
 
 
+def _measure(count_scheduled, num_nodes, num_pods, out,
+             label: str = "") -> float:
+    """The per-second rate/total printout until saturation
+    (scheduler_test.go:48-61), shared by both harness modes."""
+    prev, start = 0, time.time()
+    while True:
+        time.sleep(1)
+        scheduled = count_scheduled()
+        rate = scheduled - prev
+        print(
+            f"{time.strftime('%H:%M:%S')} Rate: {rate:5d} Total: {scheduled}",
+            file=out,
+        )
+        if scheduled >= num_pods:
+            elapsed = time.time() - start
+            throughput = num_pods / elapsed
+            print(
+                f"scheduled {num_pods} pods on {num_nodes} nodes in "
+                f"{elapsed:.1f}s ({throughput:.0f} pods/s){label}",
+                file=out,
+            )
+            return throughput
+        prev = scheduled
+
+
 def schedule_pods(
     num_nodes: int, num_pods: int, provider: str = "TPUProvider", out=sys.stdout
 ) -> float:
     """scheduler_test.go:41 schedulePods -> pods/sec over the steady
     window (prints rate/total each second like the reference)."""
-    import threading
-
     server = APIServer()
     client = RESTClient(LocalTransport(server))
     make_nodes(client, num_nodes)
@@ -124,27 +147,81 @@ def schedule_pods(
             f"created {num_pods} pods in {time.time() - t0:.1f}s; scheduling...",
             file=out,
         )
-        prev, start = 0, time.time()
-        while True:
-            time.sleep(1)
-            scheduled = count_scheduled()
-            rate = scheduled - prev
-            print(
-                f"{time.strftime('%H:%M:%S')} Rate: {rate:5d} Total: {scheduled}",
-                file=out,
-            )
-            if scheduled >= num_pods:
-                elapsed = time.time() - start
-                throughput = num_pods / elapsed
-                print(
-                    f"scheduled {num_pods} pods on {num_nodes} nodes in "
-                    f"{elapsed:.1f}s ({throughput:.0f} pods/s)",
-                    file=out,
-                )
-                return throughput
-            prev = scheduled
+        return _measure(count_scheduled, num_nodes, num_pods, out)
     finally:
         sched.stop()
+
+
+def schedule_pods_separate(
+    num_nodes: int, num_pods: int, provider: str = "TPUProvider",
+    out=sys.stdout,
+) -> float:
+    """The density test across PROCESS boundaries, like the reference's
+    real deployment (separate daemons): the apiserver runs in its own
+    interpreter (TLV binary wire), pod creation in another, and the
+    scheduler + measurement here. This validates the reference's real
+    deployment shape end-to-end on the TLV binary wire. NOTE: at current
+    pure-Python codec costs the per-event HTTP+decode overhead outweighs
+    the GIL relief, so the in-process mode still measures faster; a
+    C codec / batched watch frames are the path to flipping that."""
+    import subprocess
+
+    from kubernetes_tpu.client.transport import HTTPTransport
+
+    api_proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.hyperkube", "apiserver",
+         "--port", "0", "--enable-binary-wire"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    creator = None
+    sched = None
+    try:
+        line = api_proc.stdout.readline()
+        url = line.strip().rsplit(" ", 1)[-1]
+        client = RESTClient(HTTPTransport(url, binary=True))
+        deadline = time.time() + 15
+        while not client.healthz():
+            if time.time() > deadline:
+                raise RuntimeError(f"apiserver at {url!r} never came up")
+            time.sleep(0.1)
+        make_nodes(client, num_nodes)
+        sched = SchedulerServer(
+            client, SchedulerServerOptions(algorithm_provider=provider)
+        ).start()
+
+        def count_scheduled() -> int:
+            return len(sched.factory.assigned_informer.store.list_keys())
+
+        t0 = time.time()
+        creator = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.harness.perf",
+             "--create-only", "--server", url, "--pods", str(num_pods)],
+        )
+        creator.wait()
+        if creator.returncode != 0:
+            raise RuntimeError(
+                f"pod creator exited {creator.returncode}; the "
+                "measurement would wait forever"
+            )
+        print(
+            f"created {num_pods} pods in {time.time() - t0:.1f}s; "
+            "scheduling...",
+            file=out,
+        )
+        return _measure(count_scheduled, num_nodes, num_pods, out,
+                        label=" [separate processes]")
+    finally:
+        if sched is not None:
+            sched.stop()
+        for proc in (creator, api_proc):
+            if proc is None or proc.poll() is not None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
 
 
 def main(argv=None):
@@ -155,7 +232,25 @@ def main(argv=None):
         "--provider", default="TPUProvider",
         choices=["TPUProvider", "DefaultProvider"],
     )
+    ap.add_argument(
+        "--separate", action="store_true",
+        help="run the apiserver and pod creators in their own processes "
+        "(the reference's real deployment shape)",
+    )
+    # internal: the creator-subprocess entry for --separate
+    ap.add_argument("--create-only", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--server", default="", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.create_only:
+        from kubernetes_tpu.client.transport import HTTPTransport
+
+        client = RESTClient(HTTPTransport(args.server, binary=True))
+        make_pods(client, args.pods)
+        return
+    if args.separate:
+        schedule_pods_separate(args.nodes, args.pods, args.provider)
+        return
     schedule_pods(args.nodes, args.pods, args.provider)
 
 

@@ -77,16 +77,22 @@ class _LazyEvent:
     nothing else. They must never be handed to a consumer."""
 
     __slots__ = ("type", "resource_version", "_blob", "_pair",
-                 "match_object", "match_prev")
+                 "match_object", "match_prev", "wire_cache")
 
     def __init__(self, ev_type: str, rv: int, blob: bytes,
-                 match_object=None, match_prev=None):
+                 match_object=None, match_prev=None, wire_cache=None):
         self.type = ev_type
         self.resource_version = rv
         self._blob = blob
         self._pair = None
         self.match_object = match_object
         self.match_prev = match_prev
+        # per-COMMIT wire-encoding memo ({codec id: wire dict}): one
+        # dict is created in _record and shared by every watcher's
+        # event copy, so N wire watchers pay ONE reflective encode per
+        # commit (the payload is read-only downstream; obj_mode
+        # watchers never touch it, keeping their object isolation)
+        self.wire_cache = wire_cache if wire_cache is not None else {}
 
     def _unpack(self):
         if self._pair is None:
@@ -223,6 +229,7 @@ class MemoryStore:
             self._compacted_rv = self._history[drop - 1][1].resource_version
             del self._history[:drop]
         blob = None
+        wire_cache = {}  # ONE encode memo shared by all watcher copies
         for prefix, stream in list(self._watchers):
             if key.startswith(prefix):
                 if blob is None:
@@ -236,7 +243,8 @@ class MemoryStore:
                 if blob:
                     stream._deliver(
                         _LazyEvent(ev.type, ev.resource_version, blob,
-                                   ev.object, ev.prev_object)
+                                   ev.object, ev.prev_object,
+                                   wire_cache=wire_cache)
                     )
                 else:  # unpicklable object: fall back to deep copies
                     stream._deliver(
