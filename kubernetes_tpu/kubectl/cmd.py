@@ -3,7 +3,7 @@
 Supported: get, describe, create -f, apply -f, delete, scale, label,
 annotate, cordon, uncordon, drain, run, expose, rollout-status, logs,
 exec, attach, port-forward, patch, edit, rolling-update, proxy, top,
-autoscale, explain, config, version.
+autoscale, explain, convert, config, version.
 Resource name aliases follow kubectl shortcuts (po, no, svc, rc, rs,
 deploy, ds, ns, ev, hpa...)."""
 
@@ -904,6 +904,45 @@ class Kubectl:
             lines.append(f"   <{getattr(cls, '__name__', cls)}>")
         return "\n".join(lines)
 
+    def convert(self, filename: str, output_version: str) -> str:
+        """kubectl convert (cmd/convert.go): re-express a manifest in a
+        different wire version — decode through the SOURCE version's
+        codec (each doc's apiVersion), encode through the target's."""
+        import json as jsonlib
+
+        from kubernetes_tpu.runtime.scheme import scheme as base_scheme
+        from kubernetes_tpu.runtime.versioning import codec_for
+
+        def _codec(ver: str):
+            group, _, version = ver.rpartition("/")
+            c = codec_for(base_scheme, group, version)
+            if c is None:
+                raise ValueError(f"no codec for version {ver!r}")
+            return c
+
+        target = _codec(output_version)
+        if filename == "-":
+            raw = sys.stdin.read()
+        else:
+            with open(filename) as f:
+                raw = f.read()
+        if raw.lstrip().startswith(("{", "[")):
+            docs = jsonlib.loads(raw)
+            docs = docs if isinstance(docs, list) else [docs]
+        else:
+            import yaml
+
+            docs = [d for d in yaml.safe_load_all(raw) if d]
+        out = []
+        for d in docs:
+            for item in (d.get("items", []) if d.get("kind") == "List"
+                         else [d]):
+                obj = _codec(item.get("apiVersion", "v1")).decode(item)
+                out.append(target.encode(obj))
+        return jsonlib.dumps(out[0] if len(out) == 1 else
+                             {"kind": "List", "items": out},
+                             indent=2, sort_keys=True)
+
     # -- kubeconfig (cmd/config.go) ------------------------------------------
 
     @staticmethod
@@ -1093,6 +1132,10 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p = sub.add_parser("explain")
     p.add_argument("path")
 
+    p = sub.add_parser("convert")
+    p.add_argument("--filename", "-f", required=True)
+    p.add_argument("--output-version", default="v1")
+
     p = sub.add_parser("config")
     p.add_argument("--kubeconfig", default="")
     # REMAINDER: --server=/--cluster=/--namespace= tokens belong to the
@@ -1192,6 +1235,8 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
                           args.cpu_percent)
     elif args.verb == "explain":
         out = k.explain(args.path)
+    elif args.verb == "convert":
+        out = k.convert(args.filename, args.output_version)
     elif args.verb == "config":
         import os
 

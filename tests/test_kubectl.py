@@ -420,3 +420,20 @@ def test_attach_portforward_top_via_kubelet_api():
         assert "web" in out and "n1" in out
     finally:
         kl.stop()
+
+
+def test_convert_between_versions(kubectl, tmp_path):
+    """kubectl convert re-expresses a manifest at another wire version
+    (cmd/convert.go): the legacy extensions/v1beta1 bare-map selector
+    becomes the v1beta2 object form."""
+    k, _client = kubectl
+    src = tmp_path / "rs.json"
+    src.write_text(json.dumps({
+        "kind": "ReplicaSet", "apiVersion": "extensions/v1beta1",
+        "metadata": {"name": "web"},
+        "spec": {"replicas": 2, "selector": {"app": "web"}},
+    }))
+    out = json.loads(k.convert(str(src), "extensions/v1beta2"))
+    assert out["apiVersion"] == "extensions/v1beta2"
+    assert out["spec"]["selector"] == {"matchLabels": {"app": "web"}}
+    assert out["spec"]["replicas"] == 2
