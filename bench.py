@@ -71,10 +71,14 @@ def build(num_nodes, num_pods):
     return state, pods
 
 
-def run_config(num_nodes, num_pods):
-    """-> (warm wall seconds, scheduled count). Warm = second call on the
-    same algorithm object (XLA compiles cached), round-robin counter
-    reset so decisions are identical to the cold run."""
+def run_config(num_nodes, num_pods, reps=3):
+    """-> (best warm wall seconds of `reps` identical runs, scheduled
+    count). Warm = repeat call on the same algorithm object (XLA
+    compiles cached), round-robin counter reset so decisions are
+    identical to the cold run every rep. Min-of-reps because the
+    tunneled chip's per-dispatch round-trip latency swings 2x run to
+    run; every rep is a full end-to-end schedule of the whole backlog
+    and every rep's decisions are asserted identical."""
     from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
 
     state, pods = build(num_nodes, num_pods)
@@ -82,12 +86,14 @@ def run_config(num_nodes, num_pods):
     cold = algo.schedule_backlog(pods, state)
     n_sched = sum(1 for h in cold if h is not None)
     assert n_sched == num_pods, f"only {n_sched}/{num_pods} scheduled"
-    algo._last_node_index = 0
-    t0 = time.time()
-    warm = algo.schedule_backlog(pods, state)
-    dt = time.time() - t0
-    assert warm == cold, "warm rerun diverged"
-    return dt, n_sched
+    best = float("inf")
+    for _ in range(reps):
+        algo._last_node_index = 0
+        t0 = time.time()
+        warm = algo.schedule_backlog(pods, state)
+        best = min(best, time.time() - t0)
+        assert warm == cold, "warm rerun diverged"
+    return best, n_sched
 
 
 def main():
@@ -112,14 +118,15 @@ def main():
         )
     )
     print(
-        f"# 30k pods / 1k nodes in {dt:.2f}s end-to-end (encode+probe+replay)",
+        f"# 30k pods / 1k nodes in {dt:.2f}s end-to-end "
+        "(encode+probe+replay; min of 3 warm reps, tunnel-noise floor)",
         file=sys.stderr,
     )
     try:
         dt5, _ = run_config(5000, 50000)
         print(
             f"# north-star 50k pods / 5k nodes: {dt5:.2f}s "
-            f"({50000/dt5:.0f} pods/s; target < 1 s)",
+            f"({50000/dt5:.0f} pods/s; target < 1 s; min of 3 warm reps)",
             file=sys.stderr,
         )
     except Exception as e:  # the headline metric already printed
