@@ -93,15 +93,24 @@ def ensure_replay() -> str | None:
     return _build("replay.c", "_replay.so", [])
 
 
-def ensure_kquantity() -> str | None:
-    """Build the CPython _kquantity extension (needs Python headers)."""
+def _ensure_ext(stem: str) -> str | None:
+    """Build a CPython extension from {stem}.c (needs Python headers)."""
     inc = sysconfig.get_paths().get("include")
     if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
         return None
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return _build("_kquantity.c", f"_kquantity{suffix}", [f"-I{inc}"])
+    return _build(f"{stem}.c", f"{stem}{suffix}", [f"-I{inc}"])
+
+
+def ensure_kquantity() -> str | None:
+    return _ensure_ext("_kquantity")
+
+
+def ensure_ktlv() -> str | None:
+    return _ensure_ext("_ktlv")
 
 
 def ensure_all() -> None:
     ensure_replay()
     ensure_kquantity()
+    ensure_ktlv()

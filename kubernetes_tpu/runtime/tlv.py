@@ -210,16 +210,34 @@ def _encode(v: Any, out: bytearray, ctab: Dict[type, int],
         raise TLVError(f"type {tv.__name__} is not wire-encodable")
 
 
-def dumps(payload: Any) -> bytes:
+def _py_dumps(payload: Any) -> bytes:
     out = bytearray()
     _encode(payload, out, {}, 0)
     return bytes(out)
+
+
+def dumps(payload: Any) -> bytes:
+    if _ktlv is not None:
+        try:
+            return _ktlv.dumps(payload)
+        except _ktlv.Fallback:
+            pass  # >64-bit ints, numeric subclasses, slotted classes
+    return _py_dumps(payload)
 
 
 # -- decode -------------------------------------------------------------------
 
 
 def loads(data: bytes) -> Any:
+    if _ktlv is not None:
+        try:
+            return _ktlv.loads(data)
+        except _ktlv.Fallback:
+            pass  # e.g. >64-bit INT payloads: python path decides
+    return _py_loads(data)
+
+
+def _py_loads(data: bytes) -> Any:
     """Decode one value. Implemented as one closure over a position
     cursor with inlined varint/length fast paths — the method-call
     version ran ~3x slower, and decode sits on the watch hot path."""
@@ -323,19 +341,7 @@ def loads(data: bytes) -> Any:
             name = b[i:j].decode("utf-8")
             i = j
             nf = varint()
-            _ensure_registry()
-            cls = _BY_NAME.get(name)
-            if (cls is None and _DYNAMIC_FACTORY is not None
-                    and getattr(_DYNAMIC_OK, "on", False)):
-                cls = _DYNAMIC_FACTORY(name, nf)
-            if cls is None:
-                raise TLVError(f"unknown wire class {name!r}")
-            ftup = _FIELDS[cls]
-            if nf != len(ftup):
-                raise TLVError(
-                    f"schema drift for {name}: peer has {nf} fields, "
-                    f"local has {len(ftup)}"
-                )
+            cls, ftup = _resolve_class(name, nf)
             ctab.append((cls, ftup))
             obj = new(cls)
             d1 = depth + 1
@@ -356,3 +362,45 @@ def loads(data: bytes) -> Any:
     if i != nb:
         raise TLVError(f"{nb - i} trailing bytes after value")
     return out
+
+
+# -- native fast path ---------------------------------------------------------
+#
+# The C extension (native/_ktlv.c) implements the identical grammar and
+# raises _ktlv.Fallback for anything it cannot reproduce bit-for-bit, in
+# which case the Python codec above handles the whole payload.  The
+# registry and the dynamic-class gate stay in Python: BOTH decoders call
+# _resolve_class for every OBJDEF, so allow_dynamic() scoping and
+# schema-drift checks behave identically on both paths.
+
+
+def _resolve_class(name: str, nf: int):
+    _ensure_registry()
+    cls = _BY_NAME.get(name)
+    if (cls is None and _DYNAMIC_FACTORY is not None
+            and getattr(_DYNAMIC_OK, "on", False)):
+        cls = _DYNAMIC_FACTORY(name, nf)
+    if cls is None:
+        raise TLVError(f"unknown wire class {name!r}")
+    ftup = _FIELDS[cls]
+    if nf != len(ftup):
+        raise TLVError(
+            f"schema drift for {name}: peer has {nf} fields, "
+            f"local has {len(ftup)}"
+        )
+    return cls, ftup
+
+
+def _load_native():
+    try:
+        from kubernetes_tpu.native import build as _build
+        if _build.ensure_ktlv() is None:
+            return None
+        from kubernetes_tpu.native import _ktlv as mod  # type: ignore
+    except Exception:
+        return None
+    mod.setup(TLVError, _FIELDS, fields_of, _resolve_class)
+    return mod
+
+
+_ktlv = _load_native()
