@@ -48,22 +48,52 @@ def _lookup(wire: Dict[str, Any], path: str) -> str:
     return str(cur)
 
 
+_MISSING = object()
+
+# clause compile memo: (path, want) -> ((snake segs...), stripped want).
+# A watch storm evaluates the same few clauses tens of thousands of
+# times per second; splitting the path and to_snake'ing each segment
+# per event was ~25% of the fan-out cost.
+_COMPILED: Dict[Tuple[str, str], Tuple[Tuple[str, ...], str]] = {}
+
+
+def _compile_clause(path: str, want: str) -> Tuple[Tuple[Tuple[str, str], ...], str]:
+    got = _COMPILED.get((path, want))
+    if got is None:
+        # strip optional quoting: spec.nodeName=="" arrives as value '""'
+        stripped = want
+        if len(want) >= 2 and want[0] == want[-1] == '"':
+            stripped = want[1:-1]
+        # keep both casings per segment: attributes are snake_case,
+        # dict payloads keep the wire's camelCase verbatim
+        got = (
+            tuple((s, to_snake(s)) for s in path.split(".")),
+            stripped,
+        )
+        if len(_COMPILED) < 4096:  # hostile selector variety can't pin RAM
+            _COMPILED[(path, want)] = got
+    return got
+
+
 def _lookup_obj(obj: Any, path: str) -> str:
     """Resolve a wire-style camelCase dotted path directly against the
     dataclass graph — same result as encoding first, without paying a
     full-object encode per watch event."""
+    segs, _ = _compile_clause(path, "")
+    return _lookup_obj_segs(obj, segs)
+
+
+def _lookup_obj_segs(obj: Any, segs) -> str:
     cur: Any = obj
-    for seg in path.split("."):
+    for wire_seg, attr in segs:
         if isinstance(cur, dict):
-            if seg in cur:
-                cur = cur[seg]
-            else:
+            cur = cur.get(wire_seg, _MISSING)
+            if cur is _MISSING:
                 return ""
         else:
-            attr = to_snake(seg)
-            if not hasattr(cur, attr):
+            cur = getattr(cur, attr, _MISSING)
+            if cur is _MISSING:
                 return ""
-            cur = getattr(cur, attr)
         if cur is None:
             return ""
     if isinstance(cur, bool):
@@ -74,10 +104,20 @@ def _lookup_obj(obj: Any, path: str) -> str:
 def _matches(target: Any, clauses, lookup) -> bool:
     for path, op, want in clauses:
         got = lookup(target, path)
-        # strip optional quoting: spec.nodeName=="" arrives as value '""'
         if len(want) >= 2 and want[0] == want[-1] == '"':
             want = want[1:-1]
         ok = got == want
+        if op == "!=":
+            ok = not ok
+        if not ok:
+            return False
+    return True
+
+
+def _matches_obj(obj: Any, clauses) -> bool:
+    for path, op, want in clauses:
+        segs, stripped = _compile_clause(path, want)
+        ok = _lookup_obj_segs(obj, segs) == stripped
         if op == "!=":
             ok = not ok
         if not ok:
@@ -90,7 +130,7 @@ def matches_fields(obj: Any, clauses: List[Tuple[str, str, str]]) -> bool:
     semantics as the wire evaluator, without paying an encode."""
     if not clauses:
         return True
-    return _matches(obj, clauses, _lookup_obj)
+    return _matches_obj(obj, clauses)
 
 
 def matches_fields_wire(

@@ -212,19 +212,36 @@ def start_http_server(api: APIServer, host: str, port: int,
             self.end_headers()
             try:
                 # idle probes every few seconds detect departed clients so
-                # quiet watches don't pin a thread + store watcher forever
-                for event in watch.events(idle_timeout=3.0):
-                    if event is None:
+                # quiet watches don't pin a thread + store watcher forever.
+                # Events arrive in burst batches (everything momentarily
+                # queued) and each batch is ONE coalesced socket write —
+                # a wave-bulk bind emits tens of thousands of events
+                # back-to-back, and per-event write+flush was the
+                # frontend's throughput ceiling.
+                if binary_stream:
+                    batches = watch.frame_batches(idle_timeout=3.0)
+                else:
+                    batches = watch.event_batches(idle_timeout=3.0)
+                for batch in batches:
+                    if batch is None:
                         # keepalive: blank NDJSON line / zero-length frame
-                        frame = (
+                        payload = (
                             binary.encode_frame(None) if binary_stream
                             else b"\n"
                         )
                     elif binary_stream:
-                        frame = binary.encode_frame(event)
+                        payload = b"".join(batch)  # already frame bytes
                     else:
-                        frame = json.dumps(event).encode() + b"\n"
-                    self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
+                        payload = b"".join(
+                            json.dumps(ev).encode() + b"\n" for ev in batch
+                        )
+                    # the whole batch is ONE http chunk: the client's
+                    # dechunker pays one size-line parse per burst, not
+                    # per event (frames/NDJSON lines carry their own
+                    # boundaries, so chunking is pure transport here)
+                    self.wfile.write(
+                        b"%x\r\n%s\r\n" % (len(payload), payload)
+                    )
                     self.wfile.flush()
                 self.wfile.write(b"0\r\n\r\n")
             except (BrokenPipeError, ConnectionResetError, OSError):

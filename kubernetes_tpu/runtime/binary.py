@@ -56,14 +56,47 @@ def encode_frame(payload: Any) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+def splice_frame(ev_type: str, obj_tlv: bytes) -> bytes:
+    """Build the frame for {"type": ev_type, "object": <obj>} by
+    splicing the object's pre-encoded TLV value verbatim — the store
+    encodes each commit once and every binary watcher reuses the bytes.
+    Valid because a TLV value is self-contained (its class table ids
+    are sequential from the first OBJDEF inside it) and the wrapping
+    dict introduces no classes of its own."""
+    tb = ev_type.encode()
+    head = bytes(
+        [tlv.DICT, 2, tlv.STR, 4]) + b"type" + bytes(
+        [tlv.STR, len(tb)]) + tb + bytes([tlv.STR, 6]) + b"object"
+    body_len = len(MAGIC) + len(head) + len(obj_tlv)
+    return b"".join((_LEN.pack(body_len), MAGIC, head, obj_tlv))
+
+
 def read_frames(fp):
-    """Yield decoded frames from a binary watch stream until EOF."""
+    """Yield decoded frames from a binary watch stream until EOF.
+
+    Reads in large blocks and parses frames out of a local buffer: the
+    underlying stream is http.client's chunked reader, whose per-call
+    bookkeeping would otherwise run twice per frame — measurable at
+    watch-storm rates (tens of thousands of events in a burst). A
+    partial frame at the end of a block just waits for the next read."""
+    buf = b""
+    pos = 0
+    hdr = _LEN.size
     while True:
-        header = fp.read(_LEN.size)
-        if len(header) < _LEN.size:
+        avail = len(buf) - pos
+        if avail >= hdr:
+            (n,) = _LEN.unpack_from(buf, pos)
+            if avail >= hdr + n:
+                body = buf[pos + hdr:pos + hdr + n]
+                pos += hdr + n
+                yield decode(body)
+                continue
+        # compact + refill (read1: return as soon as any data arrives —
+        # a frame must not wait for a full block on a quiet stream)
+        buf = buf[pos:]
+        pos = 0
+        more = (fp.read1(65536) if hasattr(fp, "read1")
+                else fp.read(1))
+        if not more:
             return
-        (n,) = _LEN.unpack(header)
-        body = fp.read(n)
-        if len(body) < n:
-            return
-        yield decode(body)
+        buf += more

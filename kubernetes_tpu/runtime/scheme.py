@@ -17,12 +17,19 @@ from typing import Any, Dict, Optional, Type
 __all__ = ["Scheme", "scheme", "to_camel", "to_snake"]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def to_camel(name: str) -> str:
     parts = name.split("_")
     return parts[0] + "".join(p.title() for p in parts[1:])
 
 
+@functools.lru_cache(maxsize=4096)
 def to_snake(name: str) -> str:
+    """Memoized: the reflective codec and field selectors convert the
+    same few hundred names millions of times under watch storms."""
     out = []
     for ch in name:
         if ch.isupper():

@@ -163,7 +163,19 @@ class SchedulerCache:
         with self._lock:
             state = self._pod_states.get(key)
             if state is not None and key in self._assumed:
-                # confirm: re-add under the authoritative (bound) pod
+                if state.pod.spec.node_name == pod.spec.node_name:
+                    # confirm in place: the bind wrote only node_name +
+                    # PodScheduled condition, so the assumed pod's
+                    # accounting (requests, labels, ports) is already
+                    # exact — flipping the state avoids a full
+                    # remove+re-add (and its two incremental-encoder
+                    # events) per confirmation, which at wave scale was
+                    # most of the watch-ingest cost
+                    self._pod_states[key] = _PodState(pod, None)
+                    self._assumed.discard(key)
+                    return
+                # bound somewhere else than assumed: re-add under the
+                # authoritative (bound) pod
                 self._remove_pod_locked(state.pod)
                 self._add_pod_locked(pod)
                 self._pod_states[key] = _PodState(pod, None)

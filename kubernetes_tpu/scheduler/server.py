@@ -122,14 +122,36 @@ class SchedulerServer:
                     # competing for the interpreter
                     idle = True
                     if n:
-                        deadline = time.time() + 2.0
+                        # short grace: warmup now opens the loop after
+                        # its first (run-path) phase, so the cost of a
+                        # wrong "idle" guess shrank from the whole
+                        # program set to the template-path slice — and
+                        # every 100ms spent waiting here is 100ms the
+                        # cold-start doesn't overlap with pod creation
+                        deadline = time.time() + 0.3
                         while time.time() < deadline:
                             if len(self.factory.pod_queue) > 0:
                                 idle = False
                                 break
-                            time.sleep(0.1)
+                            time.sleep(0.05)
                     if n and idle:
-                        algo.warmup(n)
+                        algo.warmup(n, phase="run")
+
+                        def _scan_phase():
+                            # the scan-path programs only matter for
+                            # heterogeneous backlogs; warm them when the
+                            # queue is idle so they never steal the
+                            # algorithm lock from a real wave
+                            while not self.scheduler.config.stop_everything.is_set():
+                                if len(self.factory.pod_queue) == 0:
+                                    algo.warmup(n, phase="scan")
+                                    return
+                                time.sleep(0.5)
+
+                        threading.Thread(
+                            target=_scan_phase, daemon=True,
+                            name="sched-warmup-scan",
+                        ).start()
                 self._thread = self.scheduler.run()
 
             threading.Thread(

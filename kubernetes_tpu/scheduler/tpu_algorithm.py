@@ -88,13 +88,20 @@ class TPUScheduleAlgorithm:
             rep_idx[i] = r
         return reps, rep_idx
 
-    def warmup(self, num_nodes: int) -> None:
+    def warmup(self, num_nodes: int, phase: str = "all") -> None:
         """Compile the wave programs for an `num_nodes`-sized cluster
         before the first real pod arrives (server.py runs this in the
         background while informers sync): a cold XLA compile on a
         tunneled chip otherwise lands on the first scheduling cycle.
         Uses a synthetic cluster shaped like the common case (label-only
-        pods, unlabeled nodes) so the program shapes match."""
+        pods, unlabeled nodes) so the program shapes match.
+
+        phase "run" warms only the run path (probe+replay+apply — what
+        every template-created backlog hits); phase "scan" warms the
+        heterogeneous-pod scan path. The caller (server.py) runs "run"
+        first and defers "scan" until the daemon is idle, so the loop
+        opens for business after the template-path slice instead of the
+        whole program set."""
         if self._mesh_sched is not None:
             return
         from kubernetes_tpu.api.types import (
@@ -127,13 +134,23 @@ class TPUScheduleAlgorithm:
                 ]),
             )
 
-        # an eligible run (probe+replay+apply programs) and a lone pod
-        # distinct only in its requests (below min_run => the scan
-        # program) — differing by resources keeps every vocab width,
-        # and therefore every compiled shape, identical to the run's
-        backlog = [pod(f"w{i}", "100m") for i in range(max(self._wave.min_run, 2))]
-        backlog.append(pod("w-scan", "200m"))
         state = CS.build(nodes)
+        # an eligible run (probe+replay+apply programs); the lone pods
+        # distinct only in their requests (below min_run => the scan
+        # program) warm in phase "scan" — differing by resources keeps
+        # every vocab width, and therefore every compiled shape,
+        # identical to the run's
+        if phase in ("all", "run"):
+            self._warm_one(
+                [pod(f"w{i}", "100m")
+                 for i in range(max(self._wave.min_run, 2))],
+                state, nodes,
+            )
+        if phase in ("all", "scan"):
+            self._warm_one([pod("w-scan", "200m"),
+                            pod("w-scan2", "300m")], state, nodes)
+
+    def _warm_one(self, backlog, state, nodes) -> None:
         with self._sched_lock:
             saved_last, saved_inc = self._last_node_index, self._inc
             try:

@@ -29,15 +29,41 @@ ERROR = "ERROR"
 
 
 def deep_copy(obj):
-    """Deep copy via pickle: ~3x faster than copy.deepcopy for the
-    dataclass object graphs stored here, and every store write/read
-    makes one (the decode-fresh-bytes-from-etcd illusion). Falls back
-    for anything unpicklable. Shared isolation-copy helper (the
-    apiserver's object-protocol boundary uses it too)."""
+    """Deep copy through the native TLV codec when possible (~2x faster
+    than pickle for the dataclass object graphs stored here — and every
+    store write/read makes one: the decode-fresh-bytes-from-etcd
+    illusion). The TLV round-trip IS a wire round-trip, so tuples come
+    back as lists exactly as they would off real etcd; payloads the wire
+    can't carry fall back to pickle, then copy.deepcopy. Shared
+    isolation-copy helper (the apiserver's object-protocol boundary
+    uses it too)."""
+    c = _tlv_native()
+    if c is not None and type(obj) is not tuple:
+        try:
+            return c.loads(c.dumps(obj))
+        except Exception:
+            pass  # Fallback (exotic payload) or unregistered class
     try:
         return pickle.loads(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL))
     except Exception:
         return copy.deepcopy(obj)
+
+
+_TLV_NATIVE = None
+
+
+def _tlv_native():
+    """The C TLV codec, resolved lazily (imports api.types at first
+    registry build — not something a storage import should force)."""
+    global _TLV_NATIVE
+    if _TLV_NATIVE is None:
+        try:
+            from kubernetes_tpu.runtime import tlv as _t
+
+            _TLV_NATIVE = _t._ktlv if _t._ktlv is not None else False
+        except Exception:
+            _TLV_NATIVE = False
+    return _TLV_NATIVE or None
 
 
 _dc = deep_copy
@@ -76,15 +102,21 @@ class _LazyEvent:
     unpickle: a filtered-out event then costs the fan-out queue put and
     nothing else. They must never be handed to a consumer."""
 
-    __slots__ = ("type", "resource_version", "_blob", "_pair",
+    __slots__ = ("type", "resource_version", "_blob", "_pair", "_codec",
                  "match_object", "match_prev", "wire_cache")
 
-    def __init__(self, ev_type: str, rv: int, blob: bytes,
-                 match_object=None, match_prev=None, wire_cache=None):
+    def __init__(self, ev_type: str, rv: int, blob,
+                 match_object=None, match_prev=None, wire_cache=None,
+                 codec: str = "pickle"):
         self.type = ev_type
         self.resource_version = rv
+        # codec "tlv": blob is (obj_tlv_bytes, prev_tlv_bytes|None) —
+        # two self-contained TLV values, so binary watch frontends can
+        # splice obj_tlv_bytes into the wire verbatim (zero per-watcher
+        # re-encode). codec "pickle": one pickled (obj, prev) pair.
         self._blob = blob
         self._pair = None
+        self._codec = codec
         self.match_object = match_object
         self.match_prev = match_prev
         # per-COMMIT wire-encoding memo ({codec id: wire dict}): one
@@ -94,9 +126,23 @@ class _LazyEvent:
         # watchers never touch it, keeping their object isolation)
         self.wire_cache = wire_cache if wire_cache is not None else {}
 
+    @property
+    def tlv_obj_blob(self):
+        """The object's self-contained TLV bytes, or None (non-TLV
+        payload). Read-only wire splice for binary watch frontends."""
+        return self._blob[0] if self._codec == "tlv" else None
+
     def _unpack(self):
         if self._pair is None:
-            self._pair = pickle.loads(self._blob)
+            if self._codec == "tlv":
+                c = _tlv_native()
+                oblob, pblob = self._blob
+                self._pair = (
+                    c.loads(oblob),
+                    c.loads(pblob) if pblob is not None else None,
+                )
+            else:
+                self._pair = pickle.loads(self._blob)
         return self._pair
 
     @property
@@ -229,22 +275,38 @@ class MemoryStore:
             self._compacted_rv = self._history[drop - 1][1].resource_version
             del self._history[:drop]
         blob = None
+        codec = "pickle"
         wire_cache = {}  # ONE encode memo shared by all watcher copies
         for prefix, stream in list(self._watchers):
             if key.startswith(prefix):
                 if blob is None:
-                    try:
-                        blob = pickle.dumps(
-                            (ev.object, ev.prev_object),
-                            pickle.HIGHEST_PROTOCOL,
-                        )
-                    except Exception:
-                        blob = b""
+                    c = _tlv_native()
+                    if c is not None:
+                        try:
+                            oblob = c.dumps(ev.object)
+                            if ev.prev_object is None:
+                                pblob = None
+                            elif ev.prev_object is ev.object:
+                                pblob = oblob  # DELETED: same object
+                            else:
+                                pblob = c.dumps(ev.prev_object)
+                            blob = (oblob, pblob)
+                            codec = "tlv"
+                        except Exception:
+                            blob = None
+                    if blob is None:
+                        try:
+                            blob = pickle.dumps(
+                                (ev.object, ev.prev_object),
+                                pickle.HIGHEST_PROTOCOL,
+                            )
+                        except Exception:
+                            blob = b""
                 if blob:
                     stream._deliver(
                         _LazyEvent(ev.type, ev.resource_version, blob,
                                    ev.object, ev.prev_object,
-                                   wire_cache=wire_cache)
+                                   wire_cache=wire_cache, codec=codec)
                     )
                 else:  # unpicklable object: fall back to deep copies
                     stream._deliver(
