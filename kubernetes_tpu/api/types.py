@@ -506,9 +506,30 @@ class ServiceSpec:
 
 
 @dataclass
+class LoadBalancerIngress:
+    """types.go LoadBalancerIngress: one point the LB answers on."""
+
+    ip: str = ""
+    hostname: str = ""
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress: List["LoadBalancerIngress"] = field(default_factory=list)
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer: LoadBalancerStatus = field(
+        default_factory=LoadBalancerStatus
+    )
+
+
+@dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
 
 
 @dataclass

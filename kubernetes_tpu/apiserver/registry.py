@@ -99,7 +99,10 @@ def default_resources() -> Dict[str, ResourceInfo]:
         ResourceInfo(
             "nodes", "Node", t.Node, "/minions", namespaced=False, has_status=True
         ),
-        ResourceInfo("services", "Service", t.Service, "/services/specs"),
+        ResourceInfo(
+            "services", "Service", t.Service, "/services/specs",
+            has_status=True,
+        ),
         ResourceInfo("endpoints", "Endpoints", t.Endpoints, "/services/endpoints"),
         ResourceInfo("events", "Event", t.Event, "/events"),
         ResourceInfo(

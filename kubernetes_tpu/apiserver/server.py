@@ -637,6 +637,42 @@ class APIServer:
         )[0]
         return 201, stored if obj_mode else codec.encode(stored)
 
+    def _allocate_node_ports(self, svc) -> None:
+        """registry/service/rest.go + portallocator: NodePort and
+        LoadBalancer services get a cluster-unique port per service port
+        from the 30000-32767 range. Unique node ports are what let a
+        cloud load balancer address one service's traffic on a node
+        (multiple services routinely share spec.ports[].port)."""
+        if getattr(svc.spec, "type", "ClusterIP") not in (
+            "NodePort", "LoadBalancer"
+        ):
+            return
+        used = set()
+        objs, _ = self.store.list("/services/specs")
+        for other in objs:
+            if other.metadata.uid == svc.metadata.uid:
+                continue
+            for p in getattr(other.spec, "ports", ()):
+                if getattr(p, "node_port", 0):
+                    used.add(p.node_port)
+        nxt = 30000
+        for p in svc.spec.ports:
+            if p.node_port:
+                if p.node_port in used:
+                    raise APIError(
+                        422,
+                        f"spec.ports: node port {p.node_port} is "
+                        "already allocated",
+                    )
+                used.add(p.node_port)
+                continue
+            while nxt in used and nxt <= 32767:
+                nxt += 1
+            if nxt > 32767:
+                raise APIError(422, "node port range exhausted")
+            p.node_port = nxt
+            used.add(nxt)
+
     def _create_obj(self, info: ResourceInfo, ns: str, body, codec):
         obj = self._decode_body(info, body, codec)
         if info.namespaced:
@@ -657,6 +693,8 @@ class APIServer:
         prepare_meta(obj)
         if info.prepare:
             info.prepare(obj)
+        if info.resource == "services":
+            self._allocate_node_ports(obj)
         validate_meta(obj, info.namespaced)
         if info.validate:
             info.validate(obj)
@@ -726,6 +764,19 @@ class APIServer:
                 # status never moves through the main resource (pod
                 # strategy PrepareForUpdate copies old status forward)
                 new.status = cur.status
+            if info.resource == "services":
+                # keep allocated node ports across spec updates; a type
+                # flip to NodePort/LoadBalancer allocates fresh ones
+                for p_new in new.spec.ports:
+                    if p_new.node_port:
+                        continue
+                    for p_cur in cur.spec.ports:
+                        if (p_cur.name, p_cur.port) == (
+                            p_new.name, p_new.port
+                        ):
+                            p_new.node_port = p_cur.node_port
+                            break
+                self._allocate_node_ports(new)
         self.admission.admit(adm.UPDATE, info.resource, ns, new)
         self.store.update(key, new, expect_rv=cur_rv if
                           new.metadata.resource_version else None,

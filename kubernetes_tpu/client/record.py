@@ -287,6 +287,11 @@ class EventSink:
                         continue
                     except APIStatusError:
                         pass  # fall through to create
+                    except Exception:
+                        # transport failure on ONE event must not drop
+                        # the rest of the batch (per-event isolation)
+                        log.debug("event patch failed", exc_info=True)
+                        continue
                 fresh.setdefault(ev.metadata.namespace, []).append((key, ev))
                 in_batch[key] = ev
             for ns, pairs in fresh.items():
@@ -294,7 +299,9 @@ class EventSink:
                 batch = [ev for _k, ev in pairs]
                 try:
                     results = events.create_many(batch)
-                except (APIStatusError, AttributeError):
+                except Exception:
+                    # bulk endpoint absent or down: per-event fallback
+                    # with per-event isolation
                     results = None
                     for key, ev in pairs:
                         try:
@@ -302,7 +309,7 @@ class EventSink:
                             self._remember(
                                 key, (ev.metadata.name, ev.count or 1)
                             )
-                        except APIStatusError:
+                        except Exception:
                             log.debug("event create failed", exc_info=True)
                 if results is not None:
                     for (key, ev), res in zip(pairs, results):

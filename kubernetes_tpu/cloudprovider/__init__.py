@@ -14,10 +14,12 @@ from kubernetes_tpu.cloudprovider.cloud import (
     get_cloud_provider,
     register_cloud_provider,
 )
+from kubernetes_tpu.cloudprovider.local import LocalCloud
 
 __all__ = [
     "CloudProvider",
     "FakeCloud",
+    "LocalCloud",
     "LoadBalancer",
     "Route",
     "Zone",

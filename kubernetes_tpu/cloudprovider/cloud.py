@@ -138,7 +138,12 @@ class FakeCloud(CloudProvider):
         self._call("ensure-lb")
         lb = LoadBalancer(
             name=name, region=region, external_ip="1.2.3.4",
-            ports=tuple(ports), hosts=tuple(hosts),
+            # ports arrive as ints or ServicePort-shaped objects (the
+            # reference's CreateTCPLoadBalancer takes []*api.ServicePort)
+            ports=tuple(
+                p if isinstance(p, int) else p.port for p in ports
+            ),
+            hosts=tuple(hosts),
         )
         self.balancers[(name, region)] = lb
         return lb

@@ -54,16 +54,38 @@ class ServiceController(PeriodicRunner):
             if svc.spec.type != "LoadBalancer":
                 continue
             seen.add(key)
-            ports = tuple(p.port for p in svc.spec.ports)
+            port_nums = tuple(p.port for p in svc.spec.ports)
             existing = self.cloud.get_tcp_load_balancer(self._lb_name(svc), region)
             if (
                 existing is None
-                or existing.ports != ports
+                or existing.ports != port_nums
                 or existing.hosts != hosts
             ):
-                self.cloud.ensure_tcp_load_balancer(
-                    self._lb_name(svc), region, ports, hosts
+                # the reference's CreateTCPLoadBalancer takes the
+                # []*api.ServicePort themselves (node ports included)
+                lb = self.cloud.ensure_tcp_load_balancer(
+                    self._lb_name(svc), region, tuple(svc.spec.ports), hosts
                 )
+            else:
+                lb = existing
+            # persist the balancer's address in service status
+            # (servicecontroller.go persistUpdate of
+            # status.loadBalancer.ingress) — re-checked EVERY sync so a
+            # lost write (Conflict) or wiped status self-repairs
+            have = [i.ip for i in svc.status.load_balancer.ingress]
+            if have != [lb.external_ip]:
+                try:
+                    cur = self.client.resource(
+                        "services", svc.metadata.namespace
+                    ).get(svc.metadata.name)
+                    cur.status.load_balancer = t.LoadBalancerStatus(
+                        ingress=[t.LoadBalancerIngress(ip=lb.external_ip)]
+                    )
+                    self.client.resource(
+                        "services", svc.metadata.namespace
+                    ).update_status(cur)
+                except Exception:
+                    pass  # retried next sync (the have-check re-fires)
             self._owned[key] = self._lb_name(svc)
         # tear down balancers for deleted / retyped services
         for key, name in list(self._owned.items()):

@@ -155,7 +155,20 @@ def run_local_up(args) -> None:
     host, port = server.serve_http(port=args.port)
     client = _client(f"http://{host}:{port}")
     cluster = HollowCluster(client, args.nodes).run()
-    mgr = ControllerManager(client).start()
+    # the "local" cloud: each hollow node gets a live userspace proxy
+    # and the provider's LoadBalancer fronts them, so `kubectl expose
+    # --type=LoadBalancer` provisions a balancer that forwards bytes
+    from kubernetes_tpu.cloudprovider import LocalCloud
+    from kubernetes_tpu.proxy.userspace import UserspaceProxier
+
+    cloud = LocalCloud()
+    proxiers = []
+    for i in range(args.nodes):
+        node_name = f"hollow-node-{i:04d}"
+        proxier = UserspaceProxier(client, node_name=node_name).run()
+        proxiers.append(proxier)
+        cloud.register_node(node_name, proxier)
+    mgr = ControllerManager(client, cloud=cloud).start()
     sched = SchedulerServer(
         client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
     ).start()
@@ -176,6 +189,8 @@ def run_local_up(args) -> None:
     dns.stop()
     sched.stop()
     mgr.stop()
+    for proxier in proxiers:
+        proxier.stop()
     cluster.stop()
 
 

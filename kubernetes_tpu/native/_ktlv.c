@@ -85,9 +85,14 @@ static inline int buf_varint(Buf *w, uint64_t n) {
 
 /* ---- encode -------------------------------------------------------- */
 
-static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth);
+/* strict=1: tuples raise Fallback instead of encoding as LIST.  The
+ * store's deep-copy path needs round-trip fidelity (pickle keeps
+ * tuples, so the fast path must not silently listify what the
+ * fallback would preserve); the wire path keeps tuple->LIST. */
+static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth, int strict);
 
-static int enc_obj(Buf *w, PyObject *v, PyObject *ctab, int depth) {
+static int enc_obj(Buf *w, PyObject *v, PyObject *ctab, int depth,
+                   int strict) {
     PyTypeObject *tp = Py_TYPE(v);
     PyObject *cid = PyDict_GetItemWithError(ctab, (PyObject *)tp);
     PyObject *ftup;
@@ -146,7 +151,7 @@ static int enc_obj(Buf *w, PyObject *v, PyObject *ctab, int depth) {
         PyObject *fv = PyDict_GetItemWithError(
             dict, PyTuple_GET_ITEM(ftup, k));
         if (!fv && PyErr_Occurred()) { Py_DECREF(dict); return -1; }
-        if (enc(w, fv ? fv : Py_None, ctab, depth + 1) < 0) {
+        if (enc(w, fv ? fv : Py_None, ctab, depth + 1, strict) < 0) {
             Py_DECREF(dict);
             return -1;
         }
@@ -155,7 +160,7 @@ static int enc_obj(Buf *w, PyObject *v, PyObject *ctab, int depth) {
     return 0;
 }
 
-static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth) {
+static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth, int strict) {
     /* ordered by wire frequency: str and None dominate API objects */
     if (PyUnicode_CheckExact(v)) {
         Py_ssize_t k;
@@ -173,8 +178,8 @@ static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth) {
         PyObject *key, *val;
         Py_ssize_t pos = 0;
         while (PyDict_Next(v, &pos, &key, &val)) {
-            if (enc(w, key, ctab, depth + 1) < 0) return -1;
-            if (enc(w, val, ctab, depth + 1) < 0) return -1;
+            if (enc(w, key, ctab, depth + 1, strict) < 0) return -1;
+            if (enc(w, val, ctab, depth + 1, strict) < 0) return -1;
         }
         return 0;
     }
@@ -183,16 +188,17 @@ static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth) {
         if (buf_byte(w, T_LIST) < 0) return -1;
         if (buf_varint(w, (uint64_t)n) < 0) return -1;
         for (Py_ssize_t k = 0; k < n; k++)
-            if (enc(w, PyList_GET_ITEM(v, k), ctab, depth + 1) < 0)
+            if (enc(w, PyList_GET_ITEM(v, k), ctab, depth + 1, strict) < 0)
                 return -1;
         return 0;
     }
     if (PyTuple_CheckExact(v)) {
+        if (strict) return err_fallback(); /* pickle keeps tuples */
         Py_ssize_t n = PyTuple_GET_SIZE(v);
         if (buf_byte(w, T_LIST) < 0) return -1;
         if (buf_varint(w, (uint64_t)n) < 0) return -1;
         for (Py_ssize_t k = 0; k < n; k++)
-            if (enc(w, PyTuple_GET_ITEM(v, k), ctab, depth + 1) < 0)
+            if (enc(w, PyTuple_GET_ITEM(v, k), ctab, depth + 1, strict) < 0)
                 return -1;
         return 0;
     }
@@ -228,7 +234,7 @@ static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth) {
         (!PyErr_Occurred() &&
          PyObject_HasAttrString((PyObject *)Py_TYPE(v),
                                 "__dataclass_fields__")))
-        return enc_obj(w, v, ctab, depth);
+        return enc_obj(w, v, ctab, depth, strict);
     if (PyErr_Occurred()) return -1;
     /* subclasses of bool/int/float, numpy scalars, and genuinely
      * un-encodable types: let the Python authority decide */
@@ -241,12 +247,12 @@ static int check_setup(void) {
     return -1;
 }
 
-static PyObject *ktlv_dumps(PyObject *self, PyObject *arg) {
+static PyObject *dumps_common(PyObject *arg, int strict) {
     if (check_setup() < 0) return NULL;
     Buf w = {0};
     PyObject *ctab = PyDict_New();
     if (!ctab) return NULL;
-    if (enc(&w, arg, ctab, 0) < 0) {
+    if (enc(&w, arg, ctab, 0, strict) < 0) {
         Py_DECREF(ctab);
         PyMem_Free(w.buf);
         return NULL;
@@ -255,6 +261,14 @@ static PyObject *ktlv_dumps(PyObject *self, PyObject *arg) {
     PyObject *out = PyBytes_FromStringAndSize(w.buf, w.len);
     PyMem_Free(w.buf);
     return out;
+}
+
+static PyObject *ktlv_dumps(PyObject *self, PyObject *arg) {
+    return dumps_common(arg, 0);
+}
+
+static PyObject *ktlv_dumps_strict(PyObject *self, PyObject *arg) {
+    return dumps_common(arg, 1);
 }
 
 /* ---- decode -------------------------------------------------------- */
@@ -524,6 +538,8 @@ static PyMethodDef ktlv_methods[] = {
     {"setup", ktlv_setup, METH_VARARGS,
      "setup(TLVError, fields_dict, fields_of, resolve_class)"},
     {"dumps", ktlv_dumps, METH_O, "encode one value to TLV bytes"},
+    {"dumps_strict", ktlv_dumps_strict, METH_O,
+     "encode, raising Fallback on tuples (round-trip fidelity paths)"},
     {"loads", ktlv_loads, METH_O, "decode one TLV value"},
     {NULL, NULL, 0, NULL}
 };

@@ -43,6 +43,9 @@ class Rule:
     protocol: str
     endpoints: Tuple[Tuple[str, int], ...]  # (ip, port)
     session_affinity: str = "None"
+    # cluster-unique port for NodePort/LoadBalancer services (the
+    # KUBE-NODEPORTS chain key; how a cloud LB addresses one service)
+    node_port: int = 0
 
 
 class RoundRobinLoadBalancer:
@@ -157,6 +160,7 @@ class Proxier:
                         protocol=sp.protocol,
                         endpoints=tuple(sorted(endpoints)),
                         session_affinity=svc.spec.session_affinity,
+                        node_port=sp.node_port,
                     )
             self.rules = new_rules
             self.syncs += 1

@@ -32,17 +32,18 @@ def deep_copy(obj):
     """Deep copy through the native TLV codec when possible (~2x faster
     than pickle for the dataclass object graphs stored here — and every
     store write/read makes one: the decode-fresh-bytes-from-etcd
-    illusion). The TLV round-trip IS a wire round-trip, so tuples come
-    back as lists exactly as they would off real etcd; payloads the wire
-    can't carry fall back to pickle, then copy.deepcopy. Shared
-    isolation-copy helper (the apiserver's object-protocol boundary
-    uses it too)."""
+    illusion). Uses the STRICT encoder, which punts tuple-containing
+    graphs to pickle, so copies are full-fidelity regardless of whether
+    the C extension built (the wire dispatcher, not this helper, owns
+    tuple->list normalization). Payloads the wire can't carry fall back
+    to pickle, then copy.deepcopy. Shared isolation-copy helper (the
+    apiserver's object-protocol boundary uses it too)."""
     c = _tlv_native()
-    if c is not None and type(obj) is not tuple:
+    if c is not None:
         try:
-            return c.loads(c.dumps(obj))
+            return c.loads(c.dumps_strict(obj))
         except Exception:
-            pass  # Fallback (exotic payload) or unregistered class
+            pass  # Fallback (tuples, exotic payload) or unknown class
     try:
         return pickle.loads(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL))
     except Exception:
@@ -283,13 +284,15 @@ class MemoryStore:
                     c = _tlv_native()
                     if c is not None:
                         try:
-                            oblob = c.dumps(ev.object)
+                            # strict: obj_mode watchers get the same
+                            # fidelity the pickle path would give
+                            oblob = c.dumps_strict(ev.object)
                             if ev.prev_object is None:
                                 pblob = None
                             elif ev.prev_object is ev.object:
                                 pblob = oblob  # DELETED: same object
                             else:
-                                pblob = c.dumps(ev.prev_object)
+                                pblob = c.dumps_strict(ev.prev_object)
                             blob = (oblob, pblob)
                             codec = "tlv"
                         except Exception:

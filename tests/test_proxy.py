@@ -39,7 +39,7 @@ def plane():
     proxier.stop()
 
 
-def _mk_service(client, affinity="None"):
+def _mk_service(client, affinity="None", port=80):
     client.resource("services", "default").create(
         Service(
             metadata=ObjectMeta(name="web"),
@@ -47,10 +47,23 @@ def _mk_service(client, affinity="None"):
                 selector={"app": "web"},
                 cluster_ip="10.0.0.10",
                 session_affinity=affinity,
-                ports=[ServicePort(name="http", port=80, target_port=8080)],
+                ports=[ServicePort(name="http", port=port, target_port=8080)],
             ),
         )
     )
+
+
+def _free_port():
+    """A fresh port per dataplane test: sequential tests reusing one
+    service port trip over TIME_WAIT leftovers from the previous
+    test's connections."""
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _mk_endpoints(client, ips):
@@ -127,3 +140,216 @@ def test_service_delete_drops_rules(plane):
     assert wait_until(lambda: len(proxier.rules) == 0)
     with pytest.raises(LookupError):
         proxier.route("default", "web", "http")
+
+
+# -- the userspace dataplane (pkg/proxy/userspace/proxier.go) ----------------
+
+
+import socket
+import socketserver
+import threading
+
+from kubernetes_tpu.proxy.userspace import UserspaceProxier
+
+
+class _Echo(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            data = self.request.recv(4096)
+            if not data:
+                return
+            self.request.sendall(b"%s:%s" % (
+                self.server.tag.encode(), data))
+
+
+def _backend(tag):
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Echo)
+    srv.daemon_threads = True
+    srv.tag = tag
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _mk_endpoints_ports(client, backends):
+    """One subset per backend so each address can carry its own port
+    (real local listeners sit on distinct ephemeral ports)."""
+    eps = Endpoints(
+        metadata=ObjectMeta(name="web"),
+        subsets=[
+            EndpointSubset(
+                addresses=[EndpointAddress(ip=ip)],
+                ports=[EndpointPort(name="http", port=port)],
+            )
+            for ip, port in backends
+        ],
+    )
+    rc = client.resource("endpoints", "default")
+    try:
+        cur = rc.get("web")
+        cur.subsets = eps.subsets
+        rc.update(cur)
+    except Exception:
+        rc.create(eps)
+
+
+
+
+def _ready(proxier, n_eps=1):
+    """Listener exists AND its rule has endpoints (the service event can
+    land a beat before the endpoints event)."""
+    addr = proxier.proxy_addr("default", "web", "http")
+    if addr is None:
+        return False
+    return any(
+        spn.name == "web" and len(r.endpoints) >= n_eps
+        for spn, r in proxier.rules.items()
+    )
+
+
+@pytest.fixture()
+def dataplane():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    proxier = UserspaceProxier(client, node_name="n1").run()
+    backends = [_backend("a"), _backend("b")]
+    yield server, client, proxier, backends
+    proxier.stop()
+    for b in backends:
+        b.shutdown()
+        b.server_close()
+
+
+def _call(addr, payload=b"ping"):
+    with socket.create_connection(addr, timeout=5) as s:
+        s.sendall(payload)
+        return s.recv(4096)
+
+
+def test_bytes_flow_client_vip_backend(dataplane):
+    server, client, proxier, backends = dataplane
+    _mk_service(client, port=_free_port())
+    _mk_endpoints_ports(
+        client, [("127.0.0.1", b.server_address[1]) for b in backends]
+    )
+    assert wait_until(lambda: _ready(proxier, 2))
+    addr = proxier.proxy_addr("default", "web", "http")
+    # the proxy claims the service's own port when free
+    # (no NAT layer to translate), else an ephemeral one
+    got = {_call(addr), _call(addr), _call(addr), _call(addr)}
+    # real bytes flowed and round-robin hit both backends
+    assert got == {b"a:ping", b"b:ping"}
+
+
+def test_session_affinity_pins_backend(dataplane):
+    server, client, proxier, backends = dataplane
+    _mk_service(client, affinity="ClientIP", port=_free_port())
+    _mk_endpoints_ports(
+        client, [("127.0.0.1", b.server_address[1]) for b in backends]
+    )
+    assert wait_until(lambda: _ready(proxier, 2))
+    addr = proxier.proxy_addr("default", "web", "http")
+    got = {_call(addr) for _ in range(4)}
+    assert len(got) == 1  # same client ip -> same endpoint every time
+
+
+def test_endpoint_update_reroutes_live(dataplane):
+    server, client, proxier, backends = dataplane
+    _mk_service(client, port=_free_port())
+    _mk_endpoints_ports(
+        client, [("127.0.0.1", backends[0].server_address[1])]
+    )
+    assert wait_until(lambda: _ready(proxier))
+    addr = proxier.proxy_addr("default", "web", "http")
+    assert _call(addr) == b"a:ping"
+    # endpoints change from watch: new connections reach the new backend
+    _mk_endpoints_ports(
+        client, [("127.0.0.1", backends[1].server_address[1])]
+    )
+    assert wait_until(lambda: any(
+        r.endpoints == (("127.0.0.1", backends[1].server_address[1]),)
+        for r in proxier.rules.values()
+    ))
+    assert _call(addr) == b"b:ping"
+
+
+def test_no_endpoints_refuses_cleanly(dataplane):
+    server, client, proxier, backends = dataplane
+    _mk_service(client, port=_free_port())
+    _mk_endpoints_ports(client, [])
+    assert wait_until(
+        lambda: proxier.proxy_addr("default", "web", "http") is not None
+    )
+    addr = proxier.proxy_addr("default", "web", "http")
+    with socket.create_connection(addr, timeout=5) as s:
+        s.sendall(b"ping")
+        try:
+            assert s.recv(4096) == b""  # dropped like a REJECT
+        except ConnectionResetError:
+            pass  # RST is the other honest REJECT shape
+
+
+def test_service_delete_closes_listener(dataplane):
+    server, client, proxier, backends = dataplane
+    _mk_service(client, port=_free_port())
+    _mk_endpoints_ports(
+        client, [("127.0.0.1", backends[0].server_address[1])]
+    )
+    assert wait_until(lambda: _ready(proxier))
+    addr = proxier.proxy_addr("default", "web", "http")
+    client.resource("services", "default").delete("web")
+    assert wait_until(
+        lambda: proxier.proxy_addr("default", "web", "http") is None
+    )
+    with pytest.raises(OSError):
+        _call(addr)
+
+
+def test_udp_echo_through_proxy():
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    proxier = UserspaceProxier(client, udp_idle_timeout=0.25).run()
+    try:
+        usock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        usock.bind(("127.0.0.1", 0))
+
+        def udp_echo():
+            while True:
+                try:
+                    data, addr = usock.recvfrom(4096)
+                except OSError:
+                    return
+                usock.sendto(b"u:" + data, addr)
+
+        threading.Thread(target=udp_echo, daemon=True).start()
+        client.resource("services", "default").create(
+            Service(
+                metadata=ObjectMeta(name="dns"),
+                spec=ServiceSpec(
+                    cluster_ip="10.0.0.53",
+                    ports=[ServicePort(name="dns", port=10053,
+                                       protocol="UDP")],
+                ),
+            )
+        )
+        eps = Endpoints(
+            metadata=ObjectMeta(name="dns"),
+            subsets=[EndpointSubset(
+                addresses=[EndpointAddress(ip="127.0.0.1")],
+                ports=[EndpointPort(name="dns", port=usock.getsockname()[1],
+                                    protocol="UDP")],
+            )],
+        )
+        client.resource("endpoints", "default").create(eps)
+        assert wait_until(
+            lambda: proxier.proxy_addr("default", "dns", "dns") is not None
+        )
+        addr = proxier.proxy_addr("default", "dns", "dns")
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        c.settimeout(5)
+        c.sendto(b"hello", addr)
+        data, _ = c.recvfrom(4096)
+        assert data == b"u:hello"
+        c.close()
+        usock.close()
+    finally:
+        proxier.stop()
