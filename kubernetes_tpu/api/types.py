@@ -74,6 +74,10 @@ class Probe:
     period_seconds: int = 10
     failure_threshold: int = 3
     success_threshold: int = 1
+    # ExecAction.Command (types.go): a real runtime runs this in the
+    # container and the exit code is the verdict; empty means the
+    # injected prober seam decides (hollow nodes)
+    exec_command: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -450,6 +454,9 @@ class NodeStatus:
     # status.daemonEndpoints.kubeletEndpoint.Port flattened: where this
     # node's kubelet API (logs/exec/stats) listens; 0 = not serving
     kubelet_port: int = 0
+    # True when the node API serves TLS (the reference's :10250 is
+    # always https; here the scheme is explicit so clients dial right)
+    kubelet_https: bool = False
     # attach/detach controller state (NodeStatus.VolumesAttached /
     # VolumesInUse): devices the controller attached to this node and
     # devices the kubelet reports mounted

@@ -74,6 +74,25 @@ class _StoppableEvents:
         self._wr.stop()
 
 
+def build_ssl_context(tls_ca: str = "", insecure: bool = False):
+    """The one client TLS policy (kubeconfig idioms), shared by the
+    apiserver transport and the kubelet node-API dialers:
+    certificate-authority pins the CA and KEEPS hostname verification
+    (anything signed by the CA for a different host must still be
+    rejected); insecure-skip-tls-verify disables both; default is the
+    system trust store."""
+    import ssl
+
+    if tls_ca:
+        return ssl.create_default_context(cafile=tls_ca)
+    if insecure:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    return ssl.create_default_context()
+
+
 class HTTPTransport:
     """Minimal stdlib HTTP(S) transport (chunked watch streaming).
 
@@ -97,17 +116,7 @@ class HTTPTransport:
         self.object_protocol = binary
         self._ssl_ctx = None
         if base_url.startswith("https"):
-            import ssl
-
-            if tls_ca:
-                # the kubeconfig certificate-authority idiom: pin the CA
-                # and KEEP hostname verification (anything signed by the
-                # CA for a different host must still be rejected)
-                self._ssl_ctx = ssl.create_default_context(cafile=tls_ca)
-            elif insecure:
-                self._ssl_ctx = ssl._create_unverified_context()
-            else:
-                self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx = build_ssl_context(tls_ca, insecure)
 
     def _url(self, path: str, query: Optional[Dict[str, str]]) -> str:
         url = self.base_url + path

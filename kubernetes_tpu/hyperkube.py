@@ -98,16 +98,44 @@ def run_controller_manager(args) -> None:
 
 
 def run_kubelet(args) -> None:
-    from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
+    from kubernetes_tpu.kubelet import (
+        FakeRuntime,
+        Kubelet,
+        KubeletConfig,
+        ProcessRuntime,
+    )
 
+    # a standalone kubelet daemon runs REAL processes as containers
+    # (docker_manager.go's role); --fake-runtime keeps the hollow seam
+    runtime = FakeRuntime() if args.fake_runtime else ProcessRuntime()
+    if (args.serve_api and not args.fake_runtime
+            and not args.auth_token):
+        print(
+            "refusing: --serve-api with the process runtime and no "
+            "--auth-token would expose unauthenticated /exec (remote "
+            "code execution); pass --auth-token (and ideally "
+            "--tls-cert-file/--tls-private-key-file)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     kl = Kubelet(
         _client_from(args),
-        KubeletConfig(node_name=args.node, serve_api=args.serve_api),
-        FakeRuntime() if args.fake_runtime else None,
+        KubeletConfig(
+            node_name=args.node,
+            serve_api=args.serve_api,
+            api_tls_cert=args.tls_cert_file,
+            api_tls_key=args.tls_private_key_file,
+            api_auth_token=args.auth_token,
+        ),
+        runtime,
     ).run()
-    print(f"kubelet {args.node} running", flush=True)
+    print(f"kubelet {args.node} running "
+          f"({'fake' if args.fake_runtime else 'process'} runtime)",
+          flush=True)
     _wait_forever()
     kl.stop()
+    if isinstance(runtime, ProcessRuntime):
+        runtime.close()
 
 
 def run_proxy(args) -> None:
@@ -235,11 +263,24 @@ def main(argv=None):
     p = sub.add_parser("kubelet")
     add_client_flags(p)
     p.add_argument("--node", required=True)
-    p.add_argument("--fake-runtime", action="store_true", default=True)
+    p.add_argument(
+        "--fake-runtime", action=argparse.BooleanOptionalAction,
+        default=False,
+        help="hollow-node mode: instant in-memory containers instead of "
+        "real processes",
+    )
     p.add_argument(
         "--serve-api", action="store_true",
         help="serve the node API (logs/exec/stats) and register its "
         "endpoint on the Node status",
+    )
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve the node API over TLS")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument(
+        "--auth-token", default="",
+        help="require `Authorization: Bearer <token>` on the node API "
+        "(an open /exec on a process runtime is remote code execution)",
     )
 
     p = sub.add_parser("extender")

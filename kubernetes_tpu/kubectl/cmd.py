@@ -76,9 +76,19 @@ class Kubectl:
     """All verbs as methods returning output strings (testable without a
     process boundary; main() is the argv shell)."""
 
-    def __init__(self, client: RESTClient, namespace: str = "default"):
+    def __init__(self, client: RESTClient, namespace: str = "default",
+                 node_token: str = "", node_tls_ca: str = "",
+                 node_insecure: bool = False):
         self.client = client
         self.namespace = namespace
+        # node-API credentials (kubelet TLS + bearer authn): the
+        # reference proxies node endpoints through the apiserver; here
+        # kubectl dials the kubelet directly, so it carries the token
+        # and trust anchor itself
+        self.node_token = node_token
+        self.node_tls_ca = node_tls_ca
+        self.node_insecure = node_insecure
+        self._node_ssl_ctx = None
 
     def _rc(self, resource: str, all_namespaces: bool = False):
         return self.client.resource(
@@ -404,7 +414,32 @@ class Kubectl:
              if a.type == "InternalIP"),
             "127.0.0.1",
         )
-        return f"http://{host}:{port}"
+        scheme = "https" if getattr(
+            node.status, "kubelet_https", False
+        ) else "http"
+        return f"{scheme}://{host}:{port}"
+
+    def _kubelet_open(self, url, timeout: float = 10, data=None,
+                      method: str = ""):
+        """urlopen with the node-API credentials attached (bearer token
+        + the shared client TLS policy, context cached per Kubectl)."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, data=data, method=method or None
+        )
+        if self.node_token:
+            req.add_header("Authorization", f"Bearer {self.node_token}")
+        ctx = None
+        if url.startswith("https"):
+            ctx = self._node_ssl_ctx
+            if ctx is None:
+                from kubernetes_tpu.client.transport import build_ssl_context
+
+                ctx = self._node_ssl_ctx = build_ssl_context(
+                    self.node_tls_ca, self.node_insecure
+                )
+        return urllib.request.urlopen(req, timeout=timeout, context=ctx)
 
     def logs(self, name: str, container: str = "", tail: int = 0) -> str:
         """kubectl logs (cmd/logs.go): fetch container logs through the
@@ -423,7 +458,7 @@ class Kubectl:
         )
         if tail:
             url += f"?tailLines={tail}"
-        with urllib.request.urlopen(url, timeout=10) as r:
+        with self._kubelet_open(url, timeout=10) as r:
             return r.read().decode()
 
     def exec(self, name: str, command: Sequence[str],
@@ -446,8 +481,8 @@ class Kubectl:
             f"{self._kubelet_base(pod)}/exec/"
             f"{pod.metadata.namespace}/{pod.metadata.name}/{container}?{q}"
         )
-        req = urllib.request.Request(url, data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=10) as r:
+        with self._kubelet_open(url, timeout=10, data=b"",
+                                method="POST") as r:
             return r.read().decode()
 
     def attach(self, name: str, container: str = "",
@@ -470,7 +505,7 @@ class Kubectl:
         out = []
         deadline = time.monotonic() + timeout
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as r:
+            with self._kubelet_open(url, timeout=timeout) as r:
                 while time.monotonic() < deadline:
                     chunk = r.read1(65536)
                     if not chunk:
@@ -717,9 +752,12 @@ class Kubectl:
                 (a.address for a in n.status.addresses
                  if a.type == "InternalIP"), "127.0.0.1",
             )
+            scheme_str = "https" if getattr(
+                n.status, "kubelet_https", False
+            ) else "http"
             try:
-                with urllib.request.urlopen(
-                    f"http://{host}:{port}/stats/summary", timeout=5
+                with self._kubelet_open(
+                    f"{scheme_str}://{host}:{port}/stats/summary", timeout=5
                 ) as r:
                     stats[n.metadata.name] = json.loads(r.read())
             except OSError:
@@ -1028,6 +1066,14 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     parser.add_argument("--token", default="",
                         help="bearer token (e.g. a service-account JWT)")
     parser.add_argument("--namespace", "-n", default="default")
+    # node-API credentials (kubelet TLS + bearer authn — logs/exec/top
+    # dial the kubelet directly, so they carry their own trust)
+    parser.add_argument("--node-token", default="",
+                        help="bearer token for the kubelet node API")
+    parser.add_argument("--node-certificate-authority", default="",
+                        help="CA file pinning a TLS kubelet node API")
+    parser.add_argument("--node-insecure-skip-tls-verify",
+                        action="store_true")
     sub = parser.add_subparsers(dest="verb", required=True)
 
     p = sub.add_parser("get")
@@ -1152,7 +1198,12 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
             insecure=args.insecure_skip_tls_verify,
             bearer_token=args.token,
         ))
-    k = Kubectl(client, args.namespace)
+    k = Kubectl(
+        client, args.namespace,
+        node_token=getattr(args, "node_token", ""),
+        node_tls_ca=getattr(args, "node_certificate_authority", ""),
+        node_insecure=getattr(args, "node_insecure_skip_tls_verify", False),
+    )
 
     if args.verb == "get":
         out = k.get(args.resource, args.name, args.selector, args.output,

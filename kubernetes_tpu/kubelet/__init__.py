@@ -18,5 +18,12 @@ hollow-node.go:102-120) "runs" pods instantly in memory, which makes a
 
 from kubernetes_tpu.kubelet.kubelet import Kubelet, KubeletConfig
 from kubernetes_tpu.kubelet.runtime import FakeRuntime, ContainerRuntime
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 
-__all__ = ["Kubelet", "KubeletConfig", "FakeRuntime", "ContainerRuntime"]
+__all__ = [
+    "Kubelet",
+    "KubeletConfig",
+    "FakeRuntime",
+    "ContainerRuntime",
+    "ProcessRuntime",
+]

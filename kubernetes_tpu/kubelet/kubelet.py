@@ -57,6 +57,12 @@ class KubeletConfig:
     # status so kubectl logs/exec can resolve it
     serve_api: bool = False
     api_host: str = "127.0.0.1"
+    # node API hardening (server.go TLS-by-default + authn): with a
+    # runtime that runs real processes, an open /exec is remote code
+    # execution — gate it the moment the substrate is live
+    api_tls_cert: str = ""
+    api_tls_key: str = ""
+    api_auth_token: str = ""
     # image manager (pkg/kubelet/image_manager.go): disk capacity the
     # LRU garbage collector budgets against
     image_capacity_bytes: int = 20 * 1024 ** 3
@@ -112,6 +118,12 @@ class Kubelet:
         self.recorder = recorder
         self.status_manager = StatusManager(client)
         self.pleg = PLEG(self.runtime, config.pleg_relist_period)
+        if prober is None and hasattr(self.runtime, "exec_probe"):
+            # a live runtime probes for real (exec in the container);
+            # fakes keep the injected-result seam
+            from kubernetes_tpu.kubelet.prober import RuntimeProber
+
+            prober = RuntimeProber(self.runtime)
         self.probe_manager = ProbeManager(
             runner=prober,
             on_liveness_failure=self._handle_liveness_failure,
@@ -210,6 +222,11 @@ class Kubelet:
                 t.NodeAddress("InternalIP", self._api_addr[0])
             ]
             status.kubelet_port = self._api_addr[1]
+            # TLS only engages when BOTH halves are present (server.py
+            # serve(): `if tls_cert and tls_key`) — advertise exactly that
+            status.kubelet_https = bool(
+                self.config.api_tls_cert and self.config.api_tls_key
+            )
 
     def register_node(self) -> None:
         """kubelet.go registerWithApiserver."""
@@ -505,7 +522,12 @@ class Kubelet:
             from kubernetes_tpu.kubelet.server import KubeletServer
 
             self.api_server = KubeletServer(self)
-            self._api_addr = self.api_server.serve(host=self.config.api_host)
+            self._api_addr = self.api_server.serve(
+                host=self.config.api_host,
+                tls_cert=self.config.api_tls_cert,
+                tls_key=self.config.api_tls_key,
+                auth_token=self.config.api_auth_token,
+            )
         if self.config.register_node:
             self.register_node()
         self._informer.run()

@@ -57,6 +57,25 @@ class FakeProber:
             )
 
 
+class RuntimeProber:
+    """Probe against a live runtime: exec probes run their command in
+    the container and the exit code is the verdict (prober.go runProbe
+    -> ExecInContainer). Probes without a concrete action succeed, the
+    reference's missing-handler behavior."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    def __call__(self, pod: t.Pod, container: str, probe: t.Probe,
+                 kind: str = "") -> bool:
+        cmd = getattr(probe, "exec_command", None)
+        if probe.handler == "exec" and cmd:
+            return self.runtime.exec_probe(
+                pod.metadata.uid, container, cmd
+            )
+        return True
+
+
 class _Worker:
     """prober/worker.go: the per-(container, kind) probe loop."""
 

@@ -61,7 +61,16 @@ class KubeletServer:
         self.kubelet = kubelet
         self._server = None
 
-    def serve(self, host: str = "127.0.0.1", port: int = 0):
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              tls_cert: str = "", tls_key: str = "",
+              auth_token: str = ""):
+        """tls_cert/tls_key serve HTTPS (the reference's :10250 is TLS
+        by default, kubelet/server.go ListenAndServeKubeletServer);
+        auth_token demands `Authorization: Bearer <token>` on every
+        endpoint except /healthz (the webhook/x509 kubelet authn gate,
+        server.go AuthFilter). Unauthenticated exec/logs on a runtime
+        that runs REAL processes is remote code execution — the gate
+        lands with the ProcessRuntime."""
         kl = self.kubelet
 
         def find_pod(ns: str, name: str):
@@ -89,7 +98,22 @@ class KubeletServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _authorized(self) -> bool:
+                if not auth_token:
+                    return True
+                parsed = urlparse(self.path)
+                if parsed.path == "/healthz":
+                    return True  # liveness stays probeable (reference
+                    # serves healthz on the read-only port)
+                got = self.headers.get("Authorization", "")
+                if got == f"Bearer {auth_token}":
+                    return True
+                self._send(401, {"message": "Unauthorized"})
+                return False
+
             def do_GET(self):
+                if not self._authorized():
+                    return
                 try:
                     self._get(urlparse(self.path))
                 except ValueError as e:
@@ -174,6 +198,8 @@ class KubeletServer:
                 self._send(404, {"message": f"unknown path {parsed.path}"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 try:
                     self._post(urlparse(self.path))
                 except ValueError as e:
@@ -248,6 +274,16 @@ class KubeletServer:
             allow_reuse_address = True
 
         self._server = Server((host, port), Handler)
+        if tls_cert and tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            # lazy handshake: a silent client must not wedge accept()
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         threading.Thread(
             target=self._server.serve_forever,
             name=f"kubelet-server-{kl.config.node_name}",
