@@ -694,6 +694,42 @@ class Job:
     status: JobStatus = field(default_factory=JobStatus)
 
 
+# --- ScheduledJob (batch/types.go:185-247, the CronJob ancestor) ------------
+
+
+@dataclass
+class JobTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+
+
+@dataclass
+class ScheduledJobSpec:
+    """batch/types.go:198 ScheduledJobSpec."""
+
+    schedule: str = ""  # cron format
+    starting_deadline_seconds: Optional[int] = None
+    # Allow | Forbid | Replace (batch/types.go:223 ConcurrencyPolicy)
+    concurrency_policy: str = "Allow"
+    suspend: bool = False
+    job_template: JobTemplateSpec = field(default_factory=JobTemplateSpec)
+
+
+@dataclass
+class ScheduledJobStatus:
+    """batch/types.go:249 ScheduledJobStatus."""
+
+    active: List[str] = field(default_factory=list)  # "ns/job-name" refs
+    last_schedule_time: str = ""
+
+
+@dataclass
+class ScheduledJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ScheduledJobSpec = field(default_factory=ScheduledJobSpec)
+    status: ScheduledJobStatus = field(default_factory=ScheduledJobStatus)
+
+
 @dataclass
 class DeploymentSpec:
     replicas: int = 1
@@ -1076,3 +1112,208 @@ def get_taints(node: Node) -> List[Taint]:
         )
         for t in json.loads(raw)
     ]
+
+
+# --- Ingress (extensions/types.go:426-560) ----------------------------------
+
+
+@dataclass
+class IngressBackend:
+    """extensions/types.go:560 IngressBackend."""
+
+    service_name: str = ""
+    service_port: object = 0  # int or named port (intstr)
+
+
+@dataclass
+class HTTPIngressPath:
+    """extensions/types.go:550 HTTPIngressPath: path regex -> backend."""
+
+    path: str = ""
+    backend: IngressBackend = field(default_factory=IngressBackend)
+
+
+@dataclass
+class IngressRule:
+    """extensions/types.go:500 IngressRule (RuleValue.HTTP flattened)."""
+
+    host: str = ""
+    http_paths: List[HTTPIngressPath] = field(default_factory=list)
+
+
+@dataclass
+class IngressTLS:
+    """extensions/types.go:478 IngressTLS."""
+
+    hosts: List[str] = field(default_factory=list)
+    secret_name: str = ""
+
+
+@dataclass
+class IngressSpec:
+    """extensions/types.go:455 IngressSpec."""
+
+    backend: Optional[IngressBackend] = None
+    tls: List[IngressTLS] = field(default_factory=list)
+    rules: List[IngressRule] = field(default_factory=list)
+
+
+@dataclass
+class IngressStatus:
+    """extensions/types.go:471 IngressStatus: the fronting LB."""
+
+    load_balancer: LoadBalancerStatus = field(
+        default_factory=LoadBalancerStatus
+    )
+
+
+@dataclass
+class Ingress:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: IngressSpec = field(default_factory=IngressSpec)
+    status: IngressStatus = field(default_factory=IngressStatus)
+
+
+# --- NetworkPolicy (extensions/types.go:806-893) ----------------------------
+
+
+@dataclass
+class NetworkPolicyPort:
+    """extensions/types.go:861 NetworkPolicyPort."""
+
+    protocol: str = "TCP"
+    port: object = None  # int, named port, or None == all ports
+
+
+@dataclass
+class NetworkPolicyPeer:
+    """extensions/types.go:874 NetworkPolicyPeer: exactly one of
+    pod_selector (this namespace) / namespace_selector. None == not
+    specified; {} == select all (the reference's pointer semantics)."""
+
+    pod_selector: Optional[Dict[str, str]] = None
+    namespace_selector: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class NetworkPolicyIngressRule:
+    """extensions/types.go:841 NetworkPolicyIngressRule."""
+
+    ports: List[NetworkPolicyPort] = field(default_factory=list)
+    from_peers: List[NetworkPolicyPeer] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicySpec:
+    """extensions/types.go:821 NetworkPolicySpec."""
+
+    pod_selector: Dict[str, str] = field(default_factory=dict)
+    ingress: List[NetworkPolicyIngressRule] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NetworkPolicySpec = field(default_factory=NetworkPolicySpec)
+
+
+# --- PodDisruptionBudget (policy/types.go:23-66) ----------------------------
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    """policy/types.go:26 PodDisruptionBudgetSpec."""
+
+    min_available: object = 0  # int or percentage string ("28%")
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    """policy/types.go:38 PodDisruptionBudgetStatus."""
+
+    disruption_allowed: bool = False
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(
+        default_factory=PodDisruptionBudgetSpec
+    )
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus
+    )
+
+
+# --- PodSecurityPolicy (extensions/types.go:630-780) ------------------------
+
+
+@dataclass
+class HostPortRange:
+    """extensions/types.go:676 HostPortRange (inclusive)."""
+
+    min: int = 0
+    max: int = 0
+
+
+@dataclass
+class PodSecurityPolicySpec:
+    """extensions/types.go:640 PodSecurityPolicySpec (strategy options
+    flattened to their rule names: RunAsAny | MustRunAs...)."""
+
+    privileged: bool = False
+    default_add_capabilities: List[str] = field(default_factory=list)
+    required_drop_capabilities: List[str] = field(default_factory=list)
+    allowed_capabilities: List[str] = field(default_factory=list)
+    volumes: List[str] = field(default_factory=list)  # FSType whitelist
+    host_network: bool = False
+    host_ports: List[HostPortRange] = field(default_factory=list)
+    host_pid: bool = False
+    host_ipc: bool = False
+    se_linux_rule: str = "RunAsAny"
+    run_as_user_rule: str = "RunAsAny"
+    supplemental_groups_rule: str = "RunAsAny"
+
+
+@dataclass
+class PodSecurityPolicy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSecurityPolicySpec = field(
+        default_factory=PodSecurityPolicySpec
+    )
+
+
+# --- PodTemplate (api/types.go:1568 PodTemplate) ----------------------------
+
+
+@dataclass
+class PodTemplate:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+# --- ComponentStatus (api/types.go:2711-2733) -------------------------------
+
+
+@dataclass
+class ComponentCondition:
+    """api/types.go:2718 ComponentCondition."""
+
+    type: str = "Healthy"
+    status: str = "Unknown"  # True | False | Unknown
+    message: str = ""
+    error: str = ""
+
+
+@dataclass
+class ComponentStatus:
+    """api/types.go:2728 ComponentStatus: control-plane component
+    health, served virtually (registry/componentstatus does a live
+    healthz probe per GET; nothing is stored)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    conditions: List[ComponentCondition] = field(default_factory=list)

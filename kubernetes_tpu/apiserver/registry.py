@@ -64,6 +64,58 @@ def validate_pod(pod: t.Pod) -> None:
         raise ValidationError("spec.containers: required value")
 
 
+def validate_ingress(ing: t.Ingress) -> None:
+    """extensions/validation: at least one of backend or rules
+    (extensions/types.go:455-460)."""
+    if ing.spec.backend is None and not ing.spec.rules:
+        raise ValidationError(
+            "spec: at least one of `backend` or `rules` must be specified"
+        )
+    for rule in ing.spec.rules:
+        for p in rule.http_paths:
+            if p.path and not p.path.startswith("/"):
+                raise ValidationError(
+                    f"spec.rules.http.paths: path {p.path!r} must begin "
+                    "with a '/'"
+                )
+
+
+_CRON_FIELD = None  # compiled lazily
+
+
+def validate_scheduledjob(sj: t.ScheduledJob) -> None:
+    """batch/validation ValidateScheduledJobSpec: the schedule must be
+    a cron expression — @-descriptors (robfig/cron's @daily etc.) or
+    5/6 fields of cron charset."""
+    global _CRON_FIELD
+    sched = (sj.spec.schedule or "").strip()
+    ok = sched in ("@yearly", "@annually", "@monthly", "@weekly",
+                   "@daily", "@midnight", "@hourly") or (
+        sched.startswith("@every ")
+    )
+    if not ok:
+        import re
+
+        if _CRON_FIELD is None:
+            _CRON_FIELD = re.compile(r"^[0-9*,/\-?LW#A-Za-z]+$")
+        fields = sched.split()
+        ok = len(fields) in (5, 6) and all(
+            _CRON_FIELD.match(f) and (
+                any(ch.isdigit() for ch in f) or "*" in f or "?" in f
+            )
+            for f in fields
+        )
+    if not ok:
+        raise ValidationError(
+            f"spec.schedule: {sj.spec.schedule!r} is not a valid cron "
+            "expression"
+        )
+    if sj.spec.concurrency_policy not in ("Allow", "Forbid", "Replace"):
+        raise ValidationError(
+            "spec.concurrencyPolicy: must be Allow, Forbid or Replace"
+        )
+
+
 @dataclass
 class ResourceInfo:
     resource: str  # plural REST name, e.g. "pods"
@@ -160,6 +212,40 @@ def default_resources() -> Dict[str, ResourceInfo]:
             "thirdpartyresources", "ThirdPartyResource",
             t.ThirdPartyResource, "/thirdpartyresources",
             namespaced=False, group="extensions",
+        ),
+        # -- the 1.3-era additions (registry/<resource>/etcd/etcd.go) --------
+        ResourceInfo(
+            "ingresses", "Ingress", t.Ingress, "/ingress",
+            group="extensions", has_status=True,
+            validate=validate_ingress,
+        ),
+        ResourceInfo(
+            "networkpolicies", "NetworkPolicy", t.NetworkPolicy,
+            "/networkpolicies", group="extensions",
+        ),
+        ResourceInfo(
+            "poddisruptionbudgets", "PodDisruptionBudget",
+            t.PodDisruptionBudget, "/poddisruptionbudgets",
+            group="policy", has_status=True,
+        ),
+        ResourceInfo(
+            "podsecuritypolicies", "PodSecurityPolicy",
+            t.PodSecurityPolicy, "/podsecuritypolicy",
+            namespaced=False, group="extensions",
+        ),
+        ResourceInfo(
+            "scheduledjobs", "ScheduledJob", t.ScheduledJob,
+            "/scheduledjobs", group="batch", has_status=True,
+            validate=validate_scheduledjob,
+        ),
+        ResourceInfo(
+            "podtemplates", "PodTemplate", t.PodTemplate, "/podtemplates",
+        ),
+        # virtual: GET/LIST probe live component health, nothing stored
+        # (registry/componentstatus/rest.go)
+        ResourceInfo(
+            "componentstatuses", "ComponentStatus", t.ComponentStatus,
+            "/componentstatuses", namespaced=False,
         ),
     ]
     return {info.resource: info for info in infos}

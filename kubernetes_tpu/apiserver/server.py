@@ -17,7 +17,7 @@ streaming); the client layer's LocalTransport skips the socket.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api import labels as labelpkg
 from kubernetes_tpu.api import types as t
@@ -288,6 +288,14 @@ class APIServer:
         # transports bypass auth like the reference's integration masters
         self.authenticator = authenticator
         self.authorizer = authorizer
+        # componentstatuses probes (componentstatus/rest.go validators):
+        # name -> callable() -> (ok: bool, message: str). etcd-0 is the
+        # embedded store (always present); daemons register theirs via
+        # register_component (the in-process analogue of the reference's
+        # well-known localhost health ports)
+        self.component_probes: Dict[str, Callable] = {
+            "etcd-0": lambda: (True, "{\"health\": \"true\"}"),
+        }
         # dynamic third-party resources (master.go:610-766); re-install
         # any persisted ThirdPartyResource objects on startup
         self.thirdparty = ThirdPartyInstaller(self)
@@ -401,9 +409,12 @@ class APIServer:
             from kubernetes_tpu.utils import configz
 
             return 200, configz.snapshot()
-        if path in ("/api", "/api/v1", "/apis"):
-            return 200, {"resources": sorted(self.resources),
-                         "groups": group_versions()}
+        if path in ("/api", "/api/", "/apis", "/apis/", "/api/v1",
+                    "/swaggerapi", "/swaggerapi/") or (
+            path.startswith("/apis/") and len(
+                [p for p in path.split("/") if p]) == 3
+        ):
+            return self._discovery(path)
 
         # POST /api/v1/namespaces/{ns}/bindings — the collection form the
         # reference's binder uses (factory.go:537-543)
@@ -457,6 +468,17 @@ class APIServer:
 
     def _dispatch(self, method, path, query, body, ns, info, name,
                   subresource, obj_mode, codec):
+        if info.resource == "componentstatuses":
+            # virtual resource: every GET probes live component health
+            # (registry/componentstatus/rest.go); writes are rejected
+            # and so are watches — nothing is stored to watch
+            if method != "GET":
+                raise APIError(405, "componentstatuses is read-only")
+            if query.get("watch") in ("true", "1") or subresource == "watch":
+                raise APIError(
+                    405, "componentstatuses does not support watch"
+                )
+            return self._component_statuses(name, obj_mode, codec)
         if method == "GET":
             if query.get("watch") in ("true", "1") or subresource == "watch":
                 return 200, self._watch(info, ns, query, name, obj_mode,
@@ -636,6 +658,116 @@ class APIServer:
             info.key(obj.metadata.namespace, obj.metadata.name)
         )[0]
         return 201, stored if obj_mode else codec.encode(stored)
+
+    # -- discovery (apiserver.go APIGroupVersion install + genericapiserver
+    # swagger wiring, :332) --------------------------------------------------
+
+    def _discovery(self, path: str):
+        parts = [p for p in path.split("/") if p]
+        gvs = group_versions()  # {group-or-"core": [versions]}
+        if parts == ["api"]:
+            # the legacy group's version list (apiserver.go APIVersions)
+            return 200, {"kind": "APIVersions",
+                         "versions": gvs.get("core", ["v1"])}
+        if parts == ["apis"]:
+            # APIGroupList (pkg/apis/meta; served by the group mux)
+            groups = []
+            for g in sorted(g for g in gvs if g != "core"):
+                vs = gvs[g]
+                versions = [
+                    {"groupVersion": f"{g}/{v}", "version": v} for v in vs
+                ]
+                groups.append({
+                    "name": g,
+                    "versions": versions,
+                    "preferredVersion": versions[-1],
+                })
+            return 200, {"kind": "APIGroupList", "groups": groups}
+        if parts == ["swaggerapi"]:
+            # swagger 1.2 resource listing (genericapiserver.go:332); the
+            # per-path docs are the discovery documents themselves
+            apis = [{"path": "/api/v1"}] + [
+                {"path": f"/apis/{g}/{v}"}
+                for g in sorted(g for g in gvs if g != "core")
+                for v in gvs[g]
+            ]
+            return 200, {"swaggerVersion": "1.2", "apis": apis}
+        # APIResourceList for one group/version
+        if parts == ["api", "v1"]:
+            group, version = "", "v1"
+        else:
+            group, version = parts[1], parts[2]
+        self._resolve_codec(group, version)  # 404s unknown versions
+        resources = []
+        for info in sorted(self.resources.values(),
+                           key=lambda i: i.resource):
+            if (info.group or "") != group:
+                continue
+            resources.append({
+                "name": info.resource,
+                "kind": info.kind,
+                "namespaced": info.namespaced,
+            })
+            if info.has_status:
+                resources.append({
+                    "name": f"{info.resource}/status",
+                    "kind": info.kind,
+                    "namespaced": info.namespaced,
+                })
+            if info.resource == "pods":
+                resources.append({
+                    "name": "pods/binding",
+                    "kind": "Binding",
+                    "namespaced": True,
+                })
+        gv_name = f"{group}/{version}" if group else version
+        return 200, {
+            "kind": "APIResourceList",
+            "groupVersion": gv_name,
+            "resources": resources,
+        }
+
+    def register_component(self, name: str, probe: Callable) -> None:
+        """Add a componentstatuses probe: probe() -> (ok, message).
+        Daemons sharing the process (local-up, tests) register here the
+        way the reference's master probes scheduler/controller-manager
+        on their well-known localhost ports."""
+        self.component_probes[name] = probe
+
+    def _component_statuses(self, name, obj_mode, codec):
+        """registry/componentstatus/rest.go: live health, not storage."""
+        def one(cname: str) -> t.ComponentStatus:
+            probe = self.component_probes[cname]
+            try:
+                ok, msg = probe()
+            except Exception as e:  # a dead probe is an unhealthy report
+                ok, msg = False, str(e)
+            return t.ComponentStatus(
+                metadata=t.ObjectMeta(name=cname, namespace=""),
+                conditions=[t.ComponentCondition(
+                    type="Healthy",
+                    status="True" if ok else "False",
+                    message=msg if ok else "",
+                    error="" if ok else msg,
+                )],
+            )
+
+        if name:
+            if name not in self.component_probes:
+                raise KeyNotFound(name)
+            obj = one(name)
+            return 200, (obj if obj_mode else codec.encode(obj))
+        items = [one(n) for n in sorted(self.component_probes)]
+        if obj_mode:
+            return 200, ({"kind": "ComponentStatusList",
+                          "items": items,
+                          "metadata": {"resourceVersion": "0"}})
+        return 200, {
+            "kind": "ComponentStatusList",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": "0"},
+            "items": [codec.encode(o) for o in items],
+        }
 
     def _allocate_node_ports(self, svc) -> None:
         """registry/service/rest.go + portallocator: NodePort and

@@ -20,8 +20,16 @@ _GROUPS = {
     "daemonsets": "/apis/extensions/v1beta1",
     "jobs": "/apis/extensions/v1beta1",
     "horizontalpodautoscalers": "/apis/extensions/v1beta1",
+    "ingresses": "/apis/extensions/v1beta1",
+    "networkpolicies": "/apis/extensions/v1beta1",
+    "podsecuritypolicies": "/apis/extensions/v1beta1",
+    "poddisruptionbudgets": "/apis/policy/v1alpha1",
+    "scheduledjobs": "/apis/batch/v2alpha1",
 }
-_CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes"}
+_CLUSTER_SCOPED = {
+    "nodes", "namespaces", "persistentvolumes",
+    "podsecuritypolicies", "componentstatuses",
+}
 
 
 class APIStatusError(Exception):

@@ -200,6 +200,19 @@ def run_local_up(args) -> None:
     sched = SchedulerServer(
         client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
     ).start()
+    # componentstatuses: the in-process analogue of the master probing
+    # scheduler/controller-manager health ports
+    def _sched_health():
+        ok = (sched.scheduler is not None
+              and not sched.scheduler.config.stop_everything.is_set())
+        return ok, "ok" if ok else "scheduling loop stopped"
+
+    def _mgr_health():
+        ok = mgr.is_leader()
+        return ok, "ok" if ok else "not the active leader"
+
+    server.register_component("scheduler", _sched_health)
+    server.register_component("controller-manager", _mgr_health)
     dns = DNSRecords(client).run()
     from kubernetes_tpu.dns import DNSServer
 

@@ -43,6 +43,15 @@ ALIASES = {
     "secret": "secrets", "configmap": "configmaps", "cm": "configmaps",
     "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
     "limits": "limitranges", "limitrange": "limitranges",
+    "ing": "ingresses", "ingress": "ingresses",
+    "netpol": "networkpolicies", "networkpolicy": "networkpolicies",
+    "pdb": "poddisruptionbudgets",
+    "poddisruptionbudget": "poddisruptionbudgets",
+    "psp": "podsecuritypolicies",
+    "podsecuritypolicy": "podsecuritypolicies",
+    "sj": "scheduledjobs", "scheduledjob": "scheduledjobs",
+    "podtemplate": "podtemplates",
+    "cs": "componentstatuses", "componentstatus": "componentstatuses",
 }
 
 SCALABLE = {
@@ -65,6 +74,10 @@ _KIND_TO_RESOURCE = {
     "PetSet": "petsets", "ResourceQuota": "resourcequotas",
     "LimitRange": "limitranges", "ServiceAccount": "serviceaccounts",
     "Secret": "secrets", "ConfigMap": "configmaps",
+    "Ingress": "ingresses", "NetworkPolicy": "networkpolicies",
+    "PodDisruptionBudget": "poddisruptionbudgets",
+    "PodSecurityPolicy": "podsecuritypolicies",
+    "ScheduledJob": "scheduledjobs", "PodTemplate": "podtemplates",
 }
 
 
@@ -233,6 +246,121 @@ class Kubectl:
             rc.update(obj)
             out.append(f"{resource}/{obj.metadata.name} configured")
         return "\n".join(out)
+
+    def replace(self, filename: str, force: bool = False) -> str:
+        """kubectl replace (cmd/replace.go): full update of existing
+        objects from a manifest; --force deletes and re-creates."""
+        out = []
+        for obj in self._load_manifests(filename):
+            resource = self._resource_for(obj)
+            ns = obj.metadata.namespace or self.namespace
+            rc = self.client.resource(resource, ns)
+            if force:
+                try:
+                    rc.delete(obj.metadata.name)
+                except APIStatusError as e:
+                    if e.code != 404:
+                        raise
+                rc.create(obj)
+                out.append(f"{resource}/{obj.metadata.name} replaced")
+                continue
+            existing = rc.get(obj.metadata.name)  # 404 propagates: replace
+            # requires the object to exist (unlike apply)
+            obj.metadata.resource_version = existing.metadata.resource_version
+            rc.update(obj)
+            out.append(f"{resource}/{obj.metadata.name} replaced")
+        return "\n".join(out)
+
+    def taint(self, node: str, *taints: str) -> str:
+        """kubectl taint nodes (cmd/taint.go): key=value:Effect adds,
+        key:Effect- (trailing dash) removes. Writes whichever form the
+        node already carries — spec.taints when set, else the 1.3 alpha
+        annotation (get_taints' own precedence, api/helpers.go)."""
+        import json as jsonlib
+
+        def mutate(n):
+            from kubernetes_tpu.api.types import get_taints
+
+            cur = [
+                {"key": x.key, "value": x.value, "effect": x.effect}
+                for x in get_taints(n)
+            ]
+            for spec in taints:
+                if spec.endswith("-"):
+                    body = spec[:-1]
+                    if "=" in body:
+                        # `foo=bar-` is a malformed ADD, not a removal —
+                        # silently dropping foo's taints would be worse
+                        raise ValueError(
+                            f"invalid taint removal {spec!r}: want "
+                            "key[:Effect]-"
+                        )
+                    key, _, effect = body.partition(":")
+                    cur = [
+                        x for x in cur
+                        if not (x.get("key") == key and
+                                (not effect or x.get("effect") == effect))
+                    ]
+                    continue
+                if ":" not in spec:
+                    raise ValueError(
+                        f"invalid taint {spec!r}: want key[=value]:Effect"
+                    )
+                body, effect = spec.rsplit(":", 1)
+                if effect not in ("NoSchedule", "PreferNoSchedule"):
+                    raise ValueError(
+                        f"invalid taint effect {effect!r}"
+                    )
+                key, _, value = body.partition("=")
+                cur = [x for x in cur if not (
+                    x.get("key") == key and x.get("effect") == effect
+                )]
+                cur.append({"key": key, "value": value, "effect": effect})
+            if n.spec.taints is not None:
+                n.spec.taints = [
+                    t.Taint(key=x["key"], value=x["value"],
+                            effect=x["effect"])
+                    for x in cur
+                ]
+            elif cur:
+                n.metadata.annotations[t.TAINTS_ANNOTATION] = (
+                    jsonlib.dumps(cur)
+                )
+            else:
+                n.metadata.annotations.pop(t.TAINTS_ANNOTATION, None)
+
+        self._edit_meta("nodes", node, mutate)
+        return f"node/{node} tainted"
+
+    def api_versions(self) -> str:
+        """kubectl api-versions (cmd/apiversions.go): every groupVersion
+        from /apis discovery plus the core versions from /api."""
+        core = self.client.do_raw("GET", "/api")
+        groups = self.client.do_raw("GET", "/apis")
+        out = [v for v in core.get("versions", [])]
+        for g in groups.get("groups", []):
+            out += [v["groupVersion"] for v in g.get("versions", [])]
+        return "\n".join(sorted(out))
+
+    def cluster_info(self) -> str:
+        """kubectl cluster-info (cmd/clusterinfo.go): master address +
+        well-known system services."""
+        base = getattr(self.client.transport, "base_url",
+                       "<in-process>")
+        lines = [f"Kubernetes master is running at {base}"]
+        try:
+            svcs, _ = self.client.resource(
+                "services", "kube-system"
+            ).list()
+            for s in svcs:
+                lines.append(
+                    f"{s.metadata.name} is running at "
+                    f"{base}/api/v1/namespaces/kube-system/services/"
+                    f"{s.metadata.name}"
+                )
+        except APIStatusError:
+            pass
+        return "\n".join(lines)
 
     def delete(
         self, resource: str = "", name: str = "", filename: str = "",
@@ -1190,6 +1318,19 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
 
     sub.add_parser("version")
 
+    p = sub.add_parser("replace")
+    p.add_argument("--filename", "-f", required=True)
+    p.add_argument("--force", action="store_true")
+
+    p = sub.add_parser("taint")
+    p.add_argument("resource")  # must be "nodes"/"node"/"no"
+    p.add_argument("node")
+    p.add_argument("taints", nargs="+",
+                   help="key=value:Effect to add, key:Effect- to remove")
+
+    sub.add_parser("api-versions")
+    sub.add_parser("cluster-info")
+
     args = parser.parse_args(argv)
     if client is None:
         client = RESTClient(HTTPTransport(
@@ -1223,6 +1364,16 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
         out = k.label(args.resource, args.name, *args.pairs)
     elif args.verb == "annotate":
         out = k.annotate(args.resource, args.name, *args.pairs)
+    elif args.verb == "replace":
+        out = k.replace(args.filename, force=args.force)
+    elif args.verb == "taint":
+        if resolve(args.resource) != "nodes":
+            raise SystemExit("taint only applies to nodes")
+        out = k.taint(args.node, *args.taints)
+    elif args.verb == "api-versions":
+        out = k.api_versions()
+    elif args.verb == "cluster-info":
+        out = k.cluster_info()
     elif args.verb == "cordon":
         out = k.cordon(args.node)
     elif args.verb == "uncordon":

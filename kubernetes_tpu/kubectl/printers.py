@@ -106,6 +106,49 @@ def _generic_row(o) -> List[str]:
     return [o.metadata.name, age(o.metadata.creation_timestamp)]
 
 
+def _ingress_row(i) -> List[str]:
+    hosts = ",".join(r.host or "*" for r in i.spec.rules) or "*"
+    # resource_printer.go loadBalancerStatusStringer: ip, else hostname
+    addrs = ",".join(
+        ing.ip or ing.hostname
+        for ing in i.status.load_balancer.ingress
+        if ing.ip or ing.hostname
+    )
+    return [i.metadata.name, hosts, addrs,
+            age(i.metadata.creation_timestamp)]
+
+
+def _pdb_row(p) -> List[str]:
+    return [
+        p.metadata.name,
+        str(p.spec.min_available),
+        "true" if p.status.disruption_allowed else "false",
+        age(p.metadata.creation_timestamp),
+    ]
+
+
+def _scheduledjob_row(sj) -> List[str]:
+    return [
+        sj.metadata.name,
+        sj.spec.schedule,
+        str(sj.spec.suspend),
+        str(len(sj.status.active)),
+        sj.status.last_schedule_time or "<none>",
+        age(sj.metadata.creation_timestamp),
+    ]
+
+
+def _componentstatus_row(cs) -> List[str]:
+    cond = cs.conditions[0] if cs.conditions else None
+    healthy = "Healthy" if cond and cond.status == "True" else "Unhealthy"
+    return [
+        cs.metadata.name,
+        healthy,
+        (cond.message if cond else "") or "",
+        (cond.error if cond else "") or "",
+    ]
+
+
 TABLES: Dict[str, Tuple[List[str], Callable[[Any], List[str]]]] = {
     "pods": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"], _pod_row),
     "nodes": (["NAME", "STATUS", "AGE"], _node_row),
@@ -120,6 +163,17 @@ TABLES: Dict[str, Tuple[List[str], Callable[[Any], List[str]]]] = {
     "events": (
         ["LASTSEEN", "COUNT", "OBJECT", "TYPE", "REASON", "SOURCE", "MESSAGE"],
         _event_row,
+    ),
+    "ingresses": (["NAME", "HOSTS", "ADDRESS", "AGE"], _ingress_row),
+    "poddisruptionbudgets": (
+        ["NAME", "MIN-AVAILABLE", "ALLOWED-DISRUPTIONS", "AGE"], _pdb_row,
+    ),
+    "scheduledjobs": (
+        ["NAME", "SCHEDULE", "SUSPEND", "ACTIVE", "LAST-SCHEDULE", "AGE"],
+        _scheduledjob_row,
+    ),
+    "componentstatuses": (
+        ["NAME", "STATUS", "MESSAGE", "ERROR"], _componentstatus_row,
     ),
 }
 

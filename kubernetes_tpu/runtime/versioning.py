@@ -179,9 +179,10 @@ for _gv in (_v1(), _extensions_v1beta1(), _extensions_v1beta2()):
 
 # other group prefixes clients may use serve the plain wire at their
 # canonical version
-for _g, _v in (("batch", "v1"), ("autoscaling", "v1"),
+for _g, _v in (("batch", "v1"), ("batch", "v2alpha1"),
+               ("autoscaling", "v1"),
                ("apps", "v1alpha1"), ("componentconfig", "v1alpha1"),
-               ("federation", "v1beta1")):
+               ("federation", "v1beta1"), ("policy", "v1alpha1")):
     _REGISTRY[(_g, _v)] = GroupVersion(_g, _v)
 
 

@@ -241,10 +241,16 @@ class Scheduler:
             else:
                 hosts, errors = self._schedule_wave(wave, state)
         except Exception as e:
-            scheduler_algorithm_latency.observe(DEFAULT_CLOCK.now() - start)
+            # histograms are microsecond-unit like the reference's
+            # (metrics.go ExponentialBuckets(1000, 2, 15) over us)
+            scheduler_algorithm_latency.observe(
+                (DEFAULT_CLOCK.now() - start) * 1e6
+            )
             self._handle_failure(pod, e)
             return
-        scheduler_algorithm_latency.observe(DEFAULT_CLOCK.now() - start)
+        scheduler_algorithm_latency.observe(
+            (DEFAULT_CLOCK.now() - start) * 1e6
+        )
 
         successes: List[Tuple[Pod, str]] = []
         for i, (p, host) in enumerate(zip(wave, hosts)):
@@ -335,8 +341,8 @@ class Scheduler:
             self._handle_failure(pod, err, reason="FailedBinding")
 
         def succeed(pod, host, per_bind, now):
-            scheduler_binding_latency.observe(per_bind)
-            scheduler_e2e_latency.observe(now - cycle_start)
+            scheduler_binding_latency.observe(per_bind * 1e6)
+            scheduler_e2e_latency.observe((now - cycle_start) * 1e6)
             if cfg.recorder is not None:
                 cfg.recorder.eventf(
                     pod,

@@ -158,7 +158,9 @@ class TestExtensionsTwoVersions:
         assert {"v1beta1", "v1beta2"} <= set(gvs["extensions"])
         server = APIServer()
         code, body = server.handle("GET", "/apis")
-        assert body["groups"]["extensions"] == sorted(
+        assert body["kind"] == "APIGroupList"
+        ext = next(g for g in body["groups"] if g["name"] == "extensions")
+        assert [v["version"] for v in ext["versions"]] == sorted(
             gvs["extensions"]
         )
 
