@@ -80,28 +80,31 @@ def validate_ingress(ing: t.Ingress) -> None:
                 )
 
 
-_CRON_FIELD = None  # compiled lazily
+import re as _re
+
+# cron field: numbers/ranges/steps/lists, '*'/'?', or the named
+# day/month forms (JAN..DEC, SUN..SAT) robfig/cron accepts
+_CRON_FIELD = _re.compile(r"^[0-9*,/\-?LW#A-Za-z]+$")
+_CRON_WORD = _re.compile(r"(?i)\b(JAN|FEB|MAR|APR|MAY|JUN|JUL|AUG|SEP|OCT|"
+                         r"NOV|DEC|SUN|MON|TUE|WED|THU|FRI|SAT)\b")
+_EVERY_DURATION = _re.compile(r"^@every ([0-9]+(\.[0-9]+)?(ns|us|µs|ms|s|m|h))+$")
 
 
 def validate_scheduledjob(sj: t.ScheduledJob) -> None:
     """batch/validation ValidateScheduledJobSpec: the schedule must be
-    a cron expression — @-descriptors (robfig/cron's @daily etc.) or
-    5/6 fields of cron charset."""
-    global _CRON_FIELD
+    a cron expression — @-descriptors (robfig/cron's @daily etc.,
+    @every with a Go duration) or 5/6 fields of cron syntax."""
     sched = (sj.spec.schedule or "").strip()
     ok = sched in ("@yearly", "@annually", "@monthly", "@weekly",
-                   "@daily", "@midnight", "@hourly") or (
-        sched.startswith("@every ")
+                   "@daily", "@midnight", "@hourly") or bool(
+        _EVERY_DURATION.match(sched)
     )
     if not ok:
-        import re
-
-        if _CRON_FIELD is None:
-            _CRON_FIELD = re.compile(r"^[0-9*,/\-?LW#A-Za-z]+$")
         fields = sched.split()
         ok = len(fields) in (5, 6) and all(
             _CRON_FIELD.match(f) and (
                 any(ch.isdigit() for ch in f) or "*" in f or "?" in f
+                or _CRON_WORD.search(f)
             )
             for f in fields
         )

@@ -168,6 +168,21 @@ def run_federation_controller_manager(args) -> None:
     mgr.stop()
 
 
+def run_kubefed(args) -> None:
+    """federation/cmd/kubefed join/unjoin against the federated API."""
+    from kubernetes_tpu.federation import join_cluster, unjoin_cluster
+
+    fed = _client(args.server)
+    if args.action == "join":
+        if not args.cluster_endpoint:
+            raise SystemExit("join requires --cluster-endpoint")
+        join_cluster(fed, args.name, args.cluster_endpoint)
+        print(f"cluster {args.name!r} joined", flush=True)
+    else:
+        unjoin_cluster(fed, args.name)
+        print(f"cluster {args.name!r} unjoined", flush=True)
+
+
 def run_local_up(args) -> None:
     """hack/local-up-cluster.sh: a full cluster in one process."""
     from kubernetes_tpu.apiserver.server import APIServer
@@ -309,6 +324,16 @@ def main(argv=None):
     p = sub.add_parser("federation-controller-manager")
     p.add_argument("--server", "-s", default="http://127.0.0.1:8180")
 
+    # kubefed join/unjoin (federation/cmd/kubefed): register/remove a
+    # member cluster in the federated apiserver
+    p = sub.add_parser("kubefed")
+    p.add_argument("action", choices=["join", "unjoin"])
+    p.add_argument("name")
+    p.add_argument("--server", "-s", default="http://127.0.0.1:8180",
+                   help="the FEDERATED apiserver")
+    p.add_argument("--cluster-endpoint", default="",
+                   help="member apiserver URL (join)")
+
     p = sub.add_parser("local-up")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--nodes", type=int, default=3)
@@ -323,6 +348,7 @@ def main(argv=None):
         "apiserver": run_apiserver,
         "federation-apiserver": run_federation_apiserver,
         "federation-controller-manager": run_federation_controller_manager,
+        "kubefed": run_kubefed,
         "extender": run_extender,
         "scheduler": run_scheduler,
         "controller-manager": run_controller_manager,
