@@ -152,12 +152,43 @@ def _register_all() -> None:
     )
 
 
+def _build_mesh():
+    """The daemon's device mesh, gated by KUBERNETES_TPU_MESH:
+      auto (default) — shard the node axis when >1 device is visible;
+      off            — single-chip even on a multi-chip host;
+      force          — error out rather than silently run single-chip.
+    Returns None for the single-chip path."""
+    mode = os.environ.get("KUBERNETES_TPU_MESH", "auto").lower()
+    if mode == "off":
+        return None
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        if mode == "force":
+            raise RuntimeError(
+                f"KUBERNETES_TPU_MESH=force but only {len(devices)} "
+                "device(s) visible"
+            )
+        return None
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), ("nodes",))
+
+
 def _tpu_algorithm_factory(factory_args):
     """Build the batched TPU ScheduleAlgorithm (lazy import keeps jax out
     of pure control-plane processes). The daemon wires the scheduler
-    cache so waves run off the incrementally-maintained snapshot."""
+    cache so waves run off the incrementally-maintained snapshot; on a
+    multi-chip host the node axis shards across the device mesh
+    (MeshBatchScheduler — decisions bit-identical to single-chip, the
+    dryrun asserts it)."""
     from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
 
+    mesh = _build_mesh()
+    if mesh is not None:
+        return TPUScheduleAlgorithm(mesh=mesh)
     return TPUScheduleAlgorithm(
         cache=factory_args.scheduler_cache,
         service_lister=factory_args.service_lister,

@@ -101,8 +101,14 @@ class TPUScheduleAlgorithm:
         heterogeneous-pod scan path. The caller (server.py) runs "run"
         first and defers "scan" until the daemon is idle, so the loop
         opens for business after the template-path slice instead of the
-        whole program set."""
+        whole program set.
+
+        The mesh path warms too (one synthetic backlog through the
+        sharded program): a multi-chip daemon otherwise lands its cold
+        XLA compile on the first real pod's wave."""
         if self._mesh_sched is not None:
+            if phase in ("all", "run"):
+                self._warmup_mesh(num_nodes)
             return
         from kubernetes_tpu.api.types import (
             Container,
@@ -150,6 +156,51 @@ class TPUScheduleAlgorithm:
             self._warm_one([pod("w-scan", "200m"),
                             pod("w-scan2", "300m")], state, nodes)
 
+    def _warmup_mesh(self, num_nodes: int) -> None:
+        """Compile the sharded program for the cluster's node bucket
+        before real pods arrive (pad_to_buckets keeps the shape set
+        tiny, so this covers the common waves)."""
+        from kubernetes_tpu.api.types import (
+            Container,
+            Node,
+            NodeCondition,
+            NodeStatus,
+            ObjectMeta,
+            Pod as PodT,
+            PodSpec,
+        )
+        from kubernetes_tpu.oracle.state import ClusterState as CS
+
+        nodes = [
+            Node(
+                metadata=ObjectMeta(name=f"warm-{i:05d}"),
+                status=NodeStatus(
+                    allocatable={"cpu": "4", "memory": "32Gi",
+                                 "pods": "110"},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+            for i in range(max(num_nodes, 1))
+        ]
+        backlog = [
+            PodT(
+                metadata=ObjectMeta(name=f"w{i}",
+                                    labels={"app": "warm"}),
+                spec=PodSpec(containers=[
+                    Container(image="warm", requests={"cpu": "100m"})
+                ]),
+            )
+            for i in range(2)
+        ]
+        with self._sched_lock:
+            saved_last = self._last_node_index
+            try:
+                self._schedule_backlog_mesh(backlog, CS.build(nodes))
+            except Exception:
+                log.debug("mesh warmup failed", exc_info=True)
+            finally:
+                self._last_node_index = saved_last
+
     def _warm_one(self, backlog, state, nodes) -> None:
         with self._sched_lock:
             saved_last, saved_inc = self._last_node_index, self._inc
@@ -184,7 +235,10 @@ class TPUScheduleAlgorithm:
         if not pods:
             return []
         if self._mesh_sched is not None:
-            return self._schedule_backlog_mesh(pods, state)
+            # same lock as the single-chip path: serializes real waves
+            # against the background warmup's counter save/restore
+            with self._sched_lock:
+                return self._schedule_backlog_mesh(pods, state)
         with self._sched_lock:
             return self._schedule_backlog_locked(pods, state)
 

@@ -13,6 +13,12 @@ no backend has been initialized yet.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+# The 8-device CPU mesh below would flip EVERY TPUProvider daemon test
+# onto the mesh path via KUBERNETES_TPU_MESH=auto, silently dropping
+# coverage of the single-chip daemon path (the production path on any
+# 1-device host). Tests that want the mesh daemon opt in with
+# monkeypatch.setenv("KUBERNETES_TPU_MESH", "force").
+os.environ.setdefault("KUBERNETES_TPU_MESH", "off")
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()

@@ -280,3 +280,70 @@ def test_mesh_scale_1k_nodes_matches_single_chip(mesh):
     sharded = MeshBatchScheduler(mesh).schedule_names(snap, batch)
     assert sharded == single
     assert all(s is not None for s in sharded)
+
+
+def test_daemon_selects_mesh_when_multichip(monkeypatch):
+    """VERDICT r3 #5: the TPUProvider daemon must be deployable sharded —
+    with >1 visible device (the 8-device CPU mesh here) and
+    KUBERNETES_TPU_MESH=force, the provider builds a MeshBatchScheduler
+    and the daemon schedules through it end to end."""
+    import time
+
+    from kubernetes_tpu.api.types import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import RESTClient
+    from kubernetes_tpu.client.transport import LocalTransport
+    from kubernetes_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerOptions,
+    )
+
+    monkeypatch.setenv("KUBERNETES_TPU_MESH", "force")
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    for i in range(5):
+        client.nodes().create(Node(
+            metadata=ObjectMeta(name=f"m{i}", namespace=""),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    sched = SchedulerServer(
+        client, SchedulerServerOptions(algorithm_provider="TPUProvider")
+    ).start()
+    try:
+        algo = sched.scheduler.config.algorithm
+        assert algo._mesh_sched is not None, (
+            "TPUProvider did not select the mesh path"
+        )
+        assert algo._mesh_sched.mesh.devices.size > 1
+        for i in range(10):
+            client.pods().create(Pod(
+                metadata=ObjectMeta(name=f"mp{i}"),
+                spec=PodSpec(containers=[Container(
+                    requests={"cpu": "100m", "memory": "200Mi"}
+                )]),
+            ))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pods, _ = client.pods().list()
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.2)
+        pods, _ = client.pods().list()
+        assert all(p.spec.node_name for p in pods), [
+            (p.metadata.name, p.spec.node_name) for p in pods
+        ]
+        # identical pods spread across nodes (round-robin tie-break)
+        assert len({p.spec.node_name for p in pods}) == 5
+    finally:
+        sched.stop()
