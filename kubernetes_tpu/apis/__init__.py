@@ -1,0 +1,2 @@
+"""Non-core API groups whose types live outside api/types.py
+(pkg/apis/* in the reference)."""

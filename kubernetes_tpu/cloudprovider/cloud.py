@@ -60,6 +60,21 @@ class CloudProvider:
     def delete_route(self, cluster_name: str, route: Route) -> None:
         raise NotImplementedError
 
+    # Block-device attach/detach (the gce.AttachDisk/DetachDisk +
+    # aws.AttachDisk surface the volume attachers drive;
+    # providers/gce/gce.go, providers/aws/aws.go)
+    def attach_disk(self, device_id: str, node: str,
+                    read_only: bool = False) -> str:
+        """Attach the disk to the node; returns the device path.
+        Idempotent when already attached to the same node."""
+        raise NotImplementedError
+
+    def detach_disk(self, device_id: str, node: str) -> None:
+        raise NotImplementedError
+
+    def disk_is_attached(self, device_id: str, node: str) -> bool:
+        raise NotImplementedError
+
     # TCP load balancers (cloud.go TCPLoadBalancer, the 1.3 surface)
     def get_tcp_load_balancer(self, name: str, region: str) -> Optional[LoadBalancer]:
         raise NotImplementedError
@@ -77,6 +92,11 @@ class InstanceNotFound(Exception):
     pass
 
 
+class DiskConflict(Exception):
+    """A read-write disk attachment already exists elsewhere (the
+    gce.AttachDisk 'disk is already being used' error family)."""
+
+
 class FakeCloud(CloudProvider):
     """providers/fake/fake.go: scripted instances + recorded calls."""
 
@@ -88,6 +108,8 @@ class FakeCloud(CloudProvider):
         self.zone = zone or Zone("us-central1-a", "us-central1")
         self.routes: Dict[str, Route] = {}
         self.balancers: Dict[Tuple[str, str], LoadBalancer] = {}
+        # device_id -> {node: read_only} (the cloud's attachment table)
+        self.disk_attachments: Dict[str, Dict[str, bool]] = {}
         self.calls: List[str] = []
         self.addresses: Dict[str, List[Tuple[str, str]]] = {}
         self.err: Optional[Exception] = None  # injectable failure
@@ -129,6 +151,50 @@ class FakeCloud(CloudProvider):
     def delete_route(self, cluster_name, route):
         self._call("delete-route")
         self.routes.pop(f"{cluster_name}-{route.name}", None)
+
+    def attach_disk(self, device_id, node, read_only=False):
+        """GCE PD semantics: a disk is attached read-only to any number
+        of instances OR read-write to exactly one — never mixed. A
+        same-node re-attach with the same mode is idempotent; a mode
+        change re-validates like a fresh attach."""
+        self._call("attach-disk")
+        holders = self.disk_attachments.setdefault(device_id, {})
+        if holders.get(node) is read_only:
+            return f"/dev/disk/by-id/{device_id}"  # idempotent re-attach
+        others = {n: ro for n, ro in holders.items() if n != node}
+        writer = next((n for n, ro in others.items() if not ro), None)
+        if writer is not None:
+            raise DiskConflict(
+                f"disk {device_id!r} is attached read-write to {writer!r}"
+            )
+        if not read_only and others:
+            raise DiskConflict(
+                f"disk {device_id!r} has readers "
+                f"{sorted(others)}; cannot attach read-write"
+            )
+        holders[node] = read_only
+        return f"/dev/disk/by-id/{device_id}"
+
+    def detach_disk(self, device_id, node):
+        self._call("detach-disk")
+        holders = self.disk_attachments.get(device_id, {})
+        holders.pop(node, None)
+        if not holders:
+            self.disk_attachments.pop(device_id, None)
+
+    def disk_is_attached(self, device_id, node):
+        self._call("disk-is-attached")
+        return node in self.disk_attachments.get(device_id, {})
+
+    def disks_attached_to(self, node):
+        """Device ids the cloud holds on this instance (the
+        gce.DisksAreAttached bulk form; the controller's actual-state
+        reconciliation reads it so a crashed sync can't leak holds)."""
+        self._call("disks-attached-to")
+        return sorted(
+            d for d, holders in self.disk_attachments.items()
+            if node in holders
+        )
 
     def get_tcp_load_balancer(self, name, region):
         self._call("get-lb")

@@ -36,9 +36,17 @@ class AttachDetachController(PeriodicRunner):
     THREAD_NAME = "attachdetach"
 
     def __init__(self, client: RESTClient, informers,
-                 plugins: VolumePluginMgr = None):
+                 plugins: VolumePluginMgr = None, cloud=None):
         self.client = client
         self.plugins = plugins or default_plugin_mgr()
+        # with a cloud configured, attach/detach go through the REAL
+        # attacher state machines (volume/attachers.py) — the cloud's
+        # attachment table is authoritative and RW multi-attach is
+        # refused the way gce.AttachDisk refuses it. Without one, node
+        # status is the only state (the round-3 behavior, still what
+        # hollow/kubemark tests want).
+        self.cloud = cloud
+        self.conflicts = 0  # observability: RW multi-attach refusals
         self.pod_informer = informers.pods()
         self.node_informer = informers.nodes()
         self.pv_informer = informers.informer("persistentvolumes")
@@ -64,6 +72,7 @@ class AttachDetachController(PeriodicRunner):
     def desired_state(self) -> Dict[str, Set[str]]:
         """node name -> device ids that must be attached."""
         want: Dict[str, Set[str]] = {}
+        self._want_specs: Dict[Tuple[str, str], tuple] = {}
         # one snapshot of the PV/PVC universe per pass, not per pod
         pvs = {
             pv.metadata.name: pv for pv in self.pv_informer.store.list()
@@ -86,8 +95,12 @@ class AttachDetachController(PeriodicRunner):
                     continue
                 if not getattr(plugin, "attachable", False):
                     continue
-                want.setdefault(pod.spec.node_name, set()).add(
-                    plugin.device_of(spec)
+                device = plugin.device_of(spec)
+                want.setdefault(pod.spec.node_name, set()).add(device)
+                # remember (plugin, spec) so the cloud attacher can
+                # carry the source's readOnly bit to the attach call
+                self._want_specs[(pod.spec.node_name, device)] = (
+                    plugin, spec,
                 )
         return want
 
@@ -99,6 +112,17 @@ class AttachDetachController(PeriodicRunner):
         for node in self.node_informer.store.list():
             name = node.metadata.name
             have = {v.name for v in node.status.volumes_attached}
+            if self.cloud is not None:
+                # the cloud's attachment table is the ACTUAL state: a
+                # sync that attached in the cloud but crashed before
+                # recording it in node status must not leak the hold
+                # forever (reconciler.go actual-state-of-world)
+                enum = getattr(self.cloud, "disks_attached_to", None)
+                if enum is not None:
+                    try:
+                        have = have | set(enum(name))
+                    except Exception:
+                        pass
             need = want.get(name, set())
             if have == need:
                 continue
@@ -111,16 +135,47 @@ class AttachDetachController(PeriodicRunner):
             # heartbeat drops it from volumesInUse
             in_use = set(fresh.status.volumes_in_use)
             keep = need | (have & in_use)
+            # detach through the cloud FIRST: node status must never
+            # claim a device the cloud still holds elsewhere
+            for device in sorted(have - keep):
+                if self.cloud is not None:
+                    try:
+                        self.cloud.detach_disk(device, name)
+                    except Exception:
+                        keep = keep | {device}  # still held: try again
+                        continue
+                detached += 1
             fresh.status.volumes_attached = [
                 v for v in fresh.status.volumes_attached if v.name in keep
             ]
             present = {v.name for v in fresh.status.volumes_attached}
-            detached += len(have - keep)
             for device in sorted(need - present):
-                fresh.status.volumes_attached.append(
-                    t.AttachedVolume(
-                        name=device, device_path=f"/dev/disk/by-id/{device}"
+                device_path = f"/dev/disk/by-id/{device}"
+                if self.cloud is not None:
+                    from kubernetes_tpu.cloudprovider.cloud import (
+                        DiskConflict,
                     )
+                    from kubernetes_tpu.volume.attachers import (
+                        attacher_for,
+                    )
+
+                    plugin, spec = self._want_specs.get(
+                        (name, device), (None, None)
+                    )
+                    att = attacher_for(plugin, self.cloud) if plugin else None
+                    if att is not None:
+                        try:
+                            device_path = att.attach(spec, name)
+                        except DiskConflict:
+                            # held read-write elsewhere: refused, like
+                            # gce.AttachDisk; retried next sync once the
+                            # holder detaches
+                            self.conflicts += 1
+                            continue
+                        except Exception:
+                            continue  # cloud hiccup: retried next sync
+                fresh.status.volumes_attached.append(
+                    t.AttachedVolume(name=device, device_path=device_path)
                 )
                 attached += 1
             try:

@@ -134,7 +134,7 @@ class ControllerManager:
         add("serviceaccount", lambda: ServiceAccountsController(
             client, self.informers))
         add("attachdetach", lambda: AttachDetachController(
-            client, self.informers))
+            client, self.informers, cloud=cloud))
         if o.service_account_private_key is not None:
             add("serviceaccount-token", lambda: TokensController(
                 client, self.informers, o.service_account_private_key))

@@ -79,10 +79,15 @@ def run_scheduler(args) -> None:
         SchedulerServerOptions,
     )
 
-    sched = SchedulerServer(
-        _client_from(args),
-        SchedulerServerOptions(algorithm_provider=args.algorithm_provider),
-    ).start()
+    if args.config:
+        # flags-as-API-object: a versioned KubeSchedulerConfiguration
+        # file wins over individual flags (componentconfig idiom)
+        options = SchedulerServerOptions.from_config_file(args.config)
+    else:
+        options = SchedulerServerOptions(
+            algorithm_provider=args.algorithm_provider
+        )
+    sched = SchedulerServer(_client_from(args), options).start()
     print("kube-scheduler running", flush=True)
     _wait_forever()
     sched.stop()
@@ -105,30 +110,50 @@ def run_kubelet(args) -> None:
         ProcessRuntime,
     )
 
-    # a standalone kubelet daemon runs REAL processes as containers
-    # (docker_manager.go's role); --fake-runtime keeps the hollow seam
-    runtime = FakeRuntime() if args.fake_runtime else ProcessRuntime()
-    if (args.serve_api and not args.fake_runtime
-            and not args.auth_token):
-        print(
-            "refusing: --serve-api with the process runtime and no "
-            "--auth-token would expose unauthenticated /exec (remote "
-            "code execution); pass --auth-token (and ideally "
-            "--tls-cert-file/--tls-private-key-file)",
-            file=sys.stderr,
+    if args.config:
+        from kubernetes_tpu.apis.componentconfig import (
+            load_component_config,
         )
-        raise SystemExit(2)
-    kl = Kubelet(
-        _client_from(args),
-        KubeletConfig(
+
+        kc = load_component_config(args.config, "KubeletConfiguration")
+        # the config file is the whole configuration — its values are
+        # taken verbatim (a falsy file value must not lose to a flag);
+        # only nodeName falls back to --node when the file leaves it ""
+        cfg = KubeletConfig(
+            node_name=kc.node_name or args.node,
+            sync_frequency=kc.sync_frequency_seconds,
+            node_status_update_frequency=(
+                kc.node_status_update_frequency_seconds
+            ),
+            serve_api=kc.serve_api,
+            api_tls_cert=kc.api_tls_cert,
+            api_tls_key=kc.api_tls_key,
+            api_auth_token=kc.api_auth_token,
+            eviction_memory_threshold=kc.eviction_memory_threshold,
+            max_pods=kc.max_pods,
+        )
+    else:
+        cfg = KubeletConfig(
             node_name=args.node,
             serve_api=args.serve_api,
             api_tls_cert=args.tls_cert_file,
             api_tls_key=args.tls_private_key_file,
             api_auth_token=args.auth_token,
-        ),
-        runtime,
-    ).run()
+        )
+    # a standalone kubelet daemon runs REAL processes as containers
+    # (docker_manager.go's role); --fake-runtime keeps the hollow seam
+    runtime = FakeRuntime() if args.fake_runtime else ProcessRuntime()
+    if (cfg.serve_api and not args.fake_runtime
+            and not cfg.api_auth_token):
+        print(
+            "refusing: serving the node API with the process runtime "
+            "and no auth token would expose unauthenticated /exec "
+            "(remote code execution); set --auth-token or the config's "
+            "apiAuthToken (and ideally TLS)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    kl = Kubelet(_client_from(args), cfg, runtime).run()
     print(f"kubelet {args.node} running "
           f"({'fake' if args.fake_runtime else 'process'} runtime)",
           flush=True)
@@ -287,6 +312,11 @@ def main(argv=None):
         add_client_flags(p)
         if name == "scheduler":
             p.add_argument("--algorithm-provider", default="TPUProvider")
+            p.add_argument(
+                "--config", default="",
+                help="versioned KubeSchedulerConfiguration file "
+                "(componentconfig/v1alpha1); wins over flags",
+            )
 
     p = sub.add_parser("kubelet")
     add_client_flags(p)
@@ -309,6 +339,11 @@ def main(argv=None):
         "--auth-token", default="",
         help="require `Authorization: Bearer <token>` on the node API "
         "(an open /exec on a process runtime is remote code execution)",
+    )
+    p.add_argument(
+        "--config", default="",
+        help="versioned KubeletConfiguration file "
+        "(componentconfig/v1alpha1); file fields win over flags",
     )
 
     p = sub.add_parser("extender")

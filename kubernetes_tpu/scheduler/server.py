@@ -51,6 +51,37 @@ class SchedulerServerOptions:
     lock_object_namespace: str = "kube-system"
     lock_object_name: str = "kube-scheduler"
 
+    @classmethod
+    def from_component_config(cls, cfg) -> "SchedulerServerOptions":
+        """Build options from a versioned KubeSchedulerConfiguration
+        (apis/componentconfig.py) — options.go:31's embed, as a
+        conversion. Flags-as-API-object is the configuration contract;
+        this dataclass stays the daemon-internal form."""
+        return cls(
+            algorithm_provider=cfg.algorithm_provider,
+            policy_config_file=cfg.policy_config_file,
+            scheduler_name=cfg.scheduler_name,
+            hard_pod_affinity_symmetric_weight=(
+                cfg.hard_pod_affinity_symmetric_weight
+            ),
+            failure_domains=list(cfg.failure_domains),
+            kube_api_qps=cfg.kube_api_qps,
+            kube_api_burst=cfg.kube_api_burst,
+            leader_elect=cfg.leader_election.leader_elect,
+            lock_object_namespace=cfg.lock_object_namespace,
+            lock_object_name=cfg.lock_object_name,
+        )
+
+    @classmethod
+    def from_config_file(cls, path: str) -> "SchedulerServerOptions":
+        from kubernetes_tpu.apis.componentconfig import (
+            load_component_config,
+        )
+
+        return cls.from_component_config(
+            load_component_config(path, "KubeSchedulerConfiguration")
+        )
+
 
 class SchedulerServer:
     """app.Run (server.go:71)."""

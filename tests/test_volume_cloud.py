@@ -397,3 +397,118 @@ class TestLocalCloudLoadBalancer:
         informers.stop()
         backend.shutdown()
         backend.server_close()
+
+
+class TestCloudDiskAttachers:
+    """The real attach state machines (gce_pd/attacher.go,
+    aws_ebs/attacher.go) against the fake cloud — VERDICT r3 #9."""
+
+    def _plane(self):
+        from kubernetes_tpu.cloudprovider import FakeCloud
+        from kubernetes_tpu.controller.attach_detach import (
+            AttachDetachController,
+        )
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        informers = SharedInformerFactory(client)
+        cloud = FakeCloud(instances=["n1", "n2"])
+        ctrl = AttachDetachController(client, informers, cloud=cloud)
+        return server, client, informers, cloud, ctrl
+
+    @staticmethod
+    def _pd_pod(name, node, pd="data-disk", read_only=False):
+        from kubernetes_tpu.api.types import (
+            Container,
+            GCEPersistentDisk,
+            Pod,
+            PodSpec,
+            Volume,
+        )
+
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                node_name=node,
+                containers=[Container(name="c")],
+                volumes=[Volume(
+                    name="v",
+                    gce_persistent_disk=GCEPersistentDisk(
+                        pd_name=pd, read_only=read_only),
+                )],
+            ),
+        )
+
+    def test_attach_goes_through_the_cloud(self):
+        from kubernetes_tpu.api.types import Node
+
+        server, client, informers, cloud, ctrl = self._plane()
+        client.resource("nodes").create(Node(
+            metadata=ObjectMeta(name="n1", namespace="")))
+        client.pods().create(self._pd_pod("p1", "n1"))
+        informers.start()
+        informers.wait_for_sync()
+        wait_until(lambda: len(informers.pods().store.list()) == 1)
+        ctrl.sync_once()
+        # the cloud's attachment table is authoritative
+        assert cloud.disk_is_attached("gce-pd/data-disk", "n1")
+        node = client.resource("nodes").get("n1")
+        assert [v.name for v in node.status.volumes_attached] == [
+            "gce-pd/data-disk"]
+        # pod gone -> cloud detach
+        client.pods().delete("p1")
+        wait_until(lambda: not informers.pods().store.list())
+        ctrl.sync_once()
+        assert not cloud.disk_is_attached("gce-pd/data-disk", "n1")
+        informers.stop()
+
+    def test_rw_disk_attaches_to_one_node_only(self):
+        from kubernetes_tpu.api.types import Node
+
+        server, client, informers, cloud, ctrl = self._plane()
+        for n in ("n1", "n2"):
+            client.resource("nodes").create(Node(
+                metadata=ObjectMeta(name=n, namespace="")))
+        client.pods().create(self._pd_pod("p1", "n1"))
+        client.pods().create(self._pd_pod("p2", "n2"))
+        informers.start()
+        informers.wait_for_sync()
+        wait_until(lambda: len(informers.pods().store.list()) == 2)
+        ctrl.sync_once()
+        # exactly one node holds the RW disk; the other is refused
+        holders = [n for n in ("n1", "n2")
+                   if cloud.disk_is_attached("gce-pd/data-disk", n)]
+        assert len(holders) == 1
+        assert ctrl.conflicts >= 1
+        # the holder's pod leaves -> next syncs flip the attachment
+        holder = holders[0]
+        client.pods().delete("p1" if holder == "n1" else "p2")
+        wait_until(lambda: len(informers.pods().store.list()) == 1)
+        ctrl.sync_once()  # detaches from the old holder
+        ctrl.sync_once()  # attaches to the waiting node
+        other = "n2" if holder == "n1" else "n1"
+        assert cloud.disk_is_attached("gce-pd/data-disk", other)
+        assert not cloud.disk_is_attached("gce-pd/data-disk", holder)
+        informers.stop()
+
+    def test_wait_for_attach_polls_the_cloud(self):
+        from kubernetes_tpu.cloudprovider import FakeCloud
+        from kubernetes_tpu.volume.attachers import CloudDiskAttacher
+        from kubernetes_tpu.volume.plugins import (
+            VolumeSpec,
+            default_plugin_mgr,
+        )
+        from kubernetes_tpu.api.types import GCEPersistentDisk, Volume
+
+        cloud = FakeCloud(instances=["n1"])
+        spec = VolumeSpec(volume=Volume(
+            name="v", gce_persistent_disk=GCEPersistentDisk(pd_name="d")))
+        plugin = default_plugin_mgr().find_plugin_by_spec(spec)
+        att = CloudDiskAttacher(plugin, cloud)
+        assert att.wait_for_attach(spec, "n1", timeout=0.2) is None
+        path = att.attach(spec, "n1")
+        assert path == "/dev/disk/by-id/gce-pd/d"
+        assert att.wait_for_attach(spec, "n1", timeout=1.0) == path
+        # detach is idempotent
+        att.detach("gce-pd/d", "n1")
+        att.detach("gce-pd/d", "n1")
