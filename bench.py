@@ -1,12 +1,15 @@
 """Headline benchmark: the reference's scheduler_perf density test B
 (30,000 pause pods onto 1,000 identical nodes — test/component/scheduler/
-perf/scheduler_test.go:31-33) through the product scheduling path
-(TPUScheduleAlgorithm: backlog dedup -> device probe -> host replay ->
-carry fold; bit-identical to the serial oracle).
+perf/scheduler_test.go:31-33), measured the way the reference measures
+it: through the REAL control plane across PROCESS boundaries — apiserver
+in its own interpreter (TLV binary wire), pod creation in another, the
+scheduler daemon + the ScheduledPodLister poll here
+(test/component/scheduler/perf/util.go:46-78). The raw tensor-path
+number (the device program alone, no wire) is reported alongside, not
+instead (VERDICT r3 #1).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-A second measurement at the BASELINE.json north-star config (50k pods /
-5k nodes) goes to stderr.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The north-star config (50k pods / 5k nodes, raw path) goes to stderr.
 
 Baseline: the Go reference cannot be executed in this image (no Go
 toolchain), so BASELINE.md records the published era figure of ~100
@@ -22,6 +25,7 @@ BASELINE_PODS_PER_SEC = 100.0
 
 NUM_NODES = 1000
 NUM_PODS = 30000
+WIRE_REPS = 2  # tunnel + box noise: best-of (each rep is a full run)
 
 
 def build(num_nodes, num_pods):
@@ -96,32 +100,80 @@ def run_config(num_nodes, num_pods, reps=3):
     return best, n_sched
 
 
+def run_wire_path() -> float:
+    """Best-of-reps separate-process density (the reference deployment
+    shape). Raises when the sandbox forbids cross-process localhost."""
+    from kubernetes_tpu.harness.perf import schedule_pods_separate
+
+    best = 0.0
+    last_err = None
+    for rep in range(WIRE_REPS):
+        print(f"# wire-path rep {rep + 1}/{WIRE_REPS}", file=sys.stderr)
+        try:
+            best = max(best, schedule_pods_separate(
+                NUM_NODES, NUM_PODS, "TPUProvider", out=sys.stderr
+            ))
+        except Exception as e:
+            # a transient rep failure must not discard an earlier
+            # successful measurement
+            last_err = e
+            print(f"# rep {rep + 1} failed: {e}", file=sys.stderr)
+    if best <= 0:
+        raise last_err if last_err is not None else RuntimeError(
+            "no wire-path rep completed"
+        )
+    return best
+
+
 def main():
-    # Self-provision the C replay engine (cached by mtime): without it the
-    # wave fast path degrades ~10x to the Python spec replay, and the
-    # recorded number stops containing the work (round-2 VERDICT #1).
+    # Self-provision the C engines (cached by mtime): without them the
+    # wave fast path degrades ~10x to the Python spec replay and the
+    # wire rides the slow codec — the number stops containing the work.
     from kubernetes_tpu.native.build import ensure_all
 
     ensure_all()
+    wire = None
+    wire_err = ""
+    try:
+        wire = run_wire_path()
+    except Exception as e:
+        wire_err = f"{type(e).__name__}: {e}"
+        print(f"# wire-path run failed ({wire_err}); falling back to "
+              "the raw tensor path as headline", file=sys.stderr)
     dt, _ = run_config(NUM_NODES, NUM_PODS)
-    pods_per_sec = NUM_PODS / dt
+    raw = NUM_PODS / dt
     print(
-        json.dumps(
-            {
-                "metric": "scheduler_perf_1000n_30kp_pods_per_sec",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/sec",
-                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
-                "baseline_kind": "assumed (published v1.3-era ~100 pods/s; "
-                "no Go toolchain in this image to measure the reference)",
-            }
-        )
-    )
-    print(
-        f"# 30k pods / 1k nodes in {dt:.2f}s end-to-end "
-        "(encode+probe+replay; min of 3 warm reps, tunnel-noise floor)",
+        f"# raw tensor path: {NUM_PODS} pods / {NUM_NODES} nodes in "
+        f"{dt:.2f}s ({raw:.0f} pods/s; encode+probe+replay, min of 3 "
+        "warm reps)",
         file=sys.stderr,
     )
+    if wire is not None:
+        record = {
+            "metric": "scheduler_perf_density_1000n_30kp_pods_per_sec",
+            "value": round(wire, 1),
+            "unit": "pods/sec",
+            "vs_baseline": round(wire / BASELINE_PODS_PER_SEC, 2),
+            "measurement": "separate processes: apiserver (TLV wire) + "
+            "creator + scheduler daemon; elapsed from creation-done to "
+            "all-bound via the scheduler's assigned-pod informer "
+            f"(best of {WIRE_REPS})",
+            "raw_tensor_path_pods_per_sec": round(raw, 1),
+            "baseline_kind": "assumed (published v1.3-era ~100 pods/s; "
+            "no Go toolchain in this image to measure the reference)",
+        }
+    else:
+        record = {
+            "metric": "scheduler_perf_1000n_30kp_pods_per_sec",
+            "value": round(raw, 1),
+            "unit": "pods/sec",
+            "vs_baseline": round(raw / BASELINE_PODS_PER_SEC, 2),
+            "measurement": "raw tensor path only (wire-path run failed: "
+            f"{wire_err})",
+            "baseline_kind": "assumed (published v1.3-era ~100 pods/s; "
+            "no Go toolchain in this image to measure the reference)",
+        }
+    print(json.dumps(record))
     try:
         dt5, _ = run_config(5000, 50000)
         print(

@@ -79,7 +79,6 @@ class KubeletConfiguration:
     api_tls_key: str = ""
     api_auth_token: str = ""
     eviction_memory_threshold: int = 0
-    image_gc_high_threshold_percent: int = 90
     max_pods: int = 110
 
 
@@ -123,9 +122,10 @@ def _validate(obj) -> None:
             raise ComponentConfigError("kubeApiQps (QPS) must be positive")
         if obj.kube_api_burst <= 0:
             raise ComponentConfigError("kubeApiBurst must be positive")
-        if not (-100 <= obj.hard_pod_affinity_symmetric_weight <= 100):
+        if not (0 <= obj.hard_pod_affinity_symmetric_weight <= 100):
+            # server.go validation: the weight is non-negative
             raise ComponentConfigError(
-                "hardPodAffinitySymmetricWeight must be in [-100, 100]"
+                "hardPodAffinitySymmetricWeight must be in [0, 100]"
             )
     if isinstance(obj, KubeletConfiguration):
         if obj.max_pods <= 0:
@@ -164,8 +164,6 @@ def load_component_config(path: str, expected_kind: str):
         raise ComponentConfigError(
             f"expected kind {expected_kind!r}, got {kind!r}"
         )
-    body = {k: v for k, v in data.items()
-            if k not in ("apiVersion",)}
-    obj = scheme.decode(body)
+    obj = scheme.decode(data)  # decode() strips kind/apiVersion itself
     _validate(obj)
     return obj

@@ -26,6 +26,11 @@ def _is_long_running(path: str, query: dict) -> bool:
     segment that happens to be named "watch"."""
     if query.get("watch") in ("true", "1"):
         return True
+    if path.startswith("/debug/pprof/profile"):
+        # the sampler deliberately holds the request for `seconds`; it
+        # must not eat a max-in-flight slot (pprof is long-running in
+        # the reference's mux for the same reason)
+        return True
     parts = [p for p in path.split("/") if p]
     if parts[:1] == ["api"]:
         parts = parts[2:]

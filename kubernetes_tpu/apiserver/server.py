@@ -409,6 +409,29 @@ class APIServer:
             from kubernetes_tpu.utils import configz
 
             return 200, configz.snapshot()
+        if path.startswith("/debug/pprof"):
+            # net/http/pprof analogue (scheduler server.go:96-99 mounts
+            # it on every daemon; here daemons share this mux)
+            from kubernetes_tpu.utils import pprof
+
+            if path.rstrip("/").endswith(("goroutine", "threads")):
+                body = pprof.thread_stacks()
+            elif path.rstrip("/").endswith("profile"):
+                try:
+                    seconds = float(query.get("seconds", "5"))
+                except ValueError:
+                    raise APIError(400, "seconds must be a number")
+                # bound the window: a profile request is a debugging
+                # aid, not a thread-pinning primitive
+                body = pprof.sample_profile(min(seconds, 30.0))
+            else:
+                body = (
+                    "pprof endpoints:\n"
+                    "  /debug/pprof/goroutine  thread stacks\n"
+                    "  /debug/pprof/profile?seconds=N  sampled profile\n"
+                )
+            return 200, {"_raw": body.encode(),
+                         "_content_type": "text/plain; charset=utf-8"}
         if path in ("/api", "/api/", "/apis", "/apis/", "/api/v1",
                     "/swaggerapi", "/swaggerapi/") or (
             path.startswith("/apis/") and len(

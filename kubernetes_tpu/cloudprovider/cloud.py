@@ -97,7 +97,62 @@ class DiskConflict(Exception):
     gce.AttachDisk 'disk is already being used' error family)."""
 
 
-class FakeCloud(CloudProvider):
+class DiskAttachmentTable:
+    """Shared in-memory disk attach/detach semantics (GCE PD rules:
+    read-only to many XOR read-write to one). FakeCloud and LocalCloud
+    both carry this table; a real provider would call its API."""
+
+    def _disk_table(self) -> Dict[str, Dict[str, bool]]:
+        tbl = getattr(self, "disk_attachments", None)
+        if tbl is None:
+            tbl = self.disk_attachments = {}
+        return tbl
+
+    def attach_disk(self, device_id, node, read_only=False):
+        holders = self._disk_table().setdefault(device_id, {})
+        if holders.get(node) is read_only:
+            return f"/dev/disk/by-id/{device_id}"  # idempotent re-attach
+        others = {n: ro for n, ro in holders.items() if n != node}
+        writer = next((n for n, ro in others.items() if not ro), None)
+        if writer is not None:
+            raise DiskConflict(
+                f"disk {device_id!r} is attached read-write to {writer!r}"
+            )
+        if not read_only and others:
+            raise DiskConflict(
+                f"disk {device_id!r} has readers "
+                f"{sorted(others)}; cannot attach read-write"
+            )
+        holders[node] = read_only
+        return f"/dev/disk/by-id/{device_id}"
+
+    def detach_disk(self, device_id, node):
+        tbl = self._disk_table()
+        holders = tbl.get(device_id, {})
+        holders.pop(node, None)
+        if not holders:
+            tbl.pop(device_id, None)
+
+    def disk_is_attached(self, device_id, node):
+        return node in self._disk_table().get(device_id, {})
+
+    def disks_attached_to(self, node):
+        return sorted(
+            d for d, holders in self._disk_table().items()
+            if node in holders
+        )
+
+    def all_disk_attachments(self):
+        """{device_id: [nodes]} — the startup actual-state listing
+        (gce ListDisks/aws DescribeVolumes role) the controller sweeps
+        so holds of nodes deleted while it was down don't leak."""
+        return {
+            d: sorted(holders)
+            for d, holders in self._disk_table().items()
+        }
+
+
+class FakeCloud(DiskAttachmentTable, CloudProvider):
     """providers/fake/fake.go: scripted instances + recorded calls."""
 
     provider_name = "fake"
@@ -153,48 +208,20 @@ class FakeCloud(CloudProvider):
         self.routes.pop(f"{cluster_name}-{route.name}", None)
 
     def attach_disk(self, device_id, node, read_only=False):
-        """GCE PD semantics: a disk is attached read-only to any number
-        of instances OR read-write to exactly one — never mixed. A
-        same-node re-attach with the same mode is idempotent; a mode
-        change re-validates like a fresh attach."""
         self._call("attach-disk")
-        holders = self.disk_attachments.setdefault(device_id, {})
-        if holders.get(node) is read_only:
-            return f"/dev/disk/by-id/{device_id}"  # idempotent re-attach
-        others = {n: ro for n, ro in holders.items() if n != node}
-        writer = next((n for n, ro in others.items() if not ro), None)
-        if writer is not None:
-            raise DiskConflict(
-                f"disk {device_id!r} is attached read-write to {writer!r}"
-            )
-        if not read_only and others:
-            raise DiskConflict(
-                f"disk {device_id!r} has readers "
-                f"{sorted(others)}; cannot attach read-write"
-            )
-        holders[node] = read_only
-        return f"/dev/disk/by-id/{device_id}"
+        return super().attach_disk(device_id, node, read_only)
 
     def detach_disk(self, device_id, node):
         self._call("detach-disk")
-        holders = self.disk_attachments.get(device_id, {})
-        holders.pop(node, None)
-        if not holders:
-            self.disk_attachments.pop(device_id, None)
+        super().detach_disk(device_id, node)
 
     def disk_is_attached(self, device_id, node):
         self._call("disk-is-attached")
-        return node in self.disk_attachments.get(device_id, {})
+        return super().disk_is_attached(device_id, node)
 
     def disks_attached_to(self, node):
-        """Device ids the cloud holds on this instance (the
-        gce.DisksAreAttached bulk form; the controller's actual-state
-        reconciliation reads it so a crashed sync can't leak holds)."""
         self._call("disks-attached-to")
-        return sorted(
-            d for d, holders in self.disk_attachments.items()
-            if node in holders
-        )
+        return super().disks_attached_to(node)
 
     def get_tcp_load_balancer(self, name, region):
         self._call("get-lb")

@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.cloudprovider.cloud import (
     CloudProvider,
+    DiskAttachmentTable,
     InstanceNotFound,
     LoadBalancer,
     Route,
@@ -154,7 +155,7 @@ class _LocalLB:
         )
 
 
-class LocalCloud(CloudProvider):
+class LocalCloud(DiskAttachmentTable, CloudProvider):
     """One-machine cloud: instances are registered node names, the LB
     actually forwards bytes."""
 

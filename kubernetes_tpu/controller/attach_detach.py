@@ -98,18 +98,74 @@ class AttachDetachController(PeriodicRunner):
                 device = plugin.device_of(spec)
                 want.setdefault(pod.spec.node_name, set()).add(device)
                 # remember (plugin, spec) so the cloud attacher can
-                # carry the source's readOnly bit to the attach call
-                self._want_specs[(pod.spec.node_name, device)] = (
-                    plugin, spec,
-                )
+                # carry the source's readOnly bit; when multiple pods
+                # on the node share the device, ANY read-write consumer
+                # makes the attachment read-write (iteration order must
+                # not decide the mode)
+                key = (pod.spec.node_name, device)
+                prior = self._want_specs.get(key)
+                if prior is not None:
+                    from kubernetes_tpu.volume.attachers import (
+                        spec_read_only,
+                    )
+
+                    if not spec_read_only(prior[1]):
+                        continue  # already RW: strongest mode wins
+                self._want_specs[key] = (plugin, spec)
         return want
 
     # -- reconcile -----------------------------------------------------------
 
+    def _sweep_gone_nodes(self, current: Set[str]) -> int:
+        """Detach cloud holds of nodes that no longer exist. Steady
+        state compares against the nodes seen last sync; the FIRST sync
+        of a process instead lists the cloud's whole attachment table
+        (gce ListDisks role), so a node deleted while the controller
+        was down doesn't leak its holds forever."""
+        gone_nodes: Set[str] = set()
+        known = getattr(self, "_known_nodes", None)
+        if known is None:
+            list_all = getattr(self.cloud, "all_disk_attachments", None)
+            if list_all is not None:
+                try:
+                    for _d, holders in list_all().items():
+                        gone_nodes |= set(holders) - current
+                except Exception:
+                    pass
+        else:
+            gone_nodes = known - current
+        enum = getattr(self.cloud, "disks_attached_to", None)
+        detached = 0
+        failed_gone: Set[str] = set()
+        for gone in gone_nodes:
+            if enum is None:
+                break
+            try:
+                for device in enum(gone):
+                    self.cloud.detach_disk(device, gone)
+                    detached += 1
+            except Exception:
+                failed_gone.add(gone)  # sweep again next sync
+        self._known_nodes = current | failed_gone
+        return detached
+
     def sync_once(self) -> Tuple[int, int]:
         want = self.desired_state()
         attached = detached = 0
-        for node in self.node_informer.store.list():
+        nodes = self.node_informer.store.list()
+        # a node deleted while holding cloud attachments would leak its
+        # holds forever (nothing iterates it again): sweep the holds of
+        # nodes that vanished since the last sync
+        if self.cloud is not None:
+            synced = getattr(self.node_informer, "has_synced",
+                             lambda: True)
+            if synced():
+                detached += self._sweep_gone_nodes(
+                    {n.metadata.name for n in nodes}
+                )
+            # else: an unsynced (empty) node list must not read as
+            # "every node is gone" — the sweep waits for the informer
+        for node in nodes:
             name = node.metadata.name
             have = {v.name for v in node.status.volumes_attached}
             if self.cloud is not None:
@@ -139,10 +195,12 @@ class AttachDetachController(PeriodicRunner):
             # claim a device the cloud still holds elsewhere
             for device in sorted(have - keep):
                 if self.cloud is not None:
-                    try:
-                        self.cloud.detach_disk(device, name)
-                    except Exception:
-                        keep = keep | {device}  # still held: try again
+                    from kubernetes_tpu.volume.attachers import (
+                        tolerant_detach,
+                    )
+
+                    if not tolerant_detach(self.cloud, device, name):
+                        keep = keep | {device}  # still held: next sync
                         continue
                 detached += 1
             fresh.status.volumes_attached = [

@@ -29,7 +29,8 @@ def spec_read_only(spec: VolumeSpec) -> bool:
     """The source's readOnly bit (gce_pd.readOnly / awsElasticBlockStore
     .readOnly), PV or inline form (source routing shared with the
     plugin registry's _source)."""
-    for field_name in ("gce_persistent_disk", "aws_elastic_block_store"):
+    for field_name in ("gce_persistent_disk", "aws_elastic_block_store",
+                       "cinder", "fc"):
         src = _source(spec, field_name)
         if src is not None:
             return bool(getattr(src, "read_only", False))
@@ -67,11 +68,27 @@ class CloudDiskAttacher:
     def detach(self, device_id: str, node: str) -> None:
         """Idempotent: already-detached is success (attacher.go Detach
         tolerates 'not found')."""
+        if not tolerant_detach(self.cloud, device_id, node):
+            raise RuntimeError(
+                f"detach of {device_id!r} from {node!r} failed and the "
+                "cloud still reports the hold"
+            )
+
+
+def tolerant_detach(cloud: CloudProvider, device_id: str,
+                    node: str) -> bool:
+    """The one copy of the already-detached tolerance rule (attacher.go
+    Detach): returns True when the hold is gone — including when the
+    cloud raised because it was never there — and False only when the
+    cloud still reports (or cannot deny) the attachment."""
+    try:
+        cloud.detach_disk(device_id, node)
+        return True
+    except Exception:
         try:
-            self.cloud.detach_disk(device_id, node)
+            return not cloud.disk_is_attached(device_id, node)
         except Exception:
-            if self.cloud.disk_is_attached(device_id, node):
-                raise  # a real failure, not already-detached
+            return False
 
 
 def attacher_for(plugin: VolumePlugin,

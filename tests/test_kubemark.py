@@ -94,4 +94,4 @@ def test_perf_harness_small():
     out = io.StringIO()
     throughput = schedule_pods(10, 50, provider="DefaultProvider", out=out)
     assert throughput > 0
-    assert "Total: 50" in out.getvalue()
+    assert "scheduled 50 pods on 10 nodes" in out.getvalue()

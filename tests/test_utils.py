@@ -210,3 +210,32 @@ class TestTrace:
         clock.step(0.02)
         assert tr.total_time() == pytest.approx(0.03)
         tr.log_if_long(0.02)  # must not raise
+
+
+class TestPprofEndpoints:
+    """net/http/pprof analogue on the shared mux (server.go:96-99)."""
+
+    def test_thread_dump_and_profile(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+
+        api = APIServer()
+        code, out = api.handle("GET", "/debug/pprof/goroutine")
+        assert code == 200
+        text = out["_raw"].decode()
+        assert "MainThread" in text and "thread " in text
+        code, out = api.handle(
+            "GET", "/debug/pprof/profile", {"seconds": "0.2"}
+        )
+        assert code == 200
+        assert b"sampling rounds" in out["_raw"]
+        code, out = api.handle("GET", "/debug/pprof")
+        assert b"pprof endpoints" in out["_raw"]
+
+    def test_profile_rejects_garbage_seconds(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+
+        api = APIServer()
+        code, _ = api.handle(
+            "GET", "/debug/pprof/profile", {"seconds": "bananas"}
+        )
+        assert code == 400

@@ -512,3 +512,46 @@ class TestCloudDiskAttachers:
         # detach is idempotent
         att.detach("gce-pd/d", "n1")
         att.detach("gce-pd/d", "n1")
+
+
+def test_localcloud_implements_disk_ops():
+    """local-up wires cloud=LocalCloud into the attach/detach
+    controller; the local provider must carry the same disk semantics
+    as the fake (regression: it once inherited NotImplementedError)."""
+    from kubernetes_tpu.cloudprovider import LocalCloud
+    from kubernetes_tpu.cloudprovider.cloud import DiskConflict
+
+    lc = LocalCloud()
+    assert lc.attach_disk("d1", "n1") == "/dev/disk/by-id/d1"
+    with pytest.raises(DiskConflict):
+        lc.attach_disk("d1", "n2")
+    assert lc.disks_attached_to("n1") == ["d1"]
+    lc.detach_disk("d1", "n1")
+    assert lc.all_disk_attachments() == {}
+
+
+def test_startup_sweep_releases_holds_of_deleted_nodes():
+    """A node deleted while the controller was DOWN must not leak its
+    cloud holds: the first sync lists the cloud's attachment table and
+    sweeps (reconciler.go actual-state at startup)."""
+    from kubernetes_tpu.api.types import Node
+    from kubernetes_tpu.cloudprovider import FakeCloud
+    from kubernetes_tpu.controller.attach_detach import (
+        AttachDetachController,
+    )
+
+    server = APIServer()
+    client = RESTClient(LocalTransport(server))
+    informers = SharedInformerFactory(client)
+    cloud = FakeCloud(instances=["n1"])
+    # a hold left by a previous controller process on a node that no
+    # longer exists
+    cloud.attach_disk("gce-pd/orphan", "dead-node")
+    client.resource("nodes").create(Node(
+        metadata=ObjectMeta(name="n1", namespace="")))
+    ctrl = AttachDetachController(client, informers, cloud=cloud)
+    informers.start()
+    informers.wait_for_sync()
+    ctrl.sync_once()
+    assert not cloud.disk_is_attached("gce-pd/orphan", "dead-node")
+    informers.stop()
