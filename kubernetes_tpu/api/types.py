@@ -1317,3 +1317,69 @@ class ComponentStatus:
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     conditions: List[ComponentCondition] = field(default_factory=list)
+
+
+# --- RBAC (pkg/apis/rbac/types.go) ------------------------------------------
+
+
+@dataclass
+class PolicyRule:
+    """rbac/types.go:43 PolicyRule ('*' means all, :31-34)."""
+
+    verbs: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    non_resource_urls: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RBACSubject:
+    """rbac/types.go:64 Subject: User | Group | ServiceAccount."""
+
+    kind: str = "User"
+    name: str = ""
+    namespace: str = ""  # ServiceAccount subjects only
+
+
+@dataclass
+class RoleRef:
+    """rbac/types.go RoleRef: Role (same namespace) or ClusterRole."""
+
+    kind: str = "Role"
+    name: str = ""
+
+
+@dataclass
+class Role:
+    """rbac/types.go:79 Role (namespaced rule set)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole:
+    """rbac/types.go ClusterRole (cluster-wide rule set)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class RoleBinding:
+    """rbac/types.go:91 RoleBinding: subjects -> role in one namespace."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+@dataclass
+class ClusterRoleBinding:
+    """rbac/types.go ClusterRoleBinding: subjects -> ClusterRole,
+    cluster-wide."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)

@@ -123,6 +123,11 @@ def start_http_server(api: APIServer, host: str, port: int,
                         verb=method,
                         resource=info.resource if info else "",
                         namespace=ns,
+                        name=_name or "",
+                        api_group=info.group if info else "",
+                        subresource=_sub or "",
+                        path=parsed.path,
+                        query_watch=query.get("watch") in ("true", "1"),
                     )
                     if not authorizer.authorize(attrs):
                         self._send_json(

@@ -244,6 +244,24 @@ def default_resources() -> Dict[str, ResourceInfo]:
         ResourceInfo(
             "podtemplates", "PodTemplate", t.PodTemplate, "/podtemplates",
         ),
+        # -- RBAC (pkg/apis/rbac; registry/role etc. land post-window,
+        # the API group itself is in this tree) ------------------------------
+        ResourceInfo(
+            "roles", "Role", t.Role, "/roles", group="rbac",
+        ),
+        ResourceInfo(
+            "rolebindings", "RoleBinding", t.RoleBinding,
+            "/rolebindings", group="rbac",
+        ),
+        ResourceInfo(
+            "clusterroles", "ClusterRole", t.ClusterRole,
+            "/clusterroles", namespaced=False, group="rbac",
+        ),
+        ResourceInfo(
+            "clusterrolebindings", "ClusterRoleBinding",
+            t.ClusterRoleBinding, "/clusterrolebindings",
+            namespaced=False, group="rbac",
+        ),
         # virtual: GET/LIST probe live component health, nothing stored
         # (registry/componentstatus/rest.go)
         ResourceInfo(

@@ -13,6 +13,7 @@ from kubernetes_tpu.auth.authn import (
     UnionAuthenticator,
     UserInfo,
 )
+from kubernetes_tpu.auth.rbac import RBACAuthorizer
 from kubernetes_tpu.auth.authz import (
     ABACAuthorizer,
     ABACPolicy,

@@ -27,6 +27,17 @@ class Attributes:
     verb: str  # HTTP method
     resource: str
     namespace: str
+    # resource instance name ("" for collection requests) and API group
+    # ("" = core) — what RBAC resourceNames/apiGroups match against
+    name: str = ""
+    api_group: str = ""
+    # subresource ("status", "binding", ...): RBAC requires an explicit
+    # resource/subresource grant; the raw path backs nonResourceURLs
+    # (/healthz, /metrics, ...); query_watch marks ?watch=true requests
+    # (the API verb is watch, not list)
+    subresource: str = ""
+    path: str = ""
+    query_watch: bool = False
 
     @property
     def readonly(self) -> bool:

@@ -25,10 +25,15 @@ _GROUPS = {
     "podsecuritypolicies": "/apis/extensions/v1beta1",
     "poddisruptionbudgets": "/apis/policy/v1alpha1",
     "scheduledjobs": "/apis/batch/v2alpha1",
+    "roles": "/apis/rbac/v1alpha1",
+    "rolebindings": "/apis/rbac/v1alpha1",
+    "clusterroles": "/apis/rbac/v1alpha1",
+    "clusterrolebindings": "/apis/rbac/v1alpha1",
 }
 _CLUSTER_SCOPED = {
     "nodes", "namespaces", "persistentvolumes",
     "podsecuritypolicies", "componentstatuses",
+    "clusterroles", "clusterrolebindings",
 }
 
 

@@ -52,6 +52,9 @@ ALIASES = {
     "sj": "scheduledjobs", "scheduledjob": "scheduledjobs",
     "podtemplate": "podtemplates",
     "cs": "componentstatuses", "componentstatus": "componentstatuses",
+    "role": "roles", "rolebinding": "rolebindings",
+    "clusterrole": "clusterroles",
+    "clusterrolebinding": "clusterrolebindings",
 }
 
 SCALABLE = {
@@ -78,6 +81,9 @@ _KIND_TO_RESOURCE = {
     "PodDisruptionBudget": "poddisruptionbudgets",
     "PodSecurityPolicy": "podsecuritypolicies",
     "ScheduledJob": "scheduledjobs", "PodTemplate": "podtemplates",
+    "Role": "roles", "RoleBinding": "rolebindings",
+    "ClusterRole": "clusterroles",
+    "ClusterRoleBinding": "clusterrolebindings",
 }
 
 

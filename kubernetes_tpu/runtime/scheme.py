@@ -233,6 +233,18 @@ def _default_scheme() -> Scheme:
         ("ServiceAccount", t.ServiceAccount),
         ("Secret", t.Secret),
         ("ConfigMap", t.ConfigMap),
+        ("ThirdPartyResource", t.ThirdPartyResource),
+        ("Ingress", t.Ingress),
+        ("NetworkPolicy", t.NetworkPolicy),
+        ("PodDisruptionBudget", t.PodDisruptionBudget),
+        ("PodSecurityPolicy", t.PodSecurityPolicy),
+        ("ScheduledJob", t.ScheduledJob),
+        ("PodTemplate", t.PodTemplate),
+        ("ComponentStatus", t.ComponentStatus),
+        ("Role", t.Role),
+        ("RoleBinding", t.RoleBinding),
+        ("ClusterRole", t.ClusterRole),
+        ("ClusterRoleBinding", t.ClusterRoleBinding),
     ]:
         s.register(kind, cls)
     return s
