@@ -268,5 +268,18 @@ def default_resources() -> Dict[str, ResourceInfo]:
             "componentstatuses", "ComponentStatus", t.ComponentStatus,
             "/componentstatuses", namespaced=False,
         ),
+        # virtual review resources: the SERVER side of the webhook wire
+        # (pkg/apis/authentication.k8s.io TokenReview, authorization
+        # SubjectAccessReview) — POST-only, nothing stored; answered by
+        # this server's own authenticator/authorizer
+        ResourceInfo(
+            "tokenreviews", "TokenReview", dict, "/tokenreviews",
+            namespaced=False, group="authentication.k8s.io",
+        ),
+        ResourceInfo(
+            "subjectaccessreviews", "SubjectAccessReview", dict,
+            "/subjectaccessreviews", namespaced=False,
+            group="authorization.k8s.io",
+        ),
     ]
     return {info.resource: info for info in infos}

@@ -491,6 +491,14 @@ class APIServer:
 
     def _dispatch(self, method, path, query, body, ns, info, name,
                   subresource, obj_mode, codec):
+        if info.resource in ("tokenreviews", "subjectaccessreviews"):
+            # virtual review endpoints (the webhook SERVER side): POST
+            # only, verdict from this server's authn/authz, no storage
+            if method != "POST":
+                raise APIError(405, f"{info.resource} only supports POST")
+            if info.resource == "tokenreviews":
+                return self._token_review(body)
+            return self._subject_access_review(body)
         if info.resource == "componentstatuses":
             # virtual resource: every GET probes live component health
             # (registry/componentstatus/rest.go); writes are rejected
@@ -748,6 +756,80 @@ class APIServer:
             "kind": "APIResourceList",
             "groupVersion": gv_name,
             "resources": resources,
+        }
+
+    def _token_review(self, body):
+        """POST tokenreviews: validate spec.token against this server's
+        authenticator (the webhook TokenReview SERVER side — our
+        WebhookTokenAuthenticator can point at another apiserver)."""
+        if not isinstance(body, dict):
+            raise APIError(400, "TokenReview body required")
+        token = ((body.get("spec") or {}).get("token") or "")
+        status: Dict[str, Any] = {"authenticated": False}
+        if token and self.authenticator is not None:
+            try:
+                user = self.authenticator.authenticate(
+                    {"Authorization": f"Bearer {token}"}
+                )
+            except Exception:
+                user = None
+            if user is not None:
+                status = {
+                    "authenticated": True,
+                    "user": {
+                        "username": user.name,
+                        "uid": user.uid,
+                        "groups": list(user.groups),
+                    },
+                }
+        return 201, {
+            "apiVersion": "authentication.k8s.io/v1beta1",
+            "kind": "TokenReview",
+            "spec": {"token": token},
+            "status": status,
+        }
+
+    def _subject_access_review(self, body):
+        """POST subjectaccessreviews: ask this server's authorizer (the
+        webhook SubjectAccessReview SERVER side)."""
+        if not isinstance(body, dict):
+            raise APIError(400, "SubjectAccessReview body required")
+        from kubernetes_tpu.auth.authn import UserInfo
+        from kubernetes_tpu.auth.authz import Attributes
+
+        spec = body.get("spec") or {}
+        user = UserInfo(
+            name=spec.get("user", ""),
+            groups=tuple(spec.get("groups", ()) or ()),
+        )
+        ra = spec.get("resourceAttributes") or {}
+        nra = spec.get("nonResourceAttributes") or {}
+        attrs = Attributes(
+            user=user,
+            # no fabricated default: an absent verb evaluates as ""
+            # (only a '*' rule can match it), like upstream
+            verb=(ra.get("verb") or nra.get("verb") or ""),
+            resource=ra.get("resource", ""),
+            namespace=ra.get("namespace", ""),
+            name=ra.get("name", ""),
+            api_group=ra.get("group", ""),
+            subresource=ra.get("subresource", ""),
+            path=nra.get("path", ""),
+        )
+        allowed = False
+        reason = "no authorizer configured"
+        if self.authorizer is not None:
+            try:
+                allowed = bool(self.authorizer.authorize(attrs))
+                reason = ""
+            except Exception as e:
+                allowed, reason = False, str(e)
+        return 201, {
+            "apiVersion": "authorization.k8s.io/v1beta1",
+            "kind": "SubjectAccessReview",
+            "spec": spec,
+            "status": {"allowed": allowed,
+                       **({"reason": reason} if reason else {})},
         }
 
     def register_component(self, name: str, probe: Callable) -> None:

@@ -41,7 +41,10 @@ class Attributes:
 
     @property
     def readonly(self) -> bool:
-        return self.verb.upper() in READ_VERBS
+        # verbs arrive as HTTP methods from the frontend and as API
+        # verbs from SubjectAccessReviews; both read forms count
+        return (self.verb.upper() in READ_VERBS
+                or self.verb in ("get", "list", "watch"))
 
 
 class Authorizer:

@@ -36,6 +36,10 @@ _HTTP_TO_VERB = {
 
 
 def api_verb(attrs: Attributes) -> str:
+    if not attrs.verb.isupper():
+        # already an API verb (a SubjectAccessReview asks "get"/"watch"
+        # directly); only UPPERCASE HTTP methods get the REST mapping
+        return attrs.verb
     m = attrs.verb.upper()
     if not attrs.resource:
         # non-resource requests keep the lowercased HTTP method as the

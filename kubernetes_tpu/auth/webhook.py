@@ -50,11 +50,17 @@ class _TTLCache:
             self._data[key] = (value, time.monotonic() + self.ttl)
 
 
-def _post_json(url: str, payload: dict, timeout: float) -> dict:
+def _post_json(url: str, payload: dict, timeout: float,
+               bearer_token: str = "") -> dict:
+    headers = {"Content-Type": "application/json"}
+    if bearer_token:
+        # the webhook kubeconfig's user credential: the CALLER of a
+        # review endpoint authenticates like any other client
+        headers["Authorization"] = f"Bearer {bearer_token}"
     req = urllib.request.Request(
         url,
         data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
+        headers=headers,
         method="POST",
     )
     with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -65,9 +71,10 @@ class WebhookTokenAuthenticator(Authenticator):
     """TokenReview over HTTP (webhook.go AuthenticateToken)."""
 
     def __init__(self, url: str, cache_ttl: float = 120.0,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, bearer_token: str = ""):
         self.url = url
         self.timeout = timeout
+        self.bearer_token = bearer_token
         self._cache = _TTLCache(cache_ttl)
 
     def authenticate(self, headers: Dict[str, str]) -> Optional[UserInfo]:
@@ -86,7 +93,8 @@ class WebhookTokenAuthenticator(Authenticator):
             "spec": {"token": token},
         }
         try:
-            resp = _post_json(self.url, review, self.timeout)
+            resp = _post_json(self.url, review, self.timeout,
+                              self.bearer_token)
         except Exception:
             return None  # webhook down: no opinion, union continues
         status = resp.get("status", {})
@@ -108,7 +116,8 @@ class WebhookAuthorizer(Authorizer):
     DENY: an unreachable authorizer must not open the cluster."""
 
     def __init__(self, url: str, cache_ttl: float = 30.0,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, bearer_token: str = ""):
+        self.bearer_token = bearer_token
         self.url = url
         self.timeout = timeout
         self._cache = _TTLCache(cache_ttl)
@@ -130,21 +139,38 @@ class WebhookAuthorizer(Authorizer):
         if cached is not None:
             return cached
         user = attrs.user
+        # ship the FULL request shape, with the verb already mapped to
+        # the API form — the server side evaluates exactly the request
+        # being made (subresource grants, named gets, nonResourceURLs)
+        from kubernetes_tpu.auth.rbac import api_verb
+
+        verb = api_verb(attrs)
+        spec = {
+            "user": user.name if user else "",
+            "groups": list(user.groups) if user else [],
+        }
+        if attrs.resource:
+            spec["resourceAttributes"] = {
+                "verb": verb,
+                "resource": attrs.resource,
+                "namespace": attrs.namespace,
+                "name": getattr(attrs, "name", ""),
+                "group": getattr(attrs, "api_group", ""),
+                "subresource": getattr(attrs, "subresource", ""),
+            }
+        else:
+            spec["nonResourceAttributes"] = {
+                "verb": verb,
+                "path": getattr(attrs, "path", ""),
+            }
         review = {
             "apiVersion": "authorization.k8s.io/v1beta1",
             "kind": "SubjectAccessReview",
-            "spec": {
-                "user": user.name if user else "",
-                "groups": list(user.groups) if user else [],
-                "resourceAttributes": {
-                    "verb": attrs.verb,
-                    "resource": attrs.resource,
-                    "namespace": attrs.namespace,
-                },
-            },
+            "spec": spec,
         }
         try:
-            resp = _post_json(self.url, review, self.timeout)
+            resp = _post_json(self.url, review, self.timeout,
+                              self.bearer_token)
         except Exception:
             return False  # fail closed
         allowed = bool(resp.get("status", {}).get("allowed"))

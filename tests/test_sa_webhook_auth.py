@@ -277,9 +277,11 @@ class TestWebhooks:
     def test_subject_access_review_and_fail_closed(self):
         def respond(body):
             spec = body["spec"]
+            # the client ships mapped API verbs (a GET on a
+            # collection reviews as "list"), like upstream
             allowed = (
                 spec["user"] == "alice"
-                and spec["resourceAttributes"]["verb"] == "GET"
+                and spec["resourceAttributes"]["verb"] == "list"
             )
             return {"kind": "SubjectAccessReview",
                     "status": {"allowed": allowed}}
@@ -520,3 +522,125 @@ class TestRBAC:
         client.resource("clusterrolebindings").create(crb)
         items, _ = client.resource("clusterrolebindings").list()
         assert items[0].subjects[0].name == "ops"
+
+
+class TestReviewEndpoints:
+    """The SERVER side of the webhook wire: this apiserver answers
+    TokenReview and SubjectAccessReview, so the existing webhook
+    CLIENTS can point one apiserver's authn/authz at another's."""
+
+    def _api(self):
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.auth.authn import TokenAuthenticator, UserInfo
+        from kubernetes_tpu.auth.rbac import RBACAuthorizer
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        api = APIServer(authenticator=TokenAuthenticator({
+            "good-token": UserInfo(name="carol", uid="u1",
+                                   groups=("qa",)),
+        }))
+        api.authorizer = RBACAuthorizer(api)
+        admin = RESTClient(LocalTransport(api))
+        admin.resource("clusterroles").create(t.ClusterRole(
+            metadata=t.ObjectMeta(name="viewer", namespace=""),
+            rules=[t.PolicyRule(verbs=["get", "list"],
+                                resources=["pods"])]))
+        admin.resource("clusterrolebindings").create(t.ClusterRoleBinding(
+            metadata=t.ObjectMeta(name="viewer-b", namespace=""),
+            subjects=[t.RBACSubject(kind="Group", name="qa")],
+            role_ref=t.RoleRef(kind="ClusterRole", name="viewer")))
+        return api
+
+    def test_tokenreview_round_trip(self):
+        api = self._api()
+        code, out = api.handle(
+            "POST",
+            "/apis/authentication.k8s.io/v1beta1/tokenreviews",
+            body={"kind": "TokenReview",
+                  "spec": {"token": "good-token"}},
+        )
+        assert code == 201
+        assert out["status"]["authenticated"] is True
+        assert out["status"]["user"]["username"] == "carol"
+        assert out["status"]["user"]["groups"] == ["qa"]
+        code, out = api.handle(
+            "POST",
+            "/apis/authentication.k8s.io/v1beta1/tokenreviews",
+            body={"kind": "TokenReview", "spec": {"token": "bogus"}},
+        )
+        assert out["status"]["authenticated"] is False
+
+    def test_subjectaccessreview_round_trip(self):
+        api = self._api()
+
+        def sar(spec):
+            code, out = api.handle(
+                "POST",
+                "/apis/authorization.k8s.io/v1beta1/subjectaccessreviews",
+                body={"kind": "SubjectAccessReview", "spec": spec},
+            )
+            assert code == 201
+            return out["status"]["allowed"]
+
+        assert sar({"user": "carol", "groups": ["qa"],
+                    "resourceAttributes": {"verb": "get",
+                                           "resource": "pods",
+                                           "name": "p1",
+                                           "namespace": "x"}})
+        assert not sar({"user": "carol", "groups": ["qa"],
+                        "resourceAttributes": {"verb": "create",
+                                               "resource": "pods",
+                                               "namespace": "x"}})
+        assert not sar({"user": "mallory", "groups": [],
+                        "resourceAttributes": {"verb": "get",
+                                               "resource": "pods"}})
+
+    def test_webhook_clients_point_at_this_server(self):
+        """The loop closes: WebhookTokenAuthenticator /
+        WebhookAuthorizer against OUR endpoints."""
+        from kubernetes_tpu.auth.authn import UserInfo
+        from kubernetes_tpu.auth.authz import Attributes
+        from kubernetes_tpu.auth.webhook import (
+            WebhookAuthorizer,
+            WebhookTokenAuthenticator,
+        )
+
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        api = self._api()
+        # the caller of a review endpoint authenticates and needs the
+        # auth-delegator grants (create tokenreviews/SARs)
+        admin = RESTClient(LocalTransport(api))
+        admin.resource("clusterroles").create(t.ClusterRole(
+            metadata=t.ObjectMeta(name="auth-delegator", namespace=""),
+            rules=[t.PolicyRule(
+                verbs=["create"],
+                api_groups=["*"],
+                resources=["tokenreviews", "subjectaccessreviews"])]))
+        admin.resource("clusterrolebindings").create(t.ClusterRoleBinding(
+            metadata=t.ObjectMeta(name="auth-delegator-b", namespace=""),
+            subjects=[t.RBACSubject(kind="User", name="carol")],
+            role_ref=t.RoleRef(kind="ClusterRole",
+                               name="auth-delegator")))
+        host, port = api.serve_http()
+        base = f"http://{host}:{port}"
+        wa = WebhookTokenAuthenticator(
+            f"{base}/apis/authentication.k8s.io/v1beta1/tokenreviews",
+            bearer_token="good-token")
+        user = wa.authenticate(
+            {"Authorization": "Bearer good-token"})
+        assert user is not None and user.name == "carol"
+        assert wa.authenticate({"Authorization": "Bearer nope"}) is None
+        wz = WebhookAuthorizer(
+            f"{base}/apis/authorization.k8s.io/v1beta1/"
+            "subjectaccessreviews", bearer_token="good-token")
+        carol = UserInfo(name="carol", groups=("qa",))
+        assert wz.authorize(Attributes(
+            user=carol, verb="get", resource="pods", namespace="x",
+            name="p1"))
+        assert not wz.authorize(Attributes(
+            user=carol, verb="create", resource="pods", namespace="x"))
