@@ -42,9 +42,9 @@ class Attributes:
     @property
     def readonly(self) -> bool:
         # verbs arrive as HTTP methods from the frontend and as API
-        # verbs from SubjectAccessReviews; both read forms count
-        return (self.verb.upper() in READ_VERBS
-                or self.verb in ("get", "list", "watch"))
+        # verbs from SubjectAccessReviews; LIST is the one API read
+        # verb with no HTTP-method twin in READ_VERBS
+        return self.verb.upper() in READ_VERBS or self.verb == "list"
 
 
 class Authorizer:

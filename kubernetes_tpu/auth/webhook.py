@@ -124,13 +124,22 @@ class WebhookAuthorizer(Authorizer):
 
     @staticmethod
     def _key(attrs: Attributes) -> Tuple:
+        """EVERY field the review decision depends on must key the
+        cache — a named get and a collection list are different
+        questions with different answers."""
+        from kubernetes_tpu.auth.rbac import api_verb
+
         user = attrs.user
         return (
             user.name if user else "",
             tuple(user.groups) if user else (),
-            attrs.verb,
+            api_verb(attrs),
             attrs.resource,
             attrs.namespace,
+            attrs.name,
+            attrs.api_group,
+            attrs.subresource,
+            attrs.path,
         )
 
     def authorize(self, attrs: Attributes) -> bool:
@@ -154,14 +163,14 @@ class WebhookAuthorizer(Authorizer):
                 "verb": verb,
                 "resource": attrs.resource,
                 "namespace": attrs.namespace,
-                "name": getattr(attrs, "name", ""),
-                "group": getattr(attrs, "api_group", ""),
-                "subresource": getattr(attrs, "subresource", ""),
+                "name": attrs.name,
+                "group": attrs.api_group,
+                "subresource": attrs.subresource,
             }
         else:
             spec["nonResourceAttributes"] = {
                 "verb": verb,
-                "path": getattr(attrs, "path", ""),
+                "path": attrs.path,
             }
         review = {
             "apiVersion": "authorization.k8s.io/v1beta1",

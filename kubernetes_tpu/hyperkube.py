@@ -223,6 +223,25 @@ def run_local_up(args) -> None:
     host, port = server.serve_http(port=args.port)
     client = _client(f"http://{host}:{port}")
     cluster = HollowCluster(client, args.nodes).run()
+    # real nodes: kubelets on the PROCESS runtime — pods scheduled there
+    # run as live OS processes (docker_manager.go's role, sandbox form)
+    real_kubelets = []
+    real_runtimes = []
+    if getattr(args, "real_nodes", 0):
+        from kubernetes_tpu.kubelet import (
+            Kubelet,
+            KubeletConfig,
+            ProcessRuntime,
+        )
+
+        for i in range(args.real_nodes):
+            rt = ProcessRuntime()
+            real_runtimes.append(rt)
+            real_kubelets.append(Kubelet(
+                client,
+                KubeletConfig(node_name=f"real-node-{i:03d}"),
+                rt,
+            ).run())
     # the "local" cloud: each hollow node gets a live userspace proxy
     # and the provider's LoadBalancer fronts them, so `kubectl expose
     # --type=LoadBalancer` provisions a balancer that forwards bytes
@@ -272,6 +291,10 @@ def run_local_up(args) -> None:
     mgr.stop()
     for proxier in proxiers:
         proxier.stop()
+    for kl in real_kubelets:
+        kl.stop()
+    for rt in real_runtimes:
+        rt.close()
     cluster.stop()
 
 
@@ -377,6 +400,11 @@ def main(argv=None):
                    help="persist the apiserver store (WAL + snapshot)")
     p.add_argument("--dns-port", type=int, default=0,
                    help="kube-dns UDP+TCP port (0 = ephemeral; 53 needs root)")
+    p.add_argument(
+        "--real-nodes", type=int, default=0,
+        help="additionally run N kubelets on the PROCESS runtime: pods "
+        "scheduled there run as live OS processes",
+    )
 
     args = ap.parse_args(argv)
     {

@@ -644,3 +644,49 @@ class TestReviewEndpoints:
             name="p1"))
         assert not wz.authorize(Attributes(
             user=carol, verb="create", resource="pods", namespace="x"))
+
+    def test_sar_cache_keys_on_the_full_request(self):
+        """A cached named-get verdict must not answer a collection
+        list (the cache-key collision would be privilege escalation
+        under resourceNames grants)."""
+        from kubernetes_tpu.auth.authn import UserInfo
+        from kubernetes_tpu.auth.authz import Attributes
+        from kubernetes_tpu.auth.webhook import WebhookAuthorizer
+
+        api = self._api()
+        admin_calls = []
+        # grant carol get on pod p1 ONLY (resourceNames)
+        from kubernetes_tpu.api import types as t
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        admin = RESTClient(LocalTransport(api))
+        admin.resource("clusterroles").create(t.ClusterRole(
+            metadata=t.ObjectMeta(name="p1-only", namespace=""),
+            rules=[t.PolicyRule(verbs=["get"], resources=["secrets"],
+                                resource_names=["p1"])]))
+        admin.resource("clusterrolebindings").create(t.ClusterRoleBinding(
+            metadata=t.ObjectMeta(name="p1-only-b", namespace=""),
+            subjects=[t.RBACSubject(kind="User", name="carol")],
+            role_ref=t.RoleRef(kind="ClusterRole", name="p1-only")))
+        admin.resource("clusterroles").create(t.ClusterRole(
+            metadata=t.ObjectMeta(name="delegate", namespace=""),
+            rules=[t.PolicyRule(verbs=["create"], api_groups=["*"],
+                                resources=["subjectaccessreviews"])]))
+        admin.resource("clusterrolebindings").create(t.ClusterRoleBinding(
+            metadata=t.ObjectMeta(name="delegate-b", namespace=""),
+            subjects=[t.RBACSubject(kind="User", name="carol")],
+            role_ref=t.RoleRef(kind="ClusterRole", name="delegate")))
+        host, port = api.serve_http()
+        wz = WebhookAuthorizer(
+            f"http://{host}:{port}/apis/authorization.k8s.io/v1beta1/"
+            "subjectaccessreviews", bearer_token="good-token",
+            cache_ttl=60)
+        carol = UserInfo(name="carol", groups=("qa",))
+        named = Attributes(user=carol, verb="GET", resource="secrets",
+                           namespace="x", name="p1")
+        listing = Attributes(user=carol, verb="GET", resource="secrets",
+                             namespace="x")
+        assert wz.authorize(named) is True
+        # the cached named-get verdict must NOT leak onto the list
+        assert wz.authorize(listing) is False
