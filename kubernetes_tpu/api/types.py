@@ -1383,3 +1383,27 @@ class ClusterRoleBinding:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     subjects: List[RBACSubject] = field(default_factory=list)
     role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+# --- Scale subresource (extensions/types.go Scale) ---------------------------
+
+
+@dataclass
+class ScaleSpec:
+    replicas: int = 0
+
+
+@dataclass
+class ScaleStatus:
+    replicas: int = 0
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Scale:
+    """extensions/types.go Scale: the one shape every scalable
+    resource's /scale subresource serves (registry/.../etcd ScaleREST)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ScaleSpec = field(default_factory=ScaleSpec)
+    status: ScaleStatus = field(default_factory=ScaleStatus)

@@ -489,8 +489,19 @@ class APIServer:
             )
         return codec
 
+    # resources serving the /scale subresource (the reference's
+    # ScaleREST installs on rc/rs/deployment etc.)
+    SCALABLE = {
+        "replicationcontrollers", "replicasets", "deployments",
+        "jobs", "petsets",
+    }
+
     def _dispatch(self, method, path, query, body, ns, info, name,
                   subresource, obj_mode, codec):
+        if (subresource == "scale" and name
+                and info.resource in self.SCALABLE):
+            return self._scale(info, ns, name, method, body, obj_mode,
+                               codec)
         if info.resource in ("tokenreviews", "subjectaccessreviews"):
             # virtual review endpoints (the webhook SERVER side): POST
             # only, verdict from this server's authn/authz, no storage
@@ -514,6 +525,13 @@ class APIServer:
             if query.get("watch") in ("true", "1") or subresource == "watch":
                 return 200, self._watch(info, ns, query, name, obj_mode,
                                         codec)
+            if subresource and subresource not in ("status", "finalize"):
+                # a GET probing an unserved subresource must not answer
+                # with the main object (clients use this for discovery)
+                raise APIError(
+                    404, f"subresource {subresource!r} not found on "
+                    f"{info.resource}"
+                )
             if name:
                 return 200, self._get(info, ns, name, obj_mode, codec)
             return 200, self._list(info, ns, query, obj_mode, codec)
@@ -758,6 +776,78 @@ class APIServer:
             "resources": resources,
         }
 
+    def _scale(self, info, ns, name, method, body, obj_mode, codec):
+        """GET/PUT {resource}/{name}/scale (registry ScaleREST): the
+        uniform Scale shape over any scalable resource — the seam HPA
+        and `kubectl scale` drive without knowing the resource's own
+        schema."""
+        key = info.key(ns, name)
+        # a Job's scale knob is parallelism (extensions jobs/scale);
+        # everything else scales spec.replicas
+        knob = "parallelism" if info.resource == "jobs" else "replicas"
+
+        def to_scale(obj, rv) -> t.Scale:
+            sel = getattr(obj.spec, "selector", None)
+            if hasattr(sel, "match_labels"):
+                sel = dict(sel.match_labels or {})
+            elif not isinstance(sel, dict):
+                sel = {}
+            return t.Scale(
+                metadata=t.ObjectMeta(
+                    name=name, namespace=ns, resource_version=str(rv)
+                ),
+                spec=t.ScaleSpec(
+                    replicas=getattr(obj.spec, knob, 0) or 0
+                ),
+                status=t.ScaleStatus(
+                    replicas=getattr(obj.status, "replicas",
+                                     getattr(obj.status, "active", 0)),
+                    selector=sel,
+                ),
+            )
+
+        if method == "GET":
+            obj, rv = self.store.get(key)
+            out = to_scale(obj, rv)
+            return 200, (out if obj_mode else codec.encode(out))
+        if method != "PUT":
+            raise APIError(405, "scale supports GET and PUT")
+        if body is None:
+            raise APIError(400, "Scale body required")
+        if isinstance(body, dict):
+            want = int(((body.get("spec") or {}).get("replicas")) or 0)
+            want_rv = (body.get("metadata") or {}).get(
+                "resourceVersion", "")
+        else:
+            want = int(body.spec.replicas)
+            want_rv = body.metadata.resource_version
+        if want < 0:
+            raise APIError(422, "spec.replicas: must be non-negative")
+
+        written = {}
+
+        def bump(obj):
+            if obj is None:
+                raise KeyNotFound(key)
+            if want_rv and want_rv != obj.metadata.resource_version:
+                raise Conflict(
+                    f"{info.resource} {name!r}: the object has been "
+                    "modified"
+                )
+            if getattr(obj.spec, knob, None) != want:
+                setattr(obj.spec, knob, want)
+                # a spec change through ANY write path moves the
+                # generation sequence (strategy PrepareForUpdate)
+                obj.metadata.generation += 1
+            # admission sees scale writes like any other update
+            self.admission.admit(adm.UPDATE, info.resource, ns, obj)
+            written["obj"] = obj
+            return obj
+
+        rv = self.store.guaranteed_update(key, bump)
+        out = to_scale(written["obj"], rv)
+        return 200, (out if obj_mode else codec.encode(out))
+
     def _token_review(self, body):
         """POST tokenreviews: validate spec.token against this server's
         authenticator (the webhook TokenReview SERVER side — our
@@ -967,6 +1057,13 @@ class APIServer:
                 raise Conflict(
                     f"{info.resource} {name!r}: the object has been modified"
                 )
+        if subresource and subresource not in ("status", "finalize"):
+            # an unknown subresource must not silently write the main
+            # resource (a Scale body would mangle a ConfigMap)
+            raise APIError(
+                404, f"subresource {subresource!r} not found on "
+                f"{info.resource}"
+            )
         if subresource == "status":
             # status subresource: only .status moves (registry strategy
             # PrepareForStatusUpdate idiom)
@@ -1028,6 +1125,11 @@ class APIServer:
         if body is None:
             raise APIError(400, "patch body required")
         # the status/main separation holds for PATCH too
+        if subresource and subresource not in ("status",):
+            raise APIError(
+                404, f"subresource {subresource!r} not found on "
+                f"{info.resource}"
+            )
         if subresource == "status":
             body = {"status": body.get("status", {})}
         elif info.has_status:

@@ -397,20 +397,16 @@ class Kubectl:
         if resource not in SCALABLE:
             raise ValueError(f"{resource} is not scalable")
         rc = self._rc(resource)
-        for _ in range(10):
-            obj = rc.get(name)
-            if resource == "jobs":
-                obj.spec.parallelism = replicas
-            else:
-                obj.spec.replicas = replicas
-            try:
-                rc.update(obj)
-                return f"{resource}/{name} scaled"
-            except APIStatusError as e:
-                if e.code != 409:
-                    raise
-                time.sleep(0.05)
-        raise RuntimeError("scale kept conflicting")
+        # the /scale subresource (registry ScaleREST): one round-trip,
+        # no full-object read-modify-write race (the server maps a
+        # Job's scale onto parallelism)
+        self.client.do_raw(
+            "PUT", rc._path(name, "scale"),
+            body={"kind": "Scale",
+                  "metadata": {"name": name},
+                  "spec": {"replicas": replicas}},
+        )
+        return f"{resource}/{name} scaled"
 
     def _edit_meta(self, resource, name, mutate) -> None:
         rc = self._rc(resolve(resource))

@@ -245,6 +245,7 @@ def _default_scheme() -> Scheme:
         ("RoleBinding", t.RoleBinding),
         ("ClusterRole", t.ClusterRole),
         ("ClusterRoleBinding", t.ClusterRoleBinding),
+        ("Scale", t.Scale),
     ]:
         s.register(kind, cls)
     return s

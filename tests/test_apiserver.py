@@ -644,3 +644,138 @@ def test_ui_dashboard_served(api):
             assert resource in body
     finally:
         api.shutdown_http()
+
+
+class TestScaleSubresource:
+    """GET/PUT {resource}/{name}/scale (registry ScaleREST): the
+    uniform Scale shape any scaler drives."""
+
+    def test_get_and_put_scale(self):
+        from kubernetes_tpu.api.types import (
+            LabelSelector,
+            ObjectMeta,
+            ReplicaSet,
+            ReplicaSetSpec,
+        )
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import HTTPTransport
+
+        api = APIServer()
+        host, port = api.serve_http()
+        client = RESTClient(HTTPTransport(f"http://{host}:{port}"))
+        client.resource("replicasets", "default").create(ReplicaSet(
+            metadata=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(
+                replicas=3,
+                selector=LabelSelector(match_labels={"app": "web"}),
+            ),
+        ))
+        scale = client.do_raw(
+            "GET",
+            "/apis/extensions/v1beta1/namespaces/default/"
+            "replicasets/web/scale",
+        )
+        assert scale["kind"] == "Scale"
+        assert scale["spec"]["replicas"] == 3
+        assert scale["status"]["selector"] == {"app": "web"}
+        out = client.do_raw(
+            "PUT",
+            "/apis/extensions/v1beta1/namespaces/default/"
+            "replicasets/web/scale",
+            body={"kind": "Scale", "spec": {"replicas": 7}},
+        )
+        assert out["spec"]["replicas"] == 7
+        assert client.resource(
+            "replicasets", "default").get("web").spec.replicas == 7
+        # stale resourceVersion conflicts (optimistic concurrency)
+        import pytest as _pytest
+
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with _pytest.raises(APIStatusError) as ei:
+            client.do_raw(
+                "PUT",
+                "/apis/extensions/v1beta1/namespaces/default/"
+                "replicasets/web/scale",
+                body={"kind": "Scale",
+                      "metadata": {"resourceVersion": "1"},
+                      "spec": {"replicas": 1}},
+            )
+        assert ei.value.code == 409
+
+    def test_scale_on_unscalable_404s_as_subresource(self):
+        from kubernetes_tpu.api.types import ObjectMeta, ConfigMap
+
+        api = APIServer()
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/default/configmaps",
+            body={"kind": "ConfigMap", "metadata": {"name": "c"}},
+        )
+        assert code == 201
+        # a PUT to an unknown subresource must not write the object
+        code, out = api.handle(
+            "PUT", "/api/v1/namespaces/default/configmaps/c/scale",
+            body={"kind": "Scale", "spec": {"replicas": 3}},
+        )
+        assert code == 404
+        code, got = api.handle(
+            "GET", "/api/v1/namespaces/default/configmaps/c"
+        )
+        assert code == 200 and "spec" not in got
+
+    def test_job_scale_maps_to_parallelism(self):
+        from kubernetes_tpu.api.types import Job, JobSpec, ObjectMeta
+
+        api = APIServer()
+        code, _ = api.handle(
+            "POST", "/apis/batch/v1/namespaces/default/jobs",
+            body={"kind": "Job", "metadata": {"name": "j"},
+                  "spec": {"parallelism": 2}},
+        )
+        assert code == 201
+        code, out = api.handle(
+            "GET", "/apis/batch/v1/namespaces/default/jobs/j/scale")
+        assert code == 200 and out["spec"]["replicas"] == 2
+        code, out = api.handle(
+            "PUT", "/apis/batch/v1/namespaces/default/jobs/j/scale",
+            body={"kind": "Scale", "spec": {"replicas": 5}})
+        assert code == 200
+        code, got = api.handle(
+            "GET", "/apis/batch/v1/namespaces/default/jobs/j")
+        assert got["spec"]["parallelism"] == 5
+
+    def test_scale_bumps_generation_and_patch_subresource_guard(self):
+        from kubernetes_tpu.api.types import (
+            ObjectMeta,
+            ReplicaSet,
+            ReplicaSetSpec,
+        )
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        api = APIServer()
+        client = RESTClient(LocalTransport(api))
+        client.resource("replicasets", "default").create(ReplicaSet(
+            metadata=ObjectMeta(name="g"),
+            spec=ReplicaSetSpec(replicas=1),
+        ))
+        before = client.resource(
+            "replicasets", "default").get("g").metadata.generation
+        code, _ = api.handle(
+            "PUT",
+            "/apis/extensions/v1beta1/namespaces/default/"
+            "replicasets/g/scale",
+            body={"kind": "Scale", "spec": {"replicas": 4}})
+        assert code == 200
+        after = client.resource(
+            "replicasets", "default").get("g").metadata.generation
+        assert after == before + 1  # spec change moves the sequence
+        # PATCH to an unknown subresource must not write either
+        code, _ = api.handle(
+            "PATCH",
+            "/apis/extensions/v1beta1/namespaces/default/"
+            "replicasets/g/bogus",
+            body={"spec": {"replicas": 9}})
+        assert code == 404
+        assert client.resource(
+            "replicasets", "default").get("g").spec.replicas == 4
