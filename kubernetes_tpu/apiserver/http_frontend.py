@@ -280,6 +280,9 @@ def start_http_server(api: APIServer, host: str, port: int,
             self._dispatch("DELETE")
 
     class Server(ThreadingHTTPServer):
+        # the socketserver default backlog of 5 RSTs bursty clients
+        # (30-way parallel pod creators); match a real server's depth
+        request_queue_size = 128
         daemon_threads = True
         allow_reuse_address = True
 

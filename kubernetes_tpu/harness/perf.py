@@ -72,7 +72,13 @@ def make_pods(client: RESTClient, p: int, creators: int = 30,
     """perf/util.go:143-175 makePodsFromRC: pause pods, parallel
     creation. Batches flow through the bulk-create endpoint (an RC
     manager burst-creates its whole replica delta too); generateName
-    collisions retry like the reference's RC manager self-heal."""
+    collisions retry like the reference's RC manager self-heal.
+
+    The count is VERIFIED against the server afterwards and any
+    shortfall topped up: a connection dropped mid-request loses the
+    reply (pods may or may not exist), parallelize logs worker panics
+    without failing (HandleCrash semantics), and a density measurement
+    waiting for a pod that was never created stalls forever."""
     chunks = [min(chunk, p - i) for i in range(0, p, chunk)]
 
     def create(ci: int) -> None:
@@ -93,6 +99,28 @@ def make_pods(client: RESTClient, p: int, creators: int = 30,
         raise RuntimeError("pod create kept colliding")
 
     parallelize(min(creators, len(chunks)), len(chunks), create)
+
+    def count() -> int:
+        return len(client.pods().list(label_selector="name=sched-perf")[0])
+
+    have = count()
+    for _ in range(10):
+        if have >= p:
+            return
+        missing = p - have
+        print(f"pod creation shortfall: {missing} lost to dropped "
+              "connections; topping up", file=sys.stderr)
+        chunks[:] = [min(chunk, missing - i)
+                     for i in range(0, missing, chunk)]
+        # reuse the chunk worker: collision retries + loud non-collision
+        # failures (a validation error must surface, not read as a
+        # shortfall)
+        for ci in range(len(chunks)):
+            create(ci)
+        have = count()
+    raise RuntimeError(
+        f"pod creation kept falling short: {have}/{p} after top-ups"
+    )
 
 
 def _measure(count_scheduled, num_nodes, num_pods, out,

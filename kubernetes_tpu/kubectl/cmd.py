@@ -992,6 +992,7 @@ class Kubectl:
                 self._do("DELETE")
 
         class Server(ThreadingHTTPServer):
+            request_queue_size = 64  # default backlog of 5 RSTs bursts
             daemon_threads = True
             allow_reuse_address = True
 

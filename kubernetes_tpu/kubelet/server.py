@@ -270,6 +270,7 @@ class KubeletServer:
                 self._send(404, {"message": f"unknown path {parsed.path}"})
 
         class Server(ThreadingHTTPServer):
+            request_queue_size = 64  # default backlog of 5 RSTs bursts
             daemon_threads = True
             allow_reuse_address = True
 

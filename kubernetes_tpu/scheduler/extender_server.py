@@ -193,6 +193,7 @@ class TPUExtenderServer:
                 self.wfile.write(data)
 
         class Server(ThreadingHTTPServer):
+            request_queue_size = 64  # default backlog of 5 RSTs bursts
             daemon_threads = True
             allow_reuse_address = True
 
