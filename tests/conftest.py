@@ -34,3 +34,18 @@ jax.config.update("jax_num_cpu_devices", 8)
 from kubernetes_tpu.native.build import ensure_all
 
 ensure_all()
+
+
+def wait_until(cond, timeout=60.0, interval=0.01):
+    """Poll `cond` until truthy or `timeout` elapses. The single shared
+    copy (each test file used to carry its own, and the defaults
+    drifted): a passing wait returns immediately, so the generous
+    deadline only slows genuinely failing tests."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return bool(cond())

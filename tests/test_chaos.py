@@ -28,13 +28,7 @@ from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
 from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
 
 
-def wait_until(cond, timeout=20.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.05)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 def ready_node(name):

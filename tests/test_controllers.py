@@ -66,13 +66,7 @@ from kubernetes_tpu.controller.replication import (
 )
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 @pytest.fixture()
@@ -606,7 +600,7 @@ def test_controller_manager_leader_election():
     m1.stop()  # releases the lease: the standby acquires without
     # waiting out the 15s lease_duration
     assert not m1.lost_lease  # voluntary stop is not a lost lease
-    assert wait_until(lambda: m2.informers._started, timeout=15.0)
+    assert wait_until(lambda: m2.informers._started)
     update_spec(client, "replicationcontrollers", "web",
                 lambda rc: setattr(rc.spec, "replicas", 4))
     assert wait_until(lambda: len(pods_of(client)) == 4, timeout=30.0)

@@ -33,13 +33,7 @@ from kubernetes_tpu.federation import (
 from kubernetes_tpu.federation.federation import spread_replicas
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 def test_federation_health_and_spread():

@@ -18,13 +18,7 @@ from kubernetes_tpu.client.transport import LocalTransport
 from kubernetes_tpu.kubelet import FakeRuntime, Kubelet, KubeletConfig
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 @pytest.fixture()
@@ -313,7 +307,7 @@ def test_readiness_starts_false_during_initial_delay():
         # still within the initial delay: must remain unready
         assert mgr.is_ready("u-slow", "main") is False
         # after the delay, the succeeding probe flips it ready
-        assert wait_until(lambda: mgr.is_ready("u-slow", "main"), timeout=10)
+        assert wait_until(lambda: mgr.is_ready("u-slow", "main"))
     finally:
         mgr.remove_pod("u-slow")
 
@@ -421,6 +415,6 @@ def test_memory_pressure_evicts_best_effort_first():
             return any(c.type == "MemoryPressure" and c.status == "False"
                        for c in n.status.conditions)
 
-        assert wait_until(mem_clear, timeout=15)
+        assert wait_until(mem_clear)
     finally:
         kl2.stop()

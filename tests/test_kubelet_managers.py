@@ -19,13 +19,7 @@ from kubernetes_tpu.kubelet.images import ImageManager
 from kubernetes_tpu.kubelet.volumes import VolumeManager
 
 
-def wait_until(cond, timeout=15.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.05)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 class TestImageManager:

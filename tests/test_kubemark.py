@@ -23,13 +23,7 @@ from kubernetes_tpu.kubemark import HollowCluster
 from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
 
 
-def wait_until(cond, timeout=60.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.25)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 def test_hollow_cluster_runs_workload():

@@ -26,13 +26,7 @@ from kubernetes_tpu.kubelet import Kubelet, KubeletConfig, ProcessRuntime
 from kubernetes_tpu.kubelet.process_runtime import ensure_pause
 
 
-def wait_until(cond, timeout=15.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.03)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 @pytest.fixture()

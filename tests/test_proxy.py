@@ -21,13 +21,7 @@ from kubernetes_tpu.client.transport import LocalTransport
 from kubernetes_tpu.proxy import Proxier
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 @pytest.fixture()

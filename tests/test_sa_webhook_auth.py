@@ -42,13 +42,7 @@ from kubernetes_tpu.controller.manager import (
 from kubernetes_tpu.controller.serviceaccount import make_token_lookup
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.05)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 KEY = generate_key()  # RSA keygen is slow; share across tests

@@ -302,13 +302,7 @@ def make_control_plane():
     return server, client
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 class TestEndToEnd:

@@ -24,13 +24,7 @@ from kubernetes_tpu.volume import FakeMounter, default_plugin_mgr
 from kubernetes_tpu.volume.plugins import VolumeSpec
 
 
-def wait_until(cond, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_until  # noqa: E402
 
 
 def test_plugin_resolution_and_mount_cycle():
