@@ -9,6 +9,7 @@ types for consumers that need to see deletions distinctly (informers).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -28,7 +29,9 @@ class FIFO:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._items: Dict[str, Any] = {}
-        self._queue: List[str] = []
+        # deque: list.pop(0) shifts the whole backlog per pop — at a
+        # 30k-pod density backlog that turned the queue quadratic
+        self._queue: deque = deque()
         self._closed = False
 
     def add(self, obj: Any) -> None:
@@ -61,7 +64,7 @@ class FIFO:
         with self._cond:
             while True:
                 while self._queue:
-                    key = self._queue.pop(0)
+                    key = self._queue.popleft()
                     if key in self._items:
                         return self._items.pop(key)
                 if self._closed:
@@ -72,7 +75,7 @@ class FIFO:
     def replace(self, objs: Sequence[Any]) -> None:
         with self._cond:
             self._items = {self.key_func(o): o for o in objs}
-            self._queue = list(self._items.keys())
+            self._queue = deque(self._items.keys())
             if self._items:
                 self._cond.notify_all()
 
@@ -111,7 +114,11 @@ class DeltaFIFO:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._items: Dict[str, List[Delta]] = {}
-        self._queue: List[str] = []
+        # deque + membership set: `key in list` and list.pop(0) are both
+        # O(queue) — quadratic exactly when a density burst backs the
+        # informer up (measured 21us/add at 30k-event backlogs)
+        self._queue: deque = deque()
+        self._queued: set = set()
         self._closed = False
 
     def _key_of(self, obj: Any) -> str:
@@ -133,7 +140,8 @@ class DeltaFIFO:
                 and deltas[-2].type == "Deleted"
             ):
                 deltas[-2:] = [deltas[-1]]
-            if key not in self._queue:
+            if key not in self._queued:
+                self._queued.add(key)
                 self._queue.append(key)
             self._cond.notify()
 
@@ -159,7 +167,8 @@ class DeltaFIFO:
         with self._cond:
             while True:
                 while self._queue:
-                    key = self._queue.pop(0)
+                    key = self._queue.popleft()
+                    self._queued.discard(key)
                     deltas = self._items.pop(key, None)
                     if deltas:
                         if process is not None:

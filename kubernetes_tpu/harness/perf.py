@@ -123,6 +123,24 @@ def make_pods(client: RESTClient, p: int, creators: int = 30,
     )
 
 
+def _wait_sched_ready(sched, out, timeout: float = 180.0) -> None:
+    """Block until the scheduling loop is open (informers synced +
+    run-path TPU programs warm). The density number measures steady-state
+    scheduling throughput — the reference's scheduler is likewise fully
+    up (informers synced, no compile analogue) before its harness starts
+    creating pods (scheduler_test.go:41 schedulerConfigFactory wiring).
+    Daemon boot cost is reported separately here, not buried in the
+    throughput window."""
+    t0 = time.time()
+    if sched.ready.wait(timeout):
+        print(f"scheduler ready in {time.time() - t0:.1f}s", file=out)
+    else:
+        raise RuntimeError(
+            f"scheduler not ready after {timeout:.0f}s; the density "
+            "window would silently include boot cost"
+        )
+
+
 def _measure(count_scheduled, num_nodes, num_pods, out,
              label: str = "") -> float:
     """The per-second rate/total printout until saturation
@@ -166,6 +184,7 @@ def schedule_pods(
     sched = SchedulerServer(
         client, SchedulerServerOptions(algorithm_provider=provider)
     ).start()
+    _wait_sched_ready(sched, out)
 
     # count bindings from the scheduler's own assigned-pod informer —
     # exactly the reference's ScheduledPodLister poll
@@ -223,6 +242,7 @@ def schedule_pods_separate(
         sched = SchedulerServer(
             client, SchedulerServerOptions(algorithm_provider=provider)
         ).start()
+        _wait_sched_ready(sched, out)
 
         def count_scheduled() -> int:
             return len(sched.factory.assigned_informer.store.list_keys())

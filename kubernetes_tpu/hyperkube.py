@@ -407,6 +407,55 @@ def main(argv=None):
     )
 
     args = ap.parse_args(argv)
+    import os
+
+    prof_path = os.environ.get("KUBERNETES_TPU_PROFILE", "")
+    if prof_path:
+        # perf diagnosis for daemon subprocesses: a low-overhead stack
+        # sampler over every thread (cProfile is per-thread and not
+        # safe to share across a threaded server); SIGTERM — the
+        # harness's shutdown signal — dumps the tally as text.
+        import collections
+        import threading
+        import traceback
+
+        samples = collections.Counter()
+
+        def _sample():
+            while True:
+                for frame in list(sys._current_frames().values()):
+                    stack = traceback.extract_stack(frame)[-3:]
+                    key = " <- ".join(
+                        f"{f.name}@{f.filename.rsplit('/', 1)[-1]}"
+                        f":{f.lineno}"
+                        for f in reversed(stack)
+                    )
+                    samples[key] += 1
+                time.sleep(0.005)
+
+        threading.Thread(target=_sample, daemon=True,
+                         name="profile-sampler").start()
+
+        def _dump(*_a):
+            # snapshot with retry: the sampler thread keeps inserting,
+            # and a "dict changed size" escape here would swallow the
+            # shutdown signal entirely
+            for _ in range(50):
+                try:
+                    snap = dict(samples)
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                snap = {}
+            with open(prof_path, "w") as f:
+                for k, v in sorted(
+                    snap.items(), key=lambda kv: -kv[1]
+                )[:60]:
+                    f.write(f"{v:6d}  {k}\n")
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, _dump)
     {
         "apiserver": run_apiserver,
         "federation-apiserver": run_federation_apiserver,

@@ -31,6 +31,15 @@ static PyObject *g_tlverror;   /* TLVError class */
 static PyObject *g_fields;     /* _FIELDS: dict type -> tuple[str, ...] */
 static PyObject *g_fields_of;  /* fields_of(cls) -> tuple (late-registers) */
 static PyObject *g_resolve;    /* _resolve_class(name, nf) -> (cls, ftup) */
+static PyObject *g_rcache;     /* _RESOLVE_CACHE: name -> (cls, ftup). A hit
+                                * counts only when _BY_NAME still maps the
+                                * name to the same class (registry mutations
+                                * — replace=True, the third-party fresh-
+                                * process path — must never be served a
+                                * stale resolution) and the field count
+                                * matches; then it equals a g_resolve
+                                * success without the Python call */
+static PyObject *g_by_name;    /* _BY_NAME: name -> cls (the registry) */
 static PyObject *g_fallback;   /* Fallback exception class (module-owned) */
 
 static int err_tlv(const char *msg) {
@@ -242,7 +251,9 @@ static int enc(Buf *w, PyObject *v, PyObject *ctab, int depth, int strict) {
 }
 
 static int check_setup(void) {
-    if (g_tlverror && g_fields && g_fields_of && g_resolve) return 0;
+    if (g_tlverror && g_fields && g_fields_of && g_resolve && g_rcache &&
+        g_by_name)
+        return 0;
     PyErr_SetString(PyExc_RuntimeError, "_ktlv.setup() not called");
     return -1;
 }
@@ -422,10 +433,27 @@ static PyObject *dec(Rd *r, int depth) {
             if (!name) return NULL;
             r->i += (Py_ssize_t)k;
             if (rd_varint(r, &nf) < 0) { Py_DECREF(name); return NULL; }
-            /* class lookup incl. _ensure_registry + schema-drift check
-             * + gated dynamic factory lives in Python */
-            PyObject *pair = PyObject_CallFunction(
-                g_resolve, "OK", name, (unsigned long long)nf);
+            /* fast path: a still-current cache hit with the expected
+             * field count is exactly what g_resolve would return */
+            PyObject *pair = PyDict_GetItemWithError(g_rcache, name);
+            if (pair != NULL && PyTuple_CheckExact(pair) &&
+                PyTuple_GET_SIZE(pair) == 2 &&
+                PyDict_GetItemWithError(g_by_name, name) ==
+                    PyTuple_GET_ITEM(pair, 0) &&
+                PyTuple_CheckExact(PyTuple_GET_ITEM(pair, 1)) &&
+                (uint64_t)PyTuple_GET_SIZE(PyTuple_GET_ITEM(pair, 1)) == nf) {
+                Py_INCREF(pair);
+            } else {
+                if (pair == NULL && PyErr_Occurred()) {
+                    Py_DECREF(name);
+                    return NULL;
+                }
+                /* class lookup incl. _ensure_registry + schema-drift
+                 * check + gated dynamic factory lives in Python (it
+                 * also populates g_rcache on success) */
+                pair = PyObject_CallFunction(
+                    g_resolve, "OK", name, (unsigned long long)nf);
+            }
             Py_DECREF(name);
             if (!pair) return NULL;
             if (!PyTuple_CheckExact(pair) || PyTuple_GET_SIZE(pair) != 2) {
@@ -515,28 +543,36 @@ static PyObject *ktlv_loads(PyObject *self, PyObject *arg) {
 /* ---- module -------------------------------------------------------- */
 
 static PyObject *ktlv_setup(PyObject *self, PyObject *args) {
-    PyObject *err, *fields, *fields_of, *resolve;
-    if (!PyArg_ParseTuple(args, "OOOO", &err, &fields, &fields_of,
-                          &resolve))
+    PyObject *err, *fields, *fields_of, *resolve, *rcache, *by_name;
+    if (!PyArg_ParseTuple(args, "OOOOO!O!", &err, &fields, &fields_of,
+                          &resolve, &PyDict_Type, &rcache,
+                          &PyDict_Type, &by_name))
         return NULL;
     Py_XINCREF(err);
     Py_XINCREF(fields);
     Py_XINCREF(fields_of);
     Py_XINCREF(resolve);
+    Py_XINCREF(rcache);
+    Py_XINCREF(by_name);
     Py_XDECREF(g_tlverror);
     Py_XDECREF(g_fields);
     Py_XDECREF(g_fields_of);
     Py_XDECREF(g_resolve);
+    Py_XDECREF(g_rcache);
+    Py_XDECREF(g_by_name);
     g_tlverror = err;
     g_fields = fields;
     g_fields_of = fields_of;
     g_resolve = resolve;
+    g_rcache = rcache;
+    g_by_name = by_name;
     Py_RETURN_NONE;
 }
 
 static PyMethodDef ktlv_methods[] = {
     {"setup", ktlv_setup, METH_VARARGS,
-     "setup(TLVError, fields_dict, fields_of, resolve_class)"},
+     "setup(TLVError, fields_dict, fields_of, resolve_class, "
+     "resolve_cache, by_name)"},
     {"dumps", ktlv_dumps, METH_O, "encode one value to TLV bytes"},
     {"dumps_strict", ktlv_dumps_strict, METH_O,
      "encode, raising Fallback on tuples (round-trip fidelity paths)"},

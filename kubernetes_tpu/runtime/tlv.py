@@ -54,6 +54,13 @@ class TLVError(Exception):
 
 _BY_NAME: Dict[str, type] = {}
 _FIELDS: Dict[type, Tuple[str, ...]] = {}
+# name -> (cls, ftup) for STATIC registry hits, shared with the C
+# decoder so repeat OBJDEFs skip the Python callback (~35us/object of
+# pure name-resolution on the watch hot path). Every successful
+# resolution is of a registered class (the dynamic factory registers
+# what it synthesizes), so a hit is always current; register() clears
+# the cache to keep replace=True rebinds honest.
+_RESOLVE_CACHE: Dict[str, tuple] = {}
 
 # Optional factory for unknown class names (set by the third-party
 # resource layer): fn(name, nfields) -> registered class or None. Lets a
@@ -96,6 +103,7 @@ def register(cls: type, replace: bool = False) -> None:
         raise ValueError(f"wire name {name!r} already registered to {cur!r}")
     _BY_NAME[name] = cls
     _FIELDS[cls] = tuple(f.name for f in dataclasses.fields(cls))
+    _RESOLVE_CACHE.clear()
 
 
 def _ensure_registry() -> None:
@@ -388,6 +396,7 @@ def _resolve_class(name: str, nf: int):
             f"schema drift for {name}: peer has {nf} fields, "
             f"local has {len(ftup)}"
         )
+    _RESOLVE_CACHE[name] = (cls, ftup)
     return cls, ftup
 
 
@@ -399,7 +408,8 @@ def _load_native():
         from kubernetes_tpu.native import _ktlv as mod  # type: ignore
     except Exception:
         return None
-    mod.setup(TLVError, _FIELDS, fields_of, _resolve_class)
+    mod.setup(TLVError, _FIELDS, fields_of, _resolve_class,
+              _RESOLVE_CACHE, _BY_NAME)
     return mod
 
 

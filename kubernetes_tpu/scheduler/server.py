@@ -93,6 +93,11 @@ class SchedulerServer:
         self.scheduler: Optional[Scheduler] = None
         self._elector: Optional[LeaderElector] = None
         self._thread: Optional[threading.Thread] = None
+        # set once the scheduling loop is open for business (informers
+        # synced + run-path warmup done). Callers that want steady-state
+        # behavior (the perf harness, local-up readiness) wait on this;
+        # pods arriving earlier still just queue.
+        self.ready = threading.Event()
 
     def start(self) -> "SchedulerServer":
         opts = self.options
@@ -101,6 +106,21 @@ class SchedulerServer:
         from kubernetes_tpu.utils import configz
 
         configz.install("componentconfig", opts)
+        # start device-backend initialization NOW: on a tunneled chip it
+        # costs seconds and otherwise lands serially inside the first
+        # warmup/wave; the thread spends its time in backend RPCs (GIL
+        # released), so it overlaps informer sync and watch ingest
+        def _init_backend():
+            try:
+                import jax
+
+                jax.devices()
+            except Exception:
+                log.debug("device backend init failed", exc_info=True)
+
+        threading.Thread(
+            target=_init_backend, daemon=True, name="sched-backend-init"
+        ).start()
         self.factory = ConfigFactory(
             self.client,
             scheduler_name=opts.scheduler_name,
@@ -166,7 +186,10 @@ class SchedulerServer:
                                 break
                             time.sleep(0.05)
                     if n and idle:
-                        algo.warmup(n, phase="run")
+                        try:
+                            algo.warmup(n, phase="run")
+                        except Exception:
+                            log.debug("warmup failed", exc_info=True)
 
                         def _scan_phase():
                             # the scan-path programs only matter for
@@ -184,6 +207,7 @@ class SchedulerServer:
                             name="sched-warmup-scan",
                         ).start()
                 self._thread = self.scheduler.run()
+                self.ready.set()
 
             threading.Thread(
                 target=_warm_then_run, daemon=True, name="sched-warmup"
@@ -198,8 +222,9 @@ class SchedulerServer:
             opts.lock_object_namespace,
             opts.lock_object_name,
             identity,
-            on_started_leading=lambda: setattr(
-                self, "_thread", self.scheduler.run()
+            on_started_leading=lambda: (
+                setattr(self, "_thread", self.scheduler.run()),
+                self.ready.set(),
             ),
             on_stopped_leading=self._lost_lease,
         )

@@ -165,6 +165,11 @@ class WatchEvent:
     # filter into ADDED/DELETED (pkg/storage/etcd/etcd_watcher.go
     # sendModify).
     prev_object: Any = None
+    # TLV bytes of object/prev_object when the commit path already has
+    # them (the store's per-entry blob cache): _record then skips its
+    # own encode entirely. None = encode on demand.
+    obj_blob: Optional[bytes] = None
+    prev_blob: Optional[bytes] = None
 
 
 class WatchStream:
@@ -237,6 +242,13 @@ class MemoryStore:
         self._history_size = history_size
         self._compacted_rv = 0  # events <= this are gone
         self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+        # key -> TLV bytes of the stored object, encoded ONCE at commit.
+        # Serves three consumers that each used to encode on their own:
+        # watch fan-out (the event's obj blob), the NEXT commit's
+        # prev-object blob, and read-path isolation copies (loads(blob)
+        # instead of a dumps+loads round trip). Entries exist only for
+        # objects the strict codec can carry; absent = legacy path.
+        self._tlv_blobs: Dict[str, bytes] = {}
 
     # -- reads ---------------------------------------------------------------
 
@@ -245,19 +257,37 @@ class MemoryStore:
         with self._lock:
             return self._rv
 
+    @staticmethod
+    def _loads_or_dc(blob: Optional[bytes], obj):
+        """One decode from the commit blob when possible, else the full
+        deep copy — the single owner of that fallback contract."""
+        if blob is not None:
+            c = _tlv_native()
+            if c is not None:
+                try:
+                    return c.loads(blob)
+                except Exception:
+                    pass
+        return _dc(obj)
+
+    def _copy_of(self, key: str, obj):
+        """Isolation copy of a stored object — from its cached commit
+        blob (one decode) when available, else the full deep copy."""
+        return self._loads_or_dc(self._tlv_blobs.get(key), obj)
+
     def get(self, key: str) -> Tuple[Any, int]:
         with self._lock:
             if key not in self._data:
                 raise KeyNotFound(key)
             obj, rv = self._data[key]
-            return _dc(obj), rv
+            return self._copy_of(key, obj), rv
 
     def list(self, prefix: str) -> Tuple[List[Any], int]:
         """All objects under prefix plus the store's current version (the
         List + resourceVersion pair the reflector records)."""
         with self._lock:
             out = [
-                _dc(obj)
+                self._copy_of(key, obj)
                 for key, (obj, _) in sorted(self._data.items())
                 if key.startswith(prefix)
             ]
@@ -285,12 +315,18 @@ class MemoryStore:
                     if c is not None:
                         try:
                             # strict: obj_mode watchers get the same
-                            # fidelity the pickle path would give
-                            oblob = c.dumps_strict(ev.object)
+                            # fidelity the pickle path would give. The
+                            # commit path usually hands the blobs in
+                            # (encoded once into _tlv_blobs).
+                            oblob = ev.obj_blob
+                            if oblob is None:
+                                oblob = c.dumps_strict(ev.object)
                             if ev.prev_object is None:
                                 pblob = None
                             elif ev.prev_object is ev.object:
                                 pblob = oblob  # DELETED: same object
+                            elif ev.prev_blob is not None:
+                                pblob = ev.prev_blob
                             else:
                                 pblob = c.dumps_strict(ev.prev_object)
                             blob = (oblob, pblob)
@@ -333,8 +369,24 @@ class MemoryStore:
             stored = obj if owned else _dc(obj)
             self._set_rv(stored, rv)
             self._data[key] = (stored, rv)
-            self._record(key, WatchEvent(ADDED, stored, rv))
+            oblob = self._encode_blob(key, stored)
+            self._record(key, WatchEvent(ADDED, stored, rv,
+                                         obj_blob=oblob))
             return rv
+
+    def _encode_blob(self, key: str, stored) -> Optional[bytes]:
+        """Encode the committed object once; cache under key. None when
+        the strict codec can't carry it (the legacy paths then apply)."""
+        c = _tlv_native()
+        if c is not None:
+            try:
+                blob = c.dumps_strict(stored)
+                self._tlv_blobs[key] = blob
+                return blob
+            except Exception:
+                pass
+        self._tlv_blobs.pop(key, None)
+        return None
 
     def update(self, key: str, obj: Any, expect_rv: Optional[int] = None,
                owned: bool = False) -> int:
@@ -347,8 +399,12 @@ class MemoryStore:
             rv = self._next_rv()
             stored = obj if owned else _dc(obj)
             self._set_rv(stored, rv)
+            pblob = self._tlv_blobs.get(key)
             self._data[key] = (stored, rv)
-            self._record(key, WatchEvent(MODIFIED, stored, rv, prev))
+            oblob = self._encode_blob(key, stored)
+            self._record(key, WatchEvent(MODIFIED, stored, rv, prev,
+                                         obj_blob=oblob,
+                                         prev_blob=pblob))
             return rv
 
     def guaranteed_update(
@@ -367,7 +423,7 @@ class MemoryStore:
                     raise KeyNotFound(key)
                 cur = None
             else:
-                cur = _dc(self._data[key][0])
+                cur = self._copy_of(key, self._data[key][0])
             new = fn(cur)
             if new is None:
                 return self._rv
@@ -387,9 +443,11 @@ class MemoryStore:
             if expect_rv is not None and expect_rv != cur:
                 raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
             del self._data[key]
+            blob = self._tlv_blobs.pop(key, None)
             rv = self._next_rv()
-            self._record(key, WatchEvent(DELETED, obj, rv, obj))
-            return _dc(obj)
+            self._record(key, WatchEvent(DELETED, obj, rv, obj,
+                                         obj_blob=blob, prev_blob=blob))
+            return self._loads_or_dc(blob, obj)
 
     # -- watch ---------------------------------------------------------------
 
