@@ -25,7 +25,7 @@ BASELINE_PODS_PER_SEC = 100.0
 
 NUM_NODES = 1000
 NUM_PODS = 30000
-WIRE_REPS = 2  # tunnel + box noise: best-of (each rep is a full run)
+WIRE_REPS = 3  # tunnel + box noise: best-of (each rep is a full run)
 
 
 def build(num_nodes, num_pods):

@@ -55,6 +55,24 @@ jax.config.update("jax_enable_x64", True)
 if not os.environ.get("KUBERNETES_TPU_DEFAULT_GC"):
     gc.set_threshold(100_000, 50, 50)
 
+# GIL switch pacing: daemon processes run a handful of CPU-bound threads
+# (request handlers, watch streamers, ingest); the 5ms default forces
+# ~200 handoffs/s of pure overhead between them. A longer slice trades
+# intra-process fairness nobody needs for throughput. Overridable.
+_gil = os.environ.get("KUBERNETES_TPU_GIL_SWITCH_INTERVAL")
+if _gil != "":  # explicit empty string opts out entirely
+    import sys as _sys
+
+    try:
+        _sys.setswitchinterval(float(_gil) if _gil else 0.02)
+    except (TypeError, ValueError) as _e:
+        import warnings as _warnings
+
+        _warnings.warn(
+            f"ignoring invalid KUBERNETES_TPU_GIL_SWITCH_INTERVAL="
+            f"{_gil!r} ({_e}); running at the interpreter default"
+        )
+
 # Persistent XLA compilation cache: a fresh daemon facing a large cluster
 # pays tens of seconds of compile per (node, pod, width) bucket on a
 # tunneled chip; caching them on disk makes every start after the first

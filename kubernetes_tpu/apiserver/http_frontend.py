@@ -143,6 +143,7 @@ def start_http_server(api: APIServer, host: str, port: int,
                 self.headers.get("Accept") or ""
             )
             body = None
+            body_owned = False
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 raw = self.rfile.read(length)
@@ -160,6 +161,10 @@ def start_http_server(api: APIServer, host: str, port: int,
                     except binary.BinaryDecodeError as e:
                         self._send_json(400, {"message": str(e)})
                         return
+                    # freshly decoded off the wire, no reference kept
+                    # here: the server may take ownership instead of
+                    # making a second isolation copy
+                    body_owned = True
                 else:
                     try:
                         body = json.loads(raw)
@@ -167,7 +172,8 @@ def start_http_server(api: APIServer, host: str, port: int,
                         self._send_json(400, {"message": "invalid JSON body"})
                         return
             code, payload = api.handle(
-                method, parsed.path, query, body, obj_mode=wants_binary
+                method, parsed.path, query, body, obj_mode=wants_binary,
+                body_owned=body_owned,
             )
             if isinstance(payload, WatchResponse):
                 self._stream_watch(payload)

@@ -249,8 +249,13 @@ class WatchResponse:
         return {"type": out_type, "object": payload}
 
     def _match(self, obj: Any) -> bool:
-        if not self.label_selector.matches(obj.metadata.labels):
-            return False
+        # _match runs up to 4x per event per watcher during a bind storm
+        # (cur+prev on two pod watchers); skip the everything-selector
+        # call entirely
+        sel = self.label_selector
+        if sel.requirements or sel.impossible:
+            if not sel.matches(obj.metadata.labels):
+                return False
         return matches_fields(obj, self.field_clauses)
 
     def stop(self) -> None:
@@ -296,6 +301,12 @@ class APIServer:
         self.component_probes: Dict[str, Callable] = {
             "etcd-0": lambda: (True, "{\"health\": \"true\"}"),
         }
+        # per-request (per-thread) flag: the current request's object
+        # body was decoded fresh off the wire and ownership transfers to
+        # the server — _decode_body skips its isolation copy
+        import threading as _threading
+
+        self._body_owned = _threading.local()
         # dynamic third-party resources (master.go:610-766); re-install
         # any persisted ThirdPartyResource objects on startup
         self.thirdparty = ThirdPartyInstaller(self)
@@ -358,6 +369,7 @@ class APIServer:
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
         obj_mode: bool = False,
+        body_owned: bool = False,
     ):
         """Returns (status_code, payload_dict) or (200, WatchResponse).
 
@@ -366,8 +378,15 @@ class APIServer:
         reflective wire codec stays off the hot path, the way the
         reference switches to protobuf at kubemark scale. Isolation is
         preserved: object bodies are copied in, responses are the store's
-        own copies."""
+        own copies.
+
+        body_owned=True transfers ownership of an object body to the
+        server: the caller decoded it fresh off the wire and keeps no
+        reference (the HTTP binary frontend), so the isolation copy at
+        the decode boundary is skipped."""
         query = query or {}
+        if body_owned:
+            self._body_owned.flag = True
         try:
             return self._handle(method.upper(), path, query, body, obj_mode)
         except ValueError as e:
@@ -386,6 +405,9 @@ class APIServer:
             return 409, APIError(409, str(e)).status()
         except Compacted as e:
             return 410, APIError(410, str(e), reason="Expired").status()
+        finally:
+            if body_owned:
+                self._body_owned.flag = False
 
     def _handle(self, method, path, query, body, obj_mode=False):
         if path == "/healthz":
@@ -652,16 +674,20 @@ class APIServer:
         if body is None:
             raise APIError(400, "request body required")
         if not isinstance(body, dict):
-            # object protocol: copy in (the caller keeps its object; the
-            # server must be free to default/mutate)
-            from kubernetes_tpu.storage.store import deep_copy
-
             if not isinstance(body, info.cls):
                 raise APIError(
                     400,
                     f"expected {info.cls.__name__}, got "
                     f"{type(body).__name__}",
                 )
+            if getattr(self._body_owned, "flag", False):
+                # wire-decoded body: the decode WAS the isolation copy
+                # and the frontend keeps no reference
+                return body
+            # object protocol: copy in (the caller keeps its object; the
+            # server must be free to default/mutate)
+            from kubernetes_tpu.storage.store import deep_copy
+
             return deep_copy(body)
         try:
             return codec.decode(body, info.cls)
@@ -1191,30 +1217,62 @@ class APIServer:
         if body is None:
             raise APIError(400, "binding body required")
         if body.get("kind") == "BindingList" or "items" in body:
+            ops = []
             results = []
-            for item in body.get("items", []):
-                item_ns = (
-                    (item.get("metadata") or {}).get("namespace") or ns
-                )
-                try:
-                    code, _ = self._bind(item_ns, "", item)
+            bad = {}  # position -> early failure
+            for i, item in enumerate(body.get("items", [])):
+                item_ns, name, target = self._binding_fields(item, ns)
+                if not target or not name:
+                    bad[i] = "binding requires pod name and target node"
+                    ops.append(None)
+                    continue
+                ops.append((
+                    f"/pods/{item_ns}/{name}",
+                    self._make_assign(name, target),
+                ))
+            live = [op for op in ops if op is not None]
+            errs = iter(self.store.update_batch(live))
+            for i, op in enumerate(ops):
+                if op is None:
+                    results.append({"status": "Failure",
+                                    "message": bad[i]})
+                    continue
+                err = next(errs)
+                if err is None:
                     results.append({"status": "Success"})
-                except (APIError, Conflict, KeyNotFound) as e:
-                    results.append({
-                        "status": "Failure",
-                        "message": str(e),
-                    })
+                else:
+                    msg = (f"not found: {err}"
+                           if isinstance(err, KeyNotFound) else str(err))
+                    results.append({"status": "Failure", "message": msg})
             return 201, {"kind": "Status", "status": "Success",
                          "items": results}
-        target = (body.get("target") or {}).get("name") or body.get(
-            "targetNode"
-        )
-        name = (body.get("metadata") or {}).get("name") or body.get(
-            "podName"
-        ) or pod_name
+        ns, name, target = self._binding_fields(body, ns)
+        name = name or pod_name
         if not target or not name:
             raise APIError(400, "binding requires pod name and target node")
         key = f"/pods/{ns}/{name}"
+        self.store.guaranteed_update(key, self._make_assign(name, target))
+        return 201, {"kind": "Status", "status": "Success"}
+
+    @staticmethod
+    def _binding_fields(body, default_ns: str):
+        """-> (ns, pod name, target node) from a Binding body, with the
+        metadata/podName and target.name/targetNode fallbacks — the one
+        owner of that parse for both the single and bulk endpoints."""
+        meta = body.get("metadata") or {}
+        return (
+            meta.get("namespace") or default_ns,
+            meta.get("name") or body.get("podName"),
+            (body.get("target") or {}).get("name") or body.get(
+                "targetNode"
+            ),
+        )
+
+    @staticmethod
+    def _make_assign(name: str, target: str):
+        """The binding mutation (registry/pod/rest.go assignPod): set
+        spec.nodeName under the no-reassign precondition and flip the
+        PodScheduled condition."""
 
         def assign(pod):
             if pod.spec.node_name:
@@ -1233,8 +1291,7 @@ class APIServer:
                 )
             return pod
 
-        self.store.guaranteed_update(key, assign)
-        return 201, {"kind": "Status", "status": "Success"}
+        return assign
 
     # -- HTTP frontend -------------------------------------------------------
 

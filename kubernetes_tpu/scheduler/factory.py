@@ -69,7 +69,11 @@ class ConfigFactory:
         self._stopped = False
         self._components: list = []
 
-        # assigned (non-terminal) pods -> cache (factory.go:127-137)
+        # assigned (non-terminal) pods -> cache (factory.go:127-137).
+        # direct mode: during a density burst this informer ingests one
+        # confirmation per bound pod; the handlers (cache confirm, store
+        # put) are quick and thread-safe, and the DeltaFIFO hop measured
+        # ~2x their cost.
         self.assigned_informer = Informer(
             client.resource("pods", namespace=""),
             ResourceEventHandler(
@@ -79,6 +83,7 @@ class ConfigFactory:
             ),
             field_selector="spec.nodeName!=",
             name="assigned-pods",
+            direct=True,
         )
         # nodes -> cache (factory.go:139-148)
         self.node_informer = Informer(
@@ -89,6 +94,7 @@ class ConfigFactory:
                 on_delete=self.scheduler_cache.remove_node,
             ),
             name="nodes",
+            direct=True,
         )
         # unassigned pods -> FIFO (factory.go:339, selector :431-440)
         self.unassigned_reflector = Reflector(
@@ -98,16 +104,22 @@ class ConfigFactory:
             name="unassigned-pods",
         )
         # auxiliary listers (factory.go:349-365)
-        self.service_informer = Informer(client.resource("services", ""), name="services")
+        self.service_informer = Informer(
+            client.resource("services", ""), name="services", direct=True
+        )
         self.controller_informer = Informer(
-            client.resource("replicationcontrollers", ""), name="rcs"
+            client.resource("replicationcontrollers", ""), name="rcs",
+            direct=True,
         )
         self.replica_set_informer = Informer(
-            client.resource("replicasets", ""), name="rss"
+            client.resource("replicasets", ""), name="rss", direct=True
         )
-        self.pv_informer = Informer(client.resource("persistentvolumes"), name="pvs")
+        self.pv_informer = Informer(
+            client.resource("persistentvolumes"), name="pvs", direct=True
+        )
         self.pvc_informer = Informer(
-            client.resource("persistentvolumeclaims", ""), name="pvcs"
+            client.resource("persistentvolumeclaims", ""), name="pvcs",
+            direct=True,
         )
         self._components = [
             self.assigned_informer,

@@ -369,7 +369,8 @@ class TestHardening:
         entered = threading.Barrier(3)
         orig = api.handle
 
-        def slow_handle(method, path, query=None, body=None, obj_mode=False):
+        def slow_handle(method, path, query=None, body=None, obj_mode=False,
+                        body_owned=False):
             if path == "/api/v1/nodes" and method == "GET":
                 entered.wait(timeout=5)
                 gate.wait(timeout=10)

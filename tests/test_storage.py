@@ -219,7 +219,7 @@ class TestMemoryStore:
     def test_slow_watcher_gets_error(self):
         s = MemoryStore()
         w = s.watch("/pods/")
-        w._q.maxsize = 2
+        w._capacity = 2
         for i in range(5):
             s.create(f"/pods/default/p{i}", make_pod(f"p{i}"))
         types = []
