@@ -84,6 +84,12 @@ class RunTables:
     # no terms — the eligibility gate guarantees it)
     w_ip: int
     ip_totals: Optional[np.ndarray]  # i64[N]
+    # zone blend (selector_spreading.go:221-228): zone ids are static
+    # per run, so they ride host-side; the replay recomputes the
+    # per-zone aggregation over the live fit set per pick. zone_id is
+    # None on unzoned clusters (the plain float32 branch).
+    zone_id: Optional[np.ndarray] = None  # i32[N]; 0 == no zone
+    num_zones: int = 1
 
 
 def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
@@ -278,7 +284,9 @@ class WaveProbe:
 
     def probe(self, static, carry, pod, num_zones: int, num_values: int,
               J: int, rows: Optional[int] = None,
-              has_selectors: Optional[bool] = None) -> RunTables:
+              has_selectors: Optional[bool] = None,
+              zone_id: Optional[np.ndarray] = None,
+              self_anti_veto: Optional[np.ndarray] = None) -> RunTables:
         """rows (<= J) bounds the j-depth the replay can need (the
         capacity bound from wave._pick_j, +2 so a node's fit observably
         reaches False before the table horizon). The full packed array
@@ -303,13 +311,26 @@ class WaveProbe:
         fit_static = stk[0].astype(bool)
         frontier = stk[1]
         res_fit = np.arange(rows, dtype=np.int64)[:, None] < frontier[None, :]
+        if self_anti_veto is not None and rows > 1:
+            # hostname-topology hard anti-affinity against the run's own
+            # labels: one committed copy excludes every further copy on
+            # that node (wave.run_eligible computed where the term's
+            # domain exists) — the same res_fit row shape as the
+            # host-port self-conflict
+            res_fit[1:, self_anti_veto] = False
         weights = {n if isinstance(n, str) else n[0]: w
                    for n, w in self.config.priorities}
         w_spread = int(weights.get(SELECTOR_SPREAD, 0))
         w_na = int(weights.get(NODE_AFFINITY, 0))
         w_tt = int(weights.get(TAINT_TOLERATION, 0))
         w_ip = int(weights.get(INTER_POD_AFFINITY, 0))
+        zid = None
+        if (w_spread and zone_id is not None
+                and np.any(np.asarray(zone_id) > 0)):
+            zid = np.ascontiguousarray(zone_id, np.int32)
         return RunTables(
+            zone_id=zid,
+            num_zones=num_zones,
             fit_static=fit_static,
             res_fit=res_fit,
             tab=np.asarray(tab).astype(np.int64),

@@ -44,7 +44,7 @@ def _scores(t: RunTables, j: np.ndarray, fit: np.ndarray) -> np.ndarray:
     score = t.tab[j, np.arange(N)] + t.static_add
     any_fit = bool(fit.any())
     if t.spread_base is not None:
-        # ops/priorities.selector_spread (float32, no-zone branch)
+        # ops/priorities.selector_spread (float32 math, both branches)
         c = t.spread_base + (j if t.spread_selfmatch else 0)
         c = np.where(fit, c, 0)
         M = int(c[fit].max()) if any_fit else 0
@@ -54,9 +54,34 @@ def _scores(t: RunTables, j: np.ndarray, fit: np.ndarray) -> np.ndarray:
             f = np.float32(10.0) * (
                 (M - c).astype(np.float32) / np.float32(M)
             )
+        if t.zone_id is not None:
+            # zone blend over the LIVE fit set (selector_spreading.go
+            # :221-228): per-zone counts aggregate the filtered node
+            # counts; zone 0 == unzoned never participates. The
+            # reference has NO maxZone>0 guard — 0/0 is float32 NaN and
+            # Go's int(NaN) is minInt64; mirrored at the conversion.
+            zc = np.zeros(t.num_zones, np.int64)
+            np.add.at(zc, t.zone_id, c)
+            have_zones = bool(np.any(fit & (t.zone_id > 0)))
+            max_zone = int(zc[1:].max()) if t.num_zones > 1 else 0
+            max_zone = max(max_zone, 0)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                zone_score = np.float32(10.0) * (
+                    (max_zone - zc[t.zone_id]).astype(np.float32)
+                    / np.float32(max_zone)
+                )
+            # (1 - zoneWeighting) rounds ONCE from the exact 1/3, like
+            # Go's untyped-constant arithmetic (ops/priorities.py)
+            blended = (f * np.float32(1.0 / 3.0)
+                       + np.float32(2.0 / 3.0) * zone_score)
+            f = np.where(have_zones & (t.zone_id > 0), blended, f)
         if not t.has_selectors:
             f = np.full(N, np.float32(10.0), np.float32)
-        score = score + t.w_spread * f.astype(np.int64)
+        nan = np.isnan(f)
+        fi = np.where(nan, np.float32(0), f).astype(np.int64)
+        score = score + t.w_spread * np.where(
+            nan, np.int64(-(2**63)), fi
+        )
     if t.na_counts is not None:
         # ops/priorities.normalize_counts_up (float64)
         mx = max(int(t.na_counts[fit].max()) if any_fit else 0, 0)
@@ -172,6 +197,11 @@ def replay_fast(t: RunTables, K: int, last_node_index: int) -> ReplayResult:
     dynamics. Differentially tested against replay_spec."""
     lib = _load_lib()
     if lib is None:
+        return replay_spec(t, K, last_node_index)
+    if t.zone_id is not None and t.has_selectors:
+        # zone-blended spread couples every node of a zone per commit;
+        # the C engine's incremental buckets don't model that (yet) —
+        # the vectorized spec replay still beats a per-pod scan by far
         return replay_spec(t, K, last_node_index)
     J, N = t.res_fit.shape
     fs = np.ascontiguousarray(t.fit_static, np.uint8)

@@ -80,47 +80,92 @@ def config_eligible(config: SchedulerConfig) -> bool:
     return total_w * 10 < (1 << 20)
 
 
+def _lt_pernode_dom(snap: ClusterSnapshot, lt: int):
+    """For logical term lt: the per-node domain row when the term has
+    exactly one expansion entry (an explicit topology key) AND distinct
+    nodes never share a domain (each valid node is its own domain —
+    hostname-like). Returns i32[N] (-1 where the key is missing) or
+    None when the term's domains couple nodes."""
+    lt_u = np.asarray(snap.ip_lt_u)
+    if lt_u.ndim != 2 or not lt_u.size:
+        return None
+    entries = lt_u[lt]
+    valid = entries[entries >= 0]
+    if len(valid) != 1:
+        return None  # empty-key OR expansion: zone/region coupling
+    q = int(np.asarray(snap.ip_u_topo)[valid[0]])
+    dom = np.asarray(snap.ip_topo_dom)[q]
+    live = dom[dom >= 0]
+    if len(np.unique(live)) != len(live):
+        return None  # two nodes share a domain: commits couple them
+    return dom
+
+
 def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
-                 snap: ClusterSnapshot, *, config_ok: bool = None,
-                 zoned: bool = None) -> bool:
-    """True when pod row i's run can take the fast path: its commits
-    must not feed back into its own fit/score except through the
-    channels the tables model (resources, ports-self, spread counts).
-    config_ok/zoned are hoistable per-backlog invariants."""
+                 snap: ClusterSnapshot, *, config_ok: bool = None):
+    """-> (eligible, self_anti_veto) for pod row i's run. Eligible means
+    its commits don't feed back into its own fit/score except through
+    the channels the tables model (resources, ports-self, spread
+    counts, and — via the returned veto — hostname-topology hard
+    anti-affinity against itself, the one-per-node pattern:
+    self_anti_veto is then bool[N] marking nodes where one committed
+    copy excludes every further copy).
+    config_ok is a hoistable per-backlog invariant."""
     if config_ok is None:
         config_ok = config_eligible(config)
     if not config_ok:
-        return False
+        return False, None
     b = batch
-    # own inter-pod terms make fit/score depend on intra-run commits
+    # own inter-pod terms: the run stays eligible as long as none of
+    # them feed back into the run's OWN fit/score in a way the tables
+    # can't express. A term whose spec doesn't match the pod's own
+    # labels never reacts to the run's commits (the carry fold in
+    # _apply_fn records it exactly for later pods). A hard ANTI term
+    # that DOES self-match is expressible when its topology is
+    # hostname-like: each commit kills only its own node's fit
+    # (generalizing the host-port self-conflict row of res_fit).
     if b.ip_ha_lt.size and np.any(b.ip_ha_lt[i] >= 0):
-        return False
-    if b.ip_hq_lt.size and np.any(b.ip_hq_lt[i] >= 0):
-        return False
-    if b.ip_fwd_lt.size and np.any(b.ip_fwd_lt[i] >= 0):
-        return False
-    for f in ("ip_own_hard", "ip_own_pref", "ip_own_anti_hard",
-              "ip_own_anti_pref"):
-        v = getattr(b, f)
-        if v.size and np.any(v[i]):
-            return False
+        # own hard AFFINITY: the first-pod bootstrap + domain growth
+        # feedback (predicates.go:819-843) is not table-expressible
+        return False, None
+    lt_spec = np.asarray(snap.ip_lt_spec) if snap.ip_lt_spec is not None \
+        else np.zeros(0, np.int32)
+    ms = b.ip_match_spec[i] if b.ip_match_spec.size else None
+
+    def self_match(lt: int) -> bool:
+        return bool(ms is not None and ms[lt_spec[lt]])
+
+    if b.ip_fwd_lt.size:
+        for lt in b.ip_fwd_lt[i]:
+            if lt >= 0 and self_match(int(lt)):
+                # preferred term scoring its own copies: the slope in j
+                # isn't in the tables (yet)
+                return False, None
+    veto = None
+    if b.ip_hq_lt.size:
+        for lt in b.ip_hq_lt[i]:
+            if lt < 0 or not self_match(int(lt)):
+                continue
+            dom = _lt_pernode_dom(snap, int(lt))
+            if dom is None:
+                return False, None  # zone-coupled self anti-affinity
+            v = dom >= 0  # nodes where the term can ever co-locate
+            veto = v if veto is None else (veto | v)
     # volume commits conflict with the run's own copies
     if np.any(b.vp_vol_rw[i]) or np.any(b.vp_vol_ro[i]):
-        return False
+        return False, None
     if np.any(b.vp_ebs[i]) or np.any(b.vp_gce[i]):
-        return False
+        return False, None
     if b.vp_has_ebs[i] or b.vp_has_gce[i] or b.vp_ebs_bad[i] or b.vp_gce_bad[i]:
-        return False
+        return False, None
     # a service member's commits move the ServiceAffinity first-peer /
     # ServiceAntiAffinity counts
     if b.svc_member.ndim == 2 and b.svc_member.shape[1] and np.any(b.svc_member[i]):
-        return False
-    # zone-blended spread couples all nodes of a zone per commit
-    if zoned is None:
-        zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
-    if b.has_selectors[i] and zoned:
-        return False
-    return True
+        return False, None
+    # (zoned selector-spread runs stay eligible: the probe carries the
+    # node->zone map and the replay recomputes the 2/3 blend per pick —
+    # the coupling is linear in per-zone counts, exactly table shape)
+    return True, veto
 
 
 def gather_batch(batch: PodBatch, rows: np.ndarray) -> PodBatch:
@@ -153,6 +198,8 @@ def _permute_tables(t: RunTables, perm: np.ndarray) -> RunTables:
         spread_base=p1(t.spread_base),
         spread_selfmatch=t.spread_selfmatch,
         has_selectors=t.has_selectors,
+        zone_id=p1(t.zone_id),
+        num_zones=t.num_zones,
         w_na=t.w_na,
         na_counts=p1(t.na_counts),
         w_tt=t.w_tt,
@@ -250,8 +297,7 @@ class WaveScheduler:
         U = static["ip_u_topo"].shape[0]
         if U and ip_term_count.shape[1]:
             # term_count[u, dom(u, n)] += match_spec[spec(u)] * counts[n]
-            # — interpod_commit is linear in the commit count (the gate
-            # guarantees the pod owns no terms, so own/rev are untouched)
+            # — interpod_commit is linear in the commit count
             dom = static["ip_topo_dom"][static["ip_u_topo"]]  # (U, N)
             mu = pod["ip_match_spec"][static["ip_u_spec"]]  # (U,)
             add = jnp.where(
@@ -261,6 +307,36 @@ class WaveScheduler:
                 jnp.arange(U)[:, None],
                 jnp.clip(dom, 0, ip_term_count.shape[1] - 1),
             ].add(add.astype(ip_term_count.dtype))
+        LT = static["ip_lt_u"].shape[0] if "ip_lt_u" in static else 0
+        E = static["ip_lt_u"].shape[1] if LT else 0
+        if LT and E and ip_own_anti.shape[2]:
+            # the run's OWN terms, folded per node with multiplicity
+            # counts[n] — ops/interpod.interpod_commit vectorized over N
+            # (run_eligible guarantees these terms never feed back into
+            # this run's own fit/score; later pods need the exact state)
+            lt_u = static["ip_lt_u"]  # (LT, E)
+            q = static["ip_u_topo"][jnp.clip(lt_u, 0, U - 1)]
+            domq = static["ip_topo_dom"][q]  # (LT, E, N)
+            validq = (lt_u >= 0)[:, :, None] & (domq >= 0)
+            sdq = jnp.clip(domq, 0, ip_own_anti.shape[2] - 1)
+            lt_i = jnp.arange(LT)[:, None, None]
+            e_i = jnp.arange(E)[None, :, None]
+            c32 = jnp.where(validq, counts[None, None, :], 0).astype(
+                jnp.int32
+            )
+            c64 = c32.astype(jnp.int64)
+            ip_own_anti = ip_own_anti.at[lt_i, e_i, sdq].add(
+                pod["ip_own_anti_hard"][:, None, None] * c32
+            )
+            ip_rev_hard = ip_rev_hard.at[lt_i, e_i, sdq].add(
+                pod["ip_own_hard"][:, None, None] * c32
+            )
+            ip_rev_pref = ip_rev_pref.at[lt_i, e_i, sdq].add(
+                pod["ip_own_pref"][:, None, None] * c64
+            )
+            ip_rev_anti = ip_rev_anti.at[lt_i, e_i, sdq].add(
+                pod["ip_own_anti_pref"][:, None, None] * c64
+            )
         if ip_spec_total.shape[0]:
             ip_spec_total = ip_spec_total + (
                 pod["ip_match_spec"].astype(jnp.int64) * k
@@ -419,10 +495,12 @@ class WaveScheduler:
         config_ok = config_eligible(self.config)
         zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
         for rep, start, length in runs:
-            if length < self.min_run or not run_eligible(
-                self.config, batch, rep, snap, config_ok=config_ok,
-                zoned=zoned,
-            ):
+            eligible, self_anti_veto = (False, None)
+            if length >= self.min_run:
+                eligible, self_anti_veto = run_eligible(
+                    self.config, batch, rep, snap, config_ok=config_ok,
+                )
+            if not eligible:
                 pending.extend(range(start, start + length))
                 continue
             carry = flush(carry)
@@ -434,6 +512,8 @@ class WaveScheduler:
                 tables = self.probe.probe(
                     static, carry, pod, num_zones, num_values, J, rows,
                     has_selectors=bool(batch.has_selectors[rep]),
+                    zone_id=np.asarray(snap.zone_id) if zoned else None,
+                    self_anti_veto=self_anti_veto,
                 )
                 res: ReplayResult = self._replay(
                     _permute_tables(tables, perm), K, L_host

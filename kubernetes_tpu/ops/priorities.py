@@ -105,8 +105,11 @@ def selector_spread(
     zone_score = jnp.float32(MAX_PRIORITY) * (
         (max_zone - node_zcount).astype(jnp.float32) / max_zone.astype(jnp.float32)
     )
-    zone_weighting = jnp.float32(2.0 / 3.0)
-    blended = f * (jnp.float32(1.0) - zone_weighting) + zone_weighting * zone_score
+    # Go evaluates (1.0 - zoneWeighting) as an EXACT untyped-constant
+    # expression rounded once to float32 — one ulp away from
+    # f32(1) - f32(2/3). selector_spreading.go:226.
+    blended = (f * jnp.float32(1.0 / 3.0)
+               + jnp.float32(2.0 / 3.0) * zone_score)
     f = jnp.where(have_zones & (zone_id > 0), blended, f)
     # no selectors -> counts map empty -> maxCount 0 and zones skipped -> 10
     f = jnp.where(pod_has_selectors, f, jnp.float32(MAX_PRIORITY))

@@ -317,3 +317,220 @@ def test_wave_min_run_fallback_matches():
     pods = pause_pods(20)
     state = ClusterState.build(nodes)
     assert wave_backlog(state, pods, min_run=64) == oracle_backlog(state, pods)
+
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def zoned_density_nodes(n, zones=("a", "b", "c"), unzoned_every=0,
+                        pods_cap="110"):
+    nodes = density_nodes(n, pods_cap=pods_cap)
+    for i, node in enumerate(nodes):
+        if unzoned_every and i % unzoned_every == 0:
+            continue  # leave some nodes without a zone (zone 0 path)
+        node.metadata.labels[ZONE] = zones[i % len(zones)]
+    return nodes
+
+
+def spread_state(nodes):
+    return ClusterState.build(
+        nodes,
+        services=[Service(metadata=ObjectMeta(name="svc"),
+                          spec=ServiceSpec(selector={"name": "sched-perf"}))],
+    )
+
+
+def test_wave_zoned_spread_matches_oracle():
+    # selector pods on a ZONED cluster stay on the fast path now: the
+    # replay recomputes the 2/3 zone blend per pick
+    # (selector_spreading.go:221-228)
+    state = spread_state(zoned_density_nodes(18))
+    pods = pause_pods(120)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_zoned_spread_mixed_unzoned_nodes():
+    # zone 0 (no label) never joins the blend; zoned and unzoned nodes
+    # coexist in the same fit set
+    state = spread_state(zoned_density_nodes(15, unzoned_every=3))
+    pods = pause_pods(90)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_zoned_capacity_exhaustion():
+    # zones drain mid-run: nodes leave the fit set, per-zone counts
+    # re-aggregate over the survivors, tail goes unschedulable
+    state = spread_state(zoned_density_nodes(6, pods_cap="5"))
+    pods = pause_pods(45)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert want[-1] is None
+
+
+def test_wave_zoned_uneven_zone_sizes():
+    # one big zone + one single-node zone: the blend must steer picks
+    # toward the small zone exactly as the oracle does
+    nodes = zoned_density_nodes(9, zones=("a",))
+    nodes[-1].metadata.labels[ZONE] = "b"
+    state = spread_state(nodes)
+    pods = pause_pods(70)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_wave_zoned_random_backlogs(seed):
+    rng = random.Random(1000 + seed)
+    zones = ["a", "b", "c", "d"][: rng.randint(1, 4)]
+    nodes = zoned_density_nodes(
+        rng.randint(4, 24), zones=tuple(zones),
+        unzoned_every=rng.choice([0, 2, 3]),
+        pods_cap=str(rng.randint(3, 30)),
+    )
+    state = spread_state(nodes)
+    pods = pause_pods(rng.randint(20, 160))
+    # a second distinct template exercises run switching on the
+    # zoned path (separate probes, shared carry)
+    pods += pause_pods(rng.randint(10, 40),
+                       requests={"cpu": "200m", "memory": "1Gi"})
+    for i, p in enumerate(pods):
+        p.metadata.name = f"pod-{i:06d}"
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def _anti_pods(k, labels, topo="kubernetes.io/hostname", name0=0,
+               requests=None, sel_labels=None):
+    from kubernetes_tpu.api.types import (
+        Affinity, PodAffinityTerm, PodAntiAffinity, LabelSelector)
+    import json
+    out = []
+    for i in range(k):
+        p = Pod(
+            metadata=ObjectMeta(name=f"anti-{name0 + i:05d}",
+                                labels=dict(labels)),
+            spec=PodSpec(containers=[Container(
+                requests=dict(requests or {"cpu": "100m"}))]),
+        )
+        p.metadata.annotations = {
+            "scheduler.alpha.kubernetes.io/affinity": json.dumps({
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": sel_labels or dict(labels)},
+                        "topologyKey": topo,
+                        "namespaces": [],
+                    }],
+                },
+            })
+        }
+        out.append(p)
+    return out
+
+
+def hostname_nodes(n, **kw):
+    nodes = density_nodes(n, **kw)
+    for node in nodes:
+        node.metadata.labels["kubernetes.io/hostname"] = node.metadata.name
+    return nodes
+
+
+def test_wave_self_anti_one_per_node():
+    # the config-3 pattern: a run of identical pods, each with hard
+    # anti-affinity to its own labels on hostname topology — exactly one
+    # lands per node, surplus goes unschedulable; the run must stay on
+    # the fast path via the res_fit self-veto
+    nodes = hostname_nodes(12)
+    pods = _anti_pods(20, {"app": "exclusive"})
+    state = ClusterState.build(nodes)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    placed = [h for h in got if h]
+    assert len(placed) == len(set(placed)) == 12 and got.count(None) == 8
+
+
+def test_wave_self_anti_carry_feeds_later_pods():
+    # an eligible self-anti run FOLLOWED by pods of a different template
+    # that match the run's anti selector: the committed copies' own
+    # terms must veto them via the carry fold (the symmetric check)
+    nodes = hostname_nodes(8)
+    first = _anti_pods(6, {"tier": "a"})
+    # same labels (so the first run's anti terms match them) but a
+    # different resource shape => different run
+    second = _anti_pods(6, {"tier": "a"}, name0=100,
+                        requests={"cpu": "200m"})
+    state = ClusterState.build(nodes)
+    pods = first + second
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    placed = [h for h in got if h]
+    assert len(placed) == len(set(placed)) == 8  # 12 pods, 8 nodes, 1 each
+
+
+def test_wave_nonself_anti_term_fold():
+    # a run whose anti term matches OTHER labels only: no self-feedback
+    # (fast-path eligible), but later pods carrying those labels must
+    # see the committed copies' terms through the carry fold. The v1.3
+    # quirk applies: the symmetric check only runs for candidates that
+    # THEMSELVES have anti-affinity (predicates.go:884-921 is inside
+    # the pod's own PodAntiAffinity branch), so the victims carry a
+    # harmless anti term of their own to arm it.
+    nodes = hostname_nodes(10)
+    guards = _anti_pods(10, {"role": "guard"}, sel_labels={"role": "victim"})
+    victims = _anti_pods(10, {"role": "victim"}, name0=200,
+                         sel_labels={"role": "nobody"},
+                         requests={"cpu": "50m"})
+    state = ClusterState.build(nodes)
+    pods = guards + victims
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    # every node hosts a guard whose term matches the victims, and the
+    # victims' own anti-affinity arms the symmetric check: none land
+    assert got[:10].count(None) == 0 and got[10:].count(None) == 10
+
+
+def test_wave_plain_pod_ignores_existing_anti_owner():
+    # ...and the quirk itself: a pod with NO anti-affinity of its own
+    # sails past an existing anti-owner whose term matches it
+    nodes = hostname_nodes(3)
+    guards = _anti_pods(3, {"role": "guard"}, sel_labels={"role": "plain"})
+    plain = pause_pods(3, labels={"role": "plain"})
+    for i, p in enumerate(plain):
+        p.metadata.name = f"plain-{i:05d}"
+    state = ClusterState.build(nodes)
+    pods = guards + plain
+    got = wave_backlog(state, pods)
+    assert got == oracle_backlog(state, pods)
+    assert got.count(None) == 0
+
+
+def test_wave_self_anti_zone_topology_falls_back():
+    # zone-topology self anti-affinity couples nodes: must NOT take the
+    # fast path, and the scan fallback must still match the oracle
+    nodes = zoned_density_nodes(9, zones=("a", "b", "c"))
+    pods = _anti_pods(9, {"app": "zonal"}, topo=ZONE)
+    state = ClusterState.build(nodes)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert got.count(None) == 6  # one per zone
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_wave_self_anti_mixed_random(seed):
+    rng = random.Random(2000 + seed)
+    nodes = hostname_nodes(rng.randint(5, 16),
+                           pods_cap=str(rng.randint(2, 8)))
+    pods = []
+    pods += _anti_pods(rng.randint(16, 40), {"g": "x"})
+    pods += pause_pods(rng.randint(10, 50))
+    pods += _anti_pods(rng.randint(16, 30), {"g": "y"},
+                       name0=500, requests={"cpu": "150m"})
+    rng.shuffle(pods)
+    # keep runs contiguous enough to fast-path: stable-sort by template
+    pods.sort(key=lambda p: pod_feature_key(p))
+    for i, p in enumerate(pods):
+        p.metadata.name = f"pod-{i:06d}"
+    state = ClusterState.build(nodes)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
