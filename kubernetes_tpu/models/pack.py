@@ -45,6 +45,37 @@ def _unpack(layout, buf):
     return out
 
 
+def pack_arrays(arrays: dict):
+    """-> (layout tuple, uint8 host buffer): the single-buffer form of a
+    dict of numpy arrays. The layout is hashable (a jit cache key); the
+    buffer unpacks on device via _unpack(layout, buf) — usable directly
+    inside jit/shard_map bodies (the mesh wave passes pod rows this way
+    so a run costs one replicated transfer, not one per field)."""
+    items = sorted(arrays.items())
+    layout = []
+    off = 0
+    for name, a in items:
+        a = np.asarray(a)
+        # NB: ascontiguousarray promotes 0-d to (1,); keep the true
+        # shape in the layout so scalars unpack as scalars
+        shape = a.shape
+        nb = a.nbytes
+        layout.append((name, a.dtype.str, shape, off, nb))
+        off += (nb + 7) & ~7  # 8-byte alignment for every bitcast
+    buf = np.zeros(max(off, 1), np.uint8)
+    for (name, _d, _s, o, nb), (_n, a) in zip(layout, items):
+        if nb:
+            buf[o:o + nb] = (
+                np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+            )
+    return tuple(layout), buf
+
+
+def unpack(layout, buf):
+    """Device-side inverse of pack_arrays (traceable)."""
+    return _unpack(layout, buf)
+
+
 class Packer:
     """Ships dicts of numpy arrays to the device in one transfer."""
 
@@ -53,24 +84,7 @@ class Packer:
 
     def ship(self, arrays: dict) -> dict:
         """-> {name: device array}, one host->device transfer total."""
-        items = sorted(arrays.items())
-        layout = []
-        off = 0
-        for name, a in items:
-            a = np.asarray(a)
-            # NB: ascontiguousarray promotes 0-d to (1,); keep the true
-            # shape in the layout so scalars unpack as scalars
-            shape = a.shape
-            nb = a.nbytes
-            layout.append((name, a.dtype.str, shape, off, nb))
-            off += (nb + 7) & ~7  # 8-byte alignment for every bitcast
-        key = tuple(layout)
-        buf = np.zeros(max(off, 1), np.uint8)
-        for (name, _d, _s, o, nb), (_n, a) in zip(layout, items):
-            if nb:
-                buf[o:o + nb] = (
-                    np.ascontiguousarray(a).view(np.uint8).reshape(-1)
-                )
+        key, buf = pack_arrays(arrays)
         fn = self._unpack.get(key)
         if fn is None:
             fn = jax.jit(functools.partial(_unpack, key))

@@ -300,50 +300,66 @@ class WaveProbe:
         raw = self._compiled(num_zones, num_values, J)(static, carry, pod)
         # ONE device->host transfer for the whole probe product
         arr = np.ascontiguousarray(jax.device_get(raw["packed"]))
-        stk = arr[:8]
-        dt = _tab_dtype(self.config)
-        k = 8 // np.dtype(dt).itemsize
-        N = arr.shape[1]
-        tab = (
-            arr[8:].view(dt).reshape(J // k, N, k)
-            .transpose(0, 2, 1).reshape(J, N)[:rows]
-        )
-        fit_static = stk[0].astype(bool)
-        frontier = stk[1]
-        res_fit = np.arange(rows, dtype=np.int64)[:, None] < frontier[None, :]
-        if self_anti_veto is not None and rows > 1:
-            # hostname-topology hard anti-affinity against the run's own
-            # labels: one committed copy excludes every further copy on
-            # that node (wave.run_eligible computed where the term's
-            # domain exists) — the same res_fit row shape as the
-            # host-port self-conflict
-            res_fit[1:, self_anti_veto] = False
-        weights = {n if isinstance(n, str) else n[0]: w
-                   for n, w in self.config.priorities}
-        w_spread = int(weights.get(SELECTOR_SPREAD, 0))
-        w_na = int(weights.get(NODE_AFFINITY, 0))
-        w_tt = int(weights.get(TAINT_TOLERATION, 0))
-        w_ip = int(weights.get(INTER_POD_AFFINITY, 0))
-        zid = None
-        if (w_spread and zone_id is not None
-                and np.any(np.asarray(zone_id) > 0)):
-            zid = np.ascontiguousarray(zone_id, np.int32)
-        return RunTables(
-            zone_id=zid,
-            num_zones=num_zones,
-            fit_static=fit_static,
-            res_fit=res_fit,
-            tab=np.asarray(tab).astype(np.int64),
-            static_add=stk[2],
-            w_spread=w_spread,
-            spread_base=stk[3] if w_spread else None,
-            spread_selfmatch=bool(stk[4][0]) if w_spread else False,
+        return tables_from_packed(
+            self.config, arr, num_zones, J, rows,
             has_selectors=(bool(np.asarray(pod["has_selectors"]))
                            if has_selectors is None else has_selectors),
-            w_na=w_na,
-            na_counts=stk[5] if w_na else None,
-            w_tt=w_tt,
-            tt_counts=stk[6] if w_tt else None,
-            w_ip=w_ip,
-            ip_totals=stk[7] if w_ip else None,
+            zone_id=zone_id, self_anti_veto=self_anti_veto,
         )
+
+
+def tables_from_packed(config: SchedulerConfig, arr: np.ndarray,
+                       num_zones: int, J: int, rows: int,
+                       has_selectors: bool,
+                       zone_id: Optional[np.ndarray] = None,
+                       self_anti_veto: Optional[np.ndarray] = None
+                       ) -> RunTables:
+    """Unpack the probe's packed product into RunTables (shared by the
+    single-chip probe and the mesh probe, whose shard outputs
+    concatenate into the identical global array)."""
+    stk = arr[:8]
+    dt = _tab_dtype(config)
+    k = 8 // np.dtype(dt).itemsize
+    N = arr.shape[1]
+    tab = (
+        arr[8:].view(dt).reshape(J // k, N, k)
+        .transpose(0, 2, 1).reshape(J, N)[:rows]
+    )
+    fit_static = stk[0].astype(bool)
+    frontier = stk[1]
+    res_fit = np.arange(rows, dtype=np.int64)[:, None] < frontier[None, :]
+    if self_anti_veto is not None and rows > 1:
+        # hostname-topology hard anti-affinity against the run's own
+        # labels: one committed copy excludes every further copy on
+        # that node (wave.run_eligible computed where the term's
+        # domain exists) — the same res_fit row shape as the
+        # host-port self-conflict
+        res_fit[1:, self_anti_veto] = False
+    weights = {n if isinstance(n, str) else n[0]: w
+               for n, w in config.priorities}
+    w_spread = int(weights.get(SELECTOR_SPREAD, 0))
+    w_na = int(weights.get(NODE_AFFINITY, 0))
+    w_tt = int(weights.get(TAINT_TOLERATION, 0))
+    w_ip = int(weights.get(INTER_POD_AFFINITY, 0))
+    zid = None
+    if (w_spread and zone_id is not None
+            and np.any(np.asarray(zone_id) > 0)):
+        zid = np.ascontiguousarray(zone_id, np.int32)
+    return RunTables(
+        zone_id=zid,
+        num_zones=num_zones,
+        fit_static=fit_static,
+        res_fit=res_fit,
+        tab=np.asarray(tab).astype(np.int64),
+        static_add=stk[2],
+        w_spread=w_spread,
+        spread_base=stk[3] if w_spread else None,
+        spread_selfmatch=bool(stk[4][0]) if w_spread else False,
+        has_selectors=has_selectors,
+        w_na=w_na,
+        na_counts=stk[5] if w_na else None,
+        w_tt=w_tt,
+        tt_counts=stk[6] if w_tt else None,
+        w_ip=w_ip,
+        ip_totals=stk[7] if w_ip else None,
+    )

@@ -168,6 +168,48 @@ def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
     return True, veto
 
 
+def pick_j(config: SchedulerConfig, max_j: int, snap: ClusterSnapshot,
+           batch: PodBatch, rep: int, K: int) -> Tuple[int, int]:
+    """-> (J, rows). J is the compiled table depth (pow2-bucketed
+    for compile reuse); rows <= J is the replay's table horizon —
+    the capacity bound +2, so the most capacious node's fit
+    observably goes False inside the table instead of tripping the
+    horizon bail (which would force a full re-probe of the
+    remaining run). The probe ships the full packed J-table in one
+    transfer and clips to `rows` host-side (transfer is latency-
+    bound, not bandwidth-bound); `rows` exists to bound the replay
+    and keep the host tables small. Computed from the run-start
+    snapshot only — commits monotonically shrink every node's
+    remaining capacity, so this stays an upper bound for the whole
+    backlog (no device sync). Shared by the single-chip and mesh
+    wave drivers."""
+    alloc_pods = np.asarray(snap.alloc_pods)
+    if not alloc_pods.size:
+        return 16, 16
+    if not wants_resources(config):
+        # no PodFitsResources: nothing enforces the capacity bound,
+        # res_fit never goes False, and clipping rows below J would
+        # horizon-bail (and re-probe) every `rows` picks
+        J = next_pow2(min(K + 1, max_j), floor=128)
+        return J, J
+    cap = np.maximum(alloc_pods - np.asarray(snap.pod_count), 0)
+    # the commit vector shrinks cpu/mem headroom too (a fit at j
+    # implies j*commit + request <= alloc); use whichever bound is
+    # tightest so the table stays small
+    for commit, alloc, used in (
+        (int(batch.commit_mcpu[rep]), snap.alloc_mcpu, snap.req_mcpu),
+        (int(batch.commit_mem[rep]), snap.alloc_mem, snap.req_mem),
+    ):
+        if commit > 0:
+            room = np.maximum(np.asarray(alloc) - np.asarray(used), 0)
+            cap = np.minimum(cap, room // commit + 1)
+    depth = min(K, int(cap.max()) + 1) + 1
+    # floor 128: one probe program serves every wave size (a small
+    # K would otherwise compile J=16/32/64 variants for nothing)
+    J = next_pow2(min(depth, max_j), floor=128)
+    return J, min(depth, J)
+
+
 def gather_batch(batch: PodBatch, rows: np.ndarray) -> PodBatch:
     """Materialize per-position rows from the unique-representative
     batch (fancy-index every pod-axis array)."""
@@ -377,43 +419,7 @@ class WaveScheduler:
 
     def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
                 K: int) -> Tuple[int, int]:
-        """-> (J, rows). J is the compiled table depth (pow2-bucketed
-        for compile reuse); rows <= J is the replay's table horizon —
-        the capacity bound +2, so the most capacious node's fit
-        observably goes False inside the table instead of tripping the
-        horizon bail (which would force a full re-probe of the
-        remaining run). The probe ships the full packed J-table in one
-        transfer and clips to `rows` host-side (transfer is latency-
-        bound, not bandwidth-bound); `rows` exists to bound the replay
-        and keep the host tables small. Computed from the run-start
-        snapshot only — commits monotonically shrink every node's
-        remaining capacity, so this stays an upper bound for the whole
-        backlog (no device sync)."""
-        alloc_pods = np.asarray(snap.alloc_pods)
-        if not alloc_pods.size:
-            return 16, 16
-        if not wants_resources(self.config):
-            # no PodFitsResources: nothing enforces the capacity bound,
-            # res_fit never goes False, and clipping rows below J would
-            # horizon-bail (and re-probe) every `rows` picks
-            J = next_pow2(min(K + 1, self.max_j), floor=128)
-            return J, J
-        cap = np.maximum(alloc_pods - np.asarray(snap.pod_count), 0)
-        # the commit vector shrinks cpu/mem headroom too (a fit at j
-        # implies j*commit + request <= alloc); use whichever bound is
-        # tightest so the table stays small
-        for commit, alloc, used in (
-            (int(batch.commit_mcpu[rep]), snap.alloc_mcpu, snap.req_mcpu),
-            (int(batch.commit_mem[rep]), snap.alloc_mem, snap.req_mem),
-        ):
-            if commit > 0:
-                room = np.maximum(np.asarray(alloc) - np.asarray(used), 0)
-                cap = np.minimum(cap, room // commit + 1)
-        depth = min(K, int(cap.max()) + 1) + 1
-        # floor 128: one probe program serves every wave size (a small
-        # K would otherwise compile J=16/32/64 variants for nothing)
-        J = next_pow2(min(depth, self.max_j), floor=128)
-        return J, min(depth, J)
+        return pick_j(self.config, self.max_j, snap, batch, rep, K)
 
     def schedule_backlog(
         self,

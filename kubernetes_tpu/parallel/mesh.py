@@ -95,10 +95,11 @@ def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
     return dataclasses.replace(snap, **fields)
 
 
-def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values, static, carry, pod):
-    """Per-shard scan body. `static`/`carry` node arrays hold this shard's
-    slice; `pod` is replicated. Mirrors models.batch._scan_fn with the
-    normalization maxes and selection made global via collectives."""
+def _shard_fit(config, n_per_shard, n_global, static, carry, pod,
+               include_resources=True):
+    """Per-shard fit mask (the predicate section of the scan body,
+    shared with the mesh wave probe). Returns (fit, cnt_lt, topo_local,
+    offset); cnt_lt/topo_local are None unless interpod is configured."""
     (
         res,
         port_mask,
@@ -127,6 +128,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values, static, 
     # shard's node columns of the (replicated) topology-domain table
     want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
     want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    cnt_lt = topo_local = None
     if want_ip_pred or want_ip_prio:
         topo_local = jax.lax.dynamic_slice_in_dim(
             static["ip_topo_dom"], offset, n_per_shard, axis=1
@@ -160,7 +162,7 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values, static, 
             pod["vp_gce"], pod["vp_gce_bad"], pod["vp_has_gce"],
             gce_mask, static["gce_bad"], config.max_gce_pd_volumes,
         )
-    if wants_resources(config):
+    if include_resources and wants_resources(config):
         fit = fit & P.pod_fits_resources(
             pod["req_mcpu"],
             pod["req_mem"],
@@ -251,6 +253,41 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values, static, 
             pod["ip_sym_reject"],
             n_per_shard,
         )
+    return fit, cnt_lt, topo_local, offset
+
+
+def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values,
+                  static, carry, pod):
+    """Per-shard scan body. `static`/`carry` node arrays hold this shard's
+    slice; `pod` is replicated. Mirrors models.batch._scan_fn with the
+    normalization maxes and selection made global via collectives."""
+    (
+        res,
+        port_mask,
+        class_count,
+        last_idx,
+        ip_term_count,
+        ip_own_anti,
+        ip_rev_hard,
+        ip_rev_pref,
+        ip_rev_anti,
+        ip_spec_total,
+        vol_any,
+        vol_rw,
+        ebs_mask,
+        gce_mask,
+        svc_first_peer,
+        svc_peer_node_count,
+        svc_peer_total,
+    ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
+    want_ip_pred = MATCH_INTER_POD_AFFINITY in config.predicates
+    want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
+    svc_labels = service_config_labels(config)
+
+    fit, cnt_lt, topo_local, offset = _shard_fit(
+        config, n_per_shard, n_global, static, carry, pod
+    )
 
     score = jnp.zeros(req_mcpu.shape, jnp.int64)
     for name, weight in config.priorities:
@@ -464,6 +501,258 @@ def _spread_sharded(
     return jnp.where(jnp.isnan(f), jnp.int64(-(2**63)), f.astype(jnp.int64))
 
 
+def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
+                   n_global, pod_layout, static, carry, pod_buf):
+    """Per-shard wave probe (models/probe._probe_fn, sharded): this
+    shard's slice of the packed table product. The out_spec concatenates
+    shards along the node axis, so the host sees the same (8 + J-words,
+    N) array the single-chip probe ships — replay and commit mapping are
+    untouched. The pod row arrives as ONE packed replicated buffer
+    (models/pack) instead of ~40 per-field transfers."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+    from kubernetes_tpu.models.probe import _tab_dtype
+
+    pod = _unpack_pod(pod_layout, pod_buf)
+
+    (
+        res, port_mask, class_count, last_idx,
+        ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
+        ip_rev_anti, ip_spec_total,
+        vol_any, vol_rw, ebs_mask, gce_mask,
+        svc_first_peer, svc_peer_node_count, svc_peer_total,
+    ) = carry
+    req_mcpu, req_mem, req_gpu, nz_mcpu, nz_mem, pod_count = res
+    N = n_per_shard
+
+    fit_static, cnt_lt, topo_local, offset = _shard_fit(
+        config, n_per_shard, n_global, static, carry, pod,
+        include_resources=False,
+    )
+
+    j = jnp.arange(J, dtype=jnp.int64)[:, None]
+    if wants_resources(config):
+        res_fit = P.pod_fits_resources(
+            pod["req_mcpu"], pod["req_mem"], pod["req_gpu"],
+            pod["zero_req"],
+            static["alloc_mcpu"], static["alloc_mem"],
+            static["alloc_gpu"], static["alloc_pods"],
+            req_mcpu[None, :] + j * pod["commit_mcpu"],
+            req_mem[None, :] + j * pod["commit_mem"],
+            req_gpu[None, :] + j * pod["commit_gpu"],
+            pod_count[None, :] + j,
+        )
+    else:
+        res_fit = jnp.ones((J, N), bool)
+    if wants_ports(config):
+        has_ports = (pod["port_mask"] != 0).any()
+        res_fit = res_fit & ((j == 0) | ~has_ports)
+
+    nzj_cpu = nz_mcpu[None, :] + j * pod["nz_mcpu"]
+    nzj_mem = nz_mem[None, :] + j * pod["nz_mem"]
+    tab = jnp.zeros((J, N), jnp.int64)
+    static_add = jnp.zeros((N,), jnp.int64)
+    zeros = jnp.zeros((N,), jnp.int64)
+    stk_rows = {"spread_base": zeros, "spread_selfmatch": zeros,
+                "na_counts": zeros, "tt_counts": zeros, "ip_totals": zeros}
+    for name, weight in config.priorities:
+        if name == "LeastRequestedPriority":
+            tab = tab + jnp.int64(weight) * R.least_requested(
+                pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == "BalancedResourceAllocation":
+            tab = tab + jnp.int64(weight) * R.balanced_resource_allocation(
+                pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                static["alloc_mcpu"], static["alloc_mem"],
+            )
+        elif name == "SelectorSpreadPriority":
+            stk_rows["spread_base"] = (
+                class_count.astype(jnp.int32)
+                @ pod["spread_match"].astype(jnp.int32)
+            ).astype(jnp.int64)
+            stk_rows["spread_selfmatch"] = jnp.broadcast_to(
+                (pod["spread_match"][pod["class_id"]] > 0).astype(jnp.int64),
+                (N,),
+            )
+        elif name == "NodeAffinityPriority":
+            stk_rows["na_counts"] = R.node_affinity_counts(
+                pod["pref_valid"], pod["pref_weight"], pod["pref_ops"],
+                pod["pref_key"], pod["pref_set"], pod["pref_numkey"],
+                pod["pref_num"], static["label_kv"], static["label_key"],
+                static["numval"], static["set_table"],
+            )
+        elif name == "TaintTolerationPriority":
+            stk_rows["tt_counts"] = (
+                static["taint_count"] @ pod["intolerable_prefer"]
+            ).astype(jnp.int64)
+        elif name == INTER_POD_AFFINITY:
+            stk_rows["ip_totals"] = IP.interpod_totals(
+                cnt_lt,
+                IP.gather_lt(ip_rev_hard, static["ip_u_topo"], topo_local,
+                             static["ip_lt_u"], static["ip_lt_sign"]),
+                IP.gather_lt(ip_rev_pref, static["ip_u_topo"], topo_local,
+                             static["ip_lt_u"], static["ip_lt_sign"]),
+                IP.gather_lt(ip_rev_anti, static["ip_u_topo"], topo_local,
+                             static["ip_lt_u"], static["ip_lt_sign"]),
+                static["ip_lt_spec"], pod["ip_match_spec"],
+                pod["ip_fwd_lt"], pod["ip_fwd_w"],
+                config.hard_pod_affinity_weight, N,
+            )
+        elif name == "EqualPriority":
+            static_add = static_add + jnp.int64(weight) * R.equal(N)
+        elif name == "ImageLocalityPriority":
+            static_add = static_add + jnp.int64(weight) * R.image_locality(
+                static["img_size"], pod["img_count"]
+            )
+        elif isinstance(name, tuple) and name[0] == "NodeLabelPriority":
+            static_add = static_add + jnp.int64(weight) * R.node_label(
+                static[f"nl_prio_{name[1]}"], name[2]
+            )
+        else:
+            raise ValueError(f"priority {name!r} is not mesh-wave-eligible")
+    frontier = res_fit.sum(0, dtype=jnp.int64)
+    stk = jnp.stack([
+        fit_static.astype(jnp.int64),
+        frontier,
+        static_add,
+        stk_rows["spread_base"],
+        stk_rows["spread_selfmatch"],
+        stk_rows["na_counts"],
+        stk_rows["tt_counts"],
+        stk_rows["ip_totals"],
+    ])
+    dt = _tab_dtype(config)
+    k = 8 // np.dtype(dt).itemsize
+    tabp = tab.astype(dt).reshape(J // k, k, N).swapaxes(1, 2)
+    tabw = jax.lax.bitcast_convert_type(tabp, jnp.int64)
+    return jnp.concatenate([stk, tabw], axis=0)
+
+
+def _mesh_apply_fn(config, pod_layout, static, carry, pod_buf,
+                   counts_global):
+    """The wave commit fold, sharded: node-axis tables take this shard's
+    slice of the global per-node commit counts; the replicated interpod
+    tables take the identical global fold on every shard (the pattern
+    interpod_commit uses in the mesh scan)."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    pod = _unpack_pod(pod_layout, pod_buf)
+    (
+        res, port_mask, class_count, last_idx,
+        ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
+        ip_rev_anti, ip_spec_total,
+        vol_any, vol_rw, ebs_mask, gce_mask,
+        svc_first_peer, svc_peer_node_count, svc_peer_total,
+    ) = carry
+    n_per_shard = port_mask.shape[0]
+    shard = jax.lax.axis_index(AXIS)
+    offset = shard.astype(jnp.int32) * n_per_shard
+    counts = jax.lax.dynamic_slice_in_dim(
+        counts_global, offset, n_per_shard
+    )
+    k = counts_global.sum()
+    commit = jnp.stack([
+        pod["commit_mcpu"], pod["commit_mem"], pod["commit_gpu"],
+        pod["nz_mcpu"], pod["nz_mem"], jnp.int64(1),
+    ])
+    res = res + commit[:, None] * counts[None, :]
+    port_mask = jnp.where(
+        (counts > 0)[:, None], port_mask | pod["port_mask"][None, :],
+        port_mask,
+    )
+    class_count = class_count.at[:, pod["class_id"]].add(counts)
+    last_idx = last_idx + k
+    U = static["ip_u_topo"].shape[0]
+    NG = counts_global.shape[0]
+    if U and ip_term_count.shape[1]:
+        dom = static["ip_topo_dom"][static["ip_u_topo"]]  # (U, NG)
+        mu = pod["ip_match_spec"][static["ip_u_spec"]]
+        add = jnp.where(
+            dom >= 0,
+            mu[:, None].astype(jnp.int64) * counts_global[None, :], 0,
+        )
+        ip_term_count = ip_term_count.at[
+            jnp.arange(U)[:, None],
+            jnp.clip(dom, 0, ip_term_count.shape[1] - 1),
+        ].add(add.astype(ip_term_count.dtype))
+    LT = static["ip_lt_u"].shape[0] if "ip_lt_u" in static else 0
+    E = static["ip_lt_u"].shape[1] if LT else 0
+    if LT and E and ip_own_anti.shape[2]:
+        lt_u = static["ip_lt_u"]
+        q = static["ip_u_topo"][jnp.clip(lt_u, 0, U - 1)]
+        domq = static["ip_topo_dom"][q]  # (LT, E, NG)
+        validq = (lt_u >= 0)[:, :, None] & (domq >= 0)
+        sdq = jnp.clip(domq, 0, ip_own_anti.shape[2] - 1)
+        lt_i = jnp.arange(LT)[:, None, None]
+        e_i = jnp.arange(E)[None, :, None]
+        c32 = jnp.where(validq, counts_global[None, None, :], 0).astype(
+            jnp.int32
+        )
+        c64 = c32.astype(jnp.int64)
+        ip_own_anti = ip_own_anti.at[lt_i, e_i, sdq].add(
+            pod["ip_own_anti_hard"][:, None, None] * c32
+        )
+        ip_rev_hard = ip_rev_hard.at[lt_i, e_i, sdq].add(
+            pod["ip_own_hard"][:, None, None] * c32
+        )
+        ip_rev_pref = ip_rev_pref.at[lt_i, e_i, sdq].add(
+            pod["ip_own_pref"][:, None, None] * c64
+        )
+        ip_rev_anti = ip_rev_anti.at[lt_i, e_i, sdq].add(
+            pod["ip_own_anti_pref"][:, None, None] * c64
+        )
+    if ip_spec_total.shape[0]:
+        ip_spec_total = ip_spec_total + (
+            pod["ip_match_spec"].astype(jnp.int64) * k
+        ).astype(ip_spec_total.dtype)
+    return (
+        res, port_mask, class_count, last_idx,
+        ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
+        ip_rev_anti, ip_spec_total,
+        vol_any, vol_rw, ebs_mask, gce_mask,
+        svc_first_peer, svc_peer_node_count, svc_peer_total,
+    )
+
+
+def _static_specs(static: dict) -> dict:
+    """PartitionSpec per static snapshot field (shared by the mesh scan
+    and the mesh wave probe)."""
+    return {
+        k: (
+            PSpec(AXIS)
+            if k
+            in (
+                "alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods",
+                "has_taints", "taint_bad", "mem_pressure", "zone_id",
+                "ebs_bad", "gce_bad", "vz_zone", "vz_region", "vz_has",
+            )
+            or k.startswith("nl_")  # config-resolved node-label masks
+            else PSpec(AXIS, None)
+            if k
+            in (
+                "label_kv", "label_key", "numval", "taint_mask",
+                "taint_count", "img_size",
+            )
+            else PSpec()  # replicated vocab tables + global order
+        )
+        for k in static
+    }
+
+
+CARRY_SPECS = (
+    # stacked resources: node axis is axis 1
+    PSpec(None, AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
+    # interpod count tables: replicated (domain-indexed, not node)
+    PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
+    # volume masks: node-axis sharded
+    PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
+    PSpec(AXIS, None),
+    # service-group tables: replicated (small: groups x labels);
+    # every shard applies identical commits with global indices
+    PSpec(), PSpec(), PSpec(),
+)
+
+
 class MeshBatchScheduler:
     """BatchScheduler over a jax.sharding.Mesh: node axis sharded, pods
     replicated. Intended shape: one shard per chip on a v5e slice, DCN
@@ -498,42 +787,20 @@ class MeshBatchScheduler:
         pods = {f: jnp.asarray(getattr(batch, f)) for f in BatchScheduler.POD_FIELDS}
         num_zones = max(int(snap.zone_id.max()) + 1, 1)
 
-        sharded_static = {
-            k: (
-                PSpec(AXIS)
-                if k
-                in (
-                    "alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods",
-                    "has_taints", "taint_bad", "mem_pressure", "zone_id",
-                    "ebs_bad", "gce_bad", "vz_zone", "vz_region", "vz_has",
-                )
-                or k.startswith("nl_")  # config-resolved node-label masks
-                else PSpec(AXIS, None)
-                if k
-                in (
-                    "label_kv", "label_key", "numval", "taint_mask",
-                    "taint_count", "img_size",
-                )
-                else PSpec()  # replicated vocab tables + global order
-            )
-            for k in static
-        }
-        carry_specs = (
-            # stacked resources: node axis is axis 1
-            PSpec(None, AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
-            # interpod count tables: replicated (domain-indexed, not node)
-            PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
-            # volume masks: node-axis sharded
-            PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
-            PSpec(AXIS, None),
-            # service-group tables: replicated (small: groups x labels);
-            # every shard applies identical commits with global indices
-            PSpec(), PSpec(), PSpec(),
-        )
-        pod_specs = {k: PSpec() for k in pods}
-
         num_values = int(snap.svc_num_values)
-        key = (n, n_per_shard, batch.num_pods, num_zones, num_values)
+        sched = BatchScheduler(self.config)
+        carry = sched.initial_carry(snap, last_node_index)
+        final, chosen = self._exec(
+            static, carry, pods, n, n_per_shard, num_zones, num_values,
+            batch.num_pods,
+        )
+        return np.asarray(chosen), final
+
+    def _exec(self, static, carry, pods, n, n_per_shard, num_zones,
+              num_values, num_pods):
+        """Run the sharded scan with an EXTERNAL carry (the mesh wave's
+        fallback flush threads its carry through here)."""
+        key = (n, n_per_shard, num_pods, num_zones, num_values)
         run = self._jitted.get(key)
         if run is None:
             body = functools.partial(
@@ -552,21 +819,234 @@ class MeshBatchScheduler:
             sharded = shard_map(
                 spmd,
                 mesh=self.mesh,
-                in_specs=(sharded_static, carry_specs, pod_specs),
-                out_specs=(carry_specs, PSpec()),
+                in_specs=(
+                    _static_specs(static), CARRY_SPECS,
+                    {k: PSpec() for k in pods},
+                ),
+                out_specs=(CARRY_SPECS, PSpec()),
                 check_vma=False,
             )
             run = jax.jit(sharded)
             self._jitted[key] = run
-
-        sched = BatchScheduler(self.config)
-        carry = sched.initial_carry(snap, last_node_index)
         with self.mesh:
             final, chosen = run(static, carry, pods)
-        chosen = np.asarray(chosen)
-        return chosen, final
+        return final, chosen
 
     def schedule_names(self, snap: ClusterSnapshot, batch: PodBatch):
         names = list(snap.node_names)
         chosen, _ = self.schedule(snap, batch)
         return [names[i] if i >= 0 else None for i in chosen]
+
+
+class MeshWaveScheduler:
+    """The wave fast path over a device mesh: probe tables computed per
+    shard (node axis sharded, one shard per chip), the replay on the
+    host exactly as single-chip, and the commit fold applied per shard.
+    Ineligible pods flush through the sharded scan with the SAME carry,
+    so the combined output is bit-identical to both the single-chip wave
+    and the serial oracle. This is the multi-chip scaling of the
+    reference's 16-worker node fan-out (generic_scheduler.go:161) —
+    except the fan-out here is across chips, not goroutines."""
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 config: Optional[SchedulerConfig] = None,
+                 min_run: int = 16, max_j: int = 1024,
+                 pod_floor: int = 64, replay=None):
+        from kubernetes_tpu.models.replay import replay_fast
+
+        if mesh is None:
+            mesh = Mesh(np.array(jax.devices()), (AXIS,))
+        self.mesh = mesh
+        self.config = config or SchedulerConfig()
+        self.scan = MeshBatchScheduler(mesh, config=self.config)
+        self.min_run = min_run
+        self.max_j = max_j
+        self.pod_floor = pod_floor
+        self._replay = replay or replay_fast
+        self._probe_jit = {}
+        self._apply_jit = {}
+
+    # -- sharded programs ----------------------------------------------------
+
+    def _probe_run(self, static, carry, pod_layout, pod_buf, n,
+                   n_per_shard, num_zones, num_values, J):
+        key = ("probe", n, n_per_shard, num_zones, num_values, J,
+               pod_layout)
+        run = self._probe_jit.get(key)
+        if run is None:
+            from jax import shard_map
+
+            body = functools.partial(
+                _mesh_probe_fn, self.config, num_zones, num_values, J,
+                n_per_shard, n, pod_layout,
+            )
+            run = jax.jit(shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(_static_specs(static), CARRY_SPECS, PSpec()),
+                # shard slices concatenate along the node axis into the
+                # same global packed array the single-chip probe ships
+                out_specs=PSpec(None, AXIS),
+                check_vma=False,
+            ))
+            self._probe_jit[key] = run
+        with self.mesh:
+            return run(static, carry, pod_buf)
+
+    def _apply_run(self, static, carry, pod_layout, pod_buf, counts, n,
+                   n_per_shard):
+        key = ("apply", n, n_per_shard, pod_layout)
+        run = self._apply_jit.get(key)
+        if run is None:
+            from jax import shard_map
+
+            body = functools.partial(
+                _mesh_apply_fn, self.config, pod_layout
+            )
+            run = jax.jit(shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(_static_specs(static), CARRY_SPECS, PSpec(),
+                          PSpec()),
+                out_specs=CARRY_SPECS,
+                check_vma=False,
+            ))
+            self._apply_jit[key] = run
+        with self.mesh:
+            return run(static, carry, pod_buf, counts)
+
+    # -- backlog driver ------------------------------------------------------
+
+    def schedule_backlog(
+        self,
+        snap: ClusterSnapshot,
+        batch: PodBatch,
+        rep_idx: np.ndarray,
+        last_node_index: int = 0,
+    ):
+        """Single-chip WaveScheduler.schedule_backlog semantics over the
+        mesh: -> (chosen i32[P] node ids, final carry, lastNodeIndex).
+        snap must already be padded to a mesh multiple."""
+        from kubernetes_tpu.models.probe import tables_from_packed
+        from kubernetes_tpu.models.replay import ReplayResult
+        from kubernetes_tpu.models.wave import (
+            config_eligible,
+            gather_batch,
+            run_eligible,
+            _permute_tables,
+        )
+        from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
+
+        n_dev = self.mesh.devices.size
+        snap = _pad_snapshot(snap, n_dev)
+        N = len(snap.node_names)
+        n_per_shard = N // n_dev
+        P = len(rep_idx)
+
+        static = {
+            f: jnp.asarray(getattr(snap, f))
+            for f in BatchScheduler.STATIC_FIELDS
+        }
+        static.update(BatchScheduler.config_static(self.config, snap))
+        static["name_desc_order_global"] = static.pop("name_desc_order")
+        num_zones = max(int(snap.zone_id.max()) + 1, 1)
+        num_values = int(snap.svc_num_values)
+        sched = BatchScheduler(self.config)
+        carry = sched.initial_carry(snap, last_node_index)
+        zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
+
+        out = np.full(P, -1, np.int32)
+        perm = np.asarray(snap.name_desc_order).astype(np.int64)
+        runs = []
+        i = 0
+        while i < P:
+            r = rep_idx[i]
+            s = i
+            while i < P and rep_idx[i] == r:
+                i += 1
+            runs.append((int(r), s, i - s))
+
+        pending: list = []
+        L_host = int(last_node_index)
+
+        # the probe's static dict keeps the mesh's global-order key; the
+        # static used by the mesh scan flush is identical
+        def flush(carry):
+            nonlocal L_host
+            if not pending:
+                return carry
+            rows = np.asarray(pending, np.int64)
+            seg = gather_batch(batch, rep_idx[rows])
+            seg = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
+            pods = {
+                f: jnp.asarray(getattr(seg, f))
+                for f in BatchScheduler.POD_FIELDS
+            }
+            carry, chosen = self.scan._exec(
+                static, carry, pods, N, n_per_shard, num_zones,
+                num_values, seg.num_pods,
+            )
+            out[rows] = np.asarray(chosen)[: len(rows)]
+            L_host = int(jax.device_get(carry[BatchScheduler.LAST_IDX]))
+            pending.clear()
+            return carry
+
+        config_ok = config_eligible(self.config)
+        for rep, start, length in runs:
+            eligible, self_anti_veto = (False, None)
+            if length >= self.min_run:
+                eligible, self_anti_veto = run_eligible(
+                    self.config, batch, rep, snap, config_ok=config_ok,
+                )
+            if not eligible:
+                pending.extend(range(start, start + length))
+                continue
+            carry = flush(carry)
+            from kubernetes_tpu.models.pack import pack_arrays
+
+            pod_layout, pod_buf = pack_arrays({
+                f: np.asarray(getattr(batch, f)[rep])
+                for f in BatchScheduler.POD_FIELDS
+            })
+            pod_buf = jnp.asarray(pod_buf)
+            done = 0
+            while done < length:
+                K = length - done
+                J, rows_n = self._pick_j(snap, batch, rep, K)
+                packed = self._probe_run(
+                    static, carry, pod_layout, pod_buf, N, n_per_shard,
+                    num_zones, num_values, J,
+                )
+                arr = np.ascontiguousarray(jax.device_get(packed))
+                tables = tables_from_packed(
+                    self.config, arr, num_zones, J, rows_n,
+                    has_selectors=bool(batch.has_selectors[rep]),
+                    zone_id=np.asarray(snap.zone_id) if zoned else None,
+                    self_anti_veto=self_anti_veto,
+                )
+                res: ReplayResult = self._replay(
+                    _permute_tables(tables, perm), K, L_host
+                )
+                if res.n_done == 0:
+                    pending.extend(range(start + done, start + length))
+                    break
+                ids = np.where(res.chosen >= 0, perm[res.chosen], -1)
+                out[start + done: start + done + res.n_done] = ids.astype(
+                    np.int32
+                )
+                counts = np.zeros(N, np.int64)
+                counts[perm] = res.counts
+                carry = self._apply_run(
+                    static, carry, pod_layout, pod_buf,
+                    jnp.asarray(counts), N, n_per_shard,
+                )
+                L_host = res.last_node_index
+                done += res.n_done
+        carry = flush(carry)
+        return out, carry, L_host
+
+    def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
+                K: int):
+        from kubernetes_tpu.models.wave import pick_j
+
+        return pick_j(self.config, self.max_j, snap, batch, rep, K)

@@ -40,10 +40,12 @@ class TPUScheduleAlgorithm:
         self._mesh_sched = None
         self._inc = None
         if mesh is not None:
-            from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
+            from kubernetes_tpu.parallel.mesh import MeshWaveScheduler
 
-            self._mesh_sched = MeshBatchScheduler(mesh, config=config)
-            self._sched = self._mesh_sched
+            self._mesh_sched = MeshWaveScheduler(
+                mesh, config=config, min_run=min_run
+            )
+            self._sched = self._mesh_sched.scan
         else:
             from kubernetes_tpu.models.wave import WaveScheduler
 
@@ -107,8 +109,14 @@ class TPUScheduleAlgorithm:
         sharded program): a multi-chip daemon otherwise lands its cold
         XLA compile on the first real pod's wave."""
         if self._mesh_sched is not None:
+            # "run" warms the sharded probe/apply (template waves);
+            # "scan" warms the sharded fallback scan (heterogeneous or
+            # sub-min_run pods) — a cold scan compile would otherwise
+            # land on the first mixed backlog's flush
             if phase in ("all", "run"):
-                self._warmup_mesh(num_nodes)
+                self._warmup_mesh(num_nodes, scan=False)
+            if phase in ("all", "scan"):
+                self._warmup_mesh(num_nodes, scan=True)
             return
         from kubernetes_tpu.api.types import (
             Container,
@@ -156,10 +164,11 @@ class TPUScheduleAlgorithm:
             self._warm_one([pod("w-scan", "200m"),
                             pod("w-scan2", "300m")], state, nodes)
 
-    def _warmup_mesh(self, num_nodes: int) -> None:
-        """Compile the sharded program for the cluster's node bucket
-        before real pods arrive (pad_to_buckets keeps the shape set
-        tiny, so this covers the common waves)."""
+    def _warmup_mesh(self, num_nodes: int, scan: bool = False) -> None:
+        """Compile the sharded programs for the cluster's node bucket
+        before real pods arrive. scan=False: a min_run template run
+        (the sharded probe + apply); scan=True: heterogeneous pods
+        (the sharded fallback scan)."""
         from kubernetes_tpu.api.types import (
             Container,
             Node,
@@ -182,16 +191,32 @@ class TPUScheduleAlgorithm:
             )
             for i in range(max(num_nodes, 1))
         ]
-        backlog = [
-            PodT(
-                metadata=ObjectMeta(name=f"w{i}",
-                                    labels={"app": "warm"}),
-                spec=PodSpec(containers=[
-                    Container(image="warm", requests={"cpu": "100m"})
-                ]),
-            )
-            for i in range(2)
-        ]
+        if scan:
+            # distinct per-pod requests: never a run => the flush path
+            backlog = [
+                PodT(
+                    metadata=ObjectMeta(name=f"ws{i}",
+                                        labels={"app": "warm"}),
+                    spec=PodSpec(containers=[
+                        Container(image="warm",
+                                  requests={"cpu": f"{100 + i}m"})
+                    ]),
+                )
+                for i in range(2)
+            ]
+        else:
+            # a min_run-sized template run warms the sharded PROBE and
+            # APPLY programs
+            backlog = [
+                PodT(
+                    metadata=ObjectMeta(name=f"w{i}",
+                                        labels={"app": "warm"}),
+                    spec=PodSpec(containers=[
+                        Container(image="warm", requests={"cpu": "100m"})
+                    ]),
+                )
+                for i in range(max(self._mesh_sched.min_run, 2))
+            ]
         with self._sched_lock:
             saved_last = self._last_node_index
             try:
@@ -296,27 +321,34 @@ class TPUScheduleAlgorithm:
     def _schedule_backlog_mesh(
         self, pods: Sequence[Pod], state: ClusterState
     ) -> List[Optional[str]]:
+        """Mesh daemon path: the sharded WAVE driver (probe tables per
+        shard, host replay, per-shard commit fold) with the sharded scan
+        as the in-carry fallback — the multi-chip selection is no longer
+        scan-only (VERDICT r4 §2.3)."""
+        from kubernetes_tpu.parallel.mesh import _pad_snapshot
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
-        from kubernetes_tpu.snapshot.pad import pad_to_buckets
+        from kubernetes_tpu.snapshot.pad import next_pow2
 
-        snap, batch = SnapshotEncoder(
-            state, list(pods), config=getattr(self._sched, "config", None)
-        ).encode()
-        # bucket both axes so the live daemon (ever-changing node/backlog
-        # counts) reuses compiled programs instead of re-jitting per wave.
-        # Generous floors keep the bucket COUNT tiny (compiles are ~30s on
-        # a tunneled chip); scanning a few dozen padded no-op pods costs
-        # microseconds
-        snap, batch, n_real, p_real = pad_to_buckets(
-            snap, batch, node_floor=64, pod_floor=64
+        reps, rep_idx = self._dedup(pods)
+        enc = SnapshotEncoder(
+            state, reps, config=self._mesh_sched.config
         )
-        chosen, final = self._sched.schedule(
-            snap, batch, last_node_index=self._last_node_index
+        snap = enc.encode_nodes()
+        batch = enc.encode_pods()
+        n_real = snap.num_nodes
+        if n_real == 0:
+            return [None] * len(pods)
+        # bucket the node axis for compile reuse (pow2, floor 64), then
+        # to a mesh multiple so the shard math sees the final N here and
+        # node ids map back to THIS snapshot's names
+        n_dev = self._mesh_sched.mesh.devices.size
+        snap = _pad_snapshot(snap, next_pow2(n_real, 64))
+        snap = _pad_snapshot(snap, n_dev)
+        chosen, _final, last = self._mesh_sched.schedule_backlog(
+            snap, batch, rep_idx, last_node_index=self._last_node_index
         )
-        from kubernetes_tpu.models.batch import BatchScheduler
-
-        self._last_node_index = int(final[BatchScheduler.LAST_IDX])
-        return _ids_to_names(chosen[:p_real], snap.node_names, n_real)
+        self._last_node_index = last
+        return _ids_to_names(chosen, snap.node_names, n_real)
 
     def schedule(self, pod: Pod, state: ClusterState) -> str:
         host = self.schedule_backlog([pod], state)[0]

@@ -347,3 +347,72 @@ def test_daemon_selects_mesh_when_multichip(monkeypatch):
         assert len({p.spec.node_name for p in pods}) == 5
     finally:
         sched.stop()
+
+
+def test_mesh_wave_matches_single_chip_and_oracle(mesh):
+    """The mesh WAVE path (sharded probe + host replay + sharded commit
+    fold): a template-heavy backlog must match the single-chip wave AND
+    the oracle bit-for-bit, with the fallback scan sharing the carry."""
+    from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+    from tests.test_wave import (
+        density_nodes, pause_pods, oracle_backlog, spread_state)
+
+    nodes = density_nodes(40, pods_cap="12")
+    state = spread_state(nodes)
+    # 3 template runs (wave) + heterogeneous stragglers (scan fallback)
+    pods = pause_pods(120)
+    pods += pause_pods(40, requests={"cpu": "200m", "memory": "1Gi"})
+    for k in range(10):  # distinct requests => never a run
+        pods += pause_pods(1, requests={"cpu": f"{50 + k}m"})
+    for i, p in enumerate(pods):
+        p.metadata.name = f"pod-{i:06d}"
+    mesh_algo = TPUScheduleAlgorithm(mesh=mesh)
+    single = TPUScheduleAlgorithm()
+    got_mesh = mesh_algo.schedule_backlog(pods, state.clone())
+    got_single = single.schedule_backlog(pods, state.clone())
+    want = oracle_backlog(state, pods)
+    assert got_mesh == want
+    assert got_single == want
+
+
+def test_mesh_wave_zoned_and_self_anti(mesh):
+    """The round-5 wave extensions ride the mesh too: zoned selector
+    spread and hostname self-anti-affinity runs."""
+    from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+    from tests.test_wave import (
+        zoned_density_nodes, hostname_nodes, pause_pods, _anti_pods,
+        spread_state, oracle_backlog)
+    from kubernetes_tpu.oracle import ClusterState
+
+    state = spread_state(zoned_density_nodes(18))
+    pods = pause_pods(90)
+    got = TPUScheduleAlgorithm(mesh=mesh).schedule_backlog(pods, state)
+    assert got == oracle_backlog(state, pods)
+
+    nodes = hostname_nodes(12)
+    pods2 = _anti_pods(20, {"app": "excl"})
+    state2 = ClusterState.build(nodes)
+    got2 = TPUScheduleAlgorithm(mesh=mesh).schedule_backlog(pods2, state2)
+    want2 = oracle_backlog(state2, pods2)
+    assert got2 == want2
+    placed = [h for h in got2 if h]
+    assert len(placed) == len(set(placed)) == 12
+
+
+def test_mesh_wave_scale_2k_nodes(mesh):
+    """2k nodes / 6k template pods through the mesh wave: deep fill with
+    capacity exhaustion, bit-identical to the single-chip wave."""
+    from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+    from tests.test_wave import density_nodes, pause_pods, spread_state
+
+    nodes = density_nodes(2000, pods_cap="3")
+    state = spread_state(nodes)
+    pods = pause_pods(6500)  # 6000 slots: a 500-pod unschedulable tail
+    for i, p in enumerate(pods):
+        p.metadata.name = f"pod-{i:06d}"
+    got_mesh = TPUScheduleAlgorithm(mesh=mesh).schedule_backlog(
+        pods, state.clone())
+    got_single = TPUScheduleAlgorithm().schedule_backlog(
+        pods, state.clone())
+    assert got_mesh == got_single
+    assert got_mesh.count(None) == 500
