@@ -91,6 +91,40 @@ class Container:
     command: List[str] = field(default_factory=list)
     liveness_probe: Optional["Probe"] = None
     readiness_probe: Optional["Probe"] = None
+    # "" = the kubelet default (Always for :latest, IfNotPresent else);
+    # the AlwaysPullImages admission plugin forces "Always"
+    image_pull_policy: str = ""
+    security_context: Optional["SecurityContext"] = None
+
+
+@dataclass
+class SELinuxOptions:
+    user: str = ""
+    role: str = ""
+    type: str = ""
+    level: str = ""
+
+
+@dataclass
+class SecurityContext:
+    """Container-level security context (api/types.go SecurityContext —
+    the subset SecurityContextDeny polices)."""
+
+    privileged: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+    se_linux_options: Optional[SELinuxOptions] = None
+
+
+@dataclass
+class PodSecurityContext:
+    """Pod-level security context (api/types.go PodSecurityContext)."""
+
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+    se_linux_options: Optional[SELinuxOptions] = None
+    supplemental_groups: Optional[List[int]] = None
+    fs_group: Optional[int] = None
 
 
 # --- volume sources relevant to scheduling predicates -----------------------
@@ -382,6 +416,7 @@ class PodSpec:
     hostname: str = ""
     subdomain: str = ""
     service_account_name: str = ""
+    security_context: Optional[PodSecurityContext] = None
 
 
 @dataclass

@@ -212,3 +212,169 @@ class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
                     f"TopologyKey {term.topology_key!r}; only "
                     f"{self.HOSTNAME!r} is allowed"
                 )
+
+
+class AlwaysPullImages(AdmissionPlugin):
+    """plugin/pkg/admission/alwayspullimages/admission.go: force every
+    container's imagePullPolicy to Always on pod CREATE/UPDATE, so a
+    privately pulled image can't be reused by name alone by tenants
+    without registry credentials."""
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if resource != "pods" or operation not in (CREATE, UPDATE):
+            return
+        spec = getattr(obj, "spec", None)
+        if spec is None:
+            return
+        for c in list(spec.init_containers) + list(spec.containers):
+            c.image_pull_policy = "Always"
+
+
+class SecurityContextDeny(AdmissionPlugin):
+    """plugin/pkg/admission/securitycontext/scdeny/admission.go: deny
+    any pod that sets SELinuxOptions, RunAsUser, or pod-level
+    SupplementalGroups — the multitenant hardening plugin for clusters
+    without PodSecurityPolicy."""
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if resource != "pods" or operation not in (CREATE, UPDATE):
+            return
+        spec = getattr(obj, "spec", None)
+        if spec is None:
+            return
+        psc = spec.security_context
+        if psc is not None:
+            if psc.supplemental_groups is not None:
+                raise AdmissionDenied(
+                    "SecurityContext.SupplementalGroups is forbidden"
+                )
+            if psc.se_linux_options is not None:
+                raise AdmissionDenied(
+                    "pod.Spec.SecurityContext.SELinuxOptions is forbidden"
+                )
+            if psc.run_as_user is not None:
+                raise AdmissionDenied(
+                    "pod.Spec.SecurityContext.RunAsUser is forbidden"
+                )
+        for c in list(spec.init_containers) + list(spec.containers):
+            sc = c.security_context
+            if sc is None:
+                continue
+            if sc.se_linux_options is not None:
+                raise AdmissionDenied(
+                    "SecurityContext.SELinuxOptions is forbidden"
+                )
+            if sc.run_as_user is not None:
+                raise AdmissionDenied(
+                    "SecurityContext.RunAsUser is forbidden"
+                )
+
+
+class InitialResources(AdmissionPlugin):
+    """plugin/pkg/admission/initialresources/admission.go: estimate
+    resource REQUESTS for containers that specify none, from observed
+    history of the same image. The reference queries an influxdb/GCM
+    usage store; the in-process data source here samples the requests
+    of existing containers running the same image across the cluster
+    (60th percentile like the reference's default), falling back to a
+    configured table. Estimated values are annotated on the pod the way
+    the reference logs them, so users can see what was inferred."""
+
+    PERCENTILE = 0.6
+    ANNOTATION = "initial-resources.alpha.kubernetes.io/estimated"
+
+    def __init__(self, server, table: Optional[dict] = None):
+        """table: {image: {"cpu": "100m", "memory": "64Mi"}} fallback
+        estimates when the cluster holds no sample for the image."""
+        self._server = server
+        self.table = dict(table or {})
+
+    def _history(self, images: set) -> dict:
+        """{(image, res): sorted quantity strings} in ONE store scan —
+        a per-(container, resource) scan would make a density fill
+        O(pods^2) under the store lock."""
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        out: dict = {}
+        objs, _ = self._server.store.list("/pods/")
+        for pod in objs:
+            for c in pod.spec.containers:
+                if c.image not in images:
+                    continue
+                for res in ("cpu", "memory"):
+                    if res in (c.requests or {}):
+                        try:
+                            q = c.requests[res]
+                            out.setdefault((c.image, res), []).append(
+                                (parse_quantity(str(q)).value_frac,
+                                 str(q))
+                            )
+                        except Exception:
+                            pass
+        return {k: [s for _v, s in sorted(v)] for k, v in out.items()}
+
+    def _estimate(self, history: dict, image: str, res: str):
+        samples = history.get((image, res), ())
+        if samples:
+            idx = min(int(len(samples) * self.PERCENTILE),
+                      len(samples) - 1)
+            return samples[idx]  # the original quantity STRING
+        fallback = self.table.get(image, {}).get(res)
+        return fallback
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        spec = getattr(obj, "spec", None)
+        if spec is None:
+            return
+        need = {
+            c.image for c in spec.containers
+            if "cpu" not in (c.requests or {})
+            or "memory" not in (c.requests or {})
+        }
+        if not need:
+            return
+        history = self._history(need)
+        estimated = []
+        for c in spec.containers:
+            for res in ("cpu", "memory"):
+                if res in (c.requests or {}):
+                    continue
+                got = self._estimate(history, c.image, res)
+                if got is None:
+                    continue
+                if not c.requests:
+                    c.requests = {}
+                c.requests[res] = str(got)
+                estimated.append(f"{c.name or c.image}/{res}={got}")
+        if estimated:
+            obj.metadata.annotations = dict(
+                obj.metadata.annotations or {}
+            )
+            obj.metadata.annotations[self.ANNOTATION] = ",".join(
+                estimated
+            )
+
+
+#: --admission-control name -> factory(server) (the reference's
+#: admission.RegisterPlugin registry; kubeadmission defaults order)
+PLUGIN_FACTORIES = {
+    "NamespaceLifecycle": NamespaceLifecycle,
+    "AlwaysAdmit": lambda server: AlwaysAdmit(),
+    "AlwaysPullImages": lambda server: AlwaysPullImages(),
+    "SecurityContextDeny": lambda server: SecurityContextDeny(),
+    "LimitRanger": LimitRanger,
+    "ResourceQuota": ResourceQuotaAdmission,
+    "ServiceAccount": ServiceAccountAdmission,
+    "InitialResources": InitialResources,
+    "LimitPodHardAntiAffinityTopology":
+        lambda server: LimitPodHardAntiAffinityTopology(),
+}
+
+
+def make_plugin(name: str, server) -> AdmissionPlugin:
+    factory = PLUGIN_FACTORIES.get(name)
+    if factory is None:
+        raise ValueError(f"unknown admission plugin {name!r}")
+    return factory(server)

@@ -271,10 +271,15 @@ class APIServer:
         authenticator=None,
         authorizer=None,
         data_dir: Optional[str] = None,
+        admission_control: str = "",
     ):
         """data_dir: persist the store (WAL + snapshot) so a restarted
         apiserver resumes with full state and RV continuity — the role
-        etcd plays for the reference (storage/durable.py)."""
+        etcd plays for the reference (storage/durable.py).
+
+        admission_control: comma-separated plugin names replacing the
+        default chain (the --admission-control flag; names per
+        admission.PLUGIN_FACTORIES)."""
         if store is None:
             if data_dir:
                 from kubernetes_tpu.storage.durable import FileStore
@@ -286,6 +291,11 @@ class APIServer:
         self.scheme = scheme or default_scheme
         self.resources = default_resources()
         self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
+        if admission_control:
+            self.admission = adm.AdmissionChain([
+                adm.make_plugin(name.strip(), self)
+                for name in admission_control.split(",") if name.strip()
+            ])
         self._auto_ns = auto_provision_namespaces
         self._ns_active: set = set()  # memoized active namespaces
         self._http_server = None
@@ -405,6 +415,17 @@ class APIServer:
             return 409, APIError(409, str(e)).status()
         except Compacted as e:
             return 410, APIError(410, str(e), reason="Expired").status()
+        except Exception as e:
+            # NotPrimary (a write reached an unpromoted standby) -> 503
+            # so clients retry through transport failover; imported
+            # lazily to keep replication optional
+            from kubernetes_tpu.storage.replicated import NotPrimary
+
+            if isinstance(e, NotPrimary):
+                return 503, APIError(
+                    503, str(e), reason="ServiceUnavailable"
+                ).status()
+            raise
         finally:
             if body_owned:
                 self._body_owned.flag = False
@@ -456,6 +477,8 @@ class APIServer:
                          "_content_type": "text/plain; charset=utf-8"}
         if path in ("/api", "/api/", "/apis", "/apis/", "/api/v1",
                     "/swaggerapi", "/swaggerapi/") or (
+            path.startswith("/swaggerapi/")
+        ) or (
             path.startswith("/apis/") and len(
                 [p for p in path.split("/") if p]) == 3
         ):
@@ -759,14 +782,29 @@ class APIServer:
                 })
             return 200, {"kind": "APIGroupList", "groups": groups}
         if parts == ["swaggerapi"]:
-            # swagger 1.2 resource listing (genericapiserver.go:332); the
-            # per-path docs are the discovery documents themselves
+            # swagger 1.2 resource listing (genericapiserver.go:332)
             apis = [{"path": "/api/v1"}] + [
                 {"path": f"/apis/{g}/{v}"}
                 for g in sorted(g for g in gvs if g != "core")
                 for v in gvs[g]
             ]
             return 200, {"swaggerVersion": "1.2", "apis": apis}
+        if parts[0] == "swaggerapi":
+            # per-group-version api declaration WITH model schemas
+            # (pkg/apiserver/api_installer.go:169 swagger route
+            # registration; kubectl explain's data source)
+            if parts[1:] == ["api", "v1"]:
+                group, version = "", "v1"
+            elif len(parts) == 4 and parts[1] == "apis":
+                group, version = parts[2], parts[3]
+            else:
+                raise APIError(404, f"no swagger api at {path!r}")
+            self._resolve_codec(group, version)
+            return 200, {
+                "swaggerVersion": "1.2",
+                "apiVersion": f"{group}/{version}" if group else version,
+                "models": self._swagger_models(group),
+            }
         # APIResourceList for one group/version
         if parts == ["api", "v1"]:
             group, version = "", "v1"
@@ -801,6 +839,69 @@ class APIServer:
             "groupVersion": gv_name,
             "resources": resources,
         }
+
+    def _swagger_models(self, group: str) -> dict:
+        """Swagger-1.2 model schemas for every kind of `group`, walked
+        reflectively from the dataclass types (the generated-swagger
+        analogue: the reference generates these from its types too).
+        Cached per group — the schema is import-time static."""
+        cache = getattr(self, "_swagger_cache", None)
+        if cache is None:
+            cache = self._swagger_cache = {}
+        got = cache.get(group)
+        if got is not None:
+            return got
+        import dataclasses
+        import typing
+
+        from kubernetes_tpu.runtime.scheme import to_camel
+
+        models: dict = {}
+
+        def type_ref(tp):
+            origin = typing.get_origin(tp)
+            if origin in (list, tuple):
+                args = typing.get_args(tp)
+                item = type_ref(args[0]) if args else {"type": "string"}
+                return {"type": "array", "items": item}
+            if origin is dict:
+                return {"type": "object"}
+            if origin is typing.Union:
+                args = [a for a in typing.get_args(tp)
+                        if a is not type(None)]
+                return type_ref(args[0]) if args else {"type": "object"}
+            if tp is str:
+                return {"type": "string"}
+            if tp is bool:
+                return {"type": "boolean"}
+            if tp is int:
+                return {"type": "integer", "format": "int64"}
+            if tp is float:
+                return {"type": "number", "format": "double"}
+            if dataclasses.is_dataclass(tp):
+                add_model(tp)
+                return {"$ref": tp.__name__}
+            return {"type": "object"}
+
+        def add_model(cls) -> None:
+            name = cls.__name__
+            if name in models:
+                return
+            models[name] = {}  # cycle guard before recursion
+            hints = typing.get_type_hints(cls)
+            props = {}
+            for f in dataclasses.fields(cls):
+                props[to_camel(f.name)] = type_ref(
+                    hints.get(f.name, str)
+                )
+            models[name] = {"id": name, "properties": props}
+
+        for info in self.resources.values():
+            if (info.group or "") != group:
+                continue
+            add_model(info.cls)
+        cache[group] = models
+        return models
 
     def _scale(self, info, ns, name, method, body, obj_mode, codec):
         """GET/PUT {resource}/{name}/scale (registry ScaleREST): the

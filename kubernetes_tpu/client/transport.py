@@ -108,21 +108,42 @@ class HTTPTransport:
         components default to. Implies the object protocol client-side
         (no reflective codec on either end). bearer_token attaches
         `Authorization: Bearer ...` to every request (the kubeconfig
-        user.token idiom — restclient.Config.BearerToken)."""
-        self.base_url = base_url.rstrip("/")
+        user.token idiom — restclient.Config.BearerToken).
+
+        base_url may be a COMMA-SEPARATED list of servers (the HA
+        apiserver idiom — etcd clients take endpoint lists the same
+        way): a connection-level failure rotates to the next server and
+        retries, so a primary/standby failover is invisible to callers
+        beyond the retried request."""
+        urls = [u.strip().rstrip("/") for u in base_url.split(",")
+                if u.strip()]
+        self.base_urls = urls
+        self._active = 0
         self.timeout = timeout
         self.bearer_token = bearer_token
         self.binary = binary
         self.object_protocol = binary
         self._ssl_ctx = None
-        if base_url.startswith("https"):
+        if urls[0].startswith("https"):
             self._ssl_ctx = build_ssl_context(tls_ca, insecure)
+
+    @property
+    def base_url(self) -> str:
+        return self.base_urls[self._active]
 
     def _url(self, path: str, query: Optional[Dict[str, str]]) -> str:
         url = self.base_url + path
         if query:
             url += "?" + urlparse.urlencode(query)
         return url
+
+    def _rotate(self) -> bool:
+        """Advance to the next server; True while untried servers remain
+        in this rotation cycle."""
+        if len(self.base_urls) < 2:
+            return False
+        self._active = (self._active + 1) % len(self.base_urls)
+        return True
 
     def request(self, method, path, query=None, body=None):
         if self.binary:
@@ -131,26 +152,44 @@ class HTTPTransport:
         else:
             data = json.dumps(body).encode() if body is not None else None
             content_type = "application/json"
-        req = urlrequest.Request(
-            self._url(path, query), data=data, method=method.upper()
-        )
-        req.add_header("Content-Type", content_type)
-        if self.binary:
-            req.add_header("Accept", content_type)
-        if self.bearer_token:
-            req.add_header("Authorization", f"Bearer {self.bearer_token}")
-        try:
-            with urlrequest.urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
-            ) as resp:
-                payload = resp.read()
-                return resp.status, self._decode_payload(resp, payload)
-        except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
-            payload = e.read()
+        for attempt in range(max(len(self.base_urls), 1)):
+            req = urlrequest.Request(
+                self._url(path, query), data=data, method=method.upper()
+            )
+            req.add_header("Content-Type", content_type)
+            if self.binary:
+                req.add_header("Accept", content_type)
+            if self.bearer_token:
+                req.add_header(
+                    "Authorization", f"Bearer {self.bearer_token}"
+                )
             try:
-                return e.code, self._decode_payload(e, payload)
-            except Exception:
-                return e.code, {"message": payload.decode(errors="replace")}
+                with urlrequest.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_ctx
+                ) as resp:
+                    payload = resp.read()
+                    return resp.status, self._decode_payload(resp, payload)
+            except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
+                payload = e.read()
+                try:
+                    return e.code, self._decode_payload(e, payload)
+                except Exception:
+                    return e.code, {
+                        "message": payload.decode(errors="replace")
+                    }
+            except urlrequest.URLError as e:  # connection-level failure
+                rotated = self._rotate()  # NEXT request targets a peer
+                if (method.upper() in ("GET", "HEAD") and rotated
+                        and attempt + 1 < len(self.base_urls)):
+                    continue  # idempotent: replay on the next server
+                # non-idempotent verbs must NOT auto-replay: the dead
+                # server may have committed (and replicated) the write
+                # before the connection dropped — replaying would
+                # double-execute or 409 the caller's own success. The
+                # caller's retry/requeue logic re-issues against the
+                # already-rotated peer.
+                raise
+        raise AssertionError("unreachable")
 
     def _decode_payload(self, resp, payload):
         if not payload:
@@ -169,20 +208,34 @@ class HTTPTransport:
     def watch(self, path, query=None):
         query = dict(query or {})
         query["watch"] = "true"
-        req = urlrequest.Request(self._url(path, query))
-        if self.binary:
-            req.add_header("Accept", bin_codec.CONTENT_TYPE)
-        if self.bearer_token:
-            req.add_header("Authorization", f"Bearer {self.bearer_token}")
-        try:
-            resp = urlrequest.urlopen(req, timeout=None, context=self._ssl_ctx)
-        except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
-            payload = e.read()
+        last_exc = None
+        for attempt in range(max(len(self.base_urls), 1)):
+            req = urlrequest.Request(self._url(path, query))
+            if self.binary:
+                req.add_header("Accept", bin_codec.CONTENT_TYPE)
+            if self.bearer_token:
+                req.add_header(
+                    "Authorization", f"Bearer {self.bearer_token}"
+                )
             try:
-                status = self._decode_payload(e, payload)
-            except Exception:
-                status = {"message": payload.decode(errors="replace")}
-            raise WatchError(e.code, status)
+                resp = urlrequest.urlopen(
+                    req, timeout=None, context=self._ssl_ctx
+                )
+                break
+            except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
+                payload = e.read()
+                try:
+                    status = self._decode_payload(e, payload)
+                except Exception:
+                    status = {"message": payload.decode(errors="replace")}
+                raise WatchError(e.code, status)
+            except urlrequest.URLError as e:
+                last_exc = e
+                if attempt + 1 < len(self.base_urls) and self._rotate():
+                    continue
+                raise
+        else:
+            raise last_exc  # pragma: no cover
         if self.binary:
             return _BinaryEvents(resp)
         return _HTTPEvents(resp)

@@ -15,11 +15,13 @@ from kubernetes_tpu.cloudprovider.cloud import (
     register_cloud_provider,
 )
 from kubernetes_tpu.cloudprovider.local import LocalCloud
+from kubernetes_tpu.cloudprovider.multizone import MultiZoneCloud
 
 __all__ = [
     "CloudProvider",
     "FakeCloud",
     "LocalCloud",
+    "MultiZoneCloud",
     "LoadBalancer",
     "Route",
     "Zone",

@@ -44,7 +44,42 @@ def _wait_forever():
 def run_apiserver(args) -> None:
     from kubernetes_tpu.apiserver.server import APIServer
 
-    server = APIServer(data_dir=args.data_dir or None)
+    store = None
+    monitor = None
+    if getattr(args, "standby_of", ""):
+        # HA standby: WAL-shipped follower + promotion on primary loss
+        from kubernetes_tpu.storage.replicated import (
+            FollowerStore,
+            PromotionMonitor,
+        )
+
+        if not args.data_dir:
+            raise SystemExit("--standby-of requires --data-dir")
+        rhost, _, rport = args.standby_of.rpartition(":")
+        store = FollowerStore(args.data_dir, (rhost, int(rport)))
+        if not store.synced(60):
+            raise SystemExit("standby never completed its initial sync")
+        if args.primary_url:
+            probe_client = _client(args.primary_url)
+            monitor = PromotionMonitor(
+                store, probe=probe_client.healthz,
+                on_promote=lambda: print("standby PROMOTED", flush=True),
+            ).run()
+    elif getattr(args, "replicate_listen", None) is not None:
+        from kubernetes_tpu.storage.replicated import ReplicatedStore
+
+        if not args.data_dir:
+            raise SystemExit("--replicate-listen requires --data-dir")
+        store = ReplicatedStore(
+            args.data_dir, repl_port=args.replicate_listen
+        )
+        print(f"replication listener on "
+              f"{store.repl_address[0]}:{store.repl_address[1]}",
+              flush=True)
+    server = APIServer(
+        store=store, data_dir=(None if store else args.data_dir or None),
+        admission_control=getattr(args, "admission_control", ""),
+    )
     host, port = server.serve_http(
         port=args.port,
         tls_cert=args.tls_cert_file,
@@ -242,19 +277,30 @@ def run_local_up(args) -> None:
                 KubeletConfig(node_name=f"real-node-{i:03d}"),
                 rt,
             ).run())
-    # the "local" cloud: each hollow node gets a live userspace proxy
-    # and the provider's LoadBalancer fronts them, so `kubectl expose
-    # --type=LoadBalancer` provisions a balancer that forwards bytes
-    from kubernetes_tpu.cloudprovider import LocalCloud
+    # the cloud provider behind the controller-manager. "local" (the
+    # default): each hollow node gets a live userspace proxy and the
+    # provider's LoadBalancer fronts them, so `kubectl expose
+    # --type=LoadBalancer` provisions a balancer that forwards bytes.
+    # "multizone": the simulated regional cloud (zonal disks, async
+    # attach, per-zone LB frontends). "fake"/"": the recorder.
     from kubernetes_tpu.proxy.userspace import UserspaceProxier
 
-    cloud = LocalCloud()
     proxiers = []
-    for i in range(args.nodes):
-        node_name = f"hollow-node-{i:04d}"
-        proxier = UserspaceProxier(client, node_name=node_name).run()
-        proxiers.append(proxier)
-        cloud.register_node(node_name, proxier)
+    if getattr(args, "cloud_provider", "local") == "multizone":
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud
+
+        cloud = MultiZoneCloud(attach_latency=0.05, detach_latency=0.05)
+        for i in range(args.nodes):
+            cloud.add_instance(f"hollow-node-{i:04d}")
+    else:
+        from kubernetes_tpu.cloudprovider import LocalCloud
+
+        cloud = LocalCloud()
+        for i in range(args.nodes):
+            node_name = f"hollow-node-{i:04d}"
+            proxier = UserspaceProxier(client, node_name=node_name).run()
+            proxiers.append(proxier)
+            cloud.register_node(node_name, proxier)
     mgr = ControllerManager(client, cloud=cloud).start()
     sched = SchedulerServer(
         client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
@@ -320,6 +366,28 @@ def main(argv=None):
         "--enable-binary-wire", action="store_true",
         help="accept/serve the TLV binary content type (kubemark-style "
         "protobuf analogue; data-only, safe for untrusted callers)",
+    )
+    p.add_argument(
+        "--admission-control", default="",
+        help="comma-separated admission plugin chain (e.g. "
+        "NamespaceLifecycle,AlwaysPullImages,SecurityContextDeny,"
+        "LimitRanger,InitialResources,ResourceQuota)",
+    )
+    p.add_argument(
+        "--replicate-listen", type=int, default=None, metavar="PORT",
+        help="serve a WAL-shipping replication listener for a standby "
+        "(the etcd-cluster property at primary/standby scale; commits "
+        "ack only after the standby has them). Requires --data-dir",
+    )
+    p.add_argument(
+        "--standby-of", default="", metavar="HOST:PORT",
+        help="run as the replication STANDBY of the primary's "
+        "--replicate-listen address; writes 503 until promoted",
+    )
+    p.add_argument(
+        "--primary-url", default="",
+        help="with --standby-of: probe this apiserver URL and "
+        "self-promote after sustained liveness failures",
     )
 
     def add_client_flags(p):
@@ -404,6 +472,13 @@ def main(argv=None):
         "--real-nodes", type=int, default=0,
         help="additionally run N kubelets on the PROCESS runtime: pods "
         "scheduled there run as live OS processes",
+    )
+    p.add_argument(
+        "--cloud-provider", default="local",
+        choices=["local", "multizone"],
+        help="cloud provider behind the controller-manager: 'local' "
+        "(live byte-forwarding LBs) or 'multizone' (simulated regional "
+        "cloud: zonal disks, async attach, per-zone LB frontends)",
     )
 
     args = ap.parse_args(argv)

@@ -408,6 +408,153 @@ class Kubectl:
         )
         return f"{resource}/{name} scaled"
 
+    # -- set (pkg/kubectl/cmd/set: the update-one-field family) --------------
+
+    def set_image(self, target: str, assignments) -> str:
+        """kubectl set image TYPE/NAME container=image...: update
+        container images on a pod template (or pod) in place."""
+        resource, name = target.split("/", 1)
+        resource = resolve(resource)
+        want = dict(a.split("=", 1) for a in assignments)
+
+        def mutate(obj):
+            spec = (obj.spec if resource == "pods"
+                    else obj.spec.template.spec)
+            changed = 0
+            for c in list(spec.containers) + list(
+                getattr(spec, "init_containers", ())
+            ):
+                img = want.get(c.name) or want.get("*")
+                if img:
+                    c.image = img
+                    changed += 1
+            if not changed:
+                raise ValueError(
+                    f"no container of {target} matches "
+                    f"{sorted(want)} (use '*' for all)"
+                )
+
+        self._edit_meta(resource, name, mutate)
+        return f"{resource}/{name} image updated"
+
+    def set_resources(self, target: str, requests: str = "",
+                      limits: str = "", containers: str = "*") -> str:
+        """kubectl set resources TYPE/NAME [--containers=...]
+        --requests/--limits cpu=..,memory=.."""
+        resource, name = target.split("/", 1)
+        resource = resolve(resource)
+
+        def parse_kv(text):
+            out = {}
+            for part in (text or "").split(","):
+                part = part.strip()
+                if part:
+                    key, _, v = part.partition("=")
+                    out[key] = v
+            return out
+
+        req, lim = parse_kv(requests), parse_kv(limits)
+        names = {c.strip() for c in containers.split(",") if c.strip()}
+
+        def mutate(obj):
+            spec = (obj.spec if resource == "pods"
+                    else obj.spec.template.spec)
+            changed = 0
+            for c in spec.containers:
+                if "*" not in names and c.name not in names:
+                    continue
+                if req:
+                    c.requests = {**(c.requests or {}), **req}
+                if lim:
+                    c.limits = {**(c.limits or {}), **lim}
+                changed += 1
+            if not changed:
+                raise ValueError(f"no container of {target} matched")
+
+        self._edit_meta(resource, name, mutate)
+        return f"{resource}/{name} resource requirements updated"
+
+    # -- typed create generators (pkg/kubectl/cmd/create_*.go) ---------------
+
+    def create_namespace(self, name: str) -> str:
+        self.client.resource("namespaces").create(
+            t.Namespace(metadata=t.ObjectMeta(name=name, namespace=""))
+        )
+        return f"namespace/{name} created"
+
+    def create_serviceaccount(self, name: str) -> str:
+        self._rc("serviceaccounts").create(
+            t.ServiceAccount(metadata=t.ObjectMeta(name=name))
+        )
+        return f"serviceaccount/{name} created"
+
+    @staticmethod
+    def _literals(from_literal, from_file) -> dict:
+        data = {}
+        for kv in from_literal or ():
+            key, _, v = kv.partition("=")
+            data[key] = v
+        for spec_ in from_file or ():
+            path_part = spec_.partition("=")
+            if path_part[1]:
+                key, path = path_part[0], path_part[2]
+            else:
+                import os as _os
+
+                key, path = _os.path.basename(spec_), spec_
+            with open(path) as f:
+                data[key] = f.read()
+        return data
+
+    def create_secret(self, subcommand: str, name: str,
+                      from_literal=(), from_file=()) -> str:
+        """kubectl create secret generic NAME --from-literal=k=v
+        (create_secret.go; values land base64'd in .data like the
+        real wire form)."""
+        if subcommand != "generic":
+            raise ValueError(
+                f"secret type {subcommand!r} not supported (generic only)"
+            )
+        import base64
+
+        data = {
+            k: base64.b64encode(v.encode()).decode()
+            for k, v in self._literals(from_literal, from_file).items()
+        }
+        self._rc("secrets").create(t.Secret(
+            metadata=t.ObjectMeta(name=name), data=data,
+        ))
+        return f"secret/{name} created"
+
+    def create_configmap(self, name: str, from_literal=(),
+                         from_file=()) -> str:
+        self._rc("configmaps").create(t.ConfigMap(
+            metadata=t.ObjectMeta(name=name),
+            data=self._literals(from_literal, from_file),
+        ))
+        return f"configmap/{name} created"
+
+    def create_service(self, kind: str, name: str, tcp=()) -> str:
+        """kubectl create service clusterip|nodeport NAME
+        --tcp=port[:targetPort]..."""
+        if kind not in ("clusterip", "nodeport"):
+            raise ValueError(f"service kind {kind!r} not supported")
+        ports = []
+        for spec_ in tcp or ("80",):
+            p, _, tp = str(spec_).partition(":")
+            ports.append(t.ServicePort(
+                name=f"{p}-{tp or p}", port=int(p),
+                target_port=int(tp) if tp else int(p),
+            ))
+        self._rc("services").create(t.Service(
+            metadata=t.ObjectMeta(name=name, labels={"app": name}),
+            spec=t.ServiceSpec(
+                selector={"app": name}, ports=ports,
+                type="NodePort" if kind == "nodeport" else "ClusterIP",
+            ),
+        ))
+        return f"service/{name} created"
+
     def _edit_meta(self, resource, name, mutate) -> None:
         rc = self._rc(resolve(resource))
         for _ in range(10):
@@ -1218,9 +1365,32 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p.add_argument("resource")
     p.add_argument("name")
 
-    for verb in ("create", "apply"):
-        p = sub.add_parser(verb)
-        p.add_argument("--filename", "-f", required=True)
+    # create: -f FILE, or a typed generator (create_*.go):
+    #   create namespace NAME | serviceaccount NAME
+    #   create secret generic NAME --from-literal=k=v --from-file=p
+    #   create configmap NAME --from-literal=k=v --from-file=p
+    #   create service clusterip|nodeport NAME --tcp=80:8080
+    p = sub.add_parser("create")
+    p.add_argument("kind", nargs="?", default="")
+    p.add_argument("rest", nargs="*", default=[])
+    p.add_argument("--filename", "-f", default="")
+    p.add_argument("--from-literal", action="append", default=[])
+    p.add_argument("--from-file", action="append", default=[])
+    p.add_argument("--tcp", action="append", default=[])
+
+    p = sub.add_parser("apply")
+    p.add_argument("--filename", "-f", required=True)
+
+    p = sub.add_parser("set")
+    p.add_argument("what", choices=["image", "resources"])
+    p.add_argument("target")  # TYPE/NAME
+    p.add_argument("assignments", nargs="*", default=[])
+    p.add_argument("--requests", default="")
+    p.add_argument("--limits", default="")
+    p.add_argument("--containers", default="*")
+
+    p = sub.add_parser("completion")
+    p.add_argument("shell", choices=["bash", "zsh"])
 
     p = sub.add_parser("delete")
     p.add_argument("resource", nargs="?", default="")
@@ -1355,7 +1525,64 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     elif args.verb == "describe":
         out = k.describe(args.resource, args.name)
     elif args.verb == "create":
-        out = k.create(args.filename)
+        _arity = {"namespace": 1, "serviceaccount": 1, "secret": 2,
+                  "configmap": 1, "service": 2}
+        if not args.filename and len(args.rest) < _arity.get(
+            args.kind, 0
+        ):
+            parser.error(
+                f"create {args.kind} requires "
+                f"{_arity[args.kind]} positional argument(s)"
+            )
+        if args.filename:
+            out = k.create(args.filename)
+        elif args.kind == "namespace":
+            out = k.create_namespace(args.rest[0])
+        elif args.kind == "serviceaccount":
+            out = k.create_serviceaccount(args.rest[0])
+        elif args.kind == "secret":
+            out = k.create_secret(
+                args.rest[0], args.rest[1],
+                from_literal=args.from_literal, from_file=args.from_file,
+            )
+        elif args.kind == "configmap":
+            out = k.create_configmap(
+                args.rest[0],
+                from_literal=args.from_literal, from_file=args.from_file,
+            )
+        elif args.kind == "service":
+            out = k.create_service(args.rest[0], args.rest[1],
+                                   tcp=args.tcp)
+        else:
+            parser.error(
+                "create requires -f FILE or a typed generator "
+                "(namespace|serviceaccount|secret|configmap|service)"
+            )
+    elif args.verb == "set":
+        if args.what == "image":
+            out = k.set_image(args.target, args.assignments)
+        else:
+            out = k.set_resources(
+                args.target, requests=args.requests, limits=args.limits,
+                containers=args.containers,
+            )
+    elif args.verb == "completion":
+        verbs = sorted(sub.choices)
+        if args.shell == "bash":
+            out = (
+                "# bash completion for kubectl (source this file)\n"
+                "_kubectl_completions() {\n"
+                "  COMPREPLY=($(compgen -W \""
+                + " ".join(verbs)
+                + "\" -- \"${COMP_WORDS[COMP_CWORD]}\"))\n"
+                "}\n"
+                "complete -F _kubectl_completions kubectl\n"
+            )
+        else:
+            out = (
+                "#compdef kubectl\n_arguments '1： :("
+                + " ".join(verbs) + ")'\n"
+            ).replace("：", ":")
     elif args.verb == "apply":
         out = k.apply(args.filename)
     elif args.verb == "delete":

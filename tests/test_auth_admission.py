@@ -206,3 +206,92 @@ def test_service_account_and_antiaffinity_admission(plane):
         )
     ))
     client.pods().create(pod("host-anti", affinity=ok))  # allowed
+
+
+def test_always_pull_images(plane):
+    server, client = plane
+    server.admission.plugins.append(adm.AlwaysPullImages())
+    client.pods().create(Pod(
+        metadata=ObjectMeta(name="pull"),
+        spec=PodSpec(containers=[
+            Container(name="a", image="private/app:v1"),
+            Container(name="b", image="private/side:v1",
+                      image_pull_policy="IfNotPresent"),
+        ]),
+    ))
+    got = client.pods().get("pull")
+    assert all(c.image_pull_policy == "Always"
+               for c in got.spec.containers)
+
+
+def test_security_context_deny(plane):
+    from kubernetes_tpu.api.types import (
+        PodSecurityContext, SecurityContext, SELinuxOptions)
+    from kubernetes_tpu.client.rest import APIStatusError
+
+    server, client = plane
+    server.admission.plugins.append(adm.SecurityContextDeny())
+    for name, spec in (
+        ("run-as-user", PodSpec(containers=[Container(
+            name="c", security_context=SecurityContext(run_as_user=0))])),
+        ("selinux", PodSpec(containers=[Container(
+            name="c", security_context=SecurityContext(
+                se_linux_options=SELinuxOptions(level="s0")))])),
+        ("pod-groups", PodSpec(
+            containers=[Container(name="c")],
+            security_context=PodSecurityContext(
+                supplemental_groups=[1000]))),
+        ("pod-run-as", PodSpec(
+            containers=[Container(name="c")],
+            security_context=PodSecurityContext(run_as_user=1))),
+    ):
+        with pytest.raises(APIStatusError) as e:
+            client.pods().create(Pod(
+                metadata=ObjectMeta(name=name), spec=spec))
+        assert e.value.code == 403, name
+    # a plain pod still admits
+    client.pods().create(Pod(
+        metadata=ObjectMeta(name="plain"),
+        spec=PodSpec(containers=[Container(name="c")])))
+
+
+def test_initial_resources_estimates_from_history(plane):
+    server, client = plane
+    server.admission.plugins.append(adm.InitialResources(server))
+    # history: three running pods with the same image at varying requests
+    for i, cpu in enumerate(("100m", "200m", "400m")):
+        client.pods().create(Pod(
+            metadata=ObjectMeta(name=f"hist-{i}"),
+            spec=PodSpec(containers=[Container(
+                name="c", image="app:v2",
+                requests={"cpu": cpu, "memory": "64Mi"})]),
+        ))
+    # a request-less pod of the same image gets the 60th-percentile
+    # estimate (sorted [100,200,400] -> index 1 -> 200m) + the audit
+    # annotation
+    client.pods().create(Pod(
+        metadata=ObjectMeta(name="fresh"),
+        spec=PodSpec(containers=[Container(name="c", image="app:v2")]),
+    ))
+    got = client.pods().get("fresh")
+    assert str(got.spec.containers[0].requests["cpu"]) == "200m"
+    assert adm.InitialResources.ANNOTATION in got.metadata.annotations
+    # unknown image without a table entry: left untouched
+    client.pods().create(Pod(
+        metadata=ObjectMeta(name="unknown"),
+        spec=PodSpec(containers=[Container(name="c", image="mystery")]),
+    ))
+    assert not client.pods().get("unknown").spec.containers[0].requests
+
+
+def test_admission_control_flag_builds_chain():
+    from kubernetes_tpu.apiserver.server import APIServer
+
+    api = APIServer(admission_control=(
+        "NamespaceLifecycle,AlwaysPullImages,SecurityContextDeny"
+    ))
+    kinds = [type(p).__name__ for p in api.admission.plugins]
+    assert kinds == ["NamespaceLifecycle", "AlwaysPullImages",
+                     "SecurityContextDeny"]
+    with pytest.raises(ValueError):
+        APIServer(admission_control="NoSuchPlugin")

@@ -431,3 +431,66 @@ def test_convert_between_versions(kubectl, tmp_path):
     assert out["apiVersion"] == "extensions/v1beta2"
     assert out["spec"]["selector"] == {"matchLabels": {"app": "web"}}
     assert out["spec"]["replicas"] == 2
+
+
+def test_kubectl_set_image_and_resources(kubectl):
+    k, client = kubectl
+    k.run("web", image="app:v1", replicas=2)
+    out = main(
+        ["set", "image", "rc/web", "web=app:v2"], client=client)
+    assert "image updated" in out
+    rc = client.resource("replicationcontrollers", "default").get("web")
+    assert rc.spec.template.spec.containers[0].image == "app:v2"
+    out = main(
+        ["set", "resources", "rc/web", "--requests", "cpu=250m,memory=1Gi"],
+        client=client)
+    assert "updated" in out
+    rc = client.resource("replicationcontrollers", "default").get("web")
+    assert rc.spec.template.spec.containers[0].requests == {
+        "cpu": "250m", "memory": "1Gi"}
+    # no matching container is an error, not a silent no-op
+    with pytest.raises(ValueError):
+        Kubectl(client).set_image("rc/web", ["ghost=x:1"])
+
+
+def test_kubectl_typed_create_generators(kubectl, tmp_path):
+    k, client = kubectl
+    out = main(["create", "namespace", "staging"], client=client)
+    assert out == "namespace/staging created"
+    assert client.resource("namespaces").get("staging")
+
+    out = main(["create", "serviceaccount", "robot"],
+                       client=client)
+    assert "created" in out
+
+    f = tmp_path / "blob.txt"
+    f.write_text("file-value")
+    out = main(
+        ["create", "secret", "generic", "creds",
+         "--from-literal", "user=admin", "--from-file", f"blob={f}"],
+        client=client)
+    assert "secret/creds created" in out
+    import base64
+    sec = client.resource("secrets", "default").get("creds")
+    assert base64.b64decode(sec.data["user"]).decode() == "admin"
+    assert base64.b64decode(sec.data["blob"]).decode() == "file-value"
+
+    out = main(
+        ["create", "configmap", "conf", "--from-literal", "mode=fast"],
+        client=client)
+    assert "configmap/conf created" in out
+    cm = client.resource("configmaps", "default").get("conf")
+    assert cm.data == {"mode": "fast"}
+
+    out = main(
+        ["create", "service", "clusterip", "api", "--tcp", "80:8080"],
+        client=client)
+    assert "service/api created" in out
+    svc = client.resource("services", "default").get("api")
+    assert svc.spec.ports[0].port == 80
+    assert svc.spec.ports[0].target_port == 8080
+
+
+def test_kubectl_completion():
+    out = main(["completion", "bash"], client=object())
+    assert "complete -F" in out and "get" in out and "drain" in out

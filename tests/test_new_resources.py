@@ -363,3 +363,32 @@ class TestNewKubectlVerbs:
         kc.taint("n2", "old:NoSchedule-")
         assert {x.key for x in get_taints(
             client.resource("nodes").get("n2"))} == {"extra"}
+
+
+class TestSwaggerModels:
+    def test_model_schemas_served_per_group_version(self, plane):
+        server, client = plane
+        doc = client.do_raw("GET", "/swaggerapi/api/v1")
+        assert doc["swaggerVersion"] == "1.2"
+        models = doc["models"]
+        pod = models["Pod"]
+        assert pod["properties"]["metadata"] == {"$ref": "ObjectMeta"}
+        spec = models["PodSpec"]["properties"]
+        assert spec["containers"]["type"] == "array"
+        assert spec["containers"]["items"] == {"$ref": "Container"}
+        assert spec["nodeName"] == {"type": "string"}
+        # transitively referenced models are present
+        assert "Container" in models and "ObjectMeta" in models
+        # extension group serves its own kinds
+        ext = client.do_raw("GET", "/apis/extensions/v1beta1")
+        assert ext["kind"] == "APIResourceList"
+        doc2 = client.do_raw("GET", "/swaggerapi/apis/extensions/v1beta1")
+        assert "Deployment" in doc2["models"]
+
+    def test_unknown_swagger_path_404s(self, plane):
+        server, client = plane
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as e:
+            client.do_raw("GET", "/swaggerapi/apis/nope/v9")
+        assert e.value.code == 404

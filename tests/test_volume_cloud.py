@@ -549,3 +549,167 @@ def test_startup_sweep_releases_holds_of_deleted_nodes():
     ctrl.sync_once()
     assert not cloud.disk_is_attached("gce-pd/orphan", "dead-node")
     informers.stop()
+
+
+# -- the multizone cloud provider: regional semantics behind the same --------
+# interface (providers/aws + providers/gce registry breadth)
+
+
+class TestMultiZoneCloud:
+    def test_instances_and_zones(self):
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud, get_cloud_provider
+        from kubernetes_tpu.cloudprovider.cloud import InstanceNotFound
+
+        assert isinstance(get_cloud_provider("multizone"), MultiZoneCloud)
+        cloud = MultiZoneCloud()
+        zones = {cloud.add_instance(f"n{i}") for i in range(6)}
+        assert zones == set(cloud.zones)  # round-robin covers all zones
+        assert cloud.instance_zone("n0").region == "us-sim1"
+        with pytest.raises(InstanceNotFound):
+            cloud.instance_zone("ghost")
+        assert cloud.external_id("n1").startswith("mz-us-sim1-")
+
+    def test_zonal_disk_placement_rule(self):
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud
+        from kubernetes_tpu.cloudprovider.cloud import DiskConflict
+
+        cloud = MultiZoneCloud()
+        cloud.add_instance("a1", "us-sim1-a")
+        cloud.add_instance("b1", "us-sim1-b")
+        cloud.create_disk("pd-a", "us-sim1-a")
+        # attach in-zone OK; cross-zone is the GCE/EBS placement error
+        cloud.attach_disk("pd-a", "a1")
+        assert cloud.disk_is_attached("pd-a", "a1")
+        with pytest.raises(DiskConflict):
+            cloud.attach_disk("pd-a", "b1")
+        # rw-exclusivity still holds within the zone
+        cloud.add_instance("a2", "us-sim1-a")
+        with pytest.raises(DiskConflict):
+            cloud.attach_disk("pd-a", "a2")
+        cloud.detach_disk("pd-a", "a1")
+        assert not cloud.disk_is_attached("pd-a", "a1")
+
+    def test_async_attach_passes_through_attaching(self):
+        import threading
+
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud
+
+        cloud = MultiZoneCloud(attach_latency=0.3)
+        cloud.add_instance("n1", "us-sim1-a")
+        done = threading.Event()
+
+        def do():
+            cloud.attach_disk("slow-pd", "n1")
+            done.set()
+
+        threading.Thread(target=do, daemon=True).start()
+        # mid-flight: the cloud reports NOT attached yet
+        time.sleep(0.1)
+        assert not cloud.disk_is_attached("slow-pd", "n1")
+        assert done.wait(5)
+        assert cloud.disk_is_attached("slow-pd", "n1")
+
+    def test_attach_detach_controller_against_multizone(self):
+        """The SAME attach/detach controller drives the multizone cloud:
+        async latency + zonal placement behind the shared interface."""
+        from kubernetes_tpu.api.types import Node
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud
+        from kubernetes_tpu.controller.attach_detach import (
+            AttachDetachController,
+        )
+        from kubernetes_tpu.controller.framework import SharedInformerFactory
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        cloud = MultiZoneCloud(attach_latency=0.05, detach_latency=0.05)
+        cloud.add_instance("n1", "us-sim1-a")
+        informers = SharedInformerFactory(client)
+        ctrl = AttachDetachController(client, informers, cloud=cloud)
+        informers.start()
+        client.resource("nodes").create(Node(metadata=ObjectMeta(name="n1")))
+        client.pods().create(TestCloudDiskAttachers._pd_pod("p1", "n1", pd="mz-pd"))
+        informers.wait_for_sync()
+
+        def attached():
+            n = client.nodes().get("n1")
+            return {v.name for v in n.status.volumes_attached}
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            if attached() == {"gce-pd/mz-pd"}:
+                break
+            time.sleep(0.05)
+        assert attached() == {"gce-pd/mz-pd"}
+        assert cloud.disk_is_attached("gce-pd/mz-pd", "n1")
+        client.pods().delete("p1")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            if not attached() and not cloud.disk_is_attached(
+                    "gce-pd/mz-pd", "n1"):
+                break
+            time.sleep(0.05)
+        assert not cloud.disk_is_attached("gce-pd/mz-pd", "n1")
+
+    def test_service_controller_regional_lb(self):
+        """ServiceController provisions a REGIONAL LB with hosts across
+        zones through the same interface the local provider serves."""
+        from kubernetes_tpu.api.types import (
+            Node, NodeCondition, NodeStatus, Service, ServicePort,
+            ServiceSpec,
+        )
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+        from kubernetes_tpu.cloudprovider import MultiZoneCloud
+        from kubernetes_tpu.controller.cloud import ServiceController
+        from kubernetes_tpu.controller.framework import SharedInformerFactory
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        cloud = MultiZoneCloud()
+        for i in range(3):
+            cloud.add_instance(f"n{i}")
+            client.nodes().create(Node(
+                metadata=ObjectMeta(name=f"n{i}"),
+                status=NodeStatus(conditions=[NodeCondition("Ready", "True")]),
+            ))
+        informers = SharedInformerFactory(client)
+        ctrl = ServiceController(client, informers, cloud)
+        informers.start()
+        informers.wait_for_sync()
+        client.resource("services", "default").create(Service(
+            metadata=ObjectMeta(name="web"),
+            spec=ServiceSpec(
+                type="LoadBalancer", selector={"run": "web"},
+                ports=[ServicePort(port=80)],
+            ),
+        ))
+        deadline = time.monotonic() + 10
+        ingress = None
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            svc = client.resource("services", "default").get("web")
+            ing = svc.status.load_balancer.ingress
+            if ing:
+                ingress = ing[0].ip
+                break
+            time.sleep(0.05)
+        assert ingress and ingress.startswith("203.0."), ingress
+        lb = cloud.get_tcp_load_balancer(
+            ctrl._lb_name(svc), cloud.region
+        )
+        assert lb is not None and set(lb.hosts) == {"n0", "n1", "n2"}
+        assert lb.ports == (80,)
+        # deleting the service tears the LB down
+        client.resource("services", "default").delete("web")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctrl.sync_once()
+            if cloud.get_tcp_load_balancer("web", cloud.region) is None:
+                break
+            time.sleep(0.05)
