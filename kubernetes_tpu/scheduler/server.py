@@ -193,12 +193,37 @@ class SchedulerServer:
 
                         def _scan_phase():
                             # the scan-path programs only matter for
-                            # heterogeneous backlogs; warm them when the
-                            # queue is idle so they never steal the
-                            # algorithm lock from a real wave
-                            while not self.scheduler.config.stop_everything.is_set():
-                                if len(self.factory.pod_queue) == 0:
-                                    algo.warmup(n, phase="scan")
+                            # heterogeneous backlogs; warm them only
+                            # after SUSTAINED idleness — warmup holds
+                            # the algorithm lock for the whole compile,
+                            # and firing in the momentary gap between
+                            # loop-open and the first wave blocked that
+                            # wave ~10s behind a scan compile it didn't
+                            # need. "Idle" = queue empty AND no wave in
+                            # flight (a drained wave leaves the queue
+                            # empty while still computing).
+                            import time as _t
+
+                            lock = getattr(algo, "_sched_lock", None)
+                            idle_since = _t.monotonic()
+                            stop = self.scheduler.config.stop_everything
+                            while not stop.is_set():
+                                busy = len(self.factory.pod_queue) > 0
+                                if not busy and lock is not None:
+                                    if lock.acquire(blocking=False):
+                                        lock.release()
+                                    else:
+                                        busy = True  # wave in flight
+                                if busy:
+                                    idle_since = _t.monotonic()
+                                elif _t.monotonic() - idle_since >= 5.0:
+                                    try:
+                                        algo.warmup(n, phase="scan")
+                                    except Exception:
+                                        log.debug(
+                                            "scan warmup failed",
+                                            exc_info=True,
+                                        )
                                     return
                                 time.sleep(0.5)
 
