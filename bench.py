@@ -75,21 +75,21 @@ def build(num_nodes, num_pods):
     return state, pods
 
 
-def run_config(num_nodes, num_pods, reps=3):
+def measure_backlog(state, pods, config=None, reps=3):
     """-> (best warm wall seconds of `reps` identical runs, scheduled
     count). Warm = repeat call on the same algorithm object (XLA
     compiles cached), round-robin counter reset so decisions are
     identical to the cold run every rep. Min-of-reps because the
     tunneled chip's per-dispatch round-trip latency swings 2x run to
     run; every rep is a full end-to-end schedule of the whole backlog
-    and every rep's decisions are asserted identical."""
+    and every rep's decisions are asserted identical. The ONE
+    measurement protocol for the headline, north-star, and the
+    BASELINE config matrix."""
     from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
 
-    state, pods = build(num_nodes, num_pods)
-    algo = TPUScheduleAlgorithm()
+    algo = TPUScheduleAlgorithm(config=config)
     cold = algo.schedule_backlog(pods, state)
     n_sched = sum(1 for h in cold if h is not None)
-    assert n_sched == num_pods, f"only {n_sched}/{num_pods} scheduled"
     best = float("inf")
     for _ in range(reps):
         algo._last_node_index = 0
@@ -97,6 +97,13 @@ def run_config(num_nodes, num_pods, reps=3):
         warm = algo.schedule_backlog(pods, state)
         best = min(best, time.time() - t0)
         assert warm == cold, "warm rerun diverged"
+    return best, n_sched
+
+
+def run_config(num_nodes, num_pods, reps=3):
+    state, pods = build(num_nodes, num_pods)
+    best, n_sched = measure_backlog(state, pods, reps=reps)
+    assert n_sched == num_pods, f"only {n_sched}/{num_pods} scheduled"
     return best, n_sched
 
 
@@ -183,6 +190,144 @@ def main():
         )
     except Exception as e:  # the headline metric already printed
         print(f"# north-star config failed: {e}", file=sys.stderr)
+    try:
+        run_baseline_configs()
+    except Exception as e:
+        print(f"# baseline-config matrix failed: {e}", file=sys.stderr)
+
+
+def run_baseline_configs():
+    """Per-config raw-tensor-path numbers for the BASELINE.json matrix
+    (VERDICT r4 #3: publish all five). Config 5 is the north-star
+    above; the density config is the headline. Failures report without
+    aborting the bench."""
+    from kubernetes_tpu.api.types import (
+        ObjectMeta,
+        ReplicationController,
+        ReplicationControllerSpec,
+    )
+    from kubernetes_tpu.models.batch import SchedulerConfig as DevCfg
+    from kubernetes_tpu.oracle import ClusterState
+
+    def timeit(label, state, pods, config=None, reps=2):
+        try:
+            best, placed = measure_backlog(state, pods, config=config,
+                                           reps=reps)
+            print(
+                f"# {label}: {len(pods)} pods in {best:.2f}s "
+                f"({len(pods)/best:.0f} pods/s; {placed} placed; warm "
+                f"min of {reps})",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(f"# {label} FAILED: {e}", file=sys.stderr)
+
+    # config 1: 1k pause pods / 100 nodes / PodFitsResources only
+    state, pods = build(100, 1000)
+    timeit(
+        "config1 1k pods/100 nodes PodFitsResources-only", state, pods,
+        config=DevCfg(predicates=("PodFitsResources",),
+                      priorities=(("EqualPriority", 1),)),
+    )
+
+    # config 2: 10k heterogeneous-request pods / 1k nodes / LR+BA
+    state, _ = build(1000, 1)
+    from kubernetes_tpu.api.types import Container, Pod, PodSpec
+
+    pods2 = [
+        Pod(
+            metadata=ObjectMeta(name=f"het-{i:05d}"),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": f"{50 + (i % 8) * 25}m",
+                "memory": f"{100 + (i % 5) * 100}Mi",
+            })]),
+        )
+        for i in range(10000)
+    ]
+    pods2.sort(key=lambda p: (
+        str(p.spec.containers[0].requests["cpu"]),
+        str(p.spec.containers[0].requests["memory"]),
+    ))  # contiguous template runs, as an RC burst would queue them
+    timeit(
+        "config2 10k heterogeneous pods/1k nodes LR+BA", state, pods2,
+        config=DevCfg(
+            predicates=("PodFitsResources",),
+            priorities=(("LeastRequestedPriority", 1),
+                        ("BalancedResourceAllocation", 1)),
+        ),
+    )
+
+    # config 3: self anti-affinity, topologyKey=hostname, 5k pods / 2k
+    # nodes (wave-eligible since round 5 via the res_fit self-veto)
+    import json as _json
+
+    nodes = []
+    from kubernetes_tpu.api.types import Node, NodeCondition, NodeStatus
+
+    for i in range(2000):
+        nodes.append(Node(
+            metadata=ObjectMeta(
+                name=f"node-{i:05d}",
+                labels={"kubernetes.io/hostname": f"node-{i:05d}"},
+            ),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    pods3 = []
+    for g in range(5):
+        for i in range(1000):
+            p = Pod(
+                metadata=ObjectMeta(
+                    name=f"anti-{g}-{i:04d}",
+                    labels={"group": f"g{g}"},
+                    annotations={
+                        "scheduler.alpha.kubernetes.io/affinity":
+                        _json.dumps({
+                            "podAntiAffinity": {
+                                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                                    "labelSelector": {
+                                        "matchLabels": {"group": f"g{g}"}
+                                    },
+                                    "topologyKey":
+                                    "kubernetes.io/hostname",
+                                }],
+                            },
+                        })
+                    },
+                ),
+                spec=PodSpec(containers=[Container(
+                    requests={"cpu": "100m"})]),
+            )
+            pods3.append(p)
+    timeit("config3 5k hostname-anti-affinity pods/2k nodes",
+           ClusterState.build(nodes), pods3)
+
+    # config 4: SelectorSpread, RCs x replicas on ZONED nodes (reduced
+    # RC count: each distinct template costs ~3 tunnel round trips on
+    # the dev chip; the per-template cost is the number of interest)
+    zones = ("a", "b", "c")
+    for i, node in enumerate(nodes):
+        node.metadata.labels[
+            "failure-domain.beta.kubernetes.io/zone"
+        ] = zones[i % 3]
+    rcs, pods4 = [], []
+    for r in range(20):
+        lbl = {"rc": f"rc-{r}"}
+        rcs.append(ReplicationController(
+            metadata=ObjectMeta(name=f"rc-{r}"),
+            spec=ReplicationControllerSpec(selector=dict(lbl)),
+        ))
+        for i in range(40):
+            pods4.append(Pod(
+                metadata=ObjectMeta(name=f"rc{r}-{i:03d}",
+                                    labels=dict(lbl)),
+                spec=PodSpec(containers=[Container(requests={
+                    "cpu": "100m", "memory": "500Mi"})]),
+            ))
+    timeit("config4 zoned spread 20 RCs x 40 replicas/2k nodes",
+           ClusterState.build(nodes, controllers=rcs), pods4, reps=1)
 
 
 if __name__ == "__main__":

@@ -122,8 +122,12 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
     if want_ip_pred or want_ip_prio:
         cnt_lt = interpod_carry_tables(static, ip_term_count, N)
 
-    fit_static = fit_mask(
-        config, static, carry, pod, cnt_lt, include_resources=False
+    fit_static = jnp.broadcast_to(
+        # a minimal config (e.g. PodFitsResources-only) leaves no
+        # node-axis predicate here and the mask collapses to a scalar
+        fit_mask(config, static, carry, pod, cnt_lt,
+                 include_resources=False),
+        (N,),
     )
 
     j = jnp.arange(J, dtype=jnp.int64)[:, None]  # (J, 1)

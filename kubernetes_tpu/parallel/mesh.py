@@ -528,6 +528,8 @@ def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
         config, n_per_shard, n_global, static, carry, pod,
         include_resources=False,
     )
+    # minimal configs leave no node-axis predicate: scalar -> (N,)
+    fit_static = jnp.broadcast_to(fit_static, (N,))
 
     j = jnp.arange(J, dtype=jnp.int64)[:, None]
     if wants_resources(config):
