@@ -286,6 +286,85 @@ class WaveProbe:
             self._jitted[key] = fn
         return fn
 
+    def _compiled_fused(self, num_zones: int, num_values: int, J: int,
+                        layout, apply_fn):
+        """ONE program that (a) unpacks the NEXT run's pod row from its
+        packed buffer, (b) folds the PREVIOUS run's commits into the
+        carry via apply_fn, and (c) probes the next run against the
+        updated carry. On a tunneled chip every enqueue costs a full
+        round trip, so fusing ship+apply+probe cuts a multi-template
+        backlog's per-run cost to one dispatch + one transfer."""
+        key = ("fused", num_zones, num_values, J, layout)
+        fn = self._jitted.get(key)
+        if fn is None:
+            from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+            def fused(static, carry, prev_buf, counts, next_buf):
+                # prev/next share the backlog's layout (vocab widths
+                # are backlog-constant)
+                if prev_buf is not None:
+                    prev_pod = _unpack_pod(layout, prev_buf)
+                    carry = apply_fn(static, carry, prev_pod, counts)
+                next_pod = _unpack_pod(layout, next_buf)
+                packed = _probe_fn(
+                    self.config, num_zones, num_values, J, static,
+                    carry, next_pod,
+                )
+                return carry, packed
+
+            def fused_same(static, carry, buf, counts):
+                # the dominant shape: a run re-probing ITSELF past the
+                # table horizon folds its own previous counts — unpack
+                # the one buffer once (and ship it once)
+                pod = _unpack_pod(layout, buf)
+                carry = apply_fn(static, carry, pod, counts)
+                packed = _probe_fn(
+                    self.config, num_zones, num_values, J, static,
+                    carry, pod,
+                )
+                return carry, packed
+
+            fn = {
+                "prev": jax.jit(fused),
+                "same": jax.jit(fused_same),
+                # variant without the apply fold (the backlog's first
+                # probe): prev_buf=None burns a separate trace
+                "first": jax.jit(
+                    lambda static, carry, next_buf: fused(
+                        static, carry, None, None, next_buf
+                    )
+                ),
+            }
+            self._jitted[key] = fn
+        return fn
+
+    def probe_fused(self, static, carry, prev_buf, counts, next_buf,
+                    num_zones: int, num_values: int, J: int,
+                    rows: Optional[int], layout, apply_fn,
+                    has_selectors: bool,
+                    zone_id: Optional[np.ndarray] = None,
+                    self_anti_veto: Optional[np.ndarray] = None):
+        """-> (new_carry, RunTables). prev_buf/counts None on the
+        backlog's first probe (nothing to fold yet)."""
+        if rows is None:
+            rows = J
+        rows = max(1, min(rows, J))
+        fns = self._compiled_fused(num_zones, num_values, J, layout,
+                                   apply_fn)
+        if prev_buf is None:
+            carry2, raw = fns["first"](static, carry, next_buf)
+        elif prev_buf is next_buf:
+            carry2, raw = fns["same"](static, carry, next_buf, counts)
+        else:
+            carry2, raw = fns["prev"](static, carry, prev_buf, counts,
+                                      next_buf)
+        arr = np.ascontiguousarray(jax.device_get(raw["packed"]))
+        return carry2, tables_from_packed(
+            self.config, arr, num_zones, J, rows,
+            has_selectors=has_selectors, zone_id=zone_id,
+            self_anti_veto=self_anti_veto,
+        )
+
     def probe(self, static, carry, pod, num_zones: int, num_values: int,
               J: int, rows: Optional[int] = None,
               has_selectors: Optional[bool] = None,

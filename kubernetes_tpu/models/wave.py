@@ -267,7 +267,7 @@ class WaveScheduler:
         self.max_j = max_j
         self.pod_floor = pod_floor
         self._replay = replay or replay_fast
-        self._apply = jax.jit(self._apply_fn)
+        self._apply_packed_jit: dict = {}
         from kubernetes_tpu.models.pack import Packer
 
         self._packer = Packer()
@@ -408,14 +408,22 @@ class WaveScheduler:
             dev[f] for f in self._CARRY_FIELDS[2:]
         )
 
-    # -- backlog -------------------------------------------------------------
+    def _apply_packed(self, static, carry, buf, layout, counts):
+        """The commit fold from a PACKED pod-row buffer — the settle
+        path when no further probe will carry the fold for free."""
+        fn = self._apply_packed_jit.get(layout)
+        if fn is None:
+            from kubernetes_tpu.models.pack import unpack as _unpack_pod
 
-    def _pod_row(self, batch: PodBatch, i: int):
-        # one packed transfer, not one ~40ms round trip per field
-        return self._packer.ship({
-            f: np.asarray(getattr(batch, f)[i])
-            for f in BatchScheduler.POD_FIELDS
-        })
+            def run(static_, carry_, buf_, counts_):
+                pod = _unpack_pod(layout, buf_)
+                return self._apply_fn(static_, carry_, pod, counts_)
+
+            fn = jax.jit(run)
+            self._apply_packed_jit[layout] = fn
+        return fn(static, carry, buf, jnp.asarray(counts))
+
+    # -- backlog -------------------------------------------------------------
 
     def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,
                 K: int) -> Tuple[int, int]:
@@ -479,11 +487,24 @@ class WaveScheduler:
         # lastNodeIndex is tracked host-side (the replay computes it
         # exactly) so the fast path never blocks on the device carry
         L_host = int(last_node_index)
+        # deferred commit fold: (packed pod buf, layout, counts). A
+        # run's apply rides the NEXT probe's dispatch (probe_fused) —
+        # on a tunneled chip each enqueue is a round trip, so deferring
+        # halves the per-run dispatch count for multi-template backlogs
+        fold: list = []
+
+        def settle(carry):
+            if fold:
+                buf, layout, counts = fold.pop()
+                carry = self._apply_packed(static, carry, buf, layout,
+                                           counts)
+            return carry
 
         def flush(carry):
             nonlocal L_host
             if not pending:
                 return carry
+            carry = settle(carry)
             rows = np.asarray(pending, np.int64)
             seg = gather_batch(batch, rep_idx[rows])
             seg = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
@@ -510,13 +531,26 @@ class WaveScheduler:
                 pending.extend(range(start, start + length))
                 continue
             carry = flush(carry)
-            pod = self._pod_row(batch, rep)
+            from kubernetes_tpu.models.pack import pack_arrays
+
+            layout, buf = pack_arrays({
+                f: np.asarray(getattr(batch, f)[rep])
+                for f in BatchScheduler.POD_FIELDS
+            })
             done = 0
             while done < length:
                 K = length - done
                 J, rows = self._pick_j(snap, batch, rep, K)
-                tables = self.probe.probe(
-                    static, carry, pod, num_zones, num_values, J, rows,
+                prev_buf = prev_counts = None
+                if fold:
+                    if fold[0][1] == layout:
+                        prev_buf, _pl, prev_counts = fold.pop()
+                    else:  # layout drift (defensive): settle separately
+                        carry = settle(carry)
+                carry, tables = self.probe.probe_fused(
+                    static, carry, prev_buf, prev_counts, buf,
+                    num_zones, num_values, J, rows, layout,
+                    self._apply_fn,
                     has_selectors=bool(batch.has_selectors[rep]),
                     zone_id=np.asarray(snap.zone_id) if zoned else None,
                     self_anti_veto=self_anti_veto,
@@ -534,12 +568,12 @@ class WaveScheduler:
                 )
                 counts = np.zeros(N, np.int64)
                 counts[perm] = res.counts
-                carry = self._apply(
-                    static, carry, pod, jnp.asarray(counts)
-                )
-                # _apply_fn added counts.sum() == res.scheduled to the
+                # deferred: the fold rides the next probe's dispatch
+                fold.append((buf, layout, counts))
+                # _apply_fn adds counts.sum() == res.scheduled to the
                 # device last_idx; mirror it host-side
                 L_host = res.last_node_index
                 done += res.n_done
+        carry = settle(carry)
         carry = flush(carry)
         return out, carry, L_host
