@@ -90,6 +90,25 @@ class RunTables:
     # None on unzoned clusters (the plain float32 branch).
     zone_id: Optional[np.ndarray] = None  # i32[N]; 0 == no zone
     num_zones: int = 1
+    # ServiceAntiAffinity (policy configs): per-pick renormalized spread
+    # over values of a node label; counts/total grow with the run's own
+    # member commits. None when not configured / run not a member.
+    w_saa: int = 0
+    saa_counts: Optional[np.ndarray] = None  # i64[N] base peer counts
+    saa_total: int = 0  # base peer total (pre-run)
+    saa_lbl_val: Optional[np.ndarray] = None  # i32[N]; -1 unlabeled
+    saa_num_values: int = 0
+    saa_member: bool = False  # run pods are peers of their own group
+    # ServiceAffinity first-pick pin: when the run's group had NO first
+    # peer at probe time, the first commit pins the unresolved config
+    # labels to the picked node's values; rows are lbl_val per
+    # unresolved label. None = no refinement (pinned already / fixed /
+    # no group / predicate absent).
+    sa_refine_rows: Optional[np.ndarray] = None  # i32[R, N]
+    # the run's SA dynamics exceed what the tables model (a label left
+    # unresolved by BOTH svc_fixed and the current first peer's node
+    # can re-pin mid-run via the min-ord rule): route to the scan
+    sa_bail: bool = False
 
 
 def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
@@ -222,17 +241,47 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
                 static[f"nl_prio_{name[1]}"], name[2]
             )
         elif isinstance(name, tuple) and name[0] == SERVICE_ANTI_AFFINITY:
-            raise ValueError("ServiceAntiAffinity is not wave-eligible")
+            pass  # per-pick renormalization: the replay consumes the
+            # svc rows below (base counts/total + host lbl_val)
         else:
             raise ValueError(f"unknown priority {name!r}")
+    # service-group state rows (zero when no SA/SAA config: G == 0).
+    # row svc_counts: the run's group's per-node peer counts;
+    # row svc_total: its peer total (broadcast);
+    # row svc_pin: the group's first-peer order index (broadcast;
+    # ORD_NONE means the run's first commit will pin)
+    from kubernetes_tpu.snapshot.services import ORD_NONE as _ORD_NONE
+
+    G = svc_first_peer.shape[0]
+    if G:
+        g = jnp.clip(pod["svc_group"], 0, G - 1)
+        has_group = pod["svc_group"] >= 0
+        svc_counts = jnp.where(
+            has_group, svc_peer_node_count[g], 0
+        ).astype(jnp.int64)
+        svc_counts = jnp.broadcast_to(svc_counts, (N,))
+        svc_total = jnp.broadcast_to(
+            jnp.where(has_group, svc_peer_total[g], 0).astype(jnp.int64),
+            (N,),
+        )
+        svc_pin = jnp.broadcast_to(
+            jnp.where(
+                has_group, svc_first_peer[g], jnp.int32(_ORD_NONE)
+            ).astype(jnp.int64),
+            (N,),
+        )
+    else:
+        svc_counts = jnp.zeros((N,), jnp.int64)
+        svc_total = jnp.zeros((N,), jnp.int64)
+        svc_pin = jnp.full((N,), jnp.int64(_ORD_NONE))
     # The device->host shipment is LATENCY bound on a tunneled chip
     # (~75-120ms per dispatch/transfer round trip, measured), so the
     # probe's entire product ships as ONE i64 array:
-    #   rows 0-7: the 1-D tables (fit_static, fit frontier, static_add,
-    #     spread/na/tt/ip), and
-    #   rows 8+: the [J, N] j-table in the narrowest safe dtype (scores
-    #     are bounded by 10 * the summed LR/BA weights), bitcast-packed
-    #     into i64 words along the j axis.
+    #   rows 0..N_STK_ROWS-1: the 1-D tables (fit_static, fit frontier,
+    #     static_add, spread/na/tt/ip, svc counts/total/pin), and
+    #   rows N_STK_ROWS+: the [J, N] j-table in the narrowest safe dtype
+    #     (scores are bounded by 10 * the summed LR/BA weights),
+    #     bitcast-packed into i64 words along the j axis.
     # res_fit itself never ships: per-node resource fit is monotone
     # non-increasing in j (commits only consume capacity, and the
     # host-port self-conflict kills j>0 outright), so its sum over j —
@@ -247,6 +296,9 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
         stk_rows["na_counts"],
         stk_rows["tt_counts"],
         stk_rows["ip_totals"],
+        svc_counts,
+        svc_total,
+        svc_pin,
     ])
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize  # J is pow2 >= 16, always divisible
@@ -254,6 +306,9 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
     tabw = jax.lax.bitcast_convert_type(tabp, jnp.int64)  # (J//k, N)
     out["packed"] = jnp.concatenate([stk, tabw], axis=0)
     return out
+
+
+N_STK_ROWS = 11  # header rows before the packed j-table words
 
 
 def _tab_dtype(config: SchedulerConfig):
@@ -343,7 +398,8 @@ class WaveProbe:
                     rows: Optional[int], layout, apply_fn,
                     has_selectors: bool,
                     zone_id: Optional[np.ndarray] = None,
-                    self_anti_veto: Optional[np.ndarray] = None):
+                    self_anti_veto: Optional[np.ndarray] = None,
+                    svc_ctx: Optional[dict] = None):
         """-> (new_carry, RunTables). prev_buf/counts None on the
         backlog's first probe (nothing to fold yet)."""
         if rows is None:
@@ -362,7 +418,7 @@ class WaveProbe:
         return carry2, tables_from_packed(
             self.config, arr, num_zones, J, rows,
             has_selectors=has_selectors, zone_id=zone_id,
-            self_anti_veto=self_anti_veto,
+            self_anti_veto=self_anti_veto, svc_ctx=svc_ctx,
         )
 
     def probe(self, static, carry, pod, num_zones: int, num_values: int,
@@ -395,17 +451,23 @@ def tables_from_packed(config: SchedulerConfig, arr: np.ndarray,
                        num_zones: int, J: int, rows: int,
                        has_selectors: bool,
                        zone_id: Optional[np.ndarray] = None,
-                       self_anti_veto: Optional[np.ndarray] = None
-                       ) -> RunTables:
+                       self_anti_veto: Optional[np.ndarray] = None,
+                       svc_ctx: Optional[dict] = None) -> RunTables:
     """Unpack the probe's packed product into RunTables (shared by the
     single-chip probe and the mesh probe, whose shard outputs
-    concatenate into the identical global array)."""
-    stk = arr[:8]
+    concatenate into the identical global array).
+
+    svc_ctx (SA/SAA policy configs; None otherwise) carries the
+    host-side service context for the run:
+      lbl_val_row i32[N], num_values, member (bool), sa_rows
+      (i32[R, N] or None — candidate pin rows for unresolved SA
+      labels), node_ord i32[N], w_saa."""
+    stk = arr[:N_STK_ROWS]
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize
     N = arr.shape[1]
     tab = (
-        arr[8:].view(dt).reshape(J // k, N, k)
+        arr[N_STK_ROWS:].view(dt).reshape(J // k, N, k)
         .transpose(0, 2, 1).reshape(J, N)[:rows]
     )
     fit_static = stk[0].astype(bool)
@@ -428,9 +490,60 @@ def tables_from_packed(config: SchedulerConfig, arr: np.ndarray,
     if (w_spread and zone_id is not None
             and np.any(np.asarray(zone_id) > 0)):
         zid = np.ascontiguousarray(zone_id, np.int32)
+    w_saa = 0
+    saa_counts = saa_lbl = sa_rows = None
+    saa_total = saa_nv = 0
+    saa_member = False
+    sa_bail = False
+    if svc_ctx is not None:
+        from kubernetes_tpu.snapshot.services import ORD_NONE
+
+        w_saa = int(svc_ctx.get("w_saa", 0))
+        if w_saa:
+            saa_counts = stk[8].astype(np.int64)
+            saa_total = int(stk[9][0])
+            saa_lbl = np.ascontiguousarray(
+                svc_ctx["lbl_val_row"], np.int32
+            )
+            saa_nv = int(svc_ctx["num_values"])
+            saa_member = bool(svc_ctx.get("member", False))
+        pin_ord = int(stk[10][0])
+        raw_rows = svc_ctx.get("sa_rows")
+        if raw_rows is not None:
+            raw_rows = np.ascontiguousarray(raw_rows, np.int32)
+            if pin_ord == int(ORD_NONE):
+                # unpinned: the first pick pins. Exact ONLY when every
+                # node carries every unresolved label — then the pick
+                # resolves them all and any later lower-ord commit must
+                # carry identical values (the fit forces it), so the
+                # min-ord re-pin can never change the requirement.
+                if np.all(raw_rows >= 0):
+                    sa_rows = raw_rows
+                else:
+                    sa_bail = True
+            else:
+                # pinned: static iff the peer's node resolves every
+                # unresolved label (same fit-forces-match argument).
+                # A peer on an unknown node (row < 0) fails every
+                # candidate statically — no dynamics. A peer whose node
+                # LACKS a label leaves it unresolved: a lower-ord
+                # commit could re-pin it mid-run -> scan.
+                ord_node = np.asarray(svc_ctx["ord_node"])
+                peer_row = (int(ord_node[pin_ord])
+                            if pin_ord < len(ord_node) else -1)
+                if peer_row >= 0 and np.any(raw_rows[:, peer_row] < 0):
+                    sa_bail = True
     return RunTables(
         zone_id=zid,
         num_zones=num_zones,
+        w_saa=w_saa,
+        saa_counts=saa_counts,
+        saa_total=saa_total,
+        saa_lbl_val=saa_lbl,
+        saa_num_values=saa_nv,
+        saa_member=saa_member,
+        sa_refine_rows=sa_rows,
+        sa_bail=sa_bail,
         fit_static=fit_static,
         res_fit=res_fit,
         tab=np.asarray(tab).astype(np.int64),

@@ -109,6 +109,28 @@ def _scores(t: RunTables, j: np.ndarray, fit: np.ndarray) -> np.ndarray:
         else:
             f = np.zeros(N, np.float64)
         score = score + t.w_ip * np.where(fit, f.astype(np.int64), 0)
+    if t.w_saa:
+        # ops/services.service_anti_affinity: peers counted on labeled
+        # FIT nodes; the run's own member commits grow counts and total
+        labeled = t.saa_lbl_val >= 0
+        counts = t.saa_counts + (j if t.saa_member else 0)
+        eligible = fit & labeled
+        vals = np.clip(t.saa_lbl_val, 0, max(t.saa_num_values - 1, 0))
+        by_value = np.bincount(
+            vals[eligible], weights=counts[eligible].astype(np.float64),
+            minlength=max(t.saa_num_values, 1),
+        ).astype(np.int64)
+        at_node = by_value[vals]
+        total = t.saa_total + (int(j.sum()) if t.saa_member else 0)
+        if total > 0:
+            f = np.float32(10.0) * (
+                (total - at_node).astype(np.float32) / np.float32(total)
+            )
+        else:
+            f = np.full(N, np.float32(10.0), np.float32)
+        score = score + t.w_saa * np.where(
+            labeled, f.astype(np.int64), np.int64(0)
+        )
     return score
 
 
@@ -120,6 +142,7 @@ def replay_spec(
     J, N = t.res_fit.shape
     j = np.zeros(N, np.int64)
     fit = t.fit_static & t.res_fit[0]
+    sa_mask = None  # ServiceAffinity pin applied after the first pick
     chosen = np.full(K, -1, np.int32)
     L = int(last_node_index)
     n_done = K
@@ -138,10 +161,24 @@ def replay_spec(
         chosen[step] = m
         L += 1
         j[m] += 1
+        if t.sa_refine_rows is not None and sa_mask is None:
+            # the run's first commit pins the unresolved ServiceAffinity
+            # labels to the picked node's values (ops/services.
+            # service_affinity: req = first peer's value, or
+            # unconstrained when its node lacks the label)
+            req = t.sa_refine_rows[:, m]  # (R,)
+            sa_mask = np.all(
+                (req[:, None] < 0)
+                | (t.sa_refine_rows == req[:, None]),
+                axis=0,
+            )
+            fit = fit & sa_mask
         if j[m] >= J:
             n_done = step + 1  # table horizon reached: bail after commit
             break
         fit[m] = t.fit_static[m] & t.res_fit[j[m], m]
+        if sa_mask is not None:
+            fit[m] &= sa_mask[m]
     return ReplayResult(
         chosen=chosen[:n_done],
         counts=j,
@@ -198,10 +235,12 @@ def replay_fast(t: RunTables, K: int, last_node_index: int) -> ReplayResult:
     lib = _load_lib()
     if lib is None:
         return replay_spec(t, K, last_node_index)
-    if t.zone_id is not None and t.has_selectors:
-        # zone-blended spread couples every node of a zone per commit;
-        # the C engine's incremental buckets don't model that (yet) —
-        # the vectorized spec replay still beats a per-pod scan by far
+    if (t.zone_id is not None and t.has_selectors) or t.w_saa \
+            or t.sa_refine_rows is not None:
+        # zone-blended spread / ServiceAntiAffinity / the ServiceAffinity
+        # first-pick pin couple nodes per commit in ways the C engine's
+        # incremental buckets don't model (yet) — the vectorized spec
+        # replay still beats a per-pod scan by far
         return replay_spec(t, K, last_node_index)
     J, N = t.res_fit.shape
     fs = np.ascontiguousarray(t.fit_static, np.uint8)

@@ -45,6 +45,7 @@ from kubernetes_tpu.models.batch import (
     NODE_AFFINITY,
     NODE_LABEL_PRIORITY,
     SELECTOR_SPREAD,
+    SERVICE_ANTI_AFFINITY,
     TAINT_TOLERATION,
     BatchScheduler,
     SchedulerConfig,
@@ -69,10 +70,17 @@ _WAVE_PRIORITIES = {
 
 def config_eligible(config: SchedulerConfig) -> bool:
     total_w = 0
+    n_saa = 0
     for name, w in config.priorities:
         if isinstance(name, tuple):
-            if name[0] != NODE_LABEL_PRIORITY:
-                return False  # ServiceAntiAffinity renormalizes per pick
+            if name[0] == SERVICE_ANTI_AFFINITY:
+                # per-pick renormalization handled by the spec replay;
+                # the tables carry ONE term's counts
+                n_saa += 1
+                if n_saa > 1:
+                    return False
+            elif name[0] != NODE_LABEL_PRIORITY:
+                return False
         elif name not in _WAVE_PRIORITIES:
             return False
         total_w += abs(w)
@@ -158,13 +166,13 @@ def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
         return False, None
     if b.vp_has_ebs[i] or b.vp_has_gce[i] or b.vp_ebs_bad[i] or b.vp_gce_bad[i]:
         return False, None
-    # a service member's commits move the ServiceAffinity first-peer /
-    # ServiceAntiAffinity counts
-    if b.svc_member.ndim == 2 and b.svc_member.shape[1] and np.any(b.svc_member[i]):
-        return False, None
-    # (zoned selector-spread runs stay eligible: the probe carries the
-    # node->zone map and the replay recomputes the 2/3 blend per pick —
-    # the coupling is linear in per-zone counts, exactly table shape)
+    # (service-member runs stay eligible: the replay models the
+    # ServiceAffinity first-pick pin and the per-pick ServiceAntiAffinity
+    # renormalization from the probe's svc rows; the apply fold records
+    # the commits for later pods. Zoned selector-spread runs likewise:
+    # the probe carries the node->zone map and the replay recomputes the
+    # 2/3 blend per pick — the coupling is linear in per-zone counts,
+    # exactly table shape.)
     return True, veto
 
 
@@ -210,6 +218,49 @@ def pick_j(config: SchedulerConfig, max_j: int, snap: ClusterSnapshot,
     return J, min(depth, J)
 
 
+def svc_run_context(config: SchedulerConfig, snap: ClusterSnapshot,
+                    batch: PodBatch, rep: int, num_values: int):
+    """The host-side service context for one run (SA/SAA policy
+    configs): what probe.tables_from_packed needs to model the
+    ServiceAffinity first-pick pin and the ServiceAntiAffinity per-pick
+    renormalization in the replay. None when the config has no service
+    terms. Shared by the single-chip and mesh wave drivers."""
+    from kubernetes_tpu.snapshot.encode import service_config_labels
+
+    svc_labels = service_config_labels(config)
+    if not svc_labels:
+        return None
+    sa_rows_idx: List[int] = []
+    saa_li, w_saa = -1, 0
+    for e in config.predicates:
+        if isinstance(e, tuple) and e[0] == "ServiceAffinity":
+            sa_rows_idx.extend(svc_labels.index(l) for l in e[1])
+    for nm, w in config.priorities:
+        if isinstance(nm, tuple) and nm[0] == "ServiceAntiAffinity":
+            saa_li = svc_labels.index(nm[1])
+            w_saa = int(w)
+    lbl_val = np.asarray(snap.svc_lbl_val)
+    g = int(batch.svc_group[rep])
+    ctx = {"w_saa": w_saa}
+    if w_saa:
+        ctx["lbl_val_row"] = lbl_val[saa_li]
+        ctx["num_values"] = num_values
+        ctx["member"] = bool(
+            g >= 0 and batch.svc_member.shape[1]
+            and batch.svc_member[rep, g]
+        )
+    if sa_rows_idx and g >= 0:
+        unres = [
+            li for li in sa_rows_idx
+            if int(batch.svc_fixed[rep, li]) < 0
+        ]
+        if unres:
+            ctx["sa_rows"] = lbl_val[unres]
+            # pin-staleness analysis needs the ord -> node row map
+            ctx["ord_node"] = np.asarray(snap.svc_ord_node)
+    return ctx
+
+
 def gather_batch(batch: PodBatch, rows: np.ndarray) -> PodBatch:
     """Materialize per-position rows from the unique-representative
     batch (fancy-index every pod-axis array)."""
@@ -248,6 +299,15 @@ def _permute_tables(t: RunTables, perm: np.ndarray) -> RunTables:
         tt_counts=p1(t.tt_counts),
         w_ip=t.w_ip,
         ip_totals=p1(t.ip_totals),
+        w_saa=t.w_saa,
+        saa_counts=p1(t.saa_counts),
+        saa_total=t.saa_total,
+        saa_lbl_val=p1(t.saa_lbl_val),
+        saa_num_values=t.saa_num_values,
+        saa_member=t.saa_member,
+        sa_refine_rows=(None if t.sa_refine_rows is None
+                        else t.sa_refine_rows[:, perm]),
+        sa_bail=t.sa_bail,
     )
 
 
@@ -383,6 +443,14 @@ class WaveScheduler:
             ip_spec_total = ip_spec_total + (
                 pod["ip_match_spec"].astype(jnp.int64) * k
             ).astype(ip_spec_total.dtype)
+        if svc_first_peer.shape[0]:
+            from kubernetes_tpu.ops.services import service_commit_bulk
+
+            (svc_first_peer, svc_peer_node_count,
+             svc_peer_total) = service_commit_bulk(
+                svc_first_peer, svc_peer_node_count, svc_peer_total,
+                static["svc_node_ord"], pod["svc_member"], counts,
+            )
         return (
             res, port_mask, class_count, last_idx,
             ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
@@ -537,6 +605,9 @@ class WaveScheduler:
                 f: np.asarray(getattr(batch, f)[rep])
                 for f in BatchScheduler.POD_FIELDS
             })
+            svc_ctx = svc_run_context(
+                self.config, snap, batch, rep, num_values
+            )
             done = 0
             while done < length:
                 K = length - done
@@ -554,7 +625,13 @@ class WaveScheduler:
                     has_selectors=bool(batch.has_selectors[rep]),
                     zone_id=np.asarray(snap.zone_id) if zoned else None,
                     self_anti_veto=self_anti_veto,
+                    svc_ctx=svc_ctx,
                 )
+                if tables.sa_bail:
+                    # ServiceAffinity dynamics the tables can't express
+                    # (mid-run re-pin hazard): scan the rest of the run
+                    pending.extend(range(start + done, start + length))
+                    break
                 res: ReplayResult = self._replay(
                     _permute_tables(tables, perm), K, L_host
                 )

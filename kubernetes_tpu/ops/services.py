@@ -113,3 +113,28 @@ def service_commit(
         first_peer, jnp.where(inc, this_ord, ORD_NONE)
     )
     return first_peer, peer_node_count, peer_total
+
+
+def service_commit_bulk(
+    first_peer, peer_node_count, peer_total, node_ord, pod_member, counts
+):
+    """service_commit folded over a run's per-node commit COUNTS (the
+    wave apply form, shared by the single-chip and mesh drivers):
+    peers land per node, totals grow by the commit sum, and the group's
+    first peer is the MIN order index over committed nodes."""
+    G = first_peer.shape[0]
+    if G == 0:
+        return first_peer, peer_node_count, peer_total
+    inc = pod_member > 0  # (G,)
+    c32 = counts.astype(jnp.int32)
+    peer_node_count = peer_node_count + (
+        inc[:, None].astype(jnp.int32) * c32[None, :]
+    )
+    peer_total = peer_total + inc.astype(jnp.int32) * c32.sum()
+    min_ord = jnp.where(
+        counts > 0, node_ord, jnp.int32(ORD_NONE)
+    ).min()
+    first_peer = jnp.minimum(
+        first_peer, jnp.where(inc, min_ord, jnp.int32(ORD_NONE))
+    )
+    return first_peer, peer_node_count, peer_total

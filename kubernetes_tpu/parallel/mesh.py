@@ -505,10 +505,11 @@ def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
                    n_global, pod_layout, static, carry, pod_buf):
     """Per-shard wave probe (models/probe._probe_fn, sharded): this
     shard's slice of the packed table product. The out_spec concatenates
-    shards along the node axis, so the host sees the same (8 + J-words,
-    N) array the single-chip probe ships — replay and commit mapping are
-    untouched. The pod row arrives as ONE packed replicated buffer
-    (models/pack) instead of ~40 per-field transfers."""
+    shards along the node axis, so the host sees the same
+    (probe.N_STK_ROWS + J-words, N) array the single-chip probe ships —
+    replay and commit mapping are untouched. The pod row arrives as ONE
+    packed replicated buffer (models/pack) instead of ~40 per-field
+    transfers."""
     from kubernetes_tpu.models.pack import unpack as _unpack_pod
     from kubernetes_tpu.models.probe import _tab_dtype
 
@@ -610,8 +611,41 @@ def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
             static_add = static_add + jnp.int64(weight) * R.node_label(
                 static[f"nl_prio_{name[1]}"], name[2]
             )
+        elif isinstance(name, tuple) and name[0] == "ServiceAntiAffinity":
+            pass  # per-pick renormalization: the replay consumes the
+            # svc rows emitted below
         else:
             raise ValueError(f"priority {name!r} is not mesh-wave-eligible")
+    # service rows (the single-chip probe's svc_counts/svc_total/
+    # svc_pin; see probe.N_STK_ROWS)
+    from kubernetes_tpu.snapshot.services import ORD_NONE as _ORD_NONE
+
+    G = svc_first_peer.shape[0]
+    if G:
+        g = jnp.clip(pod["svc_group"], 0, G - 1)
+        has_group = pod["svc_group"] >= 0
+        # the peer-count table is REPLICATED (G, N_global): emit this
+        # shard's slice so the concatenated rows equal the single-chip
+        # probe's global row
+        counts_g = jnp.where(
+            has_group, svc_peer_node_count[g], 0
+        ).astype(jnp.int64)
+        svc_counts = jax.lax.dynamic_slice_in_dim(
+            counts_g, offset, n_per_shard
+        )
+        svc_total = jnp.broadcast_to(
+            jnp.where(has_group, svc_peer_total[g], 0).astype(jnp.int64),
+            (N,),
+        )
+        svc_pin = jnp.broadcast_to(
+            jnp.where(has_group, svc_first_peer[g],
+                      jnp.int32(_ORD_NONE)).astype(jnp.int64),
+            (N,),
+        )
+    else:
+        svc_counts = jnp.zeros((N,), jnp.int64)
+        svc_total = jnp.zeros((N,), jnp.int64)
+        svc_pin = jnp.full((N,), jnp.int64(_ORD_NONE))
     frontier = res_fit.sum(0, dtype=jnp.int64)
     stk = jnp.stack([
         fit_static.astype(jnp.int64),
@@ -622,6 +656,9 @@ def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
         stk_rows["na_counts"],
         stk_rows["tt_counts"],
         stk_rows["ip_totals"],
+        svc_counts,
+        svc_total,
+        svc_pin,
     ])
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize
@@ -707,6 +744,16 @@ def _mesh_apply_fn(config, pod_layout, static, carry, pod_buf,
         ip_spec_total = ip_spec_total + (
             pod["ip_match_spec"].astype(jnp.int64) * k
         ).astype(ip_spec_total.dtype)
+    if svc_first_peer.shape[0]:
+        # service tables are replicated: every shard applies the
+        # identical GLOBAL fold
+        from kubernetes_tpu.ops.services import service_commit_bulk
+
+        (svc_first_peer, svc_peer_node_count,
+         svc_peer_total) = service_commit_bulk(
+            svc_first_peer, svc_peer_node_count, svc_peer_total,
+            static["svc_node_ord"], pod["svc_member"], counts_global,
+        )
     return (
         res, port_mask, class_count, last_idx,
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
@@ -935,6 +982,7 @@ class MeshWaveScheduler:
             config_eligible,
             gather_batch,
             run_eligible,
+            svc_run_context,
             _permute_tables,
         )
         from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
@@ -1025,7 +1073,15 @@ class MeshWaveScheduler:
                     has_selectors=bool(batch.has_selectors[rep]),
                     zone_id=np.asarray(snap.zone_id) if zoned else None,
                     self_anti_veto=self_anti_veto,
+                    svc_ctx=svc_run_context(
+                        self.config, snap, batch, rep, num_values
+                    ),
                 )
+                if tables.sa_bail:
+                    # ServiceAffinity dynamics the tables can't express
+                    # (mid-run re-pin hazard): scan the rest of the run
+                    pending.extend(range(start + done, start + length))
+                    break
                 res: ReplayResult = self._replay(
                     _permute_tables(tables, perm), K, L_host
                 )

@@ -416,3 +416,19 @@ def test_mesh_wave_scale_2k_nodes(mesh):
         pods, state.clone())
     assert got_mesh == got_single
     assert got_mesh.count(None) == 500
+
+
+def test_mesh_wave_service_member_runs(mesh):
+    """Service-member runs (SA pin + SAA renormalization) on the MESH
+    wave path: svc rows ride the sharded probe, the fold is replicated."""
+    from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+    from tests.test_wave import (
+        _svc_policy, _svc_oracle, _zone_nodes, _member_state, _members)
+
+    cfg = _svc_policy(sa=True, saa=True)
+    state = _member_state(_zone_nodes(9))
+    pods = _members(40)
+    got = TPUScheduleAlgorithm(mesh=mesh, config=cfg).schedule_backlog(
+        pods, state.clone())
+    want = _svc_oracle(state, pods, sa=True, saa=True)
+    assert got == want

@@ -534,3 +534,201 @@ def test_wave_self_anti_mixed_random(seed):
         p.metadata.name = f"pod-{i:06d}"
     state = ClusterState.build(nodes)
     assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+# -- service-member runs on the wave path (SA pin + SAA renormalization) -----
+
+
+def _svc_policy(sa=True, saa=True, saa_weight=2):
+    import json as _json
+
+    from kubernetes_tpu.scheduler.policy import (
+        load_policy, resolve_policy_tpu)
+
+    preds = [{"name": "GeneralPredicates"}]
+    if sa:
+        preds.append({"name": "ZoneAffinity", "argument": {
+            "serviceAffinity": {"labels": ["zone"]}}})
+    prios = [{"name": "LeastRequestedPriority", "weight": 1}]
+    if saa:
+        prios.append({"name": "ZoneSpread", "weight": saa_weight,
+                      "argument": {"serviceAntiAffinity": {
+                          "label": "zone"}}})
+    cfg = resolve_policy_tpu(load_policy(_json.dumps({
+        "kind": "Policy", "predicates": preds, "priorities": prios,
+    })), 1)
+    assert cfg is not None
+    return cfg
+
+
+def _svc_oracle(state, pending, sa=True, saa=True, saa_weight=2):
+    from kubernetes_tpu.oracle import predicates as opreds
+    from kubernetes_tpu.oracle import priorities as oprios
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+
+    preds = [("GeneralPredicates", opreds.general_predicates)]
+    if sa:
+        preds.append(
+            ("ZoneAffinity", opreds.service_affinity_predicate(["zone"])))
+    prios = [PriorityConfig(oprios.least_requested_priority, 1,
+                            "LeastRequestedPriority")]
+    if saa:
+        prios.append(PriorityConfig(
+            oprios.service_anti_affinity_priority("zone"), saa_weight,
+            "ZoneSpread"))
+    oracle = GenericScheduler(predicates=preds, priorities=prios)
+    return oracle.schedule_backlog(pending, state.clone())
+
+
+def _zone_nodes(n, zones=("za", "zb", "zc"), cap="110", unlabeled=0):
+    nodes = []
+    for i in range(n):
+        labels = {"kubernetes.io/hostname": f"node-{i:04d}"}
+        if i >= unlabeled:
+            labels["zone"] = zones[i % len(zones)]
+        nodes.append(Node(
+            metadata=ObjectMeta(name=f"node-{i:04d}", labels=labels),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": cap},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    return nodes
+
+
+def _member_state(nodes, existing=()):
+    return ClusterState.build(
+        nodes,
+        assigned_pods=list(existing),
+        services=[Service(metadata=ObjectMeta(name="app"),
+                          spec=ServiceSpec(selector={"app": "x"}))],
+    )
+
+
+def _members(k, name0=0, cpu="100m"):
+    out = pause_pods(k, labels={"app": "x"}, requests={"cpu": cpu})
+    for i, p in enumerate(out):
+        p.metadata.name = f"mem-{name0 + i:05d}"
+    return out
+
+
+def test_wave_service_affinity_first_pick_pins():
+    """An unpinned member run: the FIRST commit pins the zone and the
+    rest of the run (and later runs) must follow — the replay's
+    sa_refine path, bit-identical to the oracle."""
+    cfg = _svc_policy(sa=True, saa=False)
+    nodes = _zone_nodes(9)
+    state = _member_state(nodes)
+    pods = _members(40)
+    algo = TPUScheduleAlgorithm(config=cfg)
+    got = algo.schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=True, saa=False)
+    assert got == want
+    zones = {n.metadata.name: n.metadata.labels["zone"] for n in nodes}
+    assert len({zones[h] for h in got if h}) == 1  # all in the pin zone
+
+
+def test_wave_service_affinity_existing_peer_pins():
+    """A member already assigned pins BEFORE the run: fit is static and
+    the run must stay on the fast path landing in the peer's zone."""
+    cfg = _svc_policy(sa=True, saa=False)
+    nodes = _zone_nodes(9)
+    peer = _members(1, name0=900)[0]
+    peer.spec.node_name = "node-0004"  # zone zb
+    state = _member_state(nodes, existing=[peer])
+    pods = _members(30)
+    got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=True, saa=False)
+    assert got == want
+    zones = {n.metadata.name: n.metadata.labels["zone"] for n in nodes}
+    assert {zones[h] for h in got if h} == {"zb"}
+
+
+def test_wave_service_anti_affinity_spreads_values():
+    """SAA only: member commits renormalize the per-value spread every
+    pick (the replay's w_saa path)."""
+    cfg = _svc_policy(sa=False, saa=True)
+    nodes = _zone_nodes(9)
+    state = _member_state(nodes)
+    pods = _members(60)
+    got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=False, saa=True)
+    assert got == want
+    zones = {n.metadata.name: n.metadata.labels["zone"] for n in nodes}
+    per_zone = {}
+    for h in got:
+        per_zone[zones[h]] = per_zone.get(zones[h], 0) + 1
+    assert max(per_zone.values()) - min(per_zone.values()) <= 1
+
+
+def test_wave_service_member_and_plain_runs_interleave():
+    """Member runs + non-member runs share the carry: the fold must
+    record member commits exactly for the later runs' static fits."""
+    cfg = _svc_policy(sa=True, saa=True)
+    nodes = _zone_nodes(12, unlabeled=2)
+    state = _member_state(nodes)
+    pods = _members(30) + pause_pods(30, labels={"app": "y"},
+                                     requests={"cpu": "50m"})
+    for i, p in enumerate(pods[30:]):
+        p.metadata.name = f"plain-{i:05d}"
+    got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=True, saa=True)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_wave_service_runs_random(seed):
+    rng = random.Random(3000 + seed)
+    sa = rng.random() < 0.7
+    saa = (not sa) or rng.random() < 0.7
+    cfg = _svc_policy(sa=sa, saa=saa, saa_weight=rng.choice([1, 2]))
+    nodes = _zone_nodes(rng.randint(4, 15),
+                        zones=("za", "zb", "zc")[: rng.randint(1, 3)],
+                        cap=str(rng.randint(3, 20)),
+                        unlabeled=rng.choice([0, 0, 2]))
+    existing = []
+    if rng.random() < 0.5:
+        peer = _members(1, name0=900)[0]
+        peer.spec.node_name = nodes[rng.randrange(len(nodes))].metadata.name
+        existing.append(peer)
+    state = _member_state(nodes, existing=existing)
+    pods = _members(rng.randint(20, 70))
+    if rng.random() < 0.6:
+        pods += _members(rng.randint(16, 30), name0=500, cpu="200m")
+    got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=sa, saa=saa,
+                       saa_weight=cfg.priorities[-1][1] if saa else 2)
+    assert got == want
+
+
+def test_wave_sa_unlabeled_peer_repins_falls_back():
+    """The re-pin hazard (review repro): the group IS pinned but the
+    peer sits on an UNLABELED node, so the zone stays unresolved and a
+    mid-run commit to a lower-ord labeled node re-pins. The tables
+    can't express that — the run must fall back to the scan and still
+    match the oracle bit-for-bit."""
+    cfg = _svc_policy(sa=True, saa=False)
+    nodes = _zone_nodes(9, unlabeled=9)  # start all-unlabeled
+    for i, n in enumerate(nodes[:8]):
+        n.metadata.labels["zone"] = ("za", "zb", "zc")[i % 3]
+    # node-0008 stays unlabeled; the existing peer lives there
+    peer = _members(1, name0=900)[0]
+    peer.spec.node_name = "node-0008"
+    state = _member_state(nodes, existing=[peer])
+    pods = _members(30)
+    cold = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=True, saa=False)
+    assert cold == want
+
+
+def test_wave_sa_unlabeled_nodes_unpinned_falls_back():
+    """Unpinned group + partially-labeled cluster: the first pick might
+    land on an unlabeled node and leave the label unresolved, so the
+    first-pick refinement is not exact — fall back, match the oracle."""
+    cfg = _svc_policy(sa=True, saa=False)
+    nodes = _zone_nodes(9, unlabeled=3)
+    state = _member_state(nodes)
+    pods = _members(25)
+    got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
+    want = _svc_oracle(state, pods, sa=True, saa=False)
+    assert got == want
