@@ -461,7 +461,7 @@ def tables_from_packed(config: SchedulerConfig, arr: np.ndarray,
     host-side service context for the run:
       lbl_val_row i32[N], num_values, member (bool), sa_rows
       (i32[R, N] or None — candidate pin rows for unresolved SA
-      labels), node_ord i32[N], w_saa."""
+      labels), ord_node i32[ORD] (order index -> node row), w_saa."""
     stk = arr[:N_STK_ROWS]
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize

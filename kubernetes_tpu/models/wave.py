@@ -328,6 +328,13 @@ class WaveScheduler:
         self.pod_floor = pod_floor
         self._replay = replay or replay_fast
         self._apply_packed_jit: dict = {}
+        self._zreplay = None
+        # zoned selector-spread runs replay ON DEVICE (one lax.scan
+        # dispatch) instead of the per-pick numpy spec replay — the
+        # zone blend couples whole zones per commit, which the C engine
+        # can't bucket and numpy pays ~0.4ms/pick for. Opt out (e.g.
+        # for differential testing of the host path) via replay=.
+        self._device_zoned = replay is None
         from kubernetes_tpu.models.pack import Packer
 
         self._packer = Packer()
@@ -476,6 +483,44 @@ class WaveScheduler:
             dev[f] for f in self._CARRY_FIELDS[2:]
         )
 
+    def _run_device_replay(self, static, carry, prev_buf, prev_counts,
+                           buf, layout, num_zones, num_values, J, rows,
+                           K, snap, perm, self_anti_veto, batch, rep,
+                           L_host):
+        """Zoned-spread runs: probe + pick sequence + commit fold in one
+        device dispatch (models/zreplay). Returns (carry', ReplayResult
+        in permuted space — same contract as the host replays); the
+        run's commits are ALREADY folded into carry'."""
+        from kubernetes_tpu.models.replay import ReplayResult
+        from kubernetes_tpu.models.zreplay import ZReplay
+
+        if self._zreplay is None:
+            self._zreplay = ZReplay(self.config, self._apply_fn)
+        N = snap.num_nodes
+        zone_perm = np.ascontiguousarray(
+            np.asarray(snap.zone_id)[perm], np.int32
+        )
+        veto = np.zeros(N, bool)
+        if self_anti_veto is not None:
+            veto = np.asarray(self_anti_veto)
+        veto_perm = np.ascontiguousarray(veto[perm])
+        K_bucket = next_pow2(min(K, 1 << 16), floor=256)
+        k_real = min(K, K_bucket)
+        carry, chosen, _counts, L, n_done = self._zreplay.run(
+            static, carry, prev_buf, prev_counts, buf, layout,
+            num_zones, num_values, J, K_bucket, zone_perm, veto_perm,
+            bool(batch.has_selectors[rep]), rows, k_real, L_host,
+        )
+        chosen = np.asarray(chosen)
+        n_done = int(n_done)
+        return carry, ReplayResult(
+            chosen=chosen[:n_done],
+            counts=None,  # already folded on device
+            n_done=n_done,
+            last_node_index=int(L),
+            scheduled=int((chosen[:n_done] >= 0).sum()),
+        )
+
     def _apply_packed(self, static, carry, buf, layout, counts):
         """The commit fold from a PACKED pod-row buffer — the settle
         path when no further probe will carry the fold for free."""
@@ -608,6 +653,10 @@ class WaveScheduler:
             svc_ctx = svc_run_context(
                 self.config, snap, batch, rep, num_values
             )
+            use_device_replay = (
+                self._device_zoned and zoned
+                and bool(batch.has_selectors[rep]) and svc_ctx is None
+            )
             done = 0
             while done < length:
                 K = length - done
@@ -618,6 +667,23 @@ class WaveScheduler:
                         prev_buf, _pl, prev_counts = fold.pop()
                     else:  # layout drift (defensive): settle separately
                         carry = settle(carry)
+                if use_device_replay:
+                    carry, res = self._run_device_replay(
+                        static, carry, prev_buf, prev_counts, buf,
+                        layout, num_zones, num_values, J, rows, K,
+                        snap, perm, self_anti_veto, batch, rep, L_host,
+                    )
+                    if res.n_done == 0:
+                        pending.extend(
+                            range(start + done, start + length))
+                        break
+                    ids = np.where(
+                        res.chosen >= 0, perm[res.chosen], -1)
+                    out[start + done:
+                        start + done + res.n_done] = ids.astype(np.int32)
+                    L_host = res.last_node_index
+                    done += res.n_done
+                    continue
                 carry, tables = self.probe.probe_fused(
                     static, carry, prev_buf, prev_counts, buf,
                     num_zones, num_values, J, rows, layout,

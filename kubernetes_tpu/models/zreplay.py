@@ -1,0 +1,269 @@
+"""Device replay: the wave pick sequence as ONE lax.scan dispatch.
+
+The host replays (replay.py's C engine and numpy spec) assume scores
+decompose into per-node functions of that node's commit count. The
+ZONE-blended SelectorSpread breaks that: every commit re-weights a whole
+zone, so the C engine can't bucket and the numpy spec pays ~0.4 ms per
+pick — a zoned 50k-pod north-star took ~20 s. Here the whole pick
+sequence runs ON DEVICE instead: probe + K scan steps + the commit fold
+in one jitted program, one dispatch, one small transfer out. Each step
+reassembles the combined score exactly as models/replay._scores (same
+float32/float64 formulas, same NaN -> minInt64 quirk, same selectHost
+round-robin in name-desc order) — differentially tested against the
+host spec replay and the oracle by tests/test_wave.py.
+
+Scope: runs whose only cross-node coupling is the zone blend (the
+common zoned-cluster case). ServiceAffinity/ServiceAntiAffinity
+dynamics stay on the host spec replay (policy-config scale is smaller).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.batch import (
+    BALANCED_ALLOCATION,
+    INTER_POD_AFFINITY,
+    LEAST_REQUESTED,
+    NODE_AFFINITY,
+    SELECTOR_SPREAD,
+    TAINT_TOLERATION,
+    SchedulerConfig,
+)
+from kubernetes_tpu.models.probe import N_STK_ROWS, _probe_fn, _tab_dtype
+
+
+def _weights(config: SchedulerConfig):
+    w = {n if isinstance(n, str) else n[0]: wt
+         for n, wt in config.priorities}
+    return (int(w.get(SELECTOR_SPREAD, 0)), int(w.get(NODE_AFFINITY, 0)),
+            int(w.get(TAINT_TOLERATION, 0)),
+            int(w.get(INTER_POD_AFFINITY, 0)))
+
+
+def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
+                fold_prev, static, carry, prev_buf, prev_counts,
+                pod_buf, zone_id, veto, has_selectors, rows_dyn, k_real,
+                L0):
+    """probe + K-step device replay + commit fold, one program.
+
+    zone_id/veto are PERMUTED to name-desc order already; probe rows are
+    permuted inside. Returns (carry', chosen[K] permuted-space ids,
+    counts[N] node-order, L', n_done)."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    if fold_prev:
+        prev_pod = _unpack_pod(layout, prev_buf)
+        carry = apply_fn(static, carry, prev_pod, prev_counts)
+    pod = _unpack_pod(layout, pod_buf)
+    packed = _probe_fn(config, num_zones, num_values, J, static, carry,
+                       pod)["packed"]
+    perm = static["name_desc_order"].astype(jnp.int32)
+    N = perm.shape[0]
+    stk = packed[:N_STK_ROWS][:, perm]
+    fit_static = stk[0] != 0
+    frontier = stk[1]
+    static_add = stk[2]
+    spread_base = stk[3]
+    selfmatch = stk[4][0] > 0
+    na_counts = stk[5]
+    tt_counts = stk[6]
+    ip_totals = stk[7]
+    # LR/BA scores are recomputed directly per step (int math, exactly
+    # the j-table's contents — R.least_requested/balanced mirror):
+    # cheaper on TPU than a variable-row gather from the packed table
+    from kubernetes_tpu.ops import priorities as R
+
+    w_lr = w_ba = 0
+    for name, wt in config.priorities:
+        if name == LEAST_REQUESTED:
+            w_lr += int(wt)
+        elif name == BALANCED_ALLOCATION:
+            w_ba += int(wt)
+    res = carry[0]  # (6, N) node-order
+    nz_cpu0 = res[3][perm]
+    nz_mem0 = res[4][perm]
+    alloc_cpu = static["alloc_mcpu"][perm]
+    alloc_mem = static["alloc_mem"][perm]
+    dt = _tab_dtype(config)
+    # the veto (hostname self-anti): one committed copy per node
+    frontier = jnp.where(veto, jnp.minimum(frontier, 1), frontier)
+    w_spread, w_na, w_tt, w_ip = _weights(config)
+
+    fit0 = fit_static & (0 < frontier)
+
+    def scores(j, fit, zc):
+        score = static_add
+        if w_lr or w_ba:
+            nzj_cpu = nz_cpu0 + j * pod["nz_mcpu"]
+            nzj_mem = nz_mem0 + j * pod["nz_mem"]
+            if w_lr:
+                score = score + jnp.int64(w_lr) * R.least_requested(
+                    pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                    alloc_cpu, alloc_mem,
+                )
+            if w_ba:
+                score = score + jnp.int64(w_ba) * \
+                    R.balanced_resource_allocation(
+                        pod["nz_mcpu"], pod["nz_mem"], nzj_cpu, nzj_mem,
+                        alloc_cpu, alloc_mem,
+                    )
+        if w_spread:
+            c = spread_base + jnp.where(selfmatch, j, 0)
+            M = jnp.maximum(c.max(where=fit, initial=0), 0)
+            cm = jnp.where(fit, c, 0)
+            f = jnp.where(
+                M > 0,
+                jnp.float32(10.0) * ((M - cm).astype(jnp.float32)
+                                     / M.astype(jnp.float32)),
+                jnp.float32(10.0),
+            )
+            zoned = num_zones > 1
+            if zoned:
+                # zc is maintained INCREMENTALLY in the scan state (a
+                # full scatter-add per step serializes on TPU)
+                have_zones = (fit & (zone_id > 0)).any()
+                max_zone = jnp.where(
+                    jnp.arange(num_zones) > 0, zc, 0
+                ).max(initial=0)
+                zone_score = jnp.float32(10.0) * (
+                    (max_zone - zc[zone_id]).astype(jnp.float32)
+                    / max_zone.astype(jnp.float32)
+                )
+                blended = (f * jnp.float32(1.0 / 3.0)
+                           + jnp.float32(2.0 / 3.0) * zone_score)
+                f = jnp.where(have_zones & (zone_id > 0), blended, f)
+            f = jnp.where(has_selectors, f, jnp.float32(10.0))
+            nan = jnp.isnan(f)
+            fi = jnp.where(nan, jnp.float32(0), f).astype(jnp.int64)
+            score = score + w_spread * jnp.where(
+                nan, jnp.int64(-(2**63)), fi
+            )
+        # The na/tt/ip normalizers keep the host's EXACT float64
+        # expression shapes (replay._scores): integer-division rewrites
+        # are NOT equivalent under double rounding — TaintToleration's
+        # (1.0 - c/mx)*10.0 truncates to 0 where (10*(mx-c))//mx gives 1
+        # (e.g. mx=20, c=18), a divergence an adversarial review repro
+        # caught. float64 is emulated on TPU but measured negligible
+        # here; the scan's cost was the per-step zone scatter.
+        if w_na:
+            mx = jnp.maximum(na_counts.max(where=fit, initial=0), 0)
+            f = jnp.where(
+                mx > 0,
+                10.0 * (na_counts.astype(jnp.float64)
+                        / mx.astype(jnp.float64)),
+                jnp.float64(0.0),
+            )
+            score = score + w_na * f.astype(jnp.int64)
+        if w_tt:
+            mx = jnp.maximum(tt_counts.max(where=fit, initial=0), 0)
+            f = jnp.where(
+                mx > 0,
+                (1.0 - tt_counts.astype(jnp.float64)
+                 / mx.astype(jnp.float64)) * 10.0,
+                jnp.float64(10.0),
+            )
+            score = score + w_tt * f.astype(jnp.int64)
+        if w_ip:
+            big = jnp.int64(2**62)
+            mx = jnp.maximum(
+                ip_totals.max(where=fit, initial=-big), 0
+            )
+            mn = jnp.minimum(
+                ip_totals.min(where=fit, initial=big), 0
+            )
+            rng = mx - mn
+            f = jnp.where(
+                rng > 0,
+                10.0 * ((ip_totals - mn).astype(jnp.float64)
+                        / rng.astype(jnp.float64)),
+                jnp.float64(0.0),
+            )
+            score = score + w_ip * jnp.where(
+                fit, f.astype(jnp.int64), 0
+            )
+        return score
+
+    def step(state, i):
+        j, fit, zc, L, n_done, stopped = state
+        active = (~stopped) & (i < k_real)
+        can = active & fit.any()
+        score = scores(j, fit, zc)
+        smax = jnp.where(fit, score, jnp.int64(-(2**63))).max()
+        ties = fit & (score == smax)
+        num_ties = jnp.maximum(ties.sum(), 1)
+        r = (L % num_ties).astype(jnp.int32)
+        tie_rank = jnp.cumsum(ties.astype(jnp.int32)) - 1
+        m = jnp.argmax(ties & (tie_rank == r)).astype(jnp.int32)
+        sched = can
+        # zone-count bookkeeping around the commit (only column m moves)
+        sm = jnp.where(selfmatch, jnp.int64(1), jnp.int64(0))
+        c_old_m = spread_base[m] + sm * j[m]
+        contrib_old = jnp.where(fit[m], c_old_m, 0)
+        j = j.at[m].add(jnp.where(sched, 1, 0))
+        L = L + sched.astype(jnp.int64)
+        jm = j[m]
+        # at most one bail can ever fire (stopped gates sched after)
+        bail = sched & (jm >= rows_dyn)
+        n_done = jnp.where(bail, i + 1, n_done)
+        stopped = stopped | bail
+        new_fit_m = fit_static[m] & (jm < frontier[m])
+        fit = fit.at[m].set(jnp.where(sched, new_fit_m, fit[m]))
+        c_new_m = spread_base[m] + sm * jm
+        contrib_new = jnp.where(fit[m], c_new_m, 0)
+        zc = zc.at[zone_id[m]].add(
+            jnp.where(sched, contrib_new - contrib_old, 0)
+        )
+        chosen = jnp.where(sched, m, jnp.int32(-1))
+        return (j, fit, zc, L, n_done, stopped), chosen
+
+    zc0 = jnp.zeros((num_zones,), jnp.int64).at[zone_id].add(
+        jnp.where(fit0, spread_base, 0)
+    )
+    state0 = (
+        jnp.zeros((N,), jnp.int64), fit0, zc0, jnp.int64(L0),
+        k_real.astype(jnp.int32), jnp.bool_(False),
+    )
+    (j, _fit, _zc, L, n_done, _st), chosen = jax.lax.scan(
+        step, state0, jnp.arange(K, dtype=jnp.int32)
+    )
+    # permuted j -> node-order counts; fold THIS run's commits
+    counts = jnp.zeros((N,), jnp.int64).at[perm].set(j)
+    carry = apply_fn(static, carry, pod, counts)
+    return carry, chosen, counts, L, n_done
+
+
+class ZReplay:
+    """Compile cache for the fused probe+replay+fold programs."""
+
+    def __init__(self, config: SchedulerConfig, apply_fn):
+        self.config = config
+        self.apply_fn = apply_fn
+        self._jitted = {}
+
+    def run(self, static, carry, prev_buf, prev_counts, pod_buf, layout,
+            num_zones, num_values, J, K_bucket, zone_id_perm, veto_perm,
+            has_selectors, rows, k_real, L0):
+        fold_prev = prev_buf is not None
+        key = (num_zones, num_values, J, K_bucket, layout, fold_prev)
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _zreplay_fn, self.config, num_zones, num_values, J,
+                K_bucket, layout, self.apply_fn, fold_prev,
+            ))
+            self._jitted[key] = fn
+        if not fold_prev:
+            prev_buf = jnp.zeros(0, jnp.uint8)
+            prev_counts = jnp.zeros(0, jnp.int64)
+        return fn(
+            static, carry, prev_buf, prev_counts, pod_buf,
+            jnp.asarray(zone_id_perm), jnp.asarray(veto_perm),
+            jnp.asarray(bool(has_selectors)),
+            jnp.asarray(np.int64(rows)), jnp.asarray(np.int32(k_real)),
+            np.int64(L0),
+        )

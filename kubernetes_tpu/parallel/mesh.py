@@ -1059,6 +1059,9 @@ class MeshWaveScheduler:
                 for f in BatchScheduler.POD_FIELDS
             })
             pod_buf = jnp.asarray(pod_buf)
+            svc_ctx = svc_run_context(
+                self.config, snap, batch, rep, num_values
+            )
             done = 0
             while done < length:
                 K = length - done
@@ -1073,9 +1076,7 @@ class MeshWaveScheduler:
                     has_selectors=bool(batch.has_selectors[rep]),
                     zone_id=np.asarray(snap.zone_id) if zoned else None,
                     self_anti_veto=self_anti_veto,
-                    svc_ctx=svc_run_context(
-                        self.config, snap, batch, rep, num_values
-                    ),
+                    svc_ctx=svc_ctx,
                 )
                 if tables.sa_bail:
                     # ServiceAffinity dynamics the tables can't express

@@ -33,10 +33,11 @@ def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
 class TPUScheduleAlgorithm:
     def __init__(self, mesh=None, min_run: int = 16, cache=None,
                  service_lister=None, controller_lister=None,
-                 replica_set_lister=None, config=None):
+                 replica_set_lister=None, config=None, replay=None):
         """config: a models/batch SchedulerConfig overriding the default
         provider — the device end of a resolved Policy file
-        (factory.go:266 CreateFromConfig)."""
+        (factory.go:266 CreateFromConfig). replay overrides the wave
+        replay engine (testing seam; also disables the device replay)."""
         self._mesh_sched = None
         self._inc = None
         if mesh is not None:
@@ -49,7 +50,8 @@ class TPUScheduleAlgorithm:
         else:
             from kubernetes_tpu.models.wave import WaveScheduler
 
-            self._wave = WaveScheduler(config=config, min_run=min_run)
+            self._wave = WaveScheduler(config=config, min_run=min_run,
+                                       replay=replay)
             self._sched = self._wave.scan
             if cache is not None:
                 # daemon mode: maintain the snapshot incrementally from

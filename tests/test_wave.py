@@ -732,3 +732,59 @@ def test_wave_sa_unlabeled_nodes_unpinned_falls_back():
     got = TPUScheduleAlgorithm(config=cfg).schedule_backlog(pods, state)
     want = _svc_oracle(state, pods, sa=True, saa=False)
     assert got == want
+
+
+def test_wave_zoned_device_replay_equals_host_spec():
+    """The device replay (models/zreplay, one lax.scan dispatch) and the
+    host spec replay must produce identical decisions on zoned
+    backlogs — both are compared to the oracle elsewhere; this pins
+    them against each other directly, including a capacity-exhausted
+    tail and an unzoned-node mix."""
+    from kubernetes_tpu.models.replay import replay_spec
+
+    nodes = zoned_density_nodes(14, zones=("a", "b"), unzoned_every=4,
+                                pods_cap="7")
+    state = spread_state(nodes)
+    pods = pause_pods(120)  # 98 slots -> unschedulable tail
+    dev = TPUScheduleAlgorithm()  # device replay for zoned runs
+    host = TPUScheduleAlgorithm(replay=replay_spec)  # host opt-out
+    got_dev = dev.schedule_backlog(pods, state.clone())
+    got_host = host.schedule_backlog(pods, state.clone())
+    assert got_dev == got_host
+    assert got_dev == oracle_backlog(state, pods)
+    assert got_dev.count(None) == 120 - 98
+
+
+def test_wave_zoned_tainted_device_replay_matches_host():
+    """The review's adversarial case: zoned cluster + PreferNoSchedule
+    taints in play, where an integer rewrite of TaintToleration's
+    (1.0 - c/mx)*10.0 double-rounding would diverge (mx=20, c=18 ->
+    host 0, integer form 1). Pins device replay == host spec == oracle
+    with live taint normalizers."""
+    import json as _json
+
+    from kubernetes_tpu.api.types import TAINTS_ANNOTATION, Toleration
+    from kubernetes_tpu.models.replay import replay_spec
+
+    nodes = zoned_density_nodes(8, zones=("a", "b"), pods_cap="40")
+    # escalating intolerable PreferNoSchedule taint counts per node
+    for i, node in enumerate(nodes):
+        taints = [
+            {"key": f"t{k}", "value": "v", "effect": "PreferNoSchedule"}
+            for k in range(13 + i)
+        ]
+        node.metadata.annotations = {
+            TAINTS_ANNOTATION: _json.dumps(taints)
+        }
+    state = spread_state(nodes)
+    pods = pause_pods(90)
+    for p in pods:
+        p.spec.tolerations = [Toleration(key="t0", operator="Equal",
+                                         value="v",
+                                         effect="PreferNoSchedule")]
+    got_dev = TPUScheduleAlgorithm().schedule_backlog(pods, state.clone())
+    got_host = TPUScheduleAlgorithm(replay=replay_spec).schedule_backlog(
+        pods, state.clone())
+    want = oracle_backlog(state, pods)
+    assert got_host == want
+    assert got_dev == want
