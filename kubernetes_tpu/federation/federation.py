@@ -14,7 +14,9 @@ federation/pkg/federation-controller).
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -24,6 +26,8 @@ from kubernetes_tpu.apiserver.server import APIServer
 from kubernetes_tpu.controller.framework import PeriodicRunner
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
 from kubernetes_tpu.runtime.scheme import scheme
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -253,30 +257,73 @@ def join_cluster(fed_client: RESTClient, name: str,
 
 def unjoin_cluster(fed_client: RESTClient, name: str,
                    member_client_factory=None) -> None:
-    """kubefed unjoin: remove the federation's workloads from the
-    departing member WHILE its endpoint is still known, then delete the
-    Cluster object — otherwise the member keeps running its share
-    forever and federated totals are silently exceeded."""
+    """kubefed unjoin: capture the departing member's endpoint, delete
+    the Cluster object FIRST (so propagation loops stop targeting it),
+    then remove the federation's workloads from the member — retrying
+    until a verification pass finds the member clean, because a
+    propagation pass that listed clusters BEFORE the deletion can still
+    re-create workloads AFTER a single cleanup sweep (the TOCTOU the
+    reference closes with cluster finalizers in later versions).
+    Without the cleanup the member keeps running its share forever and
+    federated totals are silently exceeded."""
     factory = member_client_factory or default_member_client_factory
     try:
         cluster = fed_client.resource("clusters").get(name)
         member = factory(cluster)
     except Exception:
         member = None
-    if member is not None:
+    fed_client.resource("clusters").delete(name)
+    if member is None:
+        return
+
+    def fed_workloads():
+        out = []
         for resource in ("replicationcontrollers", "services"):
             try:
                 fed_objs, _rv = fed_client.resource(resource, "").list()
             except APIStatusError:
                 continue
-            for obj in fed_objs:
-                try:
-                    member.resource(
-                        resource, obj.metadata.namespace
-                    ).delete(obj.metadata.name)
-                except Exception:
-                    pass  # not propagated there / member unreachable
-    fed_client.resource("clusters").delete(name)
+            out.extend(
+                (resource, o.metadata.namespace, o.metadata.name)
+                for o in fed_objs
+            )
+        return out
+
+    def sweep(targets):
+        """-> (removed, failed): deletes that succeeded vs RAISED for a
+        reason other than not-found. A pass where everything fails must
+        never read as 'clean' — that is exactly the transient-blip case
+        where a concurrent propagation pass can resurrect workloads."""
+        removed = failed = 0
+        for resource, ns, nm in targets:
+            try:
+                member.resource(resource, ns).delete(nm)
+                removed += 1
+            except APIStatusError as e:
+                if e.code != 404:
+                    failed += 1
+            except Exception:
+                failed += 1  # member unreachable: NOT proof of clean
+        return removed, failed
+
+    targets = fed_workloads()
+    sweep(targets)
+    # verify-until-stable: an in-flight propagation pass (which listed
+    # clusters before our deletion) may re-create workloads after the
+    # first sweep. Clean = one full pass that finds nothing present and
+    # nothing unreachable. The budget covers multi-second propagation
+    # passes; exhaustion is LOGGED — the member would otherwise run its
+    # stale share silently forever.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+        removed, failed = sweep(targets)
+        if removed == 0 and failed == 0:
+            return
+    log.error(
+        "kubefed unjoin %s: member cleanup never stabilized within 30s; "
+        "federation workloads may survive on the departed cluster", name,
+    )
 
 
 class FederationControllerManager:
