@@ -378,7 +378,7 @@ def test_wave_zoned_uneven_zone_sizes():
     assert wave_backlog(state, pods) == oracle_backlog(state, pods)
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(12))
 def test_wave_zoned_random_backlogs(seed):
     rng = random.Random(1000 + seed)
     zones = ["a", "b", "c", "d"][: rng.randint(1, 4)]
@@ -517,7 +517,7 @@ def test_wave_self_anti_zone_topology_falls_back():
     assert got.count(None) == 6  # one per zone
 
 
-@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("seed", range(8))
 def test_wave_self_anti_mixed_random(seed):
     rng = random.Random(2000 + seed)
     nodes = hostname_nodes(rng.randint(5, 16),
@@ -676,7 +676,7 @@ def test_wave_service_member_and_plain_runs_interleave():
     assert got == want
 
 
-@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("seed", range(10))
 def test_wave_service_runs_random(seed):
     rng = random.Random(3000 + seed)
     sa = rng.random() < 0.7
