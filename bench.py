@@ -109,9 +109,20 @@ def run_config(num_nodes, num_pods, reps=3):
 
 def run_wire_path() -> float:
     """Best-of-reps separate-process density (the reference deployment
-    shape). Raises when the sandbox forbids cross-process localhost."""
+    shape). Raises when the sandbox forbids cross-process localhost.
+    With tracing on (the default; KUBERNETES_TPU_TRACE=0 force-disables
+    for the overhead A/B), each rep ends with a per-phase breakdown
+    table (encode/probe/score/replay/transfer/wire/bind) on stderr."""
     from kubernetes_tpu.harness.perf import schedule_pods_separate
+    from kubernetes_tpu.trace import spans as trace_span
 
+    print(
+        "# tracing "
+        + ("ENABLED" if trace_span.enabled() else
+           "force-disabled (KUBERNETES_TPU_TRACE=0)")
+        + "; phase attribution via scheduler_wave_phase_seconds",
+        file=sys.stderr,
+    )
     best = 0.0
     last_err = None
     for rep in range(WIRE_REPS):

@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
+from kubernetes_tpu.metrics import apiserver_request_latency
 from kubernetes_tpu.runtime import binary
 
 
@@ -92,9 +94,19 @@ def start_http_server(api: APIServer, host: str, port: int,
                     "code": 429,
                 })
                 return
+            # apiserver_request_latencies (pkg/apiserver/metrics.go):
+            # non-long-running requests only — a watch holds its
+            # connection for minutes by design and would drown the
+            # histogram in stream lifetimes
+            timed = not _is_long_running(parsed.path, query)
+            t0 = time.perf_counter() if timed else 0.0
             try:
                 self._dispatch_inner(method, parsed, query)
             finally:
+                if timed:
+                    apiserver_request_latency.labels(method).observe(
+                        (time.perf_counter() - t0) * 1e6
+                    )
                 if limited:
                     in_flight.release()
 

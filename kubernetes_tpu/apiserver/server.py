@@ -452,6 +452,12 @@ class APIServer:
             from kubernetes_tpu.utils import configz
 
             return 200, configz.snapshot()
+        if path == "/debug/traces":
+            # the span ring buffer (trace/spans.py), newest first;
+            # ?limit=N bounds it, ?trace=<id> filters one trace
+            from kubernetes_tpu.trace.httpd import render_traces
+
+            return 200, render_traces(query)
         if path.startswith("/debug/pprof"):
             # net/http/pprof analogue (scheduler server.go:96-99 mounts
             # it on every daemon; here daemons share this mux)
@@ -1172,6 +1178,18 @@ class APIServer:
         if info.resource == "thirdpartyresources":
             # dynamic installation (master.go InstallThirdPartyResource)
             self.thirdparty.install(obj)
+        if info.resource == "pods":
+            # wire-trace continuity: a pod carrying the trace-id
+            # annotation gets its persistence marked on that trace, so
+            # the apiserver leg shows up in the same /debug/traces
+            # record as the scheduler's schedule/bind legs. No-op (one
+            # dict get) for unannotated pods.
+            from kubernetes_tpu.trace import spans as trace_span
+
+            trace_span.event_span(
+                "apiserver.create", obj,
+                rv=obj.metadata.resource_version,
+            )
         return obj  # rv already stamped in place by the store
 
     def _update(self, info: ResourceInfo, ns: str, name: str, body,

@@ -12,8 +12,10 @@ an event iterator for watches.
 from __future__ import annotations
 
 import json
+import threading
 
 from kubernetes_tpu.runtime import binary as bin_codec
+from kubernetes_tpu.trace.profile import phase_timer
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib import parse as urlparse
 from urllib import request as urlrequest
@@ -119,12 +121,19 @@ class HTTPTransport:
                 if u.strip()]
         self.base_urls = urls
         self._active = 0
+        # failover rotation races: watch threads and request threads
+        # rotate concurrently, and torn read-modify-writes of _active
+        # could skip a healthy server in the cycle
+        self._active_lock = threading.Lock()
         self.timeout = timeout
         self.bearer_token = bearer_token
         self.binary = binary
         self.object_protocol = binary
         self._ssl_ctx = None
-        if urls[0].startswith("https"):
+        # ANY https endpoint needs the context — a mixed or
+        # standby-first endpoint list must not fail the moment rotation
+        # lands on the TLS member
+        if any(u.startswith("https") for u in urls):
             self._ssl_ctx = build_ssl_context(tls_ca, insecure)
 
     @property
@@ -142,7 +151,8 @@ class HTTPTransport:
         in this rotation cycle."""
         if len(self.base_urls) < 2:
             return False
-        self._active = (self._active + 1) % len(self.base_urls)
+        with self._active_lock:
+            self._active = (self._active + 1) % len(self.base_urls)
         return True
 
     def request(self, method, path, query=None, body=None):
@@ -202,7 +212,10 @@ class HTTPTransport:
                 resp, "headers"
             ) else ""
             if ctype.startswith(bin_codec.CONTENT_TYPE):
-                return bin_codec.decode(payload)
+                # response decode is "wire" work in the phase breakdown
+                # (list/relist payloads are the big ones)
+                with phase_timer("wire"):
+                    return bin_codec.decode(payload)
         return json.loads(payload)
 
     def watch(self, path, query=None):

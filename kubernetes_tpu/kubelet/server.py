@@ -126,6 +126,25 @@ class KubeletServer:
                 if parts == ["healthz"]:
                     self._send(200, "ok", "text/plain")
                     return
+                if parts == ["metrics"]:
+                    # the node daemon renders the registry itself now
+                    # (reference kubelet serves prometheus on :10250)
+                    from kubernetes_tpu.metrics import (
+                        registry as metrics_registry,
+                    )
+
+                    self._send(200, metrics_registry.render(),
+                               "text/plain; version=0.0.4")
+                    return
+                if parts == ["debug", "traces"]:
+                    from kubernetes_tpu.trace.httpd import render_traces
+
+                    q = {
+                        k: v[0]
+                        for k, v in parse_qs(parsed.query).items() if v
+                    }
+                    self._send(200, render_traces(q))
+                    return
                 if parts == ["pods"]:
                     from kubernetes_tpu.runtime import scheme
 

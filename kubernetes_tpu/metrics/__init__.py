@@ -2,27 +2,38 @@
 
 A minimal counter/gauge/histogram registry rendered in the Prometheus
 text exposition format at /metrics. Histogram bucket layout matches the
-scheduler's exponential 1ms -> ~16s buckets (metrics.go:31-54).
+scheduler's exponential 1ms -> ~16s buckets (metrics.go:31-54); the
+trace layer adds second-unit phase/compile histograms on top.
 """
 
 from kubernetes_tpu.metrics.metrics import (
     Counter,
     Gauge,
     Histogram,
+    HistogramVec,
     Registry,
+    apiserver_request_latency,
     registry,
     scheduler_binding_latency,
     scheduler_algorithm_latency,
     scheduler_e2e_latency,
+    scheduler_slo_breach_total,
+    scheduler_wave_phase_seconds,
+    scheduler_xla_compile_seconds,
 )
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "HistogramVec",
     "Registry",
     "registry",
+    "apiserver_request_latency",
     "scheduler_e2e_latency",
     "scheduler_algorithm_latency",
     "scheduler_binding_latency",
+    "scheduler_slo_breach_total",
+    "scheduler_wave_phase_seconds",
+    "scheduler_xla_compile_seconds",
 ]

@@ -79,12 +79,18 @@ class Histogram(_Metric):
         name: str,
         help_: str = "",
         buckets: Optional[Sequence[float]] = None,
+        const_labels: Optional[Dict[str, str]] = None,
     ):
         super().__init__(name, help_)
         self.buckets = list(buckets or exponential_buckets(1000, 2, 15))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        # constant label set prefixed to every sample line (the child-
+        # of-a-vec case; HistogramVec renders through this)
+        self._const = "".join(
+            f'{k}="{v}",' for k, v in sorted((const_labels or {}).items())
+        )
 
     def observe(self, v: float) -> None:
         # bisect, not a bucket scan: observe() runs 3x per bound pod on
@@ -116,20 +122,81 @@ class Histogram(_Metric):
                     return b
             return float("inf")
 
-    def render(self) -> str:
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self) -> List[int]:
+        """Per-bucket counts (overflow bucket last) — the SLO watchdog
+        diffs consecutive snapshots to compute window quantiles instead
+        of all-history ones."""
+        with self._lock:
+            return list(self._counts)
+
+    def render(self, header: bool = True) -> str:
+        lines = (
+            [f"# HELP {self.name} {self.help}",
+             f"# TYPE {self.name} histogram"] if header else []
+        )
+        c = self._const
+        suffix = f"{{{c[:-1]}}}" if c else ""
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
-                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+                lines.append(f'{self.name}_bucket{{{c}le="{b}"}} {cum}')
             cum += self._counts[-1]
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{self.name}_sum {self._sum}")
-            lines.append(f"{self.name}_count {self._count}")
+            lines.append(f'{self.name}_bucket{{{c}le="+Inf"}} {cum}')
+            lines.append(f"{self.name}_sum{suffix} {self._sum}")
+            lines.append(f"{self.name}_count{suffix} {self._count}")
+        return "\n".join(lines)
+
+
+class HistogramVec(_Metric):
+    """A histogram family keyed by one label (prometheus HistogramVec
+    with a single-label schema — enough for the per-phase scheduler
+    attribution, where the label is the wire-path phase name)."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        label: str = "phase",
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        super().__init__(name, help_)
+        self.label = label
+        self._buckets = buckets
+        self._children: Dict[str, Histogram] = {}
+
+    def labels(self, value: str) -> Histogram:
+        child = self._children.get(value)
+        if child is None:
+            with self._lock:
+                child = self._children.get(value)
+                if child is None:
+                    child = Histogram(
+                        self.name, self.help, buckets=self._buckets,
+                        const_labels={self.label: value},
+                    )
+                    self._children[value] = child
+        return child
+
+    def sums(self) -> Dict[str, float]:
+        """{label value: cumulative observed sum} — the per-phase
+        seconds totals the bench breakdown table diffs."""
+        with self._lock:
+            children = dict(self._children)
+        return {v: h.sum for v, h in children.items()}
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for _, child in children:
+            lines.append(child.render(header=False))
         return "\n".join(lines)
 
 
@@ -168,5 +235,53 @@ scheduler_binding_latency = registry.register(
     Histogram(
         "scheduler_binding_latency_microseconds",
         "Binding latency",
+    )
+)
+
+# -- trace/device-profiling layer (kubernetes_tpu/trace) ----------------------
+
+# second-unit buckets: 10us .. ~84s (device dispatches sit in the ms-s
+# range; a single bucket ladder serves phase and compile attribution)
+_SECONDS_BUCKETS = exponential_buckets(1e-5, 2, 24)
+
+#: per-phase wall seconds of the scheduling wire path, labeled
+#: phase=encode|probe|score|replay|transfer|wire|bind
+#: (trace/profile.py owns the phase vocabulary)
+scheduler_wave_phase_seconds = registry.register(
+    HistogramVec(
+        "scheduler_wave_phase_seconds",
+        "Wire-path phase latency in seconds, labeled by phase",
+        label="phase",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: XLA compile time, attributed separately from execute time (fed by
+#: jax.monitoring compile-duration events; trace/profile.py installs
+#: the listener). The first jit call of every fresh program shape lands
+#: here instead of polluting the phase/e2e histograms.
+scheduler_xla_compile_seconds = registry.register(
+    Histogram(
+        "scheduler_xla_compile_seconds",
+        "XLA compile seconds per compiled scheduler program",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: SLO watchdog breach count (trace/slo.py)
+scheduler_slo_breach_total = registry.register(
+    Counter(
+        "scheduler_slo_breach_total",
+        "Number of scheduling-latency SLO breaches observed",
+    )
+)
+
+#: apiserver request latency (pkg/apiserver/metrics.go
+#: apiserver_request_latencies, microsecond units like the scheduler's)
+apiserver_request_latency = registry.register(
+    HistogramVec(
+        "apiserver_request_latencies_microseconds",
+        "apiserver request latency in microseconds, labeled by verb",
+        label="verb",
     )
 )

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.trace.profile import phase_timer
+
 
 def _unpack(layout, buf):
     out = {}
@@ -84,9 +86,12 @@ class Packer:
 
     def ship(self, arrays: dict) -> dict:
         """-> {name: device array}, one host->device transfer total."""
-        key, buf = pack_arrays(arrays)
-        fn = self._unpack.get(key)
-        if fn is None:
-            fn = jax.jit(functools.partial(_unpack, key))
-            self._unpack[key] = fn
-        return fn(buf)
+        # the host<->device "transfer" phase of the wire-path breakdown:
+        # every wave's shipping funnels through here
+        with phase_timer("transfer"):
+            key, buf = pack_arrays(arrays)
+            fn = self._unpack.get(key)
+            if fn is None:
+                fn = jax.jit(functools.partial(_unpack, key))
+                self._unpack[key] = fn
+            return fn(buf)

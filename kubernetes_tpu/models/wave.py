@@ -55,6 +55,7 @@ from kubernetes_tpu.models.probe import RunTables, WaveProbe
 from kubernetes_tpu.models.replay import ReplayResult, replay_fast
 from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
+from kubernetes_tpu.trace.profile import phase_timer
 
 _WAVE_PRIORITIES = {
     LEAST_REQUESTED,
@@ -534,7 +535,10 @@ class WaveScheduler:
 
             fn = jax.jit(run)
             self._apply_packed_jit[layout] = fn
-        return fn(static, carry, buf, jnp.asarray(counts))
+        # carry-fold commit (async dispatch: the timer sees the enqueue
+        # plus whatever the device makes it wait for)
+        with phase_timer("replay"):
+            return fn(static, carry, buf, jnp.asarray(counts))
 
     # -- backlog -------------------------------------------------------------
 
@@ -626,9 +630,13 @@ class WaveScheduler:
                 for f in BatchScheduler.POD_FIELDS
             })
             run = self.scan._compiled(num_zones, num_values)
-            new_carry, chosen = run(static, carry, pods)
-            out[rows] = np.asarray(chosen)[: len(rows)]
-            L_host = int(new_carry[self.LAST_IDX])
+            # "score": the fused predicate+priority scan program — the
+            # asarray/int reads force the dispatch so the timer covers
+            # compute, not just enqueue
+            with phase_timer("score"):
+                new_carry, chosen = run(static, carry, pods)
+                out[rows] = np.asarray(chosen)[: len(rows)]
+                L_host = int(new_carry[self.LAST_IDX])
             pending.clear()
             return new_carry
 
@@ -668,11 +676,13 @@ class WaveScheduler:
                     else:  # layout drift (defensive): settle separately
                         carry = settle(carry)
                 if use_device_replay:
-                    carry, res = self._run_device_replay(
-                        static, carry, prev_buf, prev_counts, buf,
-                        layout, num_zones, num_values, J, rows, K,
-                        snap, perm, self_anti_veto, batch, rep, L_host,
-                    )
+                    with phase_timer("replay"):
+                        carry, res = self._run_device_replay(
+                            static, carry, prev_buf, prev_counts, buf,
+                            layout, num_zones, num_values, J, rows, K,
+                            snap, perm, self_anti_veto, batch, rep,
+                            L_host,
+                        )
                     if res.n_done == 0:
                         pending.extend(
                             range(start + done, start + length))
@@ -684,23 +694,26 @@ class WaveScheduler:
                     L_host = res.last_node_index
                     done += res.n_done
                     continue
-                carry, tables = self.probe.probe_fused(
-                    static, carry, prev_buf, prev_counts, buf,
-                    num_zones, num_values, J, rows, layout,
-                    self._apply_fn,
-                    has_selectors=bool(batch.has_selectors[rep]),
-                    zone_id=np.asarray(snap.zone_id) if zoned else None,
-                    self_anti_veto=self_anti_veto,
-                    svc_ctx=svc_ctx,
-                )
+                with phase_timer("probe"):
+                    carry, tables = self.probe.probe_fused(
+                        static, carry, prev_buf, prev_counts, buf,
+                        num_zones, num_values, J, rows, layout,
+                        self._apply_fn,
+                        has_selectors=bool(batch.has_selectors[rep]),
+                        zone_id=(np.asarray(snap.zone_id)
+                                 if zoned else None),
+                        self_anti_veto=self_anti_veto,
+                        svc_ctx=svc_ctx,
+                    )
                 if tables.sa_bail:
                     # ServiceAffinity dynamics the tables can't express
                     # (mid-run re-pin hazard): scan the rest of the run
                     pending.extend(range(start + done, start + length))
                     break
-                res: ReplayResult = self._replay(
-                    _permute_tables(tables, perm), K, L_host
-                )
+                with phase_timer("replay"):
+                    res: ReplayResult = self._replay(
+                        _permute_tables(tables, perm), K, L_host
+                    )
                 if res.n_done == 0:
                     # no progress possible through tables; scan the rest
                     pending.extend(range(start + done, start + length))

@@ -23,6 +23,7 @@ import struct
 from typing import Any
 
 from kubernetes_tpu.runtime import tlv
+from kubernetes_tpu.trace.profile import phase_timer
 
 CONTENT_TYPE = "application/vnd.kubernetes-tpu.binary"
 # protobuf.go:17-33 magic-prefixed envelope idea; the trailing byte is a
@@ -89,7 +90,12 @@ def read_frames(fp):
             if avail >= hdr + n:
                 body = buf[pos + hdr:pos + hdr + n]
                 pos += hdr + n
-                yield decode(body)
+                # "wire" phase: the CPU cost of the TLV watch ingest
+                # (decode only — the blocking read below is idle time,
+                # not work, and must not inflate the attribution)
+                with phase_timer("wire"):
+                    obj = decode(body)
+                yield obj
                 continue
         # compact + refill (read1: return as soon as any data arrives —
         # a frame must not wait for a full block on a quiet stream)

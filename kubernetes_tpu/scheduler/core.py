@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +35,8 @@ from kubernetes_tpu.oracle.scheduler import (
     select_host,
 )
 from kubernetes_tpu.oracle.state import ClusterState
+from kubernetes_tpu.trace import profile as trace_profile
+from kubernetes_tpu.trace import spans as trace_span
 from kubernetes_tpu.utils.clock import DEFAULT_CLOCK
 from kubernetes_tpu.utils.trace import Trace
 
@@ -249,15 +252,17 @@ class Scheduler:
                 return
             pod = wave[0]  # the popped pod itself may have been dropped
         start = DEFAULT_CLOCK.now()
+        wall_start = time.time() if trace_span.enabled() else 0.0
         state = self._snapshot()
         try:
-            if len(wave) == 1:
-                hosts: List[Optional[str]] = [
-                    cfg.algorithm.schedule(wave[0], state)
-                ]
-                errors: Dict[int, Exception] = {}
-            else:
-                hosts, errors = self._schedule_wave(wave, state)
+            with trace_span.span("scheduler.wave", pods=len(wave)):
+                if len(wave) == 1:
+                    hosts: List[Optional[str]] = [
+                        cfg.algorithm.schedule(wave[0], state)
+                    ]
+                    errors: Dict[int, Exception] = {}
+                else:
+                    hosts, errors = self._schedule_wave(wave, state)
         except Exception as e:
             # histograms are microsecond-unit like the reference's
             # (metrics.go ExponentialBuckets(1000, 2, 15) over us)
@@ -269,6 +274,18 @@ class Scheduler:
         scheduler_algorithm_latency.observe(
             (DEFAULT_CLOCK.now() - start) * 1e6
         )
+        if trace_span.enabled():
+            # attribute the wave's algorithm window to every traced
+            # pod's own trace (one wall-clock read, per-pod dict gets)
+            wall_end = time.time()
+            for p, host in zip(wave, hosts):
+                tid = trace_span.extract(p)
+                if tid:
+                    trace_span.record_span(
+                        "scheduler.schedule", tid, wall_start, wall_end,
+                        pod=f"{p.metadata.namespace}/{p.metadata.name}",
+                        node=host or "", wave=len(wave),
+                    )
 
         successes: List[Tuple[Pod, str]] = []
         for i, (p, host) in enumerate(zip(wave, hosts)):
@@ -361,6 +378,14 @@ class Scheduler:
         def succeed(pod, host, per_bind, now):
             scheduler_binding_latency.observe(per_bind * 1e6)
             scheduler_e2e_latency.observe((now - cycle_start) * 1e6)
+            tid = trace_span.extract(pod)
+            if tid:
+                # span timestamps are wall-clock; the clock above is
+                # monotonic, so re-anchor the duration at "now"
+                wall = time.time()
+                trace_span.record_span(
+                    "scheduler.bind", tid, wall - per_bind, wall, node=host,
+                )
             if cfg.recorder is not None:
                 cfg.recorder.eventf(
                     pod,
@@ -372,6 +397,10 @@ class Scheduler:
                 )
 
         def bind_all() -> None:
+            with trace_profile.phase_timer("bind"):
+                _bind_all_inner()
+
+        def _bind_all_inner() -> None:
             bind_start = DEFAULT_CLOCK.now()
             if cfg.binder_many is not None and len(pairs) > 1:
                 try:

@@ -46,6 +46,16 @@ class SchedulerServerOptions:
     )
     kube_api_qps: float = 50.0
     kube_api_burst: int = 100
+    # the daemon's own observability mux (server.go:92-108 runs the
+    # reference's on :10251): /healthz, /metrics, /configz,
+    # /debug/traces. Port 0 binds ephemeral (the bound port lands on
+    # .health_address); None disables the listener entirely.
+    serve_address: str = "127.0.0.1"
+    serve_port: Optional[int] = 0
+    # SLO watchdog (trace/slo.py): objective <= 0 disables; on breach a
+    # Warning Event is emitted through the scheduler's recorder
+    slo_objective_seconds: float = 0.0
+    slo_check_interval: float = 10.0
     leader_elect: bool = False
     leader_elect_identity: str = ""
     lock_object_namespace: str = "kube-system"
@@ -93,6 +103,10 @@ class SchedulerServer:
         self.scheduler: Optional[Scheduler] = None
         self._elector: Optional[LeaderElector] = None
         self._thread: Optional[threading.Thread] = None
+        self._health_server = None
+        self._slo = None
+        #: (host, port) of the daemon's observability mux once serving
+        self.health_address: Optional[tuple] = None
         # set once the scheduling loop is open for business (informers
         # synced + run-path warmup done). Callers that want steady-state
         # behavior (the perf harness, local-up readiness) wait on this;
@@ -106,6 +120,26 @@ class SchedulerServer:
         from kubernetes_tpu.utils import configz
 
         configz.install("componentconfig", opts)
+        # compile-vs-execute attribution must be listening before the
+        # first jit fires (warmup included)
+        from kubernetes_tpu.trace import profile as trace_profile
+
+        trace_profile.install_compile_listener()
+        # the daemon's own mux (reference :10251): metrics/healthz no
+        # longer depend on riding the apiserver's shared mux
+        if opts.serve_port is not None:
+            from kubernetes_tpu.trace.httpd import start_component_server
+
+            try:
+                self._health_server, bound = start_component_server(
+                    opts.serve_address, opts.serve_port, name="scheduler"
+                )
+                self.health_address = (opts.serve_address, bound)
+            except OSError as e:
+                # a sandbox that forbids socket binding must not turn
+                # the optional metrics mux into a daemon boot failure
+                log.warning("observability mux failed to bind: %s", e)
+                self._health_server = None
         # start device-backend initialization NOW: on a tunneled chip it
         # costs seconds and otherwise lands serially inside the first
         # warmup/wave; the thread spends its time in backend RPCs (GIL
@@ -141,6 +175,17 @@ class SchedulerServer:
         self._broadcaster = EventBroadcaster()
         self._broadcaster.start_recording_to_sink(EventSink(self.client))
         config.recorder = self._broadcaster.new_recorder("scheduler")
+
+        # SLO watchdog: e2e latency sampled against the objective, with
+        # breaches emitted as Warning Events through the same recorder
+        if opts.slo_objective_seconds > 0:
+            from kubernetes_tpu.trace.slo import SLOWatchdog
+
+            self._slo = SLOWatchdog(
+                config.recorder,
+                opts.slo_objective_seconds,
+                interval=opts.slo_check_interval,
+            ).run()
 
         self.scheduler = Scheduler(config)
         if not opts.leader_elect:
@@ -268,6 +313,12 @@ class SchedulerServer:
         from kubernetes_tpu.utils import configz
 
         configz.delete("componentconfig")
+        if self._slo is not None:
+            self._slo.stop()
+        if self._health_server is not None:
+            self._health_server.shutdown()
+            self._health_server.server_close()
+            self._health_server = None
         if self._elector is not None:
             self._elector.stop()
         if self.scheduler is not None:

@@ -18,6 +18,7 @@ from typing import List, Optional, Sequence
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.oracle.scheduler import FitError
 from kubernetes_tpu.oracle.state import ClusterState
+from kubernetes_tpu.trace import profile as trace_profile
 
 log = logging.getLogger(__name__)
 
@@ -38,6 +39,10 @@ class TPUScheduleAlgorithm:
         provider — the device end of a resolved Policy file
         (factory.go:266 CreateFromConfig). replay overrides the wave
         replay engine (testing seam; also disables the device replay)."""
+        # compile-vs-execute attribution: listening before any program
+        # compiles means the first jit of every shape lands in
+        # scheduler_xla_compile_seconds, not in a phase histogram
+        trace_profile.install_compile_listener()
         self._mesh_sched = None
         self._inc = None
         if mesh is not None:
@@ -276,39 +281,40 @@ class TPUScheduleAlgorithm:
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
         from kubernetes_tpu.snapshot.pad import next_pow2
 
-        reps, rep_idx = self._dedup(pods)
-        snap = batch = None
-        keep = frozenset()
-        source = "full"
-        if self._inc is not None:
-            def ls(l):
-                return l.list() if l is not None else ()
+        with trace_profile.phase_timer("encode"):
+            reps, rep_idx = self._dedup(pods)
+            snap = batch = None
+            keep = frozenset()
+            source = "full"
+            if self._inc is not None:
+                def ls(l):
+                    return l.list() if l is not None else ()
 
-            snap, batch, keep = self._inc.wave_view(
-                reps,
-                services=ls(self._service_lister),
-                controllers=ls(self._controller_lister),
-                replica_sets=ls(self._replica_set_lister),
-            )
-            if snap is not None:
-                # identify the ENCODER INSTANCE, not just the kind: a
-                # warmup's throwaway incremental encoder and the real
-                # one must never satisfy each other's `keep` (their
-                # vocab bit/slot assignments are encoder-local)
-                source = self._inc.source_token
-        if snap is None:
-            # from-scratch encode (no daemon cache, or a scope gate hit:
-            # inter-pod affinity / volumes / SA-SAA config)
-            enc = SnapshotEncoder(state, reps, config=self._wave.config)
-            snap = enc.encode_nodes()
-            batch = enc.encode_pods()
-            n_real = snap.num_nodes
-            if n_real == 0:
-                # empty cluster: every pod fails with FitError
-                return [None] * len(pods)
-            n_bucket = next_pow2(n_real, 64)
-            if n_bucket > n_real:
-                snap = _pad_snapshot(snap, n_bucket)
+                snap, batch, keep = self._inc.wave_view(
+                    reps,
+                    services=ls(self._service_lister),
+                    controllers=ls(self._controller_lister),
+                    replica_sets=ls(self._replica_set_lister),
+                )
+                if snap is not None:
+                    # identify the ENCODER INSTANCE, not just the kind: a
+                    # warmup's throwaway incremental encoder and the real
+                    # one must never satisfy each other's `keep` (their
+                    # vocab bit/slot assignments are encoder-local)
+                    source = self._inc.source_token
+            if snap is None:
+                # from-scratch encode (no daemon cache, or a scope gate
+                # hit: inter-pod affinity / volumes / SA-SAA config)
+                enc = SnapshotEncoder(state, reps, config=self._wave.config)
+                snap = enc.encode_nodes()
+                batch = enc.encode_pods()
+                n_real = snap.num_nodes
+                if n_real == 0:
+                    # empty cluster: every pod fails with FitError
+                    return [None] * len(pods)
+                n_bucket = next_pow2(n_real, 64)
+                if n_bucket > n_real:
+                    snap = _pad_snapshot(snap, n_bucket)
         chosen, _final, last = self._wave.schedule_backlog(
             snap, batch, rep_idx, last_node_index=self._last_node_index,
             keep=keep, source=source,
@@ -331,21 +337,22 @@ class TPUScheduleAlgorithm:
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
         from kubernetes_tpu.snapshot.pad import next_pow2
 
-        reps, rep_idx = self._dedup(pods)
-        enc = SnapshotEncoder(
-            state, reps, config=self._mesh_sched.config
-        )
-        snap = enc.encode_nodes()
-        batch = enc.encode_pods()
-        n_real = snap.num_nodes
-        if n_real == 0:
-            return [None] * len(pods)
-        # bucket the node axis for compile reuse (pow2, floor 64), then
-        # to a mesh multiple so the shard math sees the final N here and
-        # node ids map back to THIS snapshot's names
-        n_dev = self._mesh_sched.mesh.devices.size
-        snap = _pad_snapshot(snap, next_pow2(n_real, 64))
-        snap = _pad_snapshot(snap, n_dev)
+        with trace_profile.phase_timer("encode"):
+            reps, rep_idx = self._dedup(pods)
+            enc = SnapshotEncoder(
+                state, reps, config=self._mesh_sched.config
+            )
+            snap = enc.encode_nodes()
+            batch = enc.encode_pods()
+            n_real = snap.num_nodes
+            if n_real == 0:
+                return [None] * len(pods)
+            # bucket the node axis for compile reuse (pow2, floor 64),
+            # then to a mesh multiple so the shard math sees the final N
+            # here and node ids map back to THIS snapshot's names
+            n_dev = self._mesh_sched.mesh.devices.size
+            snap = _pad_snapshot(snap, next_pow2(n_real, 64))
+            snap = _pad_snapshot(snap, n_dev)
         chosen, _final, last = self._mesh_sched.schedule_backlog(
             snap, batch, rep_idx, last_node_index=self._last_node_index
         )

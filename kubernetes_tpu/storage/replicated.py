@@ -164,6 +164,15 @@ class ReplicatedStore(FileStore):
                 data = _read_exact(conn, _ACK.size)
                 (n,) = _ACK.unpack(data)
                 with self._ack_cond:
+                    if self._follower is not conn:
+                        # this socket was replaced by a fresh attach: a
+                        # late buffered ack counts against the OLD
+                        # stream's byte offsets and must never satisfy
+                        # the new follower's sync wait — that would
+                        # void the acked-write-survives-kill-9
+                        # guarantee for writes the new follower hasn't
+                        # durably applied yet
+                        return
                     self._acked = n
                     self._ack_cond.notify_all()
         except (ConnectionError, OSError):
@@ -190,6 +199,7 @@ class ReplicatedStore(FileStore):
         frame = _frame(rec)
         conn = self._follower
         if conn is not None:
+            stalled = False
             try:
                 conn.sendall(frame)
                 with self._ack_cond:
@@ -205,10 +215,17 @@ class ReplicatedStore(FileStore):
                                 "dropping it (degraded, unreplicated)",
                                 self.sync_timeout,
                             )
-                            self._follower = None
+                            stalled = True
                             break
                         self._ack_cond.wait(left)
             except OSError:
+                self._drop_follower(conn)
+            if stalled:
+                # drop WITH a socket close (outside the condition —
+                # _drop_follower retakes it): merely clearing
+                # self._follower leaves the stalled peer's stream
+                # intact, so it never observes the break, never
+                # re-attaches, and keeps serving stale reads forever
                 self._drop_follower(conn)
         super()._record(key, ev)
 

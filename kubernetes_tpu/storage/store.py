@@ -458,7 +458,9 @@ class MemoryStore:
         lock acquisition — the wave-bulk bind commits thousands of
         per-pod updates back to back, and per-item lock churn was a
         measurable slice of the window. Per-item isolation: each item
-        succeeds or fails (StorageError) independently."""
+        succeeds or fails independently — ANY exception (a StorageError
+        or a raising mutation fn) stays with its item, so one bad
+        mutation in a bulk bind can't 500 the whole BindingList."""
         out: List[Optional[Exception]] = []
         with self._lock:
             for key, fn in ops:
@@ -472,7 +474,7 @@ class MemoryStore:
                         continue
                     self.update(key, new, owned=new is cur)
                     out.append(None)
-                except StorageError as e:
+                except Exception as e:
                     out.append(e)
         return out
 

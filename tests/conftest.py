@@ -26,7 +26,13 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # --xla_force_host_platform_device_count XLA flag above is the
+    # equivalent and is honored by every version in use here
+    pass
 
 # Build the native engines up front (cached by mtime) so the C-replay
 # differential fuzz tests exercise replay.c instead of silently skipping
