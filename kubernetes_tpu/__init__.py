@@ -31,6 +31,7 @@ Layout:
   oracle/    pure-Python sequential reference oracle (Go semantics) used as
              the conformance corpus generator/checker
   utils/     workqueue, backoff, trace, metrics, events
+  audit/     apiserver audit log (who-did-what ring + /debug/audit)
 
 Integer semantics note: the reference computes scores with int64 arithmetic
 (e.g. `((capacity-requested)*10)/capacity` in priorities.go:33); memory is

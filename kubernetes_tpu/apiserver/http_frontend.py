@@ -111,6 +111,32 @@ def start_http_server(api: APIServer, host: str, port: int,
                     in_flight.release()
 
         def _dispatch_inner(self, method: str, parsed, query):
+            # audit context for this request (apiserver/pkg/audit
+            # WithAudit): the handler thread's identity slot is reset per
+            # request — keep-alive reuses threads, and a stale user on a
+            # reused slot would mis-attribute the next request's trail
+            ctx = api._audit_ctx
+            ctx.user = ""
+            ctx.request_id = self.headers.get("X-Request-Id", "")
+
+            def audit_denied(code: int, user_name: str = "") -> None:
+                # denied access IS the audit log's primary story (who
+                # tried and failed): record 401/403 here because these
+                # requests never reach api.handle()'s audit hook
+                level = api.audit_policy.level_for(parsed.path)
+                if level == "None":
+                    return
+                from kubernetes_tpu import audit as _audit
+
+                _audit.record(
+                    level,
+                    user_name or "system:anonymous",
+                    _audit.verb_for(method, query),
+                    "", "", "", code, 0.0,
+                    request_id=ctx.request_id,
+                    path=parsed.path,
+                )
+
             # authn/authz when the server is configured with them
             # (handlers.go WithAuthentication/WithAuthorization shape)
             if getattr(api, "authenticator", None) is not None:
@@ -120,11 +146,14 @@ def start_http_server(api: APIServer, host: str, port: int,
                 try:
                     user = api.authenticator.authenticate(dict(self.headers))
                 except AuthenticationError as e:
+                    audit_denied(401)
                     self._send_json(401, {"message": str(e)})
                     return
                 if user is None:
+                    audit_denied(401)
                     self._send_json(401, {"message": "unauthorized"})
                     return
+                ctx.user = user.name
                 authorizer = getattr(api, "authorizer", None)
                 if authorizer is not None:
                     ns, info, _name, _sub, _grp, _ver = api._route(
@@ -142,6 +171,7 @@ def start_http_server(api: APIServer, host: str, port: int,
                         query_watch=query.get("watch") in ("true", "1"),
                     )
                     if not authorizer.authorize(attrs):
+                        audit_denied(403, user.name)
                         self._send_json(
                             403,
                             {"message": f"user {user.name!r} cannot "

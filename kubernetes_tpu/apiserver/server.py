@@ -16,6 +16,8 @@ streaming); the client layer's LocalTransport skips the socket.
 
 from __future__ import annotations
 
+import time as _time
+
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -317,6 +319,14 @@ class APIServer:
         import threading as _threading
 
         self._body_owned = _threading.local()
+        # audit subsystem (apiserver/pkg/audit): per-request who/what/
+        # when events into the process ring, policy-leveled. The HTTP
+        # frontend deposits the authenticated user + request ID in the
+        # per-thread context before calling handle().
+        from kubernetes_tpu.audit import AuditPolicy
+
+        self.audit_policy = AuditPolicy.from_env()
+        self._audit_ctx = _threading.local()
         # dynamic third-party resources (master.go:610-766); re-install
         # any persisted ThirdPartyResource objects on startup
         self.thirdparty = ThirdPartyInstaller(self)
@@ -373,6 +383,102 @@ class APIServer:
     # -- request routing -----------------------------------------------------
 
     def handle(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+        obj_mode: bool = False,
+        body_owned: bool = False,
+    ):
+        """Handle one REST request, auditing it per the audit policy.
+
+        Every request routed here — HTTP frontend or in-process
+        transport — produces at most one audit event, so "who did what"
+        is answerable from /debug/audit no matter which door the request
+        came through. Exempt paths (health/metrics/debug) skip straight
+        to dispatch with zero overhead."""
+        level = self.audit_policy.level_for(path)
+        if level == "None":
+            return self._handle_coded(
+                method, path, query, body, obj_mode, body_owned
+            )
+        ctx = self._audit_ctx
+        ctx.route = None  # _handle deposits its route here as it parses
+        t0 = _time.perf_counter()
+        code, payload = 500, None
+        try:
+            code, payload = result = self._handle_coded(
+                method, path, query, body, obj_mode, body_owned
+            )
+            return result
+        finally:
+            self._audit_record(
+                level, method, path, query or {}, body, code,
+                _time.perf_counter() - t0, response=payload,
+            )
+
+    def _audit_record(self, level, method, path, query, body, code,
+                      latency, response=None) -> None:
+        from kubernetes_tpu import audit as _audit
+
+        method = method.upper()
+        ctx = self._audit_ctx
+        route = getattr(ctx, "route", None)
+        if route is not None:
+            # _handle already routed this request; don't pay it twice
+            ns, info, name, subresource = route
+            resource = info.resource if info is not None else ""
+        else:
+            ns = resource = name = subresource = ""
+            try:
+                ns, info, name, subresource, _g, _v = self._route(path)
+                resource = info.resource if info is not None else ""
+            except Exception:
+                pass  # non-resource path: the event still carries `path`
+        if not resource and path.rstrip("/").endswith("/bindings"):
+            resource = "bindings"  # the collection-bind shortcut
+        if not name and body is not None:
+            # create: the object's name arrives in the body, not the path
+            if isinstance(body, dict):
+                meta = body.get("metadata")
+                if isinstance(meta, dict):
+                    name = meta.get("name", "")
+            else:
+                name = getattr(
+                    getattr(body, "metadata", None), "name", ""
+                ) or ""
+        if not name and response is not None:
+            # generateName create: the server minted the name — it only
+            # exists on the response object
+            if isinstance(response, dict):
+                meta = response.get("metadata")
+                if isinstance(meta, dict):
+                    name = meta.get("name", "")
+            else:
+                name = getattr(
+                    getattr(response, "metadata", None), "name", ""
+                ) or ""
+        verb = _audit.verb_for(method, query, has_name=bool(name))
+        _audit.record(
+            level,
+            getattr(ctx, "user", "") or "system:unsecured",
+            verb,
+            resource,
+            ns or "",
+            name or "",
+            code,
+            latency,
+            request_id=getattr(ctx, "request_id", "") or "",
+            path=path,
+            subresource=subresource or "",
+            request_object=(
+                body if level == "Request" and method in
+                ("POST", "PUT", "PATCH") else None
+            ),
+        )
+
+    def _handle_coded(
         self,
         method: str,
         path: str,
@@ -458,6 +564,12 @@ class APIServer:
             from kubernetes_tpu.trace.httpd import render_traces
 
             return 200, render_traces(query)
+        if path == "/debug/audit":
+            # the audit ring (audit/audit.py), newest first; ?limit=N
+            # bounds it, ?user=/&verb=/&resource= filter
+            from kubernetes_tpu.audit import render_audit
+
+            return 200, render_audit(query)
         if path.startswith("/debug/pprof"):
             # net/http/pprof analogue (scheduler server.go:96-99 mounts
             # it on every daemon; here daemons share this mux)
@@ -507,6 +619,9 @@ class APIServer:
             return self._bind(ns, "", body)
 
         ns, info, name, subresource, group, version = self._route(path)
+        # deposit for the audit hook: handle()'s finally reads it back
+        # instead of routing the path a second time
+        self._audit_ctx.route = (ns, info, name, subresource)
         if info is None:
             raise APIError(404, f"unknown path {path!r}")
         codec = self._resolve_codec(group, version)

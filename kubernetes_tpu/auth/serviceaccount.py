@@ -14,8 +14,26 @@ import base64
 import json
 from typing import Callable, Dict, Optional
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+# gated: cryptography is an optional dependency. Importing this module
+# (and everything above it: controller manager, hyperkube) must work
+# without it; only actually minting/verifying service-account JWTs
+# requires the library.
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - exercised only on slim images
+    hashes = serialization = padding = rsa = None  # type: ignore
+    HAVE_CRYPTOGRAPHY = False
+
+
+def _require_crypto() -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise ImportError(
+            "No module named 'cryptography' — service-account JWT "
+            "signing/verification requires it"
+        )
 
 from kubernetes_tpu.auth.authn import (
     AuthenticationError,
@@ -36,10 +54,12 @@ ALL_GROUP = "system:serviceaccounts"
 def generate_key() -> rsa.RSAPrivateKey:
     """A fresh signing key (the --service-account-private-key-file
     stand-in for tests/local-up)."""
+    _require_crypto()
     return rsa.generate_private_key(public_exponent=65537, key_size=2048)
 
 
 def load_private_key_pem(data: bytes) -> rsa.RSAPrivateKey:
+    _require_crypto()
     return serialization.load_pem_private_key(data, password=None)
 
 
@@ -64,6 +84,7 @@ class TokenGenerator:
     """jwt.go JWTTokenGenerator: mints RS256 service-account JWTs."""
 
     def __init__(self, private_key: rsa.RSAPrivateKey):
+        _require_crypto()
         self.private_key = private_key
 
     def generate(self, namespace: str, sa_name: str, sa_uid: str,
@@ -94,6 +115,7 @@ class JWTTokenAuthenticator(Authenticator):
     rejects tokens whose account or secret is gone (TokenGetter)."""
 
     def __init__(self, public_key, lookup: Optional[Callable] = None):
+        _require_crypto()
         self.public_key = public_key
         self.lookup = lookup
 

@@ -22,9 +22,15 @@ class ProcessError(Exception):
 
 class FIFO:
     """Coalescing FIFO: at most one entry per key; Pop returns the
-    latest version. Blocks on empty."""
+    latest version. Blocks on empty.
 
-    def __init__(self, key_func: KeyFunc = meta_namespace_key_func):
+    A non-empty `name` reports the queue through the workqueue metric
+    family (depth + adds + queue-wait) — the scheduler's pod queue is
+    the named instance, so `workqueue_depth{name="scheduler-pods"}`
+    exposes its backlog next to every controller queue's."""
+
+    def __init__(self, key_func: KeyFunc = meta_namespace_key_func,
+                 name: str = ""):
         self.key_func = key_func
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -33,12 +39,31 @@ class FIFO:
         # 30k-pod density backlog that turned the queue quadratic
         self._queue: deque = deque()
         self._closed = False
+        self.name = name
+        self._metrics = None
+        if name:
+            import time as _time
+
+            from kubernetes_tpu import metrics as _m
+
+            self._metrics = (
+                _m.workqueue_depth.labels(name),
+                _m.workqueue_adds_total.child(name=name),
+                _m.workqueue_queue_duration_seconds.labels(name),
+                _time.monotonic,
+            )
+            self._added_at: Dict[str, float] = {}
 
     def add(self, obj: Any) -> None:
         key = self.key_func(obj)
         with self._cond:
             if key not in self._items:
                 self._queue.append(key)
+                if self._metrics is not None:
+                    depth, adds, _qd, now = self._metrics
+                    adds()
+                    self._added_at.setdefault(key, now())
+                    depth.set(len(self._items) + 1)
             self._items[key] = obj
             self._cond.notify()
 
@@ -50,6 +75,12 @@ class FIFO:
         with self._cond:
             self._items.pop(key, None)
             # key stays in _queue; pop skips missing items (fifo.go Delete)
+            if self._metrics is not None:
+                # drop the enqueue timestamp NOW: a later re-add of the
+                # same key must not inherit it (phantom queue-wait), and
+                # never-recreated keys must not leak entries
+                self._added_at.pop(key, None)
+                self._metrics[0].set(len(self._items))
 
     def get_by_key(self, key: str) -> Optional[Any]:
         with self._lock:
@@ -66,7 +97,16 @@ class FIFO:
                 while self._queue:
                     key = self._queue.popleft()
                     if key in self._items:
+                        if self._metrics is not None:
+                            depth, _adds, queue_dur, now = self._metrics
+                            ts = now()
+                            queue_dur.observe(
+                                ts - self._added_at.pop(key, ts)
+                            )
+                            depth.set(len(self._items) - 1)
                         return self._items.pop(key)
+                    elif self._metrics is not None:
+                        self._added_at.pop(key, None)  # deleted entry
                 if self._closed:
                     raise ShutDown
                 if not self._cond.wait(timeout=timeout):
@@ -76,6 +116,11 @@ class FIFO:
         with self._cond:
             self._items = {self.key_func(o): o for o in objs}
             self._queue = deque(self._items.keys())
+            if self._metrics is not None:
+                depth, _adds, _qd, now = self._metrics
+                ts = now()
+                self._added_at = {k: ts for k in self._items}
+                depth.set(len(self._items))
             if self._items:
                 self._cond.notify_all()
 

@@ -9,9 +9,16 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 from kubernetes_tpu.client.rest import ResourceClient, WatchExpired
+from kubernetes_tpu.metrics import (
+    reflector_list_duration_seconds,
+    reflector_lists_total,
+    reflector_watch_duration_seconds,
+    watch_events_total,
+)
 
 log = logging.getLogger(__name__)
 
@@ -34,6 +41,13 @@ class Reflector:
         self.relist_backoff = relist_backoff
         self.max_relist_backoff = max_relist_backoff
         self.name = name or resource.resource
+        # bound counters with pre-built label keys: the watch handler
+        # runs once per event during density bursts
+        self._event_counters = {
+            et: watch_events_total.child(name=self.name, type=et)
+            for et in ("ADDED", "MODIFIED", "DELETED")
+        }
+        self._lists_counter = reflector_lists_total.child(name=self.name)
         self.last_sync_resource_version = "0"
         self._stop = threading.Event()
         self._synced_once = threading.Event()
@@ -88,11 +102,18 @@ class Reflector:
             )
 
     def _list_and_watch(self) -> None:
+        # list/relist latency + count (reflector metrics, the resync
+        # and recovery-list signal the ROADMAP's queue-lag analysis needs)
+        t0 = time.monotonic()
         items, rv = self.resource.list(
             label_selector=self.label_selector,
             field_selector=self.field_selector,
         )
         self.store.replace(items)
+        self._lists_counter()
+        reflector_list_duration_seconds.labels(self.name).observe(
+            time.monotonic() - t0
+        )
         self.last_sync_resource_version = rv
         self._synced_once.set()
         while not self._stop.is_set():
@@ -108,7 +129,13 @@ class Reflector:
                 if self._stop.is_set():
                     self._watch.stop()
                     return
-                self._watch_handler(self._watch)
+                w0 = time.monotonic()
+                try:
+                    self._watch_handler(self._watch)
+                finally:
+                    reflector_watch_duration_seconds.labels(
+                        self.name
+                    ).observe(time.monotonic() - w0)
             except WatchExpired:
                 raise  # relist from scratch
             finally:
@@ -128,6 +155,7 @@ class Reflector:
             else:
                 log.warning("reflector %s: unknown event %s", self.name, ev_type)
                 continue
+            self._event_counters[ev_type]()
             if rv:
                 self.last_sync_resource_version = rv
         # watch closed server-side: return to re-establish from last RV

@@ -87,6 +87,23 @@ class Informer:
             name=name or f"informer-{resource.resource}",
         )
         self._thread: Optional[threading.Thread] = None
+        self._started_at: Optional[float] = None
+
+    def _mark_synced(self) -> None:
+        """Set has_synced exactly once, recording start->synced latency
+        (informer_sync_duration_seconds) — the cache-warm time that gates
+        every controller's first reconcile pass."""
+        if self._initial_processed.is_set():
+            return
+        self._initial_processed.set()
+        if self._started_at is not None:
+            import time as _time
+
+            from kubernetes_tpu.metrics import informer_sync_duration_seconds
+
+            informer_sync_duration_seconds.labels(
+                self._reflector.name
+            ).observe(_time.monotonic() - self._started_at)
 
     # SharedIndexInformer.AddEventHandler
     def add_event_handler(self, handler: ResourceEventHandler) -> None:
@@ -98,6 +115,9 @@ class Informer:
             self._handlers.append(handler)
 
     def run(self) -> "Informer":
+        import time as _time
+
+        self._started_at = _time.monotonic()
         self._reflector.run()
         if self._direct:
             return self
@@ -152,7 +172,7 @@ class Informer:
             and self._reflector.has_synced()
             and len(self._fifo) == 0
         ):
-            self._initial_processed.set()
+            self._mark_synced()
 
     def _process_delta(self, d) -> None:
         obj = d.object
@@ -246,4 +266,4 @@ class _DirectAdapter:
                 if old is not None:
                     for h in inf._handlers:
                         _safe_call(h.on_delete, old)
-        inf._initial_processed.set()
+        inf._mark_synced()

@@ -12,8 +12,9 @@ import datetime
 import itertools
 import logging
 import threading
+import time
 from collections import OrderedDict
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.client.rest import APIStatusError, RESTClient
@@ -125,13 +126,17 @@ class EventBroadcaster:
 
     def shutdown(self) -> None:
         """Flush queued events and stop the worker (the reference's
-        watch.Broadcaster.Shutdown). Terminal: events recorded afterwards
-        (e.g. by still-draining bind threads) are dropped instead of
-        resurrecting the worker."""
+        watch.Broadcaster.Shutdown). Terminal AND idempotent: events
+        recorded afterwards (e.g. by still-draining bind threads) are
+        dropped instead of resurrecting the worker, and a second
+        shutdown() — controllers and their manager both shutting the
+        shared broadcaster down — returns immediately instead of
+        enqueueing another sentinel into a queue nobody drains."""
         with self._lock:
+            already = self._shut
             self._shut = True
         worker = self._worker
-        if worker is None or not worker.is_alive():
+        if already or worker is None or not worker.is_alive():
             return
         self._queue.put(_SHUTDOWN)
         worker.join(timeout=5.0)
@@ -145,7 +150,18 @@ class EventBroadcaster:
             )
         )
 
-    def start_recording_to_sink(self, sink: "EventSink") -> None:
+    def start_recording_to_sink(
+        self,
+        sink: "EventSink",
+        correlator: Optional[EventCorrelator] = None,
+        correlate: bool = True,
+    ) -> None:
+        """Fan events into `sink`, correlated by default: duplicates
+        aggregate client-side (count/firstTimestamp/lastTimestamp) and a
+        per-source+object token bucket sheds event storms before they
+        reach the store (StartRecordingToSink's EventCorrelator)."""
+        if correlate:
+            sink = _CorrelatingSink(sink, correlator or EventCorrelator())
         self._add(sink.record)
 
     def _add(self, fn: Callable[[t.Event], None]) -> None:
@@ -165,6 +181,146 @@ class EventBroadcaster:
             self._queue.put_nowait(ev)
         except _queue.Full:
             pass  # DropIfChannelFull (watch/mux.go:40)
+
+
+class EventSpamFilter:
+    """Token-bucket spam filter per (source, involved object) — the
+    events_cache.go EventSourceObjectSpamFilter. Each source+object pair
+    gets `burst` immediate events; afterwards tokens refill at `qps`
+    (default one event per 5 minutes, the reference's default). The
+    bucket map is LRU-bounded so a wave of distinct objects cannot grow
+    it without bound."""
+
+    def __init__(
+        self,
+        burst: int = 25,
+        qps: float = 1.0 / 300.0,
+        clock: Callable[[], float] = time.monotonic,
+        max_keys: int = 4096,
+    ):
+        self.burst = float(burst)
+        self.qps = qps
+        self._clock = clock
+        self._max_keys = max_keys
+        self._lock = threading.Lock()
+        # key -> [tokens, last refill ts]
+        self._buckets: "OrderedDict[Tuple, List[float]]" = OrderedDict()
+
+    @staticmethod
+    def _key(ev: t.Event) -> Tuple:
+        ref = ev.involved_object
+        return (
+            ev.source_component,
+            ref.kind,
+            ref.namespace,
+            ref.name,
+        )
+
+    def allow(self, ev: t.Event) -> bool:
+        now = self._clock()
+        with self._lock:
+            b = self._buckets.get(self._key(ev))
+            if b is None:
+                b = [self.burst, now]
+                self._buckets[self._key(ev)] = b
+                while len(self._buckets) > self._max_keys:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(self._key(ev))
+                b[0] = min(self.burst, b[0] + (now - b[1]) * self.qps)
+                b[1] = now
+            if b[0] >= 1.0:
+                b[0] -= 1.0
+                return True
+            return False
+
+
+class EventCorrelator:
+    """Client-side event correlation (events_cache.go EventCorrelator):
+    identical events (same source/object/reason/type/message) aggregate
+    into one logical event whose count/firstTimestamp/lastTimestamp
+    advance, and a per-source+object token bucket drops spam before it
+    ever reaches the API. correlate() returns the (possibly rewritten)
+    event to record, or None when the spam filter discarded it."""
+
+    MAX_CACHE = 4096
+
+    def __init__(
+        self,
+        spam_filter: Optional[EventSpamFilter] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._filter = spam_filter or EventSpamFilter(clock=clock)
+        self._lock = threading.Lock()
+        # aggregation key -> [canonical event name, count, firstTimestamp]
+        self._cache: "OrderedDict[Tuple, List]" = OrderedDict()
+
+    @staticmethod
+    def _agg_key(ev: t.Event) -> Tuple:
+        ref = ev.involved_object
+        return (
+            ev.source_component,
+            ref.kind,
+            ref.namespace,
+            ref.name,
+            ev.reason,
+            ev.type,
+            ev.message,
+        )
+
+    def correlate(self, ev: t.Event) -> Optional[t.Event]:
+        key = self._agg_key(ev)
+        with self._lock:
+            rec = self._cache.get(key)
+            if rec is None:
+                self._cache[key] = [
+                    ev.metadata.name, ev.count or 1, ev.first_timestamp,
+                ]
+                while len(self._cache) > self.MAX_CACHE:
+                    self._cache.popitem(last=False)
+            else:
+                # the canonical (first-seen) name keeps every duplicate
+                # aggregating onto ONE store object instead of minting a
+                # new Event per occurrence
+                rec[1] += 1
+                self._cache.move_to_end(key)
+                ev.metadata.name = rec[0]
+                ev.count = rec[1]
+                ev.first_timestamp = rec[2]
+        if not self._filter.allow(ev):
+            from kubernetes_tpu.metrics import client_events_discarded_total
+
+            client_events_discarded_total.inc(
+                source=ev.source_component, reason=ev.reason
+            )
+            return None
+        return ev
+
+
+class _CorrelatingSink:
+    """Sink adapter running every event through an EventCorrelator
+    before delivery — the recordToSink pipeline shape. Exposes
+    record_many so the broadcaster's batch path stays bulk-capable."""
+
+    def __init__(self, sink: "EventSink", correlator: EventCorrelator):
+        self.sink = sink
+        self.correlator = correlator
+
+    def record(self, ev: t.Event) -> None:
+        out = self.correlator.correlate(ev)
+        if out is not None:
+            self.sink.record(out)
+
+    def record_many(self, evs) -> None:
+        out = [e for e in map(self.correlator.correlate, evs) if e is not None]
+        if not out:
+            return
+        many = getattr(self.sink, "record_many", None)
+        if many is not None:
+            many(out)
+        else:
+            for e in out:
+                self.sink.record(e)
 
 
 _event_seq = itertools.count()
@@ -229,18 +385,22 @@ class EventSink:
             prior = self._seen.get(key)
             if prior is not None:
                 name, count = prior
+                # an upstream EventCorrelator may carry a HIGHER count
+                # (this cache evicted mid-storm); never step backwards
+                new_count = max(count + 1, ev.count or 1)
                 try:
                     events.patch(
                         name,
-                        {"count": count + 1, "lastTimestamp": ev.last_timestamp},
+                        {"count": new_count,
+                         "lastTimestamp": ev.last_timestamp},
                     )
-                    self._remember(key, (name, count + 1))
+                    self._remember(key, (name, new_count))
                     return
                 except APIStatusError:
                     pass  # fall through to create
             try:
                 events.create(ev)
-                self._remember(key, (ev.metadata.name, 1))
+                self._remember(key, (ev.metadata.name, ev.count or 1))
             except APIStatusError:
                 log.debug("event create failed", exc_info=True)
 
@@ -276,14 +436,17 @@ class EventSink:
                 prior = self._seen.get(key)
                 if prior is not None:
                     name, count = prior
+                    # same never-backwards rule as record(): a
+                    # correlated event's count wins when higher
+                    new_count = max(count + 1, ev.count or 1)
                     try:
                         self.client.resource(
                             "events", ev.metadata.namespace
                         ).patch(name, {
-                            "count": count + 1,
+                            "count": new_count,
                             "lastTimestamp": ev.last_timestamp,
                         })
-                        self._remember(key, (name, count + 1))
+                        self._remember(key, (name, new_count))
                         continue
                     except APIStatusError:
                         pass  # fall through to create

@@ -250,7 +250,10 @@ class QueueWorker:
 
     def __init__(self, name: str, sync_fn: Callable[[str], None], workers: int = 1):
         self.name = name
-        self.queue = RateLimitingQueue()
+        # the queue carries the controller's name so its depth/latency
+        # shows up per-loop at /metrics (workqueue_* families) — the
+        # "which control loop is falling behind" signal
+        self.queue = RateLimitingQueue(name=name)
         self._sync = sync_fn
         self._workers = workers
         self._threads: List[threading.Thread] = []

@@ -153,6 +153,24 @@ class ControllerManager:
                 )
             )
 
+    def serve_observability(self, host: str = "127.0.0.1",
+                            port: int = 0) -> int:
+        """Serve the daemon mux (/healthz /metrics /configz
+        /debug/traces /debug/audit) for this controller manager — the
+        reference's :10252 surface. Every controller's named workqueue
+        renders its depth/latency families here. Returns the bound
+        port."""
+        from kubernetes_tpu.trace.httpd import start_component_server
+
+        self._obs_server, bound = start_component_server(
+            host, port,
+            # healthy while it has not LOST a lease: a standby that never
+            # led is still a healthy process (crash-restart HA)
+            healthz=lambda: not getattr(self, "lost_lease", False),
+            name="controller-manager",
+        )
+        return bound
+
     def start(self) -> "ControllerManager":
         self._lifecycle_lock = threading.Lock()
         self._stopped = False
@@ -224,3 +242,9 @@ class ControllerManager:
             except Exception:
                 pass
         self.informers.stop()
+        self._broadcaster.shutdown()
+        obs = getattr(self, "_obs_server", None)
+        if obs is not None:
+            obs.shutdown()
+            obs.server_close()  # release the listening socket too
+            self._obs_server = None

@@ -1,9 +1,10 @@
 """kubectl verbs (pkg/kubectl/cmd/*.go).
 
-Supported: get, describe, create -f, apply -f, delete, scale, label,
-annotate, cordon, uncordon, drain, run, expose, rollout-status, logs,
-exec, attach, port-forward, patch, edit, rolling-update, proxy, top,
-autoscale, explain, convert, config, version.
+Supported: get (incl. --watch streaming), describe, create -f, apply -f,
+delete, scale, label, annotate, cordon, uncordon, drain, run, expose,
+rollout-status, logs, exec, attach, port-forward, patch, edit,
+rolling-update, proxy, top, audit tail, autoscale, explain, convert,
+config, version.
 Resource name aliases follow kubectl shortcuts (po, no, svc, rc, rs,
 deploy, ds, ns, ev, hpa...)."""
 
@@ -16,10 +17,28 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from kubernetes_tpu.api import types as t
-from kubernetes_tpu.client.rest import APIStatusError, RESTClient
+from kubernetes_tpu.client.rest import APIStatusError, RESTClient, WatchExpired
 from kubernetes_tpu.client.transport import HTTPTransport
 from kubernetes_tpu.kubectl.printers import print_table
 from kubernetes_tpu.runtime.scheme import scheme
+
+
+def _fmt_num(v) -> str:
+    """Numeric cell or <unknown> — summary fields may be absent when a
+    node runs a stats-less runtime."""
+    if v is None:
+        return "<unknown>"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def _tabulate(rows: List[List[str]]) -> str:
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows
+    )
 
 ALIASES = {
     "po": "pods", "pod": "pods",
@@ -149,6 +168,75 @@ class Kubectl:
                 sort_keys=True,
             )
         return print_table(resource, objs, namespace_col=all_namespaces)
+
+    def get_watch(
+        self,
+        resource: str,
+        name: str = "",
+        selector: str = "",
+        all_namespaces: bool = False,
+        max_events: int = 0,
+        out=None,
+    ) -> str:
+        """kubectl get --watch (resource_printer streaming): print the
+        current rows, then one row per watch event as it arrives, until
+        the stream closes (or `max_events` streamed rows for bounded
+        runs — tests and scripts). A `name` narrows the stream to that
+        object (the metadata.name field selector, like the reference's
+        single-object watch). Returns everything emitted."""
+        from kubernetes_tpu.kubectl.printers import TABLES, _generic_row
+
+        resource = resolve(resource)
+        rc = self._rc(resource, all_namespaces)
+        field_selector = f"metadata.name={name}" if name else ""
+        headers, row_fn = TABLES.get(resource, (["NAME", "AGE"], _generic_row))
+        lines: List[str] = []
+
+        def emit(cells):
+            line = "   ".join(str(c) for c in cells).rstrip()
+            lines.append(line)
+            if out is not None:
+                out(line)
+            else:
+                print(line, flush=True)
+
+        emit(headers)
+        objs, rv = rc.list(
+            label_selector=selector, field_selector=field_selector
+        )
+        objs.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        for o in objs:
+            emit(row_fn(o))
+        streamed = 0
+        while max_events <= 0 or streamed < max_events:
+            try:
+                stream = rc.watch(
+                    resource_version=rv, label_selector=selector,
+                    field_selector=field_selector,
+                )
+                for ev_type, obj in stream:
+                    if ev_type == "DELETED":
+                        emit([f"{resource}/{obj.metadata.name}", "deleted"])
+                    else:
+                        emit(row_fn(obj))
+                    if obj.metadata.resource_version:
+                        rv = obj.metadata.resource_version
+                    streamed += 1
+                    if max_events > 0 and streamed >= max_events:
+                        break
+                else:
+                    # server closed the stream without hitting the cap:
+                    # a bounded run keeps re-watching, an unbounded one
+                    # is done (kubectl -w exits when the stream ends)
+                    if max_events > 0:
+                        continue
+                    break
+                break
+            except WatchExpired:
+                objs, rv = rc.list(
+                    label_selector=selector, field_selector=field_selector
+                )
+        return "\n".join(lines)
 
     def describe(self, resource: str, name: str) -> str:
         resource = resolve(resource)
@@ -1040,30 +1128,84 @@ class Kubectl:
             except OSError:
                 continue
         if what == "nodes":
-            rows = [["NAME", "MEMORY(bytes available)", "PODS"]]
+            rows = [["NAME", "CPU(s)", "MEMORY(bytes)",
+                     "MEMORY(available)", "DEVICES", "PODS"]]
             for name in sorted(stats):
-                s = stats[name]
-                mem = s.get("node", {}).get("memory", {}).get("availableBytes")
+                node = stats[name].get("node", {})
+                mem = node.get("memory", {})
+                avail = mem.get("availableBytes")
                 rows.append([
                     name,
-                    "<unknown>" if mem is None else str(mem),
-                    str(len(s.get("pods", []))),
+                    _fmt_num(node.get("cpu", {}).get("usageCoreSeconds")),
+                    _fmt_num(mem.get("workingSetBytes")),
+                    "<unknown>" if avail is None else str(avail),
+                    _fmt_num(node.get("devices", {}).get("requested")),
+                    str(len(stats[name].get("pods", []))),
                 ])
         elif what == "pods":
-            rows = [["NAMESPACE", "NAME", "NODE"]]
+            rows = [["NAMESPACE", "NAME", "NODE", "CPU(s)",
+                     "MEMORY(bytes)", "DEVICES"]]
             for name in sorted(stats):
                 for p in stats[name].get("pods", []):
                     ref = p.get("podRef", {})
-                    rows.append([ref.get("namespace", ""),
-                                 ref.get("name", ""), name])
+                    rows.append([
+                        ref.get("namespace", ""),
+                        ref.get("name", ""),
+                        name,
+                        _fmt_num(p.get("cpu", {}).get("usageCoreSeconds")),
+                        _fmt_num(p.get("memory", {}).get("rssBytes")),
+                        _fmt_num(p.get("devices", {}).get("requested")),
+                    ])
             rows[1:] = sorted(rows[1:])
         else:
             raise ValueError(f"top supports nodes|pods, not {what!r}")
-        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
-        return "\n".join(
-            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
-            for r in rows
+        return _tabulate(rows)
+
+    def audit_tail(self, limit: int = 20, output: str = "",
+                   user: str = "", verb: str = "",
+                   resource: str = "") -> str:
+        """kubectl audit tail: the newest apiserver audit events from
+        /debug/audit — who did what, the response code, and the request
+        latency. Filters mirror the endpoint's (?user/?verb/?resource)."""
+        query = {"limit": str(max(1, limit))}
+        if user:
+            query["user"] = user
+        if verb:
+            query["verb"] = verb
+        if resource:
+            query["resource"] = resource
+        code, payload = self.client.transport.request(
+            "GET", "/debug/audit", query, None
         )
+        if code != 200:
+            raise APIStatusError(
+                code, payload if isinstance(payload, dict) else {}
+            )
+        items = payload.get("items", [])
+        if output == "json":
+            return json.dumps(items, indent=2, sort_keys=True)
+        rows = [["TIME", "LEVEL", "USER", "VERB", "RESOURCE",
+                 "NAMESPACE/NAME", "CODE", "LATENCY", "REQUEST-ID"]]
+        # the ring is newest-first; a tail reads oldest-first like a log
+        for e in reversed(items):
+            ts = e.get("timestamp")
+            when = (
+                time.strftime("%H:%M:%S", time.localtime(ts))
+                if isinstance(ts, (int, float)) else ""
+            )
+            ns, nm = e.get("namespace", ""), e.get("name", "")
+            rows.append([
+                when,
+                e.get("level", ""),
+                e.get("user", ""),
+                e.get("verb", ""),
+                e.get("resource", "") or e.get("path", ""),
+                f"{ns}/{nm}" if (ns or nm) else "",
+                str(e.get("code", "")),
+                f"{e.get('latencySeconds', 0) * 1e3:.1f}ms",
+                e.get("requestID", ""),
+            ])
+        return _tabulate(rows)
 
     def autoscale(self, resource: str, name: str, min_replicas: int,
                   max_replicas: int, cpu_percent: int = 80) -> str:
@@ -1360,6 +1502,11 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p.add_argument("--selector", "-l", default="")
     p.add_argument("--output", "-o", default="")
     p.add_argument("--all-namespaces", action="store_true")
+    p.add_argument("--watch", "-w", action="store_true",
+                   help="stream rows as watch events arrive")
+    p.add_argument("--watch-max", type=int, default=0,
+                   help="stop after N streamed rows (0 = until the "
+                        "stream closes)")
 
     p = sub.add_parser("describe")
     p.add_argument("resource")
@@ -1470,6 +1617,15 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p = sub.add_parser("top")
     p.add_argument("what", choices=["node", "nodes", "pod", "pods"])
 
+    p = sub.add_parser("audit")
+    p.add_argument("subverb", choices=["tail"])
+    p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--user", default="")
+    # dest renamed: the subcommand itself already owns args.verb
+    p.add_argument("--verb", dest="verb_filter", default="")
+    p.add_argument("--resource", default="")
+
     p = sub.add_parser("autoscale")
     p.add_argument("target")  # resource/name
     p.add_argument("--min", type=int, required=True)
@@ -1520,6 +1676,16 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     )
 
     if args.verb == "get":
+        if args.watch:
+            if args.output:
+                parser.error("--watch supports only the default table output")
+            # rows were already streamed to stdout; return them for
+            # callers driving main() programmatically
+            return k.get_watch(
+                args.resource, name=args.name, selector=args.selector,
+                all_namespaces=args.all_namespaces,
+                max_events=args.watch_max,
+            )
         out = k.get(args.resource, args.name, args.selector, args.output,
                     args.all_namespaces)
     elif args.verb == "describe":
@@ -1661,6 +1827,11 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
         return out
     elif args.verb == "top":
         out = k.top(args.what)
+    elif args.verb == "audit":
+        out = k.audit_tail(
+            limit=args.limit, output=args.output, user=args.user,
+            verb=args.verb_filter, resource=args.resource,
+        )
     elif args.verb == "autoscale":
         resource, name = args.target.split("/", 1)
         out = k.autoscale(resource, name, args.min, args.max,

@@ -56,6 +56,81 @@ def _relay(a: socket.socket, b: socket.socket) -> None:
         pass
 
 
+def build_summary(kl) -> dict:
+    """The /stats/summary payload (kubelet Summary API,
+    stats/summary.go): per-node and per-pod cpu/memory/device usage.
+
+    cpu/memory come from the container runtime's /proc sampling when it
+    runs real processes (ProcessRuntime.pod_stats); device usage is the
+    pod's requested accelerator count (the devices are logical slots
+    here, so requested == held while the pod runs). Consumers: `kubectl
+    top nodes|pods` and the heterogeneity-aware scoring work
+    (PAPERS.md: per-node accounting)."""
+    import os as _os
+
+    from kubernetes_tpu.api.resource import resource_list_gpu
+
+    clk = 100.0
+    try:
+        clk = float(_os.sysconf("SC_CLK_TCK")) or 100.0
+    except (ValueError, OSError, AttributeError):
+        pass
+    mem_avail = None
+    if kl.eviction_manager is not None:
+        mem_avail = kl.eviction_manager.memory_available()
+    with kl._lock:
+        pods = list(kl._pods.values())
+    pod_stats = getattr(kl.runtime, "pod_stats", None)
+    node_cpu_seconds = 0.0
+    node_rss = 0
+    node_devices = 0
+    out_pods = []
+    for p in pods:
+        containers = []
+        pod_cpu = 0.0
+        pod_rss = 0
+        stats = pod_stats(p.metadata.uid) if pod_stats is not None else {}
+        for cname, cs in sorted(stats.items()):
+            cpu_s = cs.get("cpu_jiffies", 0) / clk
+            rss = cs.get("memory_rss_bytes", 0)
+            pod_cpu += cpu_s
+            pod_rss += rss
+            containers.append({
+                "name": cname,
+                "cpu": {"usageCoreSeconds": round(cpu_s, 3)},
+                "memory": {"rssBytes": rss},
+            })
+        devices = sum(
+            resource_list_gpu(c.requests) for c in p.spec.containers
+        )
+        node_cpu_seconds += pod_cpu
+        node_rss += pod_rss
+        node_devices += devices
+        out_pods.append({
+            "podRef": {
+                "namespace": p.metadata.namespace,
+                "name": p.metadata.name,
+                "uid": p.metadata.uid,
+            },
+            "cpu": {"usageCoreSeconds": round(pod_cpu, 3)},
+            "memory": {"rssBytes": pod_rss},
+            "devices": {"requested": devices},
+            "containers": containers,
+        })
+    return {
+        "node": {
+            "nodeName": kl.config.node_name,
+            "cpu": {"usageCoreSeconds": round(node_cpu_seconds, 3)},
+            "memory": {
+                "availableBytes": mem_avail,
+                "workingSetBytes": node_rss,
+            },
+            "devices": {"requested": node_devices},
+        },
+        "pods": out_pods,
+    }
+
+
 class KubeletServer:
     def __init__(self, kubelet):
         self.kubelet = kubelet
@@ -145,6 +220,15 @@ class KubeletServer:
                     }
                     self._send(200, render_traces(q))
                     return
+                if parts == ["debug", "audit"]:
+                    from kubernetes_tpu.audit import render_audit
+
+                    q = {
+                        k: v[0]
+                        for k, v in parse_qs(parsed.query).items() if v
+                    }
+                    self._send(200, render_audit(q))
+                    return
                 if parts == ["pods"]:
                     from kubernetes_tpu.runtime import scheme
 
@@ -195,24 +279,7 @@ class KubeletServer:
                         pass  # client hung up: detach
                     return
                 if parts == ["stats", "summary"]:
-                    # cadvisor-lite: node memory availability (the signal
-                    # the eviction manager consumes) + per-pod presence
-                    mem_avail = None
-                    if kl.eviction_manager is not None:
-                        mem_avail = kl.eviction_manager.memory_available()
-                    with kl._lock:
-                        pods = list(kl._pods.values())
-                    self._send(200, {
-                        "node": {
-                            "nodeName": kl.config.node_name,
-                            "memory": {"availableBytes": mem_avail},
-                        },
-                        "pods": [
-                            {"podRef": {"namespace": p.metadata.namespace,
-                                        "name": p.metadata.name}}
-                            for p in pods
-                        ],
-                    })
+                    self._send(200, build_summary(kl))
                     return
                 self._send(404, {"message": f"unknown path {parsed.path}"})
 

@@ -3,16 +3,25 @@
 A minimal counter/gauge/histogram registry rendered in the Prometheus
 text exposition format at /metrics. Histogram bucket layout matches the
 scheduler's exponential 1ms -> ~16s buckets (metrics.go:31-54); the
-trace layer adds second-unit phase/compile histograms on top.
+trace layer adds second-unit phase/compile histograms on top, and the
+control-loop layer adds workqueue/reflector/informer families plus the
+audit event counter.
 """
 
 from kubernetes_tpu.metrics.metrics import (
     Counter,
     Gauge,
+    GaugeVec,
     Histogram,
     HistogramVec,
     Registry,
+    apiserver_audit_event_total,
     apiserver_request_latency,
+    client_events_discarded_total,
+    informer_sync_duration_seconds,
+    reflector_list_duration_seconds,
+    reflector_lists_total,
+    reflector_watch_duration_seconds,
     registry,
     scheduler_binding_latency,
     scheduler_algorithm_latency,
@@ -20,20 +29,39 @@ from kubernetes_tpu.metrics.metrics import (
     scheduler_slo_breach_total,
     scheduler_wave_phase_seconds,
     scheduler_xla_compile_seconds,
+    watch_events_total,
+    workqueue_adds_total,
+    workqueue_depth,
+    workqueue_queue_duration_seconds,
+    workqueue_retries_total,
+    workqueue_work_duration_seconds,
 )
 
 __all__ = [
     "Counter",
     "Gauge",
+    "GaugeVec",
     "Histogram",
     "HistogramVec",
     "Registry",
     "registry",
+    "apiserver_audit_event_total",
     "apiserver_request_latency",
+    "client_events_discarded_total",
+    "informer_sync_duration_seconds",
+    "reflector_list_duration_seconds",
+    "reflector_lists_total",
+    "reflector_watch_duration_seconds",
     "scheduler_e2e_latency",
     "scheduler_algorithm_latency",
     "scheduler_binding_latency",
     "scheduler_slo_breach_total",
     "scheduler_wave_phase_seconds",
     "scheduler_xla_compile_seconds",
+    "watch_events_total",
+    "workqueue_adds_total",
+    "workqueue_depth",
+    "workqueue_queue_duration_seconds",
+    "workqueue_retries_total",
+    "workqueue_work_duration_seconds",
 ]

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
@@ -43,6 +43,18 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def child(self, **labels: str) -> "Callable[..., None]":
+        """A bound fast-path incrementer with the label key pre-built —
+        per-event hot paths (workqueue adds, watch events) pay one dict
+        update under the lock instead of a sort+tuple per call."""
+        key = tuple(sorted(labels.items()))
+
+        def inc(amount: float = 1.0) -> None:
+            with self._lock:
+                self._values[key] = self._values.get(key, 0.0) + amount
+
+        return inc
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -54,23 +66,80 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        const_labels: Optional[Dict[str, str]] = None,
+    ):
         super().__init__(name, help_)
         self._value = 0.0
+        self._const = ",".join(
+            f'{k}="{v}"' for k, v in sorted((const_labels or {}).items())
+        )
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = v
 
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
     def get(self) -> float:
         with self._lock:
             return self._value
 
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
-            f"{self.name} {self._value}"
+    def render(self, header: bool = True) -> str:
+        suffix = f"{{{self._const}}}" if self._const else ""
+        lines = (
+            [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+            if header else []
         )
+        lines.append(f"{self.name}{suffix} {self._value}")
+        return "\n".join(lines)
+
+
+class GaugeVec(_Metric):
+    """A gauge family keyed by one label (prometheus GaugeVec with a
+    single-label schema — the per-queue depth case, where the label is
+    the workqueue name)."""
+
+    def __init__(self, name: str, help_: str = "", label: str = "name"):
+        super().__init__(name, help_)
+        self.label = label
+        self._children: Dict[str, Gauge] = {}
+
+    def labels(self, value: str) -> Gauge:
+        child = self._children.get(value)
+        if child is None:
+            with self._lock:
+                child = self._children.get(value)
+                if child is None:
+                    child = Gauge(
+                        self.name, self.help,
+                        const_labels={self.label: value},
+                    )
+                    self._children[value] = child
+        return child
+
+    def values(self) -> Dict[str, float]:
+        with self._lock:
+            children = dict(self._children)
+        return {v: g.get() for v, g in children.items()}
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for _, child in children:
+            lines.append(child.render(header=False))
+        return "\n".join(lines)
 
 
 class Histogram(_Metric):
@@ -207,8 +276,18 @@ class Registry:
 
     def register(self, m: _Metric) -> _Metric:
         with self._lock:
+            if any(x.name == m.name for x in self._metrics):
+                # prometheus.MustRegister panics on a duplicate collector;
+                # a silent second registration would render the family
+                # twice and corrupt scrapes
+                raise ValueError(f"metric {m.name!r} already registered")
             self._metrics.append(m)
         return m
+
+    def metrics(self) -> List[_Metric]:
+        """Registered metric objects (the lint walk, test_metrics_lint)."""
+        with self._lock:
+            return list(self._metrics)
 
     def render(self) -> str:
         with self._lock:
@@ -283,5 +362,119 @@ apiserver_request_latency = registry.register(
         "apiserver_request_latencies_microseconds",
         "apiserver request latency in microseconds, labeled by verb",
         label="verb",
+    )
+)
+
+# -- audit subsystem (kubernetes_tpu/audit) -----------------------------------
+
+#: one increment per audit event emitted, labeled by policy level and
+#: request verb (apiserver/pkg/audit/metrics.go apiserver_audit_event_total)
+apiserver_audit_event_total = registry.register(
+    Counter(
+        "apiserver_audit_event_total",
+        "Audit events emitted by the apiserver, labeled by level and verb",
+    )
+)
+
+# -- control-loop metrics (utils/workqueue, client/cache) ---------------------
+
+#: current number of queued-but-unprocessed items per named workqueue
+#: (workqueue/metrics.go depth) — the controller-lag signal
+workqueue_depth = registry.register(
+    GaugeVec(
+        "workqueue_depth",
+        "Current depth of each named workqueue",
+        label="name",
+    )
+)
+
+#: total adds accepted per named workqueue (deduped re-adds excluded)
+workqueue_adds_total = registry.register(
+    Counter(
+        "workqueue_adds_total",
+        "Total adds handled by each named workqueue",
+    )
+)
+
+#: seconds an item sat queued before a worker picked it up
+workqueue_queue_duration_seconds = registry.register(
+    HistogramVec(
+        "workqueue_queue_duration_seconds",
+        "Seconds an item waits in a named workqueue before processing",
+        label="name",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: seconds a worker spent processing one item (get -> done)
+workqueue_work_duration_seconds = registry.register(
+    HistogramVec(
+        "workqueue_work_duration_seconds",
+        "Seconds spent processing one item from a named workqueue",
+        label="name",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: rate-limited requeues per named workqueue (sync errors retrying)
+workqueue_retries_total = registry.register(
+    Counter(
+        "workqueue_retries_total",
+        "Total rate-limited requeues per named workqueue",
+    )
+)
+
+#: reflector relists (the initial list plus every resync/recovery list)
+reflector_lists_total = registry.register(
+    Counter(
+        "reflector_lists_total",
+        "Total list operations performed by each named reflector",
+    )
+)
+
+#: wall seconds of one reflector list call (fetch + store replace)
+reflector_list_duration_seconds = registry.register(
+    HistogramVec(
+        "reflector_list_duration_seconds",
+        "Seconds per reflector list operation, labeled by reflector",
+        label="name",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: lifetime of one watch session (established -> closed/expired)
+reflector_watch_duration_seconds = registry.register(
+    HistogramVec(
+        "reflector_watch_duration_seconds",
+        "Seconds one reflector watch session stayed open",
+        label="name",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: watch events applied to local stores, labeled name + event type
+watch_events_total = registry.register(
+    Counter(
+        "watch_events_total",
+        "Watch events applied by reflectors, labeled by name and type",
+    )
+)
+
+#: seconds from informer start to the initial list fully applied
+informer_sync_duration_seconds = registry.register(
+    HistogramVec(
+        "informer_sync_duration_seconds",
+        "Seconds from informer start until the initial sync completed",
+        label="name",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: events dropped by the client-side spam filter (client/record.py
+#: EventCorrelator token bucket)
+client_events_discarded_total = registry.register(
+    Counter(
+        "client_events_discarded_total",
+        "Events discarded by the client event spam filter",
     )
 )

@@ -64,7 +64,9 @@ class ConfigFactory:
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.failure_domains = failure_domains or []
         self.scheduler_cache = SchedulerCache(ttl=cache_ttl).run()
-        self.pod_queue = FIFO()
+        # named: the pod backlog renders as workqueue_depth{name=
+        # "scheduler-pods"} beside the controller queues at /metrics
+        self.pod_queue = FIFO(name="scheduler-pods")
         self.pod_backoff = Backoff(initial=1.0, max_duration=60.0)
         self._stopped = False
         self._components: list = []

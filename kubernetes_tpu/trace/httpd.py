@@ -4,8 +4,8 @@ The reference scheduler runs its own :10251 mux serving /healthz and
 prometheus /metrics (plugin/cmd/kube-scheduler/app/server.go:92-108);
 in this framework only the apiserver's shared mux rendered the registry
 until now. This module is that per-daemon mux: a tiny threaded HTTP
-server any component can hang its /healthz, /metrics, /configz, and
-/debug/traces?limit=N endpoints on. The scheduler daemon serves it by
+server any component can hang its /healthz, /metrics, /configz,
+/debug/traces?limit=N, and /debug/audit endpoints on. The scheduler daemon serves it by
 default (scheduler/server.py); the kubelet reuses render_traces() on
 its existing node-API server.
 """
@@ -102,6 +102,11 @@ def start_component_server(
                     return
                 if path == "/debug/traces":
                     self._send(200, render_traces(query))
+                    return
+                if path == "/debug/audit":
+                    from kubernetes_tpu.audit import render_audit
+
+                    self._send(200, render_audit(query))
                     return
                 self._send(404, {"message": f"unknown path {parsed.path}"})
             except Exception as e:  # a broken probe must not kill the mux

@@ -16,8 +16,9 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Hashable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from kubernetes_tpu.utils.clock import Clock, DEFAULT_CLOCK
 from kubernetes_tpu.utils.flowcontrol import Backoff
@@ -28,15 +29,41 @@ class ShutDown(Exception):
 
 
 class WorkQueue:
-    """FIFO of unique items with in-flight tracking."""
+    """FIFO of unique items with in-flight tracking.
 
-    def __init__(self):
+    A non-empty `name` opts the queue into the workqueue metric family
+    (workqueue/metrics.go): per-queue depth, adds, queue-wait and
+    work-duration — the controller-lag signals. Unnamed queues carry
+    zero metric overhead (the scheduler-internal scratch queues)."""
+
+    def __init__(self, name: str = ""):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[Hashable] = []
         self._dirty: set = set()
         self._processing: set = set()
         self._shutting_down = False
+        self.name = name
+        self._metrics = None
+        if name:
+            from kubernetes_tpu import metrics as _m
+
+            self._metrics = (
+                _m.workqueue_depth.labels(name),
+                _m.workqueue_adds_total.child(name=name),
+                _m.workqueue_queue_duration_seconds.labels(name),
+                _m.workqueue_work_duration_seconds.labels(name),
+            )
+            self._added_at: Dict[Hashable, float] = {}
+            self._started_at: Dict[Hashable, float] = {}
+
+    # metric helpers — called with self._cond held
+    def _note_queued(self, item: Hashable) -> None:
+        if self._metrics is not None:
+            depth, adds, _qd, _wd = self._metrics
+            adds()
+            self._added_at.setdefault(item, _time.monotonic())
+            depth.set(len(self._queue))
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -45,6 +72,7 @@ class WorkQueue:
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
+                self._note_queued(item)
                 self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Hashable:
@@ -59,13 +87,24 @@ class WorkQueue:
             item = self._queue.pop(0)
             self._processing.add(item)
             self._dirty.discard(item)
+            if self._metrics is not None:
+                depth, _adds, queue_dur, _wd = self._metrics
+                now = _time.monotonic()
+                queue_dur.observe(now - self._added_at.pop(item, now))
+                self._started_at[item] = now
+                depth.set(len(self._queue))
             return item
 
     def done(self, item: Hashable) -> None:
         with self._cond:
             self._processing.discard(item)
+            if self._metrics is not None:
+                _depth, _adds, _qd, work_dur = self._metrics
+                now = _time.monotonic()
+                work_dur.observe(now - self._started_at.pop(item, now))
             if item in self._dirty:
                 self._queue.append(item)
+                self._note_queued(item)
                 self._cond.notify()
 
     def shut_down(self) -> None:
@@ -80,12 +119,19 @@ class WorkQueue:
 
 class DelayingQueue(WorkQueue):
     """WorkQueue + add_after(item, delay). A waiter thread moves items
-    from a heap into the queue when their time comes."""
+    from a heap into the queue when their time comes.
 
-    def __init__(self, clock: Optional[Clock] = None):
-        super().__init__()
+    Re-adding an item already waiting keeps the EARLIEST ready time
+    (delaying_queue.go insert: "if the item already exists, only change
+    the time if it would cause the item to be delivered earlier") — a
+    controller that re-enqueues with a long backoff must not push out an
+    imminent retry. Stale heap entries are invalidated lazily."""
+
+    def __init__(self, clock: Optional[Clock] = None, name: str = ""):
+        super().__init__(name=name)
         self._clock = clock or DEFAULT_CLOCK
         self._heap: List[Tuple[float, int, Hashable]] = []
+        self._waiting: Dict[Hashable, float] = {}  # item -> ready time
         self._seq = 0
         self._heap_cond = threading.Condition()
         self._waiter = threading.Thread(target=self._wait_loop, daemon=True)
@@ -93,12 +139,25 @@ class DelayingQueue(WorkQueue):
 
     def add_after(self, item: Hashable, delay: float) -> None:
         if delay <= 0:
+            with self._heap_cond:
+                # an immediate add supersedes any pending delayed entry
+                self._waiting.pop(item, None)
             self.add(item)
             return
         with self._heap_cond:
-            heapq.heappush(self._heap, (self._clock.now() + delay, self._seq, item))
+            ready_at = self._clock.now() + delay
+            current = self._waiting.get(item)
+            if current is not None and current <= ready_at:
+                return  # already due sooner: keep the earlier deadline
+            self._waiting[item] = ready_at
+            heapq.heappush(self._heap, (ready_at, self._seq, item))
             self._seq += 1
             self._heap_cond.notify()
+
+    def waiting(self) -> int:
+        """Number of distinct items still delayed (test/introspection)."""
+        with self._heap_cond:
+            return len(self._waiting)
 
     def _wait_loop(self) -> None:
         while True:
@@ -113,7 +172,10 @@ class DelayingQueue(WorkQueue):
                 if ready_at > now:
                     self._heap_cond.wait(timeout=min(ready_at - now, 0.5))
                     continue
-                _, _, item = heapq.heappop(self._heap)
+                ts, _, item = heapq.heappop(self._heap)
+                if self._waiting.get(item) != ts:
+                    continue  # superseded by an earlier re-add or add()
+                del self._waiting[item]
             self.add(item)
 
     def shut_down(self) -> None:
@@ -131,8 +193,9 @@ class RateLimitingQueue(DelayingQueue):
         base_delay: float = 0.005,
         max_delay: float = 1000.0,
         clock: Optional[Clock] = None,
+        name: str = "",
     ):
-        super().__init__(clock=clock)
+        super().__init__(clock=clock, name=name)
         self._backoff = Backoff(base_delay, max_delay, clock=clock)
         self._requeues: dict = {}
         self._requeue_lock = threading.Lock()
@@ -140,6 +203,10 @@ class RateLimitingQueue(DelayingQueue):
     def add_rate_limited(self, item: Hashable) -> None:
         with self._requeue_lock:
             self._requeues[item] = self._requeues.get(item, 0) + 1
+        if self.name:
+            from kubernetes_tpu.metrics import workqueue_retries_total
+
+            workqueue_retries_total.inc(name=self.name)
         self.add_after(item, self._backoff.next_(str(item)))
 
     def num_requeues(self, item: Hashable) -> int:

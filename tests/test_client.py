@@ -215,6 +215,141 @@ class TestEvents:
         rec.eventf(pod("p"), "Warning", "FailedScheduling", "no fit: %s", "cpu")
         assert rec.events == ["Warning FailedScheduling no fit: cpu"]
 
+    def test_broadcaster_shutdown_is_idempotent(self):
+        bcast = EventBroadcaster()
+        seen = []
+        bcast._add(seen.append)
+        rec = bcast.new_recorder("c")
+        rec.event(pod("p"), "Normal", "R", "m")
+        bcast.shutdown()
+        # a second (and third) shutdown must return immediately instead
+        # of enqueueing sentinels nobody drains
+        t0 = time.time()
+        bcast.shutdown()
+        bcast.shutdown()
+        assert time.time() - t0 < 1.0
+        assert len(seen) == 1
+        # post-shutdown records are dropped, not resurrected
+        rec.event(pod("p"), "Normal", "R", "m2")
+        assert len(seen) == 1
+
+    def test_pending_queue_is_bounded_with_dead_sink(self):
+        # a sink that never drains must not let the pending queue grow
+        # without bound: the broadcaster drops (DropIfChannelFull), so
+        # memory stays capped at QUEUE_LEN
+        bcast = EventBroadcaster()
+        blocker = threading.Event()
+
+        def stuck_sink(ev):
+            blocker.wait(30.0)
+
+        bcast._add(stuck_sink)
+        rec = bcast.new_recorder("c")
+        for i in range(EventBroadcaster.QUEUE_LEN * 3):
+            rec.event(pod(f"p{i}"), "Normal", "R", "m")
+        assert bcast._queue.qsize() <= EventBroadcaster.QUEUE_LEN
+        blocker.set()
+        bcast.shutdown()
+
+    def test_correlator_aggregates_identical_events(self):
+        from kubernetes_tpu.client.record import EventCorrelator
+
+        corr = EventCorrelator()
+        rec_pod = pod("p")
+
+        def ev(msg="same"):
+            from kubernetes_tpu.client.record import (
+                _now_iso,
+                object_reference,
+            )
+
+            return t.Event(
+                metadata=t.ObjectMeta(name="p.1", namespace="default"),
+                involved_object=object_reference(rec_pod),
+                reason="Scheduled",
+                message=msg,
+                source_component="scheduler",
+                first_timestamp=_now_iso(),
+                last_timestamp=_now_iso(),
+                count=1,
+                type="Normal",
+            )
+
+        first = corr.correlate(ev())
+        assert first is not None and first.count == 1
+        for i in range(2, 6):
+            dup = corr.correlate(ev())
+            assert dup is not None
+            assert dup.count == i
+            # every duplicate aggregates onto the FIRST event's name —
+            # one store object, not one per occurrence
+            assert dup.metadata.name == first.metadata.name
+            assert dup.first_timestamp == first.first_timestamp
+        # a different message is a different logical event
+        other = corr.correlate(ev("different"))
+        assert other.count == 1
+
+    def test_spam_filter_token_refill(self):
+        from kubernetes_tpu.client.record import EventSpamFilter
+
+        clock = [0.0]
+        f = EventSpamFilter(burst=3, qps=0.5, clock=lambda: clock[0])
+        ev = t.Event(
+            metadata=t.ObjectMeta(name="e", namespace="default"),
+            involved_object=t.ObjectReference(
+                kind="Pod", namespace="default", name="p"
+            ),
+            reason="R", message="m", source_component="watchdog",
+            first_timestamp="t", last_timestamp="t", count=1,
+            type="Warning",
+        )
+        assert all(f.allow(ev) for _ in range(3))  # burst
+        assert not f.allow(ev)  # bucket dry
+        clock[0] = 2.0  # 2s * 0.5 qps = 1 token back
+        assert f.allow(ev)
+        assert not f.allow(ev)
+        # an unrelated source+object has its own bucket
+        other = t.Event(
+            metadata=t.ObjectMeta(name="e2", namespace="default"),
+            involved_object=t.ObjectReference(
+                kind="Pod", namespace="default", name="q"
+            ),
+            reason="R", message="m", source_component="watchdog",
+            first_timestamp="t", last_timestamp="t", count=1,
+            type="Warning",
+        )
+        assert f.allow(other)
+
+    def test_correlated_sink_drops_storm_before_store(self):
+        # an event storm on ONE object passes the first `burst` events
+        # then sheds the rest client-side: the store sees one aggregated
+        # object, and the API is not flooded
+        from kubernetes_tpu.client.record import (
+            EventCorrelator,
+            EventSpamFilter,
+        )
+
+        server, c = make_client()
+        bcast = EventBroadcaster()
+        corr = EventCorrelator(
+            spam_filter=EventSpamFilter(burst=5, qps=0.0)
+        )
+        bcast.start_recording_to_sink(EventSink(c), correlator=corr)
+        rec = bcast.new_recorder("slo-watchdog")
+        target = pod("hot")
+        for _ in range(50):
+            rec.event(target, "Warning", "SLOBreach", "p99 over budget")
+        deadline = time.time() + 5.0
+        events = []
+        while time.time() < deadline:
+            events, _ = c.events().list()
+            if len(events) == 1 and events[0].count >= 5:
+                break
+            time.sleep(0.01)
+        assert len(events) == 1  # single store object
+        assert events[0].count == 5  # burst passed, storm shed
+        bcast.shutdown()
+
 
 class TestLeaderElection:
     def test_single_winner_and_failover(self):

@@ -344,8 +344,11 @@ def test_daemonset_one_pod_per_node(plane):
         lambda: sorted(p.spec.node_name for p in pods_of(client))
         == ["n1", "n2", "n3"]
     )
-    status = client.resource("daemonsets", "default").get("agent").status
-    assert status.desired_number_scheduled == 3
+    # status lands in a follow-up sync after the n3 pod create: poll
+    assert wait_until(
+        lambda: client.resource("daemonsets", "default")
+        .get("agent").status.desired_number_scheduled == 3
+    )
 
 
 # --- GC + namespace ----------------------------------------------------------

@@ -1,0 +1,96 @@
+"""Static lint over the metrics registry: every registered metric must
+carry HELP text, a snake_case name with the conventional type/unit
+suffix, and no name may be registered twice. Keeps the /metrics surface
+scrapeable and greppable as it grows (prometheus naming conventions;
+the reference gates metrics the same way in its metrics linter)."""
+
+import re
+
+import pytest
+
+from kubernetes_tpu.metrics.metrics import (
+    Counter,
+    Gauge,
+    GaugeVec,
+    Histogram,
+    HistogramVec,
+    Registry,
+    registry,
+)
+
+# importing the daemons pulls in any metrics they register lazily, so
+# the walk below sees the full production registry
+import kubernetes_tpu.trace  # noqa: F401
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_UNIT_SUFFIXES = ("_seconds", "_microseconds", "_milliseconds", "_bytes")
+
+
+def _registered():
+    ms = registry.metrics()
+    assert ms, "registry is empty - nothing imported the metric modules?"
+    return ms
+
+
+def test_every_metric_has_help_text():
+    for m in _registered():
+        assert m.help and m.help.strip(), (
+            f"metric {m.name!r} registered without HELP text"
+        )
+
+
+def test_names_are_snake_case():
+    for m in _registered():
+        assert _SNAKE.match(m.name), (
+            f"metric {m.name!r} is not snake_case"
+        )
+
+
+def test_counters_end_in_total():
+    for m in _registered():
+        if isinstance(m, Counter):
+            assert m.name.endswith("_total"), (
+                f"counter {m.name!r} must end in _total"
+            )
+
+
+def test_histograms_carry_a_unit_suffix():
+    for m in _registered():
+        if isinstance(m, (Histogram, HistogramVec)):
+            assert m.name.endswith(_UNIT_SUFFIXES), (
+                f"histogram {m.name!r} must end in one of "
+                f"{_UNIT_SUFFIXES}"
+            )
+
+
+def test_no_duplicate_registration():
+    names = [m.name for m in _registered()]
+    dupes = {n for n in names if names.count(n) > 1}
+    assert not dupes, f"duplicate metric registrations: {sorted(dupes)}"
+
+
+def test_registry_rejects_duplicate_register():
+    r = Registry()
+    r.register(Counter("probe_dup_total", "probe"))
+    with pytest.raises(ValueError):
+        r.register(Gauge("probe_dup_total", "same name, other type"))
+
+
+def test_gauges_lint_clean_too():
+    # gauges are exempt from the unit-suffix rule (depth is a count of
+    # items) but must still be snake_case with help
+    for m in _registered():
+        if isinstance(m, (Gauge, GaugeVec)):
+            assert _SNAKE.match(m.name) and m.help.strip()
+
+
+def test_rendered_exposition_parses():
+    # every line of the text exposition is either a comment or
+    # `name{labels} value` — a malformed render corrupts whole scrapes
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+(inf)?$"
+    )
+    for line in registry.render().splitlines():
+        if not line or line.startswith("# "):
+            continue
+        assert sample.match(line), f"unparseable exposition line: {line!r}"

@@ -85,6 +85,47 @@ class TestDelayingQueue:
         q.add_after("x", 0.05)
         assert q.get(timeout=2) == "x"
 
+    def test_readd_keeps_earliest_ready_time(self):
+        # delaying_queue.go insert: a re-add may only move the deadline
+        # EARLIER. The long re-add must not push out the imminent retry,
+        # and the item must be delivered exactly once.
+        clock = FakeClock()
+        q = DelayingQueue(clock=clock)
+        q.add_after("x", 10.0)
+        q.add_after("x", 0.05)  # earlier: supersedes
+        q.add_after("x", 60.0)  # later: ignored
+        assert q.waiting() == 1
+        clock.step(0.2)
+        assert q.get(timeout=2) == "x"
+        q.done("x")
+        assert q.waiting() == 0
+        assert len(q) == 0  # exactly once: no second delivery pending
+        clock.step(120.0)
+        with pytest.raises(TimeoutError):
+            q.get(timeout=0.3)
+
+    def test_delayed_items_keep_ready_order(self):
+        # two items with different deadlines come out in deadline order,
+        # even when added in reverse
+        clock = FakeClock()
+        q = DelayingQueue(clock=clock)
+        q.add_after("late", 5.0)
+        q.add_after("early", 1.0)
+        clock.step(10.0)
+        first = q.get(timeout=2)
+        second = q.get(timeout=2)
+        assert (first, second) == ("early", "late")
+
+    def test_immediate_add_supersedes_delayed(self):
+        # Add() bypasses the delay; when the stale deadline fires the
+        # dirty-set dedup keeps the item single
+        clock = FakeClock()
+        q = DelayingQueue(clock=clock)
+        q.add_after("x", 30.0)
+        q.add_after("x", 0)  # immediate
+        assert q.get(timeout=1) == "x"
+        assert q.waiting() == 0
+
 
 class TestRateLimitingQueue:
     def test_backoff_growth_and_forget(self):
