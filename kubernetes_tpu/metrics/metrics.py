@@ -177,6 +177,15 @@ class Histogram(_Metric):
         with self._lock:
             return self._count
 
+    def reset(self) -> None:
+        """Zero the distribution (bench/test harness seam — keeps the
+        field set in one place so observe()/percentile() refactors
+        can't desynchronize external resets)."""
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
     def percentile(self, q: float) -> float:
         """Approximate q-quantile from bucket upper bounds (the way the
         e2e metrics scraper reads histograms, metrics_util.go)."""

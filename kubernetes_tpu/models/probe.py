@@ -111,8 +111,12 @@ class RunTables:
     sa_bail: bool = False
 
 
-def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
-              static, carry, pod):
+def _probe_rows(config: SchedulerConfig, num_zones: int, num_values: int,
+                J: int, static, carry, pod):
+    """The probe body: -> (stk i64[N_STK_ROWS, N] header rows,
+    tab i64[J, N] weighted LR+BA j-table). Callers that consume only
+    `stk` (the grouped header probe, the device replay) leave `tab`
+    dead and XLA eliminates it."""
     (
         res,
         port_mask,
@@ -177,7 +181,6 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
     nzj_mem = nz_mem[None, :] + j * pod["nz_mem"]
     tab = jnp.zeros((J, N), jnp.int64)
     static_add = jnp.zeros((N,), jnp.int64)
-    out = {}
     zeros = jnp.zeros((N,), jnp.int64)
     stk_rows = {"spread_base": zeros, "spread_selfmatch": zeros,
                 "na_counts": zeros, "tt_counts": zeros, "ip_totals": zeros}
@@ -300,12 +303,43 @@ def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
         svc_total,
         svc_pin,
     ])
+    return stk, tab
+
+
+def _probe_fn(config: SchedulerConfig, num_zones: int, num_values: int, J: int,
+              static, carry, pod):
+    stk, tab = _probe_rows(config, num_zones, num_values, J, static, carry,
+                           pod)
+    N = stk.shape[1]
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize  # J is pow2 >= 16, always divisible
     tabp = tab.astype(dt).reshape(J // k, k, N).swapaxes(1, 2)
     tabw = jax.lax.bitcast_convert_type(tabp, jnp.int64)  # (J//k, N)
-    out["packed"] = jnp.concatenate([stk, tabw], axis=0)
-    return out
+    return {"packed": jnp.concatenate([stk, tabw], axis=0)}
+
+
+def _group_probe_fn(config: SchedulerConfig, num_zones: int, num_values: int,
+                    G: int, layout, static, carry, group_buf):
+    """Header-row probe for G stacked run representatives in one traced
+    program: vmap of _probe_rows (J=1 — the host rebuilds the resource
+    j-axis itself from the shipped resource block, see models/hosttab).
+    Output is ONE array so the whole product crosses the device->host
+    boundary in one transfer: rows [0, G*N_STK_ROWS) are the per-run
+    headers, the final 6 rows are the live resource block (the carry's
+    usage at probe time — the base the host j-tables start from)."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    pods = _unpack_pod(layout, group_buf)
+
+    def one(pod):
+        stk, _tab = _probe_rows(config, num_zones, num_values, 1, static,
+                                carry, pod)
+        return stk
+
+    stk = jax.vmap(one)(pods)  # (G, N_STK_ROWS, N)
+    N = stk.shape[-1]
+    return jnp.concatenate([stk.reshape(G * N_STK_ROWS, N), carry[0]],
+                           axis=0)
 
 
 N_STK_ROWS = 11  # header rows before the packed j-table words
@@ -421,6 +455,64 @@ class WaveProbe:
             self_anti_veto=self_anti_veto, svc_ctx=svc_ctx,
         )
 
+    def _compiled_group(self, num_zones: int, num_values: int, G: int,
+                        layout, prev_key, apply_fn, apply_group_fn):
+        """ONE program: fold the pending deferred apply (single-run or
+        grouped — prev_key carries its kind+layout), then header-probe G
+        stacked runs against the updated carry. The multi-template
+        analogue of _compiled_fused: one dispatch + one transfer where
+        the per-run loop paid one each."""
+        key = ("group", num_zones, num_values, G, layout, prev_key)
+        fn = self._jitted.get(key)
+        if fn is None:
+            from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+            kind = prev_key[0] if prev_key else None
+            prev_layout = prev_key[1] if prev_key else None
+
+            def grouped(static, carry, prev_buf, prev_counts, group_buf):
+                if kind == "single":
+                    carry = apply_fn(static, carry,
+                                     _unpack_pod(prev_layout, prev_buf),
+                                     prev_counts)
+                elif kind == "group":
+                    carry = apply_group_fn(prev_layout, static, carry,
+                                           prev_buf, prev_counts)
+                out = _group_probe_fn(
+                    self.config, num_zones, num_values, G, layout,
+                    static, carry, group_buf,
+                )
+                return carry, out
+
+            fn = jax.jit(grouped)
+            self._jitted[key] = fn
+        return fn
+
+    def probe_group(self, static, carry, prev, group_buf,
+                    num_zones: int, num_values: int, G: int, layout,
+                    apply_fn, apply_group_fn):
+        """-> (new_carry, headers u64[G, N_STK_ROWS, N], usage i64[6, N]).
+        `prev` is the deferred fold riding this dispatch: None or
+        (kind, buf, layout, counts). `usage` is the carry's resource
+        block at probe time — the host j-table base (models/hosttab)."""
+        prev_key = None
+        prev_buf = prev_counts = None
+        if prev is not None:
+            kind, prev_buf, prev_layout, prev_counts = prev
+            prev_key = (kind, prev_layout)
+        fn = self._compiled_group(num_zones, num_values, G, layout,
+                                  prev_key, apply_fn, apply_group_fn)
+        if prev_key is None:
+            prev_buf = jnp.zeros(0, jnp.uint8)
+            prev_counts = jnp.zeros(0, jnp.int64)
+        carry2, raw = fn(static, carry, prev_buf,
+                         jnp.asarray(prev_counts), group_buf)
+        arr = np.ascontiguousarray(jax.device_get(raw))
+        N = arr.shape[1]
+        headers = arr[: G * N_STK_ROWS].reshape(G, N_STK_ROWS, N)
+        usage = arr[G * N_STK_ROWS:]
+        return carry2, headers, usage
+
     def probe(self, static, carry, pod, num_zones: int, num_values: int,
               J: int, rows: Optional[int] = None,
               has_selectors: Optional[bool] = None,
@@ -470,9 +562,29 @@ def tables_from_packed(config: SchedulerConfig, arr: np.ndarray,
         arr[N_STK_ROWS:].view(dt).reshape(J // k, N, k)
         .transpose(0, 2, 1).reshape(J, N)[:rows]
     )
-    fit_static = stk[0].astype(bool)
     frontier = stk[1]
     res_fit = np.arange(rows, dtype=np.int64)[:, None] < frontier[None, :]
+    return tables_from_stk(
+        config, stk, res_fit, np.asarray(tab).astype(np.int64), num_zones,
+        has_selectors=has_selectors, zone_id=zone_id,
+        self_anti_veto=self_anti_veto, svc_ctx=svc_ctx,
+    )
+
+
+def tables_from_stk(config: SchedulerConfig, stk: np.ndarray,
+                    res_fit: np.ndarray, tab: np.ndarray, num_zones: int,
+                    has_selectors: bool,
+                    zone_id: Optional[np.ndarray] = None,
+                    self_anti_veto: Optional[np.ndarray] = None,
+                    svc_ctx: Optional[dict] = None) -> RunTables:
+    """Assemble RunTables from the probe's header rows plus a resource
+    j-axis (res_fit + weighted LR/BA tab) supplied by the caller —
+    either reconstructed from the packed single-run product
+    (tables_from_packed) or rebuilt host-side from the live resource
+    block by the grouped multi-run path (models/hosttab)."""
+    N = stk.shape[1]
+    rows = res_fit.shape[0]
+    fit_static = stk[0].astype(bool)
     if self_anti_veto is not None and rows > 1:
         # hostname-topology hard anti-affinity against the run's own
         # labels: one committed copy excludes every further copy on

@@ -42,6 +42,7 @@ from kubernetes_tpu.models.batch import (
     IMAGE_LOCALITY,
     INTER_POD_AFFINITY,
     LEAST_REQUESTED,
+    MATCH_INTER_POD_AFFINITY,
     NODE_AFFINITY,
     NODE_LABEL_PRIORITY,
     SELECTOR_SPREAD,
@@ -51,7 +52,12 @@ from kubernetes_tpu.models.batch import (
     SchedulerConfig,
     wants_resources,
 )
-from kubernetes_tpu.models.probe import RunTables, WaveProbe
+from kubernetes_tpu.models import hosttab
+from kubernetes_tpu.models.probe import (
+    RunTables,
+    WaveProbe,
+    tables_from_stk,
+)
 from kubernetes_tpu.models.replay import ReplayResult, replay_fast
 from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
@@ -108,6 +114,144 @@ def _lt_pernode_dom(snap: ClusterSnapshot, lt: int):
     if len(np.unique(live)) != len(live):
         return None  # two nodes share a domain: commits couple them
     return dom
+
+
+def run_pure(config: SchedulerConfig, batch: PodBatch, i: int,
+             *, svc_free: bool = None) -> bool:
+    """True when row i's commits touch ONLY the carry channels a grouped
+    probe can account for without a re-probe: the resource block
+    (models/hosttab rebuilds the j-axis from the shipped usage), host
+    port masks and spread class counts (exact host-side deltas).
+    Impure-but-eligible runs — inter-pod term owners / spec matchers,
+    service members — keep the per-run probe: their commits mutate carry
+    tables (ip reverse tables, svc peer counts) that later runs' probed
+    headers can't be adjusted for host-side.  svc_free is the hoistable
+    per-config invariant (no ServiceAffinity/ServiceAntiAffinity
+    labels)."""
+    if svc_free is None:
+        from kubernetes_tpu.snapshot.encode import service_config_labels
+
+        svc_free = not service_config_labels(config)
+    if not svc_free:
+        # SA pin ordinals and SAA peer counts are per-probe state
+        return False
+    b = batch
+    want_ip = MATCH_INTER_POD_AFFINITY in config.predicates or any(
+        n == INTER_POD_AFFINITY for n, _ in config.priorities
+    )
+    if want_ip:
+        if b.ip_match_spec.size and np.any(b.ip_match_spec[i]):
+            return False  # commits grow other pods' term counts
+        for rows in (b.ip_ha_lt, b.ip_hq_lt, b.ip_fwd_lt):
+            if rows.size and np.any(rows[i] >= 0):
+                return False  # own terms fold into the reverse tables
+    return True
+
+
+def group_buffer(batch: PodBatch, reps):
+    """Pack a group's run representatives (padded to a pow2 run bucket
+    by repeating the LAST rep — padded slots schedule nothing and their
+    commit counts stay zero) into ONE stacked buffer:
+    -> (G_bucket, layout, uint8 host buffer). Shared by the single-chip
+    and mesh wave drivers: the padding rule is part of the
+    host_group_replay / grouped-fold contract."""
+    from kubernetes_tpu.models.pack import pack_arrays
+
+    G_bucket = next_pow2(len(reps), floor=8)
+    reps = list(reps) + [reps[-1]] * (G_bucket - len(reps))
+    seg = gather_batch(batch, np.asarray(reps, np.int64))
+    layout, buf = pack_arrays({
+        f: np.asarray(getattr(seg, f))
+        for f in BatchScheduler.POD_FIELDS
+    })
+    return G_bucket, layout, buf
+
+
+def host_group_replay(config: SchedulerConfig, snap: ClusterSnapshot,
+                      batch: PodBatch, group, headers: np.ndarray,
+                      usage: np.ndarray, replay_fn, perm: np.ndarray,
+                      L_host: int, out: np.ndarray, zoned: bool,
+                      max_j: int, num_zones: int):
+    """FIFO host replay of a group of runs from ONE grouped probe.
+
+    group: list of (rep, start, length); headers: i64[G, N_STK_ROWS, N]
+    probed against the pre-group carry; usage: the carry's resource
+    block i64[6, N] at probe time.  Each run's j-axis is rebuilt from
+    the LIVE usage (prior runs' commits folded in — models/hosttab),
+    its spread base is advanced by the prior runs' class commits, and
+    port-conflicting nodes are vetoed — exactly the adjustments a fresh
+    per-run probe would have baked in, so decisions are bit-identical
+    to the serial per-run sequence (tests/test_wave.py fuzz).
+
+    Returns (counts_mat i64[G, N] node-order commits per run, n_full
+    runs completely replayed, partial_done picks of run n_full when it
+    stopped early (0 otherwise), L_host). Shared by the single-chip and
+    mesh wave drivers."""
+    G = len(group)
+    N = usage.shape[1]
+    usage = usage.astype(np.int64, copy=True)
+    alloc = {
+        f: np.asarray(getattr(snap, f)).astype(np.int64)
+        for f in ("alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods")
+    }
+    zone_arr = np.asarray(snap.zone_id) if zoned else None
+    counts_mat = np.zeros((G, N), np.int64)
+    class_acc: dict = {}  # class id -> accumulated commit counts [N]
+    port_kills: list = []  # (port row, touched mask) of committed runs
+    n_full = 0
+    partial_done = 0
+    for r, (rep, start, length) in enumerate(group):
+        pod = {
+            f: np.asarray(getattr(batch, f))[rep]
+            for f in ("req_mcpu", "req_mem", "req_gpu", "zero_req",
+                      "commit_mcpu", "commit_mem", "commit_gpu",
+                      "nz_mcpu", "nz_mem", "port_mask", "class_id",
+                      "spread_match")
+        }
+        K = length
+        _J, rows = pick_j(config, max_j, snap, batch, rep, K)
+        stk = headers[r].copy()
+        # cross-run host-port conflicts: a prior run's commit holds its
+        # ports on the touched nodes; overlapping wants can't land there
+        for port_row, touched in port_kills:
+            if np.any(port_row & pod["port_mask"]):
+                stk[0] = np.where(touched, 0, stk[0])
+        # spread base advance: prior commits of class c add
+        # spread_match[c] matches per committed copy on that node
+        spread_match = np.asarray(pod["spread_match"])
+        for cls, cnts in class_acc.items():
+            m = int(spread_match[cls]) if cls < spread_match.shape[0] else 0
+            if m:
+                stk[3] = stk[3] + m * cnts
+        res_fit, tab = hosttab.resource_tables(config, pod, alloc, usage,
+                                               rows)
+        tables = tables_from_stk(
+            config, stk, res_fit, tab, num_zones,
+            has_selectors=bool(batch.has_selectors[rep]),
+            zone_id=zone_arr,
+        )
+        res: ReplayResult = replay_fn(_permute_tables(tables, perm), K,
+                                      L_host)
+        if res.n_done == 0:
+            break  # no progress through tables: caller re-probes
+        ids = np.where(res.chosen >= 0, perm[res.chosen], -1)
+        out[start:start + res.n_done] = ids.astype(np.int32)
+        counts = np.zeros(N, np.int64)
+        counts[perm] = res.counts
+        counts_mat[r] = counts
+        L_host = res.last_node_index
+        # fold this run's commits into the host-tracked channels
+        usage += np.outer(hosttab.commit_vector(pod), counts)
+        if np.any(pod["port_mask"]):
+            port_kills.append((pod["port_mask"], counts > 0))
+        cls = int(pod["class_id"])
+        prev = class_acc.get(cls)
+        class_acc[cls] = counts if prev is None else prev + counts
+        if res.n_done < K:
+            partial_done = res.n_done
+            break  # table horizon: caller re-probes the remainder
+        n_full += 1
+    return counts_mat, n_full, partial_done, L_host
 
 
 def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
@@ -175,6 +319,13 @@ def run_eligible(config: SchedulerConfig, batch: PodBatch, i: int,
     # 2/3 blend per pick — the coupling is linear in per-zone counts,
     # exactly table shape.)
     return True, veto
+
+
+def _host_group_cap(num_nodes: int) -> int:
+    """How many runs one grouped header probe may carry: bounds the
+    device->host shipment (N_STK_ROWS i64 rows per run) to ~32 MB so a
+    bandwidth-limited tunnel still sees one cheap fat transfer."""
+    return max(8, min(256, (1 << 25) // max(num_nodes * 96, 1)))
 
 
 def pick_j(config: SchedulerConfig, max_j: int, snap: ClusterSnapshot,
@@ -329,7 +480,11 @@ class WaveScheduler:
         self.pod_floor = pod_floor
         self._replay = replay or replay_fast
         self._apply_packed_jit: dict = {}
+        self._apply_group_jit: dict = {}
         self._zreplay = None
+        # per-wave device-dispatch tally (tests assert the grouped path
+        # keeps this independent of the template count)
+        self.dispatches: dict = {}
         # zoned selector-spread runs replay ON DEVICE (one lax.scan
         # dispatch) instead of the per-pick numpy spec replay — the
         # zone blend couples whole zones per commit, which the C engine
@@ -496,7 +651,8 @@ class WaveScheduler:
         from kubernetes_tpu.models.zreplay import ZReplay
 
         if self._zreplay is None:
-            self._zreplay = ZReplay(self.config, self._apply_fn)
+            self._zreplay = ZReplay(self.config, self._apply_fn,
+                                    self._apply_group_fn)
         N = snap.num_nodes
         zone_perm = np.ascontiguousarray(
             np.asarray(snap.zone_id)[perm], np.int32
@@ -538,7 +694,61 @@ class WaveScheduler:
         # carry-fold commit (async dispatch: the timer sees the enqueue
         # plus whatever the device makes it wait for)
         with phase_timer("replay"):
+            self._count("apply")
             return fn(static, carry, buf, jnp.asarray(counts))
+
+    def _apply_group_fn(self, layout, static, carry, buf, counts):
+        """Fold a whole GROUP of runs' commits (counts i64[G, N], one
+        row per stacked pod in `buf`) into the carry in one scatter.
+        Valid only for PURE runs (run_pure): the resource block, port
+        masks, spread class counts, and the round-robin counter are the
+        only carry channels their commits touch — the ip/vol/svc blocks
+        pass through untouched, exactly as G zero-commit _apply_fn
+        folds would have left them."""
+        from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+        pods = _unpack_pod(layout, buf)
+        (res, port_mask, class_count, last_idx), rest = (
+            carry[:4], carry[4:]
+        )
+        commit = jnp.stack([
+            pods["commit_mcpu"], pods["commit_mem"], pods["commit_gpu"],
+            pods["nz_mcpu"], pods["nz_mem"],
+            jnp.ones_like(pods["commit_mcpu"]),
+        ])  # (6, G)
+        # elementwise product + reduce instead of an s64 dot_general
+        # (which has no TPU lowering); XLA fuses the reduction
+        res = res + (commit[:, :, None] * counts[None, :, :]).sum(axis=1)
+        touched = counts > 0  # (G, N)
+        add_bits = jnp.where(
+            touched[:, :, None], pods["port_mask"][:, None, :],
+            jnp.zeros_like(pods["port_mask"][:, None, :]),
+        )  # (G, N, W)
+        port_mask = port_mask | jax.lax.reduce(
+            add_bits, port_mask.dtype.type(0), jax.lax.bitwise_or, (0,)
+        )
+        class_count = class_count.at[:, pods["class_id"]].add(
+            counts.T.astype(class_count.dtype)
+        )
+        last_idx = last_idx + counts.sum()
+        return (res, port_mask, class_count, last_idx) + tuple(rest)
+
+    def _apply_group_packed(self, static, carry, buf, layout, counts):
+        """Standalone dispatch of the grouped fold (the settle path)."""
+        fn = self._apply_group_jit.get(layout)
+        if fn is None:
+            def run(static_, carry_, buf_, counts_):
+                return self._apply_group_fn(layout, static_, carry_,
+                                            buf_, counts_)
+
+            fn = jax.jit(run)
+            self._apply_group_jit[layout] = fn
+        with phase_timer("replay"):
+            self._count("apply")
+            return fn(static, carry, buf, jnp.asarray(counts))
+
+    def _count(self, key: str) -> None:
+        self.dispatches[key] = self.dispatches.get(key, 0) + 1
 
     # -- backlog -------------------------------------------------------------
 
@@ -566,6 +776,7 @@ class WaveScheduler:
         if source != self._dev_source:
             self._dev.clear()
             self._dev_source = source
+        self.dispatches = {}
         P = len(rep_idx)
         res_host = np.stack([
             np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
@@ -604,17 +815,22 @@ class WaveScheduler:
         # lastNodeIndex is tracked host-side (the replay computes it
         # exactly) so the fast path never blocks on the device carry
         L_host = int(last_node_index)
-        # deferred commit fold: (packed pod buf, layout, counts). A
-        # run's apply rides the NEXT probe's dispatch (probe_fused) —
-        # on a tunneled chip each enqueue is a round trip, so deferring
-        # halves the per-run dispatch count for multi-template backlogs
+        # deferred commit fold: ("single", buf, layout, counts[N]) or
+        # ("group", buf, layout, counts[G, N]). A run's (or group's)
+        # apply rides the NEXT probe's dispatch — on a tunneled chip
+        # each enqueue is a round trip, so deferring halves the per-run
+        # dispatch count for multi-template backlogs
         fold: list = []
 
         def settle(carry):
             if fold:
-                buf, layout, counts = fold.pop()
-                carry = self._apply_packed(static, carry, buf, layout,
-                                           counts)
+                kind, buf, layout, counts = fold.pop()
+                if kind == "single":
+                    carry = self._apply_packed(static, carry, buf,
+                                               layout, counts)
+                else:
+                    carry = self._apply_group_packed(static, carry, buf,
+                                                     layout, counts)
             return carry
 
         def flush(carry):
@@ -634,6 +850,7 @@ class WaveScheduler:
             # asarray/int reads force the dispatch so the timer covers
             # compute, not just enqueue
             with phase_timer("score"):
+                self._count("scan")
                 new_carry, chosen = run(static, carry, pods)
                 out[rows] = np.asarray(chosen)[: len(rows)]
                 L_host = int(new_carry[self.LAST_IDX])
@@ -642,41 +859,65 @@ class WaveScheduler:
 
         config_ok = config_eligible(self.config)
         zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
+        from kubernetes_tpu.snapshot.encode import service_config_labels
+
+        svc_free = not service_config_labels(self.config)
+        from kubernetes_tpu.models.pack import pack_arrays
+
+        # classify every run once: eligibility, the self-anti veto, the
+        # service context, the replay path, and commit purity (whether
+        # a grouped probe's host adjustments can cover its commits)
+        infos: List[dict] = []
         for rep, start, length in runs:
-            eligible, self_anti_veto = (False, None)
+            eligible, veto = (False, None)
             if length >= self.min_run:
-                eligible, self_anti_veto = run_eligible(
+                eligible, veto = run_eligible(
                     self.config, batch, rep, snap, config_ok=config_ok,
                 )
-            if not eligible:
-                pending.extend(range(start, start + length))
-                continue
-            carry = flush(carry)
-            from kubernetes_tpu.models.pack import pack_arrays
+            svc_ctx = svc_run_context(
+                self.config, snap, batch, rep, num_values
+            ) if eligible else None
+            device = bool(
+                eligible and self._device_zoned and zoned
+                and bool(batch.has_selectors[rep]) and svc_ctx is None
+            )
+            pure = bool(
+                eligible and veto is None and svc_ctx is None
+                and run_pure(self.config, batch, rep, svc_free=svc_free)
+            )
+            infos.append({
+                "rep": rep, "start": start, "length": length,
+                "eligible": eligible, "veto": veto, "svc_ctx": svc_ctx,
+                "device": device, "pure": pure,
+            })
 
+        def run_single(carry, info, done0=0):
+            """The per-run fast path: probe_fused (or the single-run
+            device replay) + host replay + deferred fold — one device
+            round trip per re-probe, exactly the pre-grouping shape."""
+            nonlocal L_host
+            rep, start, length = info["rep"], info["start"], info["length"]
+            self_anti_veto = info["veto"]
+            svc_ctx = info["svc_ctx"]
             layout, buf = pack_arrays({
                 f: np.asarray(getattr(batch, f)[rep])
                 for f in BatchScheduler.POD_FIELDS
             })
-            svc_ctx = svc_run_context(
-                self.config, snap, batch, rep, num_values
-            )
-            use_device_replay = (
-                self._device_zoned and zoned
-                and bool(batch.has_selectors[rep]) and svc_ctx is None
-            )
-            done = 0
+            done = done0
             while done < length:
                 K = length - done
                 J, rows = self._pick_j(snap, batch, rep, K)
                 prev_buf = prev_counts = None
                 if fold:
-                    if fold[0][1] == layout:
-                        prev_buf, _pl, prev_counts = fold.pop()
-                    else:  # layout drift (defensive): settle separately
+                    kind, fbuf, flayout, fcounts = fold[0]
+                    if kind == "single" and flayout == layout:
+                        fold.pop()
+                        prev_buf, prev_counts = fbuf, fcounts
+                    else:  # grouped fold or layout drift: settle apart
                         carry = settle(carry)
-                if use_device_replay:
+                if info["device"]:
                     with phase_timer("replay"):
+                        self._count("zreplay")
                         carry, res = self._run_device_replay(
                             static, carry, prev_buf, prev_counts, buf,
                             layout, num_zones, num_values, J, rows, K,
@@ -695,6 +936,7 @@ class WaveScheduler:
                     done += res.n_done
                     continue
                 with phase_timer("probe"):
+                    self._count("probe")
                     carry, tables = self.probe.probe_fused(
                         static, carry, prev_buf, prev_counts, buf,
                         num_zones, num_values, J, rows, layout,
@@ -725,11 +967,161 @@ class WaveScheduler:
                 counts = np.zeros(N, np.int64)
                 counts[perm] = res.counts
                 # deferred: the fold rides the next probe's dispatch
-                fold.append((buf, layout, counts))
+                fold.append(("single", buf, layout, counts))
                 # _apply_fn adds counts.sum() == res.scheduled to the
                 # device last_idx; mirror it host-side
                 L_host = res.last_node_index
                 done += res.n_done
+            return carry
+
+        def run_group_host(carry, group):
+            """K pure runs, ONE probe dispatch + ONE deferred fold: the
+            grouped header probe ships every run's static channels and
+            the live resource block; the host rebuilds each run's
+            j-axis against the accumulating usage (models/hosttab) and
+            replays them in FIFO order."""
+            nonlocal L_host
+            G = len(group)
+            G_bucket, glayout, gbuf = group_buffer(batch, [g["rep"] for g in group])
+            prev = fold.pop() if fold else None
+            with phase_timer("probe"):
+                self._count("group_probe")
+                carry, headers, usage = self.probe.probe_group(
+                    static, carry, prev, gbuf, num_zones, num_values,
+                    G_bucket, glayout, self._apply_fn,
+                    self._apply_group_fn,
+                )
+            with phase_timer("replay"):
+                counts_mat, n_full, partial_done, L_host = \
+                    host_group_replay(
+                        self.config, snap, batch,
+                        [(g["rep"], g["start"], g["length"])
+                         for g in group],
+                        headers[:G], usage, self._replay, perm, L_host,
+                        out, zoned, self.max_j, num_zones,
+                    )
+            if counts_mat.any():
+                cm = np.zeros((G_bucket, counts_mat.shape[1]), np.int64)
+                cm[:G] = counts_mat
+                fold.append(("group", gbuf, glayout, cm))
+            if n_full == G:
+                return carry, G, None
+            return carry, n_full, (n_full, partial_done)
+
+        def run_group_device(carry, group):
+            """K zoned-spread runs, ONE fused device dispatch: probe +
+            pick scan + commit fold per run inside one outer lax.scan
+            (models/zreplay.run_group), carry threaded run to run."""
+            nonlocal L_host
+            from kubernetes_tpu.models.zreplay import ZReplay
+
+            if self._zreplay is None:
+                self._zreplay = ZReplay(self.config, self._apply_fn,
+                                        self._apply_group_fn)
+            G = len(group)
+            G_bucket, glayout, gbuf = group_buffer(batch, [g["rep"] for g in group])
+            maxlen = max(g["length"] for g in group)
+            # floor 64 (not the single-run 256): the inner pick scan
+            # runs K_bucket steps PER RUN, so padding costs G times over
+            K_bucket = next_pow2(min(maxlen, 1 << 16), floor=64)
+            zone_perm = np.ascontiguousarray(
+                np.asarray(snap.zone_id)[perm], np.int32
+            )
+            vetos = np.zeros((G_bucket, N), bool)
+            has_sels = np.zeros(G_bucket, bool)
+            rows_arr = np.ones(G_bucket, np.int64)
+            k_reals = np.zeros(G_bucket, np.int32)
+            J_g = 128
+            for i, g in enumerate(group):
+                Jr, rr = self._pick_j(snap, batch, g["rep"],
+                                      g["length"])
+                J_g = max(J_g, Jr)
+                rows_arr[i] = rr
+                k_reals[i] = min(g["length"], K_bucket)
+                has_sels[i] = bool(batch.has_selectors[g["rep"]])
+                if g["veto"] is not None:
+                    vetos[i] = np.asarray(g["veto"])[perm]
+            prev = fold.pop() if fold else None
+            with phase_timer("replay"):
+                self._count("zreplay_group")
+                carry, chosen, n_done, L = self._zreplay.run_group(
+                    static, carry, prev, gbuf, glayout, num_zones,
+                    num_values, J_g, K_bucket, G_bucket, zone_perm,
+                    vetos, has_sels, rows_arr, k_reals, L_host,
+                )
+                chosen = np.asarray(chosen)
+                n_done = np.asarray(n_done)
+                L_host = int(L)
+            partial = None
+            consumed = 0
+            for i, g in enumerate(group):
+                nd = int(n_done[i])
+                if nd:
+                    ids = np.where(chosen[i, :nd] >= 0,
+                                   perm[chosen[i, :nd]], -1)
+                    out[g["start"]:
+                        g["start"] + nd] = ids.astype(np.int32)
+                if nd < g["length"]:
+                    partial = (i, nd)
+                    break
+                consumed += 1
+            return carry, consumed, partial
+
+        host_cap = _host_group_cap(N)
+        idx = 0
+        while idx < len(infos):
+            info = infos[idx]
+            if not info["eligible"]:
+                pending.extend(range(info["start"],
+                                     info["start"] + info["length"]))
+                idx += 1
+                continue
+            carry = flush(carry)
+            group = [info]
+            jdx = idx + 1
+            if info["device"]:
+                # device-path runs group freely (each probe runs against
+                # the live in-program carry — no purity needed), bounded
+                # by the pick-scan waste of the shared K bucket
+                picks = info["length"]
+                while (jdx < len(infos) and len(group) < 512
+                       and info["length"] <= (1 << 16)):
+                    nxt = infos[jdx]
+                    if not nxt["device"] or nxt["length"] > (1 << 16):
+                        break
+                    maxlen = max(max(g["length"] for g in group),
+                                 nxt["length"])
+                    if (len(group) + 1) * next_pow2(
+                            min(maxlen, 1 << 16), floor=64
+                    ) > 8 * (picks + nxt["length"]):
+                        break
+                    group.append(nxt)
+                    picks += nxt["length"]
+                    jdx += 1
+            else:
+                while (info["pure"] and jdx < len(infos)
+                       and len(group) < host_cap):
+                    nxt = infos[jdx]
+                    if not (nxt["pure"] and not nxt["device"]):
+                        break
+                    group.append(nxt)
+                    jdx += 1
+            if len(group) >= 2:
+                if info["device"]:
+                    carry, consumed, partial = run_group_device(
+                        carry, group)
+                else:
+                    carry, consumed, partial = run_group_host(
+                        carry, group)
+                if partial is not None:
+                    g_idx, done = partial
+                    carry = run_single(carry, group[g_idx], done0=done)
+                    idx += g_idx + 1
+                else:
+                    idx += consumed
+                continue
+            carry = run_single(carry, info)
+            idx += 1
         carry = settle(carry)
         carry = flush(carry)
         return out, carry, L_host

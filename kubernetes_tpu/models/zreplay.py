@@ -1,4 +1,4 @@
-"""Device replay: the wave pick sequence as ONE lax.scan dispatch.
+"""Device replay: wave pick sequences as ONE lax.scan dispatch.
 
 The host replays (replay.py's C engine and numpy spec) assume scores
 decompose into per-node functions of that node's commit count. The
@@ -11,6 +11,17 @@ reassembles the combined score exactly as models/replay._scores (same
 float32/float64 formulas, same NaN -> minInt64 quirk, same selectHost
 round-robin in name-desc order) — differentially tested against the
 host spec replay and the oracle by tests/test_wave.py.
+
+Two entry points share the same probe+scan body:
+
+  * ZReplay.run — one run per dispatch (the original shape), and
+  * ZReplay.run_group — G runs per dispatch: an OUTER lax.scan carries
+    the live device carry across runs, so each run's probe sees every
+    earlier run's commits and a 500-template zoned backlog costs ONE
+    device round trip instead of 500. A run that trips its table
+    horizon aborts the remainder (n_done reports how far each run got)
+    and the host driver resumes from there — output stays bit-identical
+    to the serial per-run sequence.
 
 Scope: runs whose only cross-node coupling is the zone blend (the
 common zoned-cluster case). ServiceAffinity/ServiceAntiAffinity
@@ -34,7 +45,7 @@ from kubernetes_tpu.models.batch import (
     TAINT_TOLERATION,
     SchedulerConfig,
 )
-from kubernetes_tpu.models.probe import N_STK_ROWS, _probe_fn, _tab_dtype
+from kubernetes_tpu.models.probe import _probe_rows
 
 
 def _weights(config: SchedulerConfig):
@@ -45,26 +56,20 @@ def _weights(config: SchedulerConfig):
             int(w.get(INTER_POD_AFFINITY, 0)))
 
 
-def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
-                fold_prev, static, carry, prev_buf, prev_counts,
-                pod_buf, zone_id, veto, has_selectors, rows_dyn, k_real,
-                L0):
-    """probe + K-step device replay + commit fold, one program.
+def _replay_run(config, num_zones, num_values, J, K, static, carry, pod,
+                zone_id, veto, has_selectors, rows_dyn, k_real, L0,
+                active0):
+    """Probe `pod` against the live carry, then K pick steps.
 
-    zone_id/veto are PERMUTED to name-desc order already; probe rows are
-    permuted inside. Returns (carry', chosen[K] permuted-space ids,
-    counts[N] node-order, L', n_done)."""
-    from kubernetes_tpu.models.pack import unpack as _unpack_pod
-
-    if fold_prev:
-        prev_pod = _unpack_pod(layout, prev_buf)
-        carry = apply_fn(static, carry, prev_pod, prev_counts)
-    pod = _unpack_pod(layout, pod_buf)
-    packed = _probe_fn(config, num_zones, num_values, J, static, carry,
-                       pod)["packed"]
+    zone_id/veto are PERMUTED to name-desc order already. active0 gates
+    every commit (False == this run is aborted: compute shapes run but
+    nothing schedules). Returns (j i64[N] permuted-space commit counts,
+    chosen i32[K] permuted-space ids, L, n_done, bailed)."""
+    stk, _tab = _probe_rows(config, num_zones, num_values, J, static,
+                            carry, pod)
     perm = static["name_desc_order"].astype(jnp.int32)
     N = perm.shape[0]
-    stk = packed[:N_STK_ROWS][:, perm]
+    stk = stk[:, perm]
     fit_static = stk[0] != 0
     frontier = stk[1]
     static_add = stk[2]
@@ -89,7 +94,6 @@ def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
     nz_mem0 = res[4][perm]
     alloc_cpu = static["alloc_mcpu"][perm]
     alloc_mem = static["alloc_mem"][perm]
-    dt = _tab_dtype(config)
     # the veto (hostname self-anti): one committed copy per node
     frontier = jnp.where(veto, jnp.minimum(frontier, 1), frontier)
     w_spread, w_na, w_tt, w_ip = _weights(config)
@@ -190,7 +194,7 @@ def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
 
     def step(state, i):
         j, fit, zc, L, n_done, stopped = state
-        active = (~stopped) & (i < k_real)
+        active = (~stopped) & (i < k_real) & active0
         can = active & fit.any()
         score = scores(j, fit, zc)
         smax = jnp.where(fit, score, jnp.int64(-(2**63))).max()
@@ -228,8 +232,33 @@ def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
         jnp.zeros((N,), jnp.int64), fit0, zc0, jnp.int64(L0),
         k_real.astype(jnp.int32), jnp.bool_(False),
     )
-    (j, _fit, _zc, L, n_done, _st), chosen = jax.lax.scan(
+    (j, _fit, _zc, L, n_done, stopped), chosen = jax.lax.scan(
         step, state0, jnp.arange(K, dtype=jnp.int32)
+    )
+    return j, chosen, L, n_done, stopped
+
+
+def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
+                fold_prev, static, carry, prev_buf, prev_counts,
+                pod_buf, zone_id, veto, has_selectors, rows_dyn, k_real,
+                L0):
+    """probe + K-step device replay + commit fold, one program.
+
+    zone_id/veto are PERMUTED to name-desc order already; probe rows are
+    permuted inside. Returns (carry', chosen[K] permuted-space ids,
+    counts[N] node-order, L', n_done)."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    if fold_prev:
+        prev_pod = _unpack_pod(layout, prev_buf)
+        carry = apply_fn(static, carry, prev_pod, prev_counts)
+    pod = _unpack_pod(layout, pod_buf)
+    perm = static["name_desc_order"].astype(jnp.int32)
+    N = perm.shape[0]
+    j, chosen, L, n_done, _stopped = _replay_run(
+        config, num_zones, num_values, J, K, static, carry, pod,
+        zone_id, veto, has_selectors, rows_dyn, k_real, L0,
+        jnp.bool_(True),
     )
     # permuted j -> node-order counts; fold THIS run's commits
     counts = jnp.zeros((N,), jnp.int64).at[perm].set(j)
@@ -237,12 +266,56 @@ def _zreplay_fn(config, num_zones, num_values, J, K, layout, apply_fn,
     return carry, chosen, counts, L, n_done
 
 
+def _zreplay_group_fn(config, num_zones, num_values, J, K, G, layout,
+                      apply_fn, prev_kind, prev_layout, apply_group_fn,
+                      static, carry, prev_buf, prev_counts, group_buf,
+                      zone_id, vetos, has_sels, rows_arr, k_reals, L0):
+    """G runs — probe + replay + fold each — in ONE device program: an
+    outer lax.scan threads the carry run to run, so every probe sees the
+    earlier runs' commits exactly as the serial per-run loop would.
+    A table-horizon bail aborts the remainder (aborted runs schedule
+    nothing and report n_done == 0); the host resumes from there."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    if prev_kind == "single":
+        carry = apply_fn(static, carry,
+                         _unpack_pod(prev_layout, prev_buf), prev_counts)
+    elif prev_kind == "group":
+        carry = apply_group_fn(prev_layout, static, carry, prev_buf,
+                               prev_counts)
+    pods = _unpack_pod(layout, group_buf)  # each field: leading G axis
+    perm = static["name_desc_order"].astype(jnp.int32)
+    N = perm.shape[0]
+
+    def run_body(state, x):
+        carry, L, aborted = state
+        pod, veto, has_sel, rows_dyn, k_real = x
+        j, chosen, L2, n_done, bailed = _replay_run(
+            config, num_zones, num_values, J, K, static, carry, pod,
+            zone_id, veto, has_sel, rows_dyn, k_real, L, ~aborted,
+        )
+        counts = jnp.zeros((N,), jnp.int64).at[perm].set(j)
+        # aborted runs committed nothing: counts == 0 and the fold is a
+        # no-op, so folding unconditionally keeps ONE trace
+        carry = apply_fn(static, carry, pod, counts)
+        n_done = jnp.where(aborted, 0, n_done)
+        return (carry, L2, aborted | bailed), (chosen, n_done)
+
+    (carry, L, _ab), (chosen, n_done) = jax.lax.scan(
+        run_body, (carry, L0, jnp.bool_(False)),
+        (pods, vetos, has_sels, rows_arr, k_reals),
+    )
+    return carry, chosen, n_done, L
+
+
 class ZReplay:
     """Compile cache for the fused probe+replay+fold programs."""
 
-    def __init__(self, config: SchedulerConfig, apply_fn):
+    def __init__(self, config: SchedulerConfig, apply_fn,
+                 apply_group_fn=None):
         self.config = config
         self.apply_fn = apply_fn
+        self.apply_group_fn = apply_group_fn
         self._jitted = {}
 
     def run(self, static, carry, prev_buf, prev_counts, pod_buf, layout,
@@ -266,4 +339,35 @@ class ZReplay:
             jnp.asarray(bool(has_selectors)),
             jnp.asarray(np.int64(rows)), jnp.asarray(np.int32(k_real)),
             np.int64(L0),
+        )
+
+    def run_group(self, static, carry, prev, group_buf, layout,
+                  num_zones, num_values, J, K_bucket, G,
+                  zone_id_perm, vetos_perm, has_sels, rows_arr, k_reals,
+                  L0):
+        """-> (carry', chosen i32[G, K_bucket] permuted-space,
+        n_done i32[G], L'). `prev` is a deferred fold riding this
+        dispatch: None or (kind, buf, layout, counts)."""
+        prev_kind = prev_layout = None
+        prev_buf = prev_counts = None
+        if prev is not None:
+            prev_kind, prev_buf, prev_layout, prev_counts = prev
+        key = ("group", num_zones, num_values, J, K_bucket, G, layout,
+               prev_kind, prev_layout)
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _zreplay_group_fn, self.config, num_zones, num_values,
+                J, K_bucket, G, layout, self.apply_fn, prev_kind,
+                prev_layout, self.apply_group_fn,
+            ))
+            self._jitted[key] = fn
+        if prev_kind is None:
+            prev_buf = jnp.zeros(0, jnp.uint8)
+            prev_counts = jnp.zeros(0, jnp.int64)
+        return fn(
+            static, carry, prev_buf, jnp.asarray(prev_counts), group_buf,
+            jnp.asarray(zone_id_perm), jnp.asarray(vetos_perm),
+            jnp.asarray(has_sels), jnp.asarray(rows_arr),
+            jnp.asarray(k_reals), np.int64(L0),
         )

@@ -501,20 +501,10 @@ def _spread_sharded(
     return jnp.where(jnp.isnan(f), jnp.int64(-(2**63)), f.astype(jnp.int64))
 
 
-def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
-                   n_global, pod_layout, static, carry, pod_buf):
-    """Per-shard wave probe (models/probe._probe_fn, sharded): this
-    shard's slice of the packed table product. The out_spec concatenates
-    shards along the node axis, so the host sees the same
-    (probe.N_STK_ROWS + J-words, N) array the single-chip probe ships —
-    replay and commit mapping are untouched. The pod row arrives as ONE
-    packed replicated buffer (models/pack) instead of ~40 per-field
-    transfers."""
-    from kubernetes_tpu.models.pack import unpack as _unpack_pod
-    from kubernetes_tpu.models.probe import _tab_dtype
-
-    pod = _unpack_pod(pod_layout, pod_buf)
-
+def _mesh_probe_rows(config, num_zones, num_values, J, n_per_shard,
+                     n_global, static, carry, pod):
+    """Per-shard probe body (models/probe._probe_rows, sharded):
+    -> (stk [N_STK_ROWS, n_per_shard], tab [J, n_per_shard])."""
     (
         res, port_mask, class_count, last_idx,
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
@@ -660,11 +650,98 @@ def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
         svc_total,
         svc_pin,
     ])
+    return stk, tab
+
+
+def _mesh_probe_fn(config, num_zones, num_values, J, n_per_shard,
+                   n_global, pod_layout, static, carry, pod_buf):
+    """Per-shard wave probe (models/probe._probe_fn, sharded): this
+    shard's slice of the packed table product. The out_spec concatenates
+    shards along the node axis, so the host sees the same
+    (probe.N_STK_ROWS + J-words, N) array the single-chip probe ships —
+    replay and commit mapping are untouched. The pod row arrives as ONE
+    packed replicated buffer (models/pack) instead of ~40 per-field
+    transfers."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+    from kubernetes_tpu.models.probe import _tab_dtype
+
+    pod = _unpack_pod(pod_layout, pod_buf)
+    stk, tab = _mesh_probe_rows(
+        config, num_zones, num_values, J, n_per_shard, n_global, static,
+        carry, pod,
+    )
+    N = n_per_shard
     dt = _tab_dtype(config)
     k = 8 // np.dtype(dt).itemsize
     tabp = tab.astype(dt).reshape(J // k, k, N).swapaxes(1, 2)
     tabw = jax.lax.bitcast_convert_type(tabp, jnp.int64)
     return jnp.concatenate([stk, tabw], axis=0)
+
+
+def _mesh_group_probe_fn(config, num_zones, num_values, G, n_per_shard,
+                         n_global, pod_layout, static, carry, group_buf):
+    """The grouped header probe, sharded: vmap of _mesh_probe_rows over
+    G stacked run representatives (J=1 — the host rebuilds the resource
+    j-axis from the shipped usage block, models/hosttab). The run axis
+    rides as a leading axis on every shard; the node axis stays sharded,
+    and the out_spec concatenates shards so the host sees the same
+    (G*N_STK_ROWS + 6, N) array the single-chip grouped probe ships."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+    from kubernetes_tpu.models.probe import N_STK_ROWS
+
+    pods = _unpack_pod(pod_layout, group_buf)
+
+    def one(pod):
+        stk, _tab = _mesh_probe_rows(
+            config, num_zones, num_values, 1, n_per_shard, n_global,
+            static, carry, pod,
+        )
+        return stk
+
+    stk = jax.vmap(one)(pods)  # (G, N_STK_ROWS, n_per_shard)
+    return jnp.concatenate(
+        [stk.reshape(G * N_STK_ROWS, n_per_shard), carry[0]], axis=0
+    )
+
+
+def _mesh_apply_group_fn(config, pod_layout, static, carry, group_buf,
+                         counts_global):
+    """The grouped commit fold, sharded: node-axis tables take this
+    shard's slice of the per-run global commit counts [G, N]. Valid for
+    PURE runs only (models/wave.run_pure): resource block, port masks,
+    spread class counts, and the round-robin counter — the replicated
+    ip/svc tables pass through untouched."""
+    from kubernetes_tpu.models.pack import unpack as _unpack_pod
+
+    pods = _unpack_pod(pod_layout, group_buf)
+    (res, port_mask, class_count, last_idx), rest = carry[:4], carry[4:]
+    n_per_shard = port_mask.shape[0]
+    shard = jax.lax.axis_index(AXIS)
+    offset = shard.astype(jnp.int32) * n_per_shard
+    counts = jax.lax.dynamic_slice_in_dim(
+        counts_global, offset, n_per_shard, axis=1
+    )  # (G, n_per_shard)
+    commit = jnp.stack([
+        pods["commit_mcpu"], pods["commit_mem"], pods["commit_gpu"],
+        pods["nz_mcpu"], pods["nz_mem"],
+        jnp.ones_like(pods["commit_mcpu"]),
+    ])  # (6, G)
+    # elementwise product + reduce instead of an s64 dot_general
+    # (which has no TPU lowering); XLA fuses the reduction
+    res = res + (commit[:, :, None] * counts[None, :, :]).sum(axis=1)
+    touched = counts > 0
+    add_bits = jnp.where(
+        touched[:, :, None], pods["port_mask"][:, None, :],
+        jnp.zeros_like(pods["port_mask"][:, None, :]),
+    )
+    port_mask = port_mask | jax.lax.reduce(
+        add_bits, port_mask.dtype.type(0), jax.lax.bitwise_or, (0,)
+    )
+    class_count = class_count.at[:, pods["class_id"]].add(
+        counts.T.astype(class_count.dtype)
+    )
+    last_idx = last_idx + counts_global.sum()
+    return (res, port_mask, class_count, last_idx) + tuple(rest)
 
 
 def _mesh_apply_fn(config, pod_layout, static, carry, pod_buf,
@@ -914,6 +991,9 @@ class MeshWaveScheduler:
         self._replay = replay or replay_fast
         self._probe_jit = {}
         self._apply_jit = {}
+        # per-wave device-dispatch tally (tests assert the grouped path
+        # keeps this independent of the template count)
+        self.dispatches: dict = {}
 
     # -- sharded programs ----------------------------------------------------
 
@@ -964,6 +1044,59 @@ class MeshWaveScheduler:
         with self.mesh:
             return run(static, carry, pod_buf, counts)
 
+    def _group_probe_run(self, static, carry, pod_layout, group_buf, n,
+                         n_per_shard, num_zones, num_values, G):
+        """-> (headers [G, N_STK_ROWS, N], usage i64[6, N]) — the
+        grouped header probe for G stacked runs, ONE sharded dispatch
+        and ONE device->host transfer."""
+        from kubernetes_tpu.models.probe import N_STK_ROWS
+
+        key = ("gprobe", n, n_per_shard, num_zones, num_values, G,
+               pod_layout)
+        run = self._probe_jit.get(key)
+        if run is None:
+            from jax import shard_map
+
+            body = functools.partial(
+                _mesh_group_probe_fn, self.config, num_zones,
+                num_values, G, n_per_shard, n, pod_layout,
+            )
+            run = jax.jit(shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(_static_specs(static), CARRY_SPECS, PSpec()),
+                out_specs=PSpec(None, AXIS),
+                check_vma=False,
+            ))
+            self._probe_jit[key] = run
+        with self.mesh:
+            raw = run(static, carry, group_buf)
+        arr = np.ascontiguousarray(jax.device_get(raw))
+        headers = arr[: G * N_STK_ROWS].reshape(G, N_STK_ROWS, n)
+        return headers, arr[G * N_STK_ROWS:]
+
+    def _apply_group_run(self, static, carry, pod_layout, group_buf,
+                         counts, n, n_per_shard):
+        key = ("gapply", n, n_per_shard, pod_layout)
+        run = self._apply_jit.get(key)
+        if run is None:
+            from jax import shard_map
+
+            body = functools.partial(
+                _mesh_apply_group_fn, self.config, pod_layout
+            )
+            run = jax.jit(shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(_static_specs(static), CARRY_SPECS, PSpec(),
+                          PSpec()),
+                out_specs=CARRY_SPECS,
+                check_vma=False,
+            ))
+            self._apply_jit[key] = run
+        with self.mesh:
+            return run(static, carry, group_buf, counts)
+
     # -- backlog driver ------------------------------------------------------
 
     def schedule_backlog(
@@ -979,9 +1112,13 @@ class MeshWaveScheduler:
         from kubernetes_tpu.models.probe import tables_from_packed
         from kubernetes_tpu.models.replay import ReplayResult
         from kubernetes_tpu.models.wave import (
+            _host_group_cap,
             config_eligible,
             gather_batch,
+            group_buffer,
+            host_group_replay,
             run_eligible,
+            run_pure,
             svc_run_context,
             _permute_tables,
         )
@@ -1032,6 +1169,7 @@ class MeshWaveScheduler:
                 f: jnp.asarray(getattr(seg, f))
                 for f in BatchScheduler.POD_FIELDS
             }
+            self.dispatches["scan"] = self.dispatches.get("scan", 0) + 1
             carry, chosen = self.scan._exec(
                 static, carry, pods, N, n_per_shard, num_zones,
                 num_values, seg.num_pods,
@@ -1041,31 +1179,52 @@ class MeshWaveScheduler:
             pending.clear()
             return carry
 
+        from kubernetes_tpu.models.pack import pack_arrays
+        from kubernetes_tpu.snapshot.encode import service_config_labels
+
+        self.dispatches = {}
+
+        def count(key):
+            self.dispatches[key] = self.dispatches.get(key, 0) + 1
+
         config_ok = config_eligible(self.config)
+        svc_free = not service_config_labels(self.config)
+        infos = []
         for rep, start, length in runs:
-            eligible, self_anti_veto = (False, None)
+            eligible, veto = (False, None)
             if length >= self.min_run:
-                eligible, self_anti_veto = run_eligible(
+                eligible, veto = run_eligible(
                     self.config, batch, rep, snap, config_ok=config_ok,
                 )
-            if not eligible:
-                pending.extend(range(start, start + length))
-                continue
-            carry = flush(carry)
-            from kubernetes_tpu.models.pack import pack_arrays
+            svc_ctx = svc_run_context(
+                self.config, snap, batch, rep, num_values
+            ) if eligible else None
+            pure = bool(
+                eligible and veto is None and svc_ctx is None
+                and run_pure(self.config, batch, rep, svc_free=svc_free)
+            )
+            infos.append({
+                "rep": rep, "start": start, "length": length,
+                "eligible": eligible, "veto": veto, "svc_ctx": svc_ctx,
+                "pure": pure,
+            })
 
+        def run_single(carry, info, done0=0):
+            nonlocal L_host
+            rep, start, length = (info["rep"], info["start"],
+                                  info["length"])
+            self_anti_veto = info["veto"]
+            svc_ctx = info["svc_ctx"]
             pod_layout, pod_buf = pack_arrays({
                 f: np.asarray(getattr(batch, f)[rep])
                 for f in BatchScheduler.POD_FIELDS
             })
             pod_buf = jnp.asarray(pod_buf)
-            svc_ctx = svc_run_context(
-                self.config, snap, batch, rep, num_values
-            )
-            done = 0
+            done = done0
             while done < length:
                 K = length - done
                 J, rows_n = self._pick_j(snap, batch, rep, K)
+                count("probe")
                 packed = self._probe_run(
                     static, carry, pod_layout, pod_buf, N, n_per_shard,
                     num_zones, num_values, J,
@@ -1095,12 +1254,76 @@ class MeshWaveScheduler:
                 )
                 counts = np.zeros(N, np.int64)
                 counts[perm] = res.counts
+                count("apply")
                 carry = self._apply_run(
                     static, carry, pod_layout, pod_buf,
                     jnp.asarray(counts), N, n_per_shard,
                 )
                 L_host = res.last_node_index
                 done += res.n_done
+            return carry
+
+        def run_group(carry, group):
+            """K pure runs through ONE sharded header probe + ONE
+            sharded grouped fold; the host replay (shared with the
+            single-chip driver) rebuilds each run's j-axis against the
+            accumulating usage and replays in FIFO order."""
+            nonlocal L_host
+            G = len(group)
+            G_bucket, glayout, gbuf = group_buffer(
+                batch, [g["rep"] for g in group]
+            )
+            gbuf = jnp.asarray(gbuf)
+            count("group_probe")
+            headers, usage = self._group_probe_run(
+                static, carry, glayout, gbuf, N, n_per_shard,
+                num_zones, num_values, G_bucket,
+            )
+            counts_mat, n_full, partial_done, L_host = host_group_replay(
+                self.config, snap, batch,
+                [(g["rep"], g["start"], g["length"]) for g in group],
+                headers[:G], usage, self._replay, perm, L_host, out,
+                zoned, self.max_j, num_zones,
+            )
+            if counts_mat.any():
+                cm = np.zeros((G_bucket, N), np.int64)
+                cm[:G] = counts_mat
+                count("apply")
+                carry = self._apply_group_run(
+                    static, carry, glayout, gbuf, jnp.asarray(cm), N,
+                    n_per_shard,
+                )
+            if n_full == G:
+                return carry, G, None
+            return carry, n_full, (n_full, partial_done)
+
+        host_cap = _host_group_cap(N)
+        idx = 0
+        while idx < len(infos):
+            info = infos[idx]
+            if not info["eligible"]:
+                pending.extend(range(info["start"],
+                                     info["start"] + info["length"]))
+                idx += 1
+                continue
+            carry = flush(carry)
+            group = [info]
+            jdx = idx + 1
+            while (info["pure"] and jdx < len(infos)
+                   and len(group) < host_cap and infos[jdx]["pure"]):
+                group.append(infos[jdx])
+                jdx += 1
+            if len(group) >= 2:
+                carry, consumed, partial = run_group(carry, group)
+                if partial is not None:
+                    g_idx, done = partial
+                    carry = run_single(carry, group[g_idx], done0=done)
+                    idx += g_idx + 1
+                else:
+                    idx += consumed
+                continue
+            carry = run_single(carry, info)
+            idx += 1
         carry = flush(carry)
         return out, carry, L_host
 

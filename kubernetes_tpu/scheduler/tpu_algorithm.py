@@ -167,6 +167,15 @@ class TPUScheduleAlgorithm:
                  for i in range(max(self._wave.min_run, 2))],
                 state, nodes,
             )
+            # two adjacent template runs warm the GROUPED programs
+            # (header probe + grouped fold) — the multi-template
+            # backlog shape every RC/RS burst mix hits
+            n = max(self._wave.min_run, 2)
+            self._warm_one(
+                [pod(f"wg{i}", "100m") for i in range(n)]
+                + [pod(f"wh{i}", "150m") for i in range(n)],
+                state, nodes,
+            )
         if phase in ("all", "scan"):
             self._warm_one([pod("w-scan", "200m"),
                             pod("w-scan2", "300m")], state, nodes)
@@ -213,7 +222,10 @@ class TPUScheduleAlgorithm:
             ]
         else:
             # a min_run-sized template run warms the sharded PROBE and
-            # APPLY programs
+            # APPLY programs; a second adjacent template warms the
+            # sharded GROUPED header probe + grouped fold. The two
+            # templates arrive as separate waves below so the
+            # single-run programs still compile.
             backlog = [
                 PodT(
                     metadata=ObjectMeta(name=f"w{i}",
@@ -224,10 +236,27 @@ class TPUScheduleAlgorithm:
                 )
                 for i in range(max(self._mesh_sched.min_run, 2))
             ]
+        state = CS.build(nodes)
+        grouped = None
+        if not scan:
+            n = max(self._mesh_sched.min_run, 2)
+            grouped = [
+                PodT(
+                    metadata=ObjectMeta(name=f"wg{t}-{i}",
+                                        labels={"app": "warm"}),
+                    spec=PodSpec(containers=[
+                        Container(image="warm",
+                                  requests={"cpu": f"{100 + 50 * t}m"})
+                    ]),
+                )
+                for t in range(2) for i in range(n)
+            ]
         with self._sched_lock:
             saved_last = self._last_node_index
             try:
-                self._schedule_backlog_mesh(backlog, CS.build(nodes))
+                self._schedule_backlog_mesh(backlog, state)
+                if grouped is not None:
+                    self._schedule_backlog_mesh(grouped, state)
             except Exception:
                 log.debug("mesh warmup failed", exc_info=True)
             finally:

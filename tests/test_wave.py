@@ -788,3 +788,282 @@ def test_wave_zoned_tainted_device_replay_matches_host():
     want = oracle_backlog(state, pods)
     assert got_host == want
     assert got_dev == want
+
+
+# -- grouped multi-run dispatch (fused wave groups) ---------------------------
+#
+# The grouped driver amortizes device round trips across DISTINCT
+# templates: one header probe for K runs, host-rebuilt resource j-axes
+# against the accumulating usage, one grouped fold. These fixtures hit
+# every cross-run coupling channel the host adjustments must model
+# exactly — resources, spread class counts, host ports — plus the
+# channels that must BREAK grouping (own inter-pod terms), asserting
+# bit-identity to the serial oracle throughout.
+
+
+def template_pods(num_templates, per, labels=None, cpu0=50, mem_step=50,
+                  name0=""):
+    pods = []
+    for t in range(num_templates):
+        for i in range(per):
+            pods.append(Pod(
+                metadata=ObjectMeta(
+                    name=f"{name0}tpl{t:03d}-{i:03d}",
+                    labels=dict(labels or {"name": "sched-perf"}),
+                ),
+                spec=PodSpec(containers=[Container(requests={
+                    "cpu": f"{cpu0 + t * 5}m",
+                    "memory": f"{100 + (t % 7) * mem_step}Mi",
+                })]),
+            ))
+    return pods
+
+
+def test_wave_grouped_heterogeneous_spread_coupling():
+    # 12 templates all selected by ONE service: every run's commits move
+    # every later run's spread counts — the host class-count adjustment
+    # path, live under the default provider config
+    state = spread_state(density_nodes(15))
+    pods = template_pods(12, 10)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_grouped_resource_coupling_fills_nodes():
+    # tight capacity: earlier runs' commits exhaust nodes mid-group, so
+    # later runs' host-rebuilt res_fit/LR/BA tables must reflect the
+    # accumulated usage exactly; tail goes unschedulable
+    nodes = density_nodes(4, pods_cap="110", cpu="2", mem="4Gi")
+    state = ClusterState.build(nodes)
+    pods = template_pods(8, 15, cpu0=200, mem_step=100)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert None in want  # the fixture really does exhaust capacity
+
+
+def test_wave_grouped_port_conflicts_across_runs():
+    # three templates sharing a host port (distinct resources => distinct
+    # runs): a node taken by run A's copy must reject runs B/C — the
+    # cross-run port veto; a fourth portless template is unaffected
+    nodes = density_nodes(6)
+    pods = []
+    for t in range(3):
+        for i in range(4):
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"pp{t}-{i}",
+                                    labels={"app": "p"}),
+                spec=PodSpec(containers=[
+                    Container(requests={"cpu": f"{100 + t * 50}m"},
+                              ports=[ContainerPort(host_port=8080)])
+                ]),
+            ))
+    pods += template_pods(1, 5, labels={"app": "free"}, cpu0=75,
+                          name0="free-")
+    state = ClusterState.build(nodes)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    port_hosts = [h for h in got[:12] if h]
+    assert len(port_hosts) == len(set(port_hosts)) == 6  # one per node
+
+
+def test_wave_grouped_zoned_multi_template():
+    # many selector templates on a ZONED cluster ride the grouped DEVICE
+    # dispatch (zreplay.run_group): one outer scan, carry threaded run
+    # to run — the config-4 shape
+    state = spread_state(zoned_density_nodes(12))
+    pods = template_pods(6, 15)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+def test_wave_grouped_zoned_capacity_tail():
+    # zoned device group + capacity exhaustion inside the group
+    state = spread_state(zoned_density_nodes(6, pods_cap="8"))
+    pods = template_pods(5, 14)
+    got = wave_backlog(state, pods)
+    want = oracle_backlog(state, pods)
+    assert got == want
+    assert want[-1] is None
+
+
+def test_wave_grouped_impure_run_breaks_group():
+    # pure templates around an anti-affinity template (own terms =>
+    # impure): the impure run must take the per-run path and its carry
+    # fold must be visible to the later pure runs
+    nodes = hostname_nodes(10)
+    pods = template_pods(3, 8, labels={"g": "a"})
+    pods += _anti_pods(8, {"g": "a"}, name0=500,
+                       requests={"cpu": "300m"})
+    pods += template_pods(3, 8, labels={"g": "a"}, cpu0=400,
+                          name0="post-")
+    state = ClusterState.build(nodes)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_wave_grouped_random_templates(seed):
+    # randomized multi-template backlogs: varying template counts, run
+    # lengths, capacities, zones, services, host ports — grouped (host
+    # AND device), single, and scan paths interleave; bit-identity to
+    # the oracle throughout
+    rng = random.Random(4000 + seed)
+    zones = ["a", "b", "c"][: rng.randint(1, 3)]
+    if rng.random() < 0.5:
+        nodes = zoned_density_nodes(
+            rng.randint(5, 20), zones=tuple(zones),
+            unzoned_every=rng.choice([0, 3]),
+            pods_cap=str(rng.randint(4, 30)),
+        )
+    else:
+        nodes = density_nodes(rng.randint(5, 20),
+                              pods_cap=str(rng.randint(4, 30)))
+    state = (spread_state(nodes) if rng.random() < 0.6
+             else ClusterState.build(nodes))
+    pods = []
+    for t in range(rng.randint(3, 14)):
+        k = rng.randint(1, 18)
+        lbl = ({"name": "sched-perf"} if rng.random() < 0.7
+               else {"app": f"x{t % 3}"})
+        tpl = template_pods(1, k, labels=lbl, cpu0=40 + t * 7,
+                            mem_step=30 + t, name0=f"s{t:02d}-")
+        if rng.random() < 0.15:
+            for p in tpl:
+                p.spec.containers[0].ports = [
+                    ContainerPort(host_port=7000 + t % 2)]
+        pods.extend(tpl)
+    assert wave_backlog(state, pods) == oracle_backlog(state, pods), (
+        f"seed {seed}"
+    )
+
+
+def test_wave_grouped_probe_count_is_o1():
+    # the regression the tentpole exists for: 100 distinct templates
+    # must NOT issue 100 probes. One grouped header probe (plus its
+    # deferred fold) covers the whole backlog.
+    from kubernetes_tpu.models.batch import SchedulerConfig
+    from kubernetes_tpu.scheduler.tpu_algorithm import (
+        TPUScheduleAlgorithm,
+    )
+
+    nodes = density_nodes(50)
+    state = ClusterState.build(nodes)
+    pods = template_pods(100, 8, cpu0=20, mem_step=13)
+    cfg = SchedulerConfig(
+        predicates=("PodFitsResources",),
+        priorities=(("LeastRequestedPriority", 1),
+                    ("BalancedResourceAllocation", 1)),
+    )
+    algo = TPUScheduleAlgorithm(min_run=1, config=cfg)
+    got = algo.schedule_backlog(pods, state)
+    d = dict(algo._wave.dispatches)
+    assert d.get("probe", 0) == 0, f"per-template probes: {d}"
+    assert d.get("group_probe", 0) <= 1, f"grouped probes scaled: {d}"
+    assert sum(d.values()) <= 3, (
+        f"dispatches must be O(1) in templates, got {d}"
+    )
+    # and the decisions still match the oracle
+    from kubernetes_tpu.oracle import GenericScheduler
+    from kubernetes_tpu.oracle import predicates as opreds
+    from kubernetes_tpu.oracle import priorities as oprios
+    from kubernetes_tpu.oracle.scheduler import PriorityConfig
+
+    oracle = GenericScheduler(
+        predicates=[("PodFitsResources", opreds.pod_fits_resources)],
+        priorities=[
+            PriorityConfig(oprios.least_requested_priority, 1, "LR"),
+            PriorityConfig(oprios.balanced_resource_allocation, 1,
+                           "BA"),
+        ],
+    )
+    assert got == oracle.schedule_backlog(pods, state.clone())
+
+
+def test_wave_grouped_mesh_matches_oracle():
+    # the grouped path through the MESH driver (sharded header probe +
+    # shared host replay + sharded grouped fold) on the 8-virtual-device
+    # CPU mesh; skipped automatically where jax.shard_map is absent
+    import jax
+    from jax.sharding import Mesh
+    from kubernetes_tpu.parallel.mesh import MeshWaveScheduler
+    from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+    devices = jax.devices()
+    assert len(devices) >= 8
+    mesh = Mesh(np.array(devices[:8]), ("nodes",))
+    nodes = density_nodes(13)  # not divisible by 8: padding live
+    state = spread_state(nodes)
+    pods = template_pods(7, 9)
+    want = oracle_backlog(state, pods)
+
+    # dedup positions -> unique rows (the driver contract)
+    reps, rep_idx = {}, []
+    uniq = []
+    for i, p in enumerate(pods):
+        k = pod_feature_key(p)
+        if k not in reps:
+            reps[k] = len(uniq)
+            uniq.append(i)
+        rep_idx.append(reps[k])
+    enc2 = SnapshotEncoder(state, [pods[i] for i in uniq])
+    snap = enc2.encode_nodes()
+    batch = enc2.encode_pods()
+    ws = MeshWaveScheduler(mesh, min_run=1)
+    chosen, _, _ = ws.schedule_backlog(
+        snap, batch, np.asarray(rep_idx, np.int64)
+    )
+    got = [snap.node_names[c]
+           if 0 <= c < len(state.node_infos) else None for c in chosen]
+    assert got == want
+    d = ws.dispatches
+    assert d.get("group_probe", 0) >= 1, f"mesh grouping idle: {d}"
+
+
+def _wave_direct(state, pods, max_j):
+    """Drive WaveScheduler directly (dedup + pad like the algorithm
+    shell) with a clamped table horizon."""
+    from kubernetes_tpu.models.wave import WaveScheduler
+    from kubernetes_tpu.parallel.mesh import _pad_snapshot
+    from kubernetes_tpu.snapshot.pad import next_pow2
+
+    uniq, rep_of, rep_idx = [], {}, []
+    for p in pods:
+        k = pod_feature_key(p)
+        if k not in rep_of:
+            rep_of[k] = len(uniq)
+            uniq.append(p)
+        rep_idx.append(rep_of[k])
+    enc = SnapshotEncoder(state, uniq)
+    snap = enc.encode_nodes()
+    batch = enc.encode_pods()
+    snap_p = _pad_snapshot(snap, next_pow2(snap.num_nodes, 4))
+    ws = WaveScheduler(min_run=1, max_j=max_j)
+    chosen, _, _ = ws.schedule_backlog(
+        snap_p, batch, np.asarray(rep_idx, np.int64)
+    )
+    got = [snap.node_names[c] if 0 <= c < snap.num_nodes else None
+           for c in chosen]
+    return got, ws.dispatches
+
+
+def test_wave_grouped_host_horizon_resume():
+    # huge per-node capacity + a clamped 128-row table horizon: runs
+    # inside a HOST group trip the horizon mid-run, the group aborts,
+    # the partial run resumes on the single path, and the remaining
+    # runs regroup — decisions stay bit-identical to the oracle
+    nodes = density_nodes(2, pods_cap="1000")
+    state = ClusterState.build(nodes)
+    pods = template_pods(3, 300, cpu0=1, mem_step=0)
+    got, d = _wave_direct(state, pods, max_j=128)
+    assert got == oracle_backlog(state, pods)
+    assert d.get("probe", 0) >= 1, f"no single-path resume happened: {d}"
+
+
+def test_wave_grouped_device_horizon_resume():
+    # the same horizon abort through the grouped DEVICE dispatch: the
+    # outer scan aborts at the bail, later runs schedule nothing, the
+    # host resumes from the bail point
+    state = spread_state(zoned_density_nodes(2, pods_cap="1000"))
+    pods = template_pods(3, 300, cpu0=1, mem_step=0)
+    got, d = _wave_direct(state, pods, max_j=128)
+    assert got == oracle_backlog(state, pods)
+    assert d.get("zreplay", 0) >= 1, f"no single-path resume: {d}"
