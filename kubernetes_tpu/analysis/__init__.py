@@ -1,0 +1,124 @@
+"""Static analysis & sanitizer suite — machine-checked contracts for
+the tensor-program scheduler.
+
+Three passes, runnable standalone (``python -m kubernetes_tpu.analysis``)
+and as tier-1 tests (tests/test_analysis.py):
+
+  1. **Jaxpr auditor** (jaxpr_audit / programs): traces every registered
+     device program (scan, probe, group probe, apply / group apply,
+     zreplay run / run_group, the mesh shard_map variants) at
+     representative padded shapes and walks the jaxprs to enforce
+     contracts a TPU deployment needs — no primitives lacking TPU
+     lowerings (the s64 ``dot_general`` class that broke PR 3), no host
+     callbacks or dynamic shapes in hot programs, no unintended float64
+     upcasts, and a statically counted device-transfer budget per wave
+     (grouped probe ships exactly ONE host-bound array regardless of the
+     template count; the apply fold ships zero).
+
+  2. **AST lint** (lint): custom rules over the whole package — host
+     syncs and impurity inside traced scopes of the hot packages, bare
+     ``except:``, mutable default args, non-daemon threads without
+     joins, metrics constructed outside the registry module — with a
+     ``# lint: allow[rule]`` suppression syntax.
+
+  3. **Runtime sanitizers** (locks / compile_guard): an instrumented
+     lock wrapper recording the cross-thread acquisition-order graph
+     with cycle detection (armed under the chaos tests), and a
+     jax.monitoring compile-event sentinel that fails when a
+     steady-state wave triggers recompilation.
+
+Each pass emits ``Finding`` rows; the CLI exits non-zero when any
+unsuppressed finding survives, which is the CI gate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Finding:
+    """One violation (or suppressed would-be violation) from any pass."""
+
+    pass_name: str  # "jaxpr" | "lint" | "locks"
+    rule: str  # stable rule id, the token a suppression names
+    where: str  # "module.py:123" or a program name
+    message: str
+    suppressed: bool = False
+
+
+def render_report(findings: List[Finding], title: str = "") -> str:
+    """Human-readable findings report (the CLI output, also embedded in
+    assertion messages by the tests)."""
+    lines: List[str] = []
+    if title:
+        lines.append(title)
+    active = [f for f in findings if not f.suppressed]
+    muted = [f for f in findings if f.suppressed]
+    for f in active:
+        lines.append(f"  [{f.pass_name}/{f.rule}] {f.where}: {f.message}")
+    for f in muted:
+        # suppressed rows stay listed (marked) so allowance drift is
+        # auditable from the report, not just countable
+        lines.append(
+            f"  [suppressed {f.pass_name}/{f.rule}] {f.where}: "
+            f"{f.message}"
+        )
+    lines.append(
+        f"{len(active)} finding(s), {len(muted)} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def run_static_passes(root: Optional[str] = None,
+                      include_jaxpr: bool = True,
+                      include_lint: bool = True,
+                      include_mesh: bool = True) -> List[Finding]:
+    """The CLI/CI body: lint the tree and audit the device programs.
+    (The lock-order and recompilation sanitizers are runtime passes;
+    they arm under the chaos/SLO tests instead.)"""
+    findings: List[Finding] = []
+    if include_jaxpr:
+        # the mesh shard_map variants need a multi-device host
+        # platform. XLA_FLAGS is read at backend INIT (lazy, first
+        # devices() call), so setting it here still works even though
+        # the package __init__ imported jax long ago; JAX_PLATFORMS
+        # however snapshots at import, so on an accelerator host the
+        # config override below is the only handle — and if a backend
+        # already initialized with <2 devices, audit_all reports
+        # `mesh-unavailable` LOUDLY instead of silently shrinking the
+        # gate's coverage.
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        if not os.environ.get("JAX_PLATFORMS"):
+            try:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass  # backend pinned already: the loud finding covers it
+    if include_lint:
+        from kubernetes_tpu.analysis import lint
+
+        findings.extend(lint.lint_tree(root))
+    if include_jaxpr:
+        from kubernetes_tpu.analysis import jaxpr_audit
+
+        try:
+            findings.extend(
+                jaxpr_audit.audit_all(include_mesh=include_mesh))
+        except Exception as e:  # a program failing to TRACE is itself red
+            findings.append(Finding(
+                "jaxpr", "trace-failure", "audit_all",
+                f"registered program failed to trace: {e!r}",
+            ))
+    return findings
+
+
+__all__ = ["Finding", "render_report", "run_static_passes"]

@@ -1,0 +1,37 @@
+"""CLI entry: ``python -m kubernetes_tpu.analysis``.
+
+Runs the static passes (AST lint + jaxpr audit) over the installed tree
+and exits non-zero when any unsuppressed finding survives — the CI
+gate. The runtime sanitizers (lock-order graph, compile sentinel) arm
+under the chaos/SLO tests instead; see tests/test_chaos.py and
+tests/test_slo.py.
+
+Flags:
+    --lint-only     skip the jaxpr audit (no program tracing; jax is
+                    still imported by the package __init__)
+    --jaxpr-only    skip the AST lint
+    --no-mesh       audit single-chip programs only (without it, an
+                    unformable mesh is a `mesh-unavailable` finding,
+                    never a silent coverage shrink)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from kubernetes_tpu.analysis import render_report, run_static_passes
+
+    findings = run_static_passes(
+        include_jaxpr="--lint-only" not in argv,
+        include_lint="--jaxpr-only" not in argv,
+        include_mesh="--no-mesh" not in argv,
+    )
+    print(render_report(findings, "kubernetes_tpu static analysis:"))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Recompilation sentinel: fail when a steady-state wave compiles.
+
+The wave drivers buy their throughput by compiling once per program
+shape and replaying; an innocuous edit that keys a jit cache on a
+per-wave value (a python int that should have been a static bucket, a
+layout that drifts) silently turns every wave into a multi-second
+XLA compile. The SLO suite's throughput gates catch the damage; this
+sentinel catches the CAUSE, attributing the exact jax.monitoring
+compile events that fired inside the guarded window.
+
+    sentinel = CompileSentinel()          # installs the listener
+    ... warm-up wave (compiles freely) ...
+    with sentinel.expect_no_compiles("wave 2"):
+        ... steady-state wave ...         # any compile -> AssertionError
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from contextlib import contextmanager
+from typing import List, Tuple
+
+#: jax.monitoring event-key fragments that mean "XLA compiled something"
+_COMPILE_EVENT_MARKERS = ("backend_compile", "compile_duration")
+
+# jax.monitoring has no unregister, so exactly ONE module-level listener
+# ever registers; it fans events out to the live sentinels (weakly held:
+# a dropped sentinel stops receiving and can be collected instead of
+# leaking an ever-growing events list per construction site)
+_sentinels: "weakref.WeakSet[CompileSentinel]" = weakref.WeakSet()
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+        try:
+            from jax import monitoring
+        except Exception:  # no jax / no monitoring: sentinels are inert
+            return
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if any(m in event for m in _COMPILE_EVENT_MARKERS):
+                for s in list(_sentinels):
+                    s._note(event, duration)
+
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            pass
+
+
+class CompileSentinel:
+    """Counts XLA compile events via jax.monitoring; armable windows."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.events: List[Tuple[str, float]] = []
+        self.install()
+
+    def install(self) -> None:
+        _install_listener()
+        _sentinels.add(self)
+
+    def _note(self, event: str, duration: float) -> None:
+        with self._mu:
+            self.events.append((event, duration))
+
+    def compile_count(self) -> int:
+        with self._mu:
+            return len(self.events)
+
+    @contextmanager
+    def expect_no_compiles(self, label: str = ""):
+        """Assert zero XLA compiles happen inside the block."""
+        before = self.compile_count()
+        yield self
+        with self._mu:
+            new = self.events[before:]
+        if new:
+            detail = ", ".join(
+                f"{ev} ({dur * 1e3:.0f}ms)" for ev, dur in new[:5]
+            )
+            where = label or "guarded window"
+            raise AssertionError(
+                f"recompilation in steady state ({where}): "
+                f"{len(new)} XLA compile event(s): {detail}"
+            )

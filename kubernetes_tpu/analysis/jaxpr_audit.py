@@ -1,0 +1,255 @@
+"""Jaxpr auditor: machine-checked lowering/transfer contracts.
+
+Traces every registered device program (analysis/programs) with
+``jax.make_jaxpr`` — no device execution — and walks the jaxpr tree
+(recursing through pjit / scan / while / cond / shard_map sub-jaxprs)
+enforcing:
+
+* ``denylisted-primitive`` — primitives known to lack a TPU lowering in
+  a hot program. The founding member is the 64-bit-integer
+  ``dot_general`` (the PR 3 incident: an s64 matmul traced fine on CPU
+  and exploded at TPU lowering time); the grouped folds use
+  elementwise-mul + reduce instead, and this pass keeps it that way.
+* ``host-callback`` — ``pure_callback`` / ``debug_callback`` /
+  ``io_callback`` et al. have no place in a hot program: each is a
+  device->host round trip per dispatch (or worse, per scan step).
+* ``dynamic-shape`` — every aval must have concrete integer dims; shape
+  polymorphism would defeat the compile-cache reuse the wave drivers
+  key on.
+* ``f64-upcast`` — float64 (or complex128) appearing in a program not
+  registered as deliberately float64 (the scan/zreplay score
+  normalizers mirror the reference's float64 math and are allowed; the
+  probe/apply/transfer programs must stay integer/f32 — a weak-type
+  Python-float upcast there silently doubles table width and, on real
+  TPU, rides the slow f64 emulation path).
+* ``transfer-contract`` — the statically counted device->host transfer
+  budget per dispatch: each registered program's non-carry output leaf
+  count must equal its declaration. The grouped wave's O(1)-dispatch
+  property is checked structurally: the grouped probe ships exactly ONE
+  host-bound array at BOTH registered G values (probe=1 per wave), and
+  the apply folds ship ZERO (the apply dispatch's outputs are all
+  carry) — so a wave costs one probe transfer + one fold dispatch no
+  matter how many templates rode it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List
+
+from kubernetes_tpu.analysis import Finding
+from kubernetes_tpu.analysis.programs import ProgramSpec, build_programs
+
+#: primitive names that are host callbacks in disguise
+CALLBACK_PRIMITIVES = {
+    "pure_callback", "debug_callback", "io_callback", "callback",
+    "outside_call", "host_callback_call",
+}
+
+#: (primitive name, why) entries denied on any 64-bit integer operand
+INT64_DENYLIST = {
+    "dot_general": "64-bit integer dot_general has no TPU lowering "
+                   "(use elementwise-mul + reduce)",
+    "conv_general_dilated": "64-bit integer convolution has no TPU "
+                            "lowering",
+}
+
+#: primitives that merely MOVE f64 data. The snapshot legitimately
+#: carries float64 vocab tables (numeric label values for Gt/Lt
+#: selector ops ride as f64 by reference semantics), so f64 flowing
+#: through unpack bitcasts / gathers / selects is data plumbing; the
+#: f64-upcast rule fires only on f64-PRODUCING arithmetic, which is
+#: the signature of a weak-type Python-float promotion.
+F64_MOVEMENT_PRIMITIVES = {
+    "bitcast_convert_type", "reshape", "broadcast_in_dim", "squeeze",
+    "transpose", "gather", "dynamic_slice", "dynamic_update_slice",
+    "slice", "concatenate", "select_n", "scatter", "scatter-add",
+    "pad", "rev", "copy", "device_put", "stop_gradient",
+    # comparisons CONSUME f64 and emit bool; they never appear here
+    # (output-dtype gated) but the container prims do:
+    "pjit", "closed_call", "core_call", "scan", "while", "cond",
+    "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+    "shard_map", "xla_call",
+}
+
+#: source files whose f64 arithmetic is reference-exact BY CONTRACT
+#: (priorities.go float64 fraction/normalizer math, mirrored
+#: operation-for-operation so truncations agree). An f64-producing
+#: equation whose trace provenance passes through one of these is the
+#: documented math; anywhere else it is a weak-type upcast.
+ALLOWED_F64_SOURCES = (
+    "kubernetes_tpu/ops/priorities.py",
+    "kubernetes_tpu/ops/interpod.py",
+)
+
+
+def _f64_provenance_ok(eqn) -> bool:
+    tb = getattr(eqn.source_info, "traceback", None)
+    if tb is None:
+        return False
+    try:
+        frames = tb.frames
+    except Exception:
+        return False
+    for fr in frames:
+        fname = getattr(fr, "file_name", "") or ""
+        if any(src in fname for src in ALLOWED_F64_SOURCES):
+            return True
+    return False
+
+
+def _subjaxprs(eqn) -> Iterable[Any]:
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    def walk(v):
+        if isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from walk(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                yield from walk(x)
+
+    for val in eqn.params.values():
+        yield from walk(val)
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Depth-first over every equation including sub-jaxprs (scan
+    bodies, branches, pjit calls, shard_map bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _avals(eqn):
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            yield aval
+
+
+def _is_i64(dtype) -> bool:
+    import numpy as np
+
+    return np.issubdtype(dtype, np.integer) and np.dtype(dtype).itemsize == 8
+
+
+def audit_jaxpr(name: str, jaxpr, allow_f64: bool = False
+                ) -> List[Finding]:
+    """Walk one closed jaxpr against the primitive/dtype/shape rules."""
+    import numpy as np
+
+    findings: List[Finding] = []
+    f64_hits: List[str] = []
+    for eqn in iter_eqns(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr")
+                         else jaxpr):
+        prim = eqn.primitive.name
+        if prim in CALLBACK_PRIMITIVES:
+            findings.append(Finding(
+                "jaxpr", "host-callback", name,
+                f"{prim} inside a hot device program (a host round "
+                "trip per dispatch)",
+            ))
+        deny = INT64_DENYLIST.get(prim)
+        if deny is not None and any(
+            _is_i64(getattr(a, "dtype", np.float32)) for a in _avals(eqn)
+        ):
+            findings.append(Finding(
+                "jaxpr", "denylisted-primitive", name,
+                f"{prim} on 64-bit integers: {deny}",
+            ))
+        for aval in _avals(eqn):
+            shape = getattr(aval, "shape", ())
+            if not all(isinstance(d, int) for d in shape):
+                findings.append(Finding(
+                    "jaxpr", "dynamic-shape", name,
+                    f"{prim} has a non-static dim {shape} — defeats "
+                    "the compile-cache keying the wave drivers rely on",
+                ))
+                break
+        if not allow_f64 and prim not in F64_MOVEMENT_PRIMITIVES:
+            for v in eqn.outvars:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None and dt in (
+                    np.dtype(np.float64), np.dtype(np.complex128),
+                ) and not _f64_provenance_ok(eqn):
+                    f64_hits.append(prim)
+                    break
+    if f64_hits:
+        findings.append(Finding(
+            "jaxpr", "f64-upcast", name,
+            f"float64 values flow through {len(f64_hits)} equation(s) "
+            f"(first: {f64_hits[0]}) in a program registered as "
+            "f64-free — a weak-type upcast fattens tables/transfers "
+            "and hits TPU f64 emulation",
+        ))
+    return findings
+
+
+def _transfer_findings(spec: ProgramSpec) -> List[Finding]:
+    """The statically-counted transfer budget: non-carry output leaves
+    must match the declaration."""
+    import jax
+
+    if spec.expected_host_leaves is None:
+        return []
+    out = jax.eval_shape(spec.fn, *spec.args)
+    n_out = len(jax.tree_util.tree_leaves(out))
+    host = n_out - spec.carry_out_leaves
+    if host != spec.expected_host_leaves:
+        return [Finding(
+            "jaxpr", "transfer-contract", spec.name,
+            f"{host} host-bound output leaf(s) per dispatch, contract "
+            f"says {spec.expected_host_leaves} — an extra device->host "
+            "transfer crept into the wave hot path "
+            f"({n_out} outputs total, {spec.carry_out_leaves} carry)",
+        )]
+    return []
+
+
+def audit_program(spec: ProgramSpec) -> List[Finding]:
+    import jax
+
+    jaxpr = jax.make_jaxpr(spec.fn)(*spec.args)
+    findings = audit_jaxpr(spec.name, jaxpr, allow_f64=spec.allow_f64)
+    findings.extend(_transfer_findings(spec))
+    return findings
+
+
+_PROGRAM_CACHE: dict = {}  # include_mesh -> [ProgramSpec]
+
+
+def registered_programs(include_mesh: bool = True) -> List[ProgramSpec]:
+    progs = _PROGRAM_CACHE.get(include_mesh)
+    if progs is None:
+        progs = build_programs(include_mesh=include_mesh)
+        _PROGRAM_CACHE[include_mesh] = progs
+    return progs
+
+
+def audit_all(include_mesh: bool = True) -> List[Finding]:
+    """Trace + audit every registered program (the CI pass body)."""
+    findings: List[Finding] = []
+    specs = registered_programs(include_mesh=include_mesh)
+    if include_mesh and not any(s.name.startswith("mesh_")
+                                for s in specs):
+        # asked-for coverage that cannot be delivered must be a loud
+        # finding, never a silent shrink: on a 1-device host (or a jax
+        # build with no shard_map) the five mesh programs drop out
+        import jax
+
+        findings.append(Finding(
+            "jaxpr", "mesh-unavailable", "programs",
+            f"mesh shard_map variants not auditable here "
+            f"({len(jax.devices())} visible device(s)); start python "
+            "with XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(before any backend initializes), or pass --no-mesh to "
+            "accept the reduced coverage explicitly",
+        ))
+    for spec in specs:
+        findings.extend(audit_program(spec))
+    return findings

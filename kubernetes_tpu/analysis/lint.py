@@ -1,0 +1,472 @@
+"""AST lint: repo-specific static rules over the whole package.
+
+Two rule families:
+
+* **Traced-scope rules** apply only inside functions that end up inside
+  a jitted program (directly ``jax.jit``-ed / ``vmap``-ed / used as a
+  ``lax.scan`` body / ``shard_map``-ed, or reachable from one through
+  the intra-package call graph) in the hot packages (``models/``,
+  ``ops/``, ``snapshot/``, ``parallel/``):
+
+    - ``host-sync``       .item() / .block_until_ready() / np.asarray /
+                          jax.device_get inside a traced scope — each is
+                          a silent device round trip (or a trace-time
+                          crash) on the wave hot path
+    - ``traced-impure``   time.*/RNG/print/open inside a traced scope —
+                          traced once, burned into the compiled program,
+                          then silently constant (or recompiling)
+
+* **Package-wide rules** apply everywhere under ``kubernetes_tpu/``:
+
+    - ``bare-except``       ``except:`` swallows KeyboardInterrupt and
+                            SystemExit; name the exception
+    - ``mutable-default``   mutable default argument values
+    - ``nondaemon-thread``  a non-daemon Thread with no ``.join`` in its
+                            module outlives shutdown and wedges exit
+    - ``metric-outside-registry``  Counter/Gauge/Histogram constructed
+                            outside metrics/metrics.py bypass the
+                            duplicate-name registry
+
+Suppression: append ``# lint: allow[rule]`` (comma-separate several
+rule ids) on the offending line or the line directly above it.
+Suppressed findings still appear in the report, marked, so allowance
+drift stays visible.
+
+The traced-scope detection is a deliberate over-approximation: every
+function whose *name* is passed to a tracing entry point is a seed, and
+tracedness propagates through name-resolvable calls (local names,
+``from x import y`` names, module-alias attributes, ``self.`` methods).
+Functions passed as *values* through parameters (the wave driver hands
+``_apply_fn`` into zreplay/probe constructors) can't be seen that way
+and are seeded explicitly in ``EXTRA_TRACED_SEEDS``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.analysis import Finding
+
+#: packages whose traced scopes get the host-sync/impurity rules
+HOT_PREFIXES = (
+    "kubernetes_tpu.models",
+    "kubernetes_tpu.ops",
+    "kubernetes_tpu.snapshot",
+    "kubernetes_tpu.parallel",
+)
+
+#: functions traced only through higher-order *value* flow the call
+#: graph can't resolve (passed as apply_fn/apply_group_fn parameters)
+EXTRA_TRACED_SEEDS = (
+    ("kubernetes_tpu.models.wave", "_apply_fn"),
+    ("kubernetes_tpu.models.wave", "_apply_group_fn"),
+)
+
+# tracing entry points: bare-suffix names, and lax.-qualified loop names
+_TRACE_BARE = {"jit", "vmap", "pmap", "shard_map", "eval_shape",
+               "make_jaxpr"}
+_TRACE_LAX = {"scan", "while_loop", "cond", "fori_loop", "map",
+              "associative_scan", "switch"}
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+_METRIC_CLASSES = {"Counter", "Gauge", "GaugeVec", "Histogram",
+                   "HistogramVec"}
+_METRIC_HOME = "kubernetes_tpu.metrics.metrics"
+
+_HOST_SYNC_ATTRS = {"item", "block_until_ready", "copy_to_host_async"}
+_NP_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray"}
+_TIME_FUNCS = {"time", "monotonic", "perf_counter", "sleep",
+               "process_time"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Module:
+    """One parsed module: alias maps, function table, seeds, edges."""
+
+    def __init__(self, relpath: str, modname: str, text: str):
+        self.relpath = relpath
+        self.modname = modname
+        self.text = text
+        self.tree = ast.parse(text, filename=relpath)
+        self.lines = text.splitlines()
+        # line -> set of allowed rule ids (same line or one above)
+        self.allow: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self.allow.setdefault(i, set()).update(rules)
+                self.allow.setdefault(i + 1, set()).update(rules)
+        # import resolution
+        self.mod_alias: Dict[str, str] = {}  # local name -> module path
+        self.from_funcs: Dict[str, Tuple[str, str]] = {}  # name -> (mod, fn)
+        self.np_aliases: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.mod_alias[local] = a.name if a.asname else \
+                        a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                src = node.module
+                if node.level:  # relative import: resolve in-package
+                    base = self.modname.split(".")
+                    src = ".".join(base[: len(base) - node.level]
+                                   + ([src] if src else []))
+                for a in node.names:
+                    local = a.asname or a.name
+                    target = f"{src}.{a.name}"
+                    if target == "numpy":
+                        self.np_aliases.add(local)
+                    # a from-import may bind a submodule OR a function;
+                    # record both interpretations, resolution prefers
+                    # the function table
+                    self.mod_alias.setdefault(local, target)
+                    self.from_funcs[local] = (src, a.name)
+        # function table: bare name -> nodes (over-approximate)
+        self.funcs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, []).append(node)
+        # `body = functools.partial(F, ...)` bindings: a name later fed
+        # to jit/scan/shard_map resolves through to F
+        self.partials: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                callee = _dotted(node.value.func) or ""
+                if callee.split(".")[-1] == "partial" and node.value.args:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.partials.setdefault(t.id, []).extend(
+                                _callable_refs(node.value.args[0])
+                            )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return rule in self.allow.get(line, ())
+
+
+def _trace_callee_kind(callee: ast.AST) -> Optional[str]:
+    """'bare' / 'lax' when `callee` is a tracing entry point."""
+    name = _dotted(callee)
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] in _TRACE_BARE:
+        return "bare"
+    if parts[-1] in _TRACE_LAX and len(parts) >= 2 and parts[-2] == "lax":
+        return "lax"
+    return None
+
+
+def _callable_refs(node: ast.AST) -> List[ast.AST]:
+    """Candidate function references inside an argument expression:
+    names, attributes, and functools.partial targets."""
+    out: List[ast.AST] = []
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        out.append(node)
+    elif isinstance(node, ast.Call):
+        callee = _dotted(node.func) or ""
+        if callee.split(".")[-1] == "partial" and node.args:
+            out.extend(_callable_refs(node.args[0]))
+        elif _trace_callee_kind(node.func):
+            # jax.jit(shard_map(body, ...)): recurse into the wrapped fn
+            for a in node.args:
+                out.extend(_callable_refs(a))
+            for kw in node.keywords:
+                if kw.arg in (None, "f", "fun", "body", "body_fun",
+                              "cond_fun"):
+                    out.extend(_callable_refs(kw.value))
+    elif isinstance(node, ast.Lambda):
+        out.append(node)
+    return out
+
+
+def _build_modules(sources: Dict[str, str]
+                   ) -> Tuple[Dict[str, _Module], List[Finding]]:
+    mods: Dict[str, _Module] = {}
+    broken: List[Finding] = []
+    for relpath, text in sources.items():
+        modname = relpath[:-3].replace(os.sep, ".").replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        try:
+            mods[modname] = _Module(relpath, modname, text)
+        except SyntaxError as e:  # a broken file is its own finding
+            broken.append(Finding(
+                "lint", "syntax-error",
+                f"{relpath}:{e.lineno or 0}",
+                f"file does not parse: {e.msg}",
+            ))
+    return mods, broken
+
+
+def _resolve_ref(mod: _Module, ref: ast.AST,
+                 mods: Dict[str, _Module],
+                 depth: int = 0) -> List[Tuple[str, str]]:
+    """(module, funcname) candidates a Name/Attribute reference denotes."""
+    out: List[Tuple[str, str]] = []
+    if depth > 4:  # partial-of-partial chains bottom out fast
+        return out
+    if isinstance(ref, ast.Name):
+        if ref.id in mod.funcs:
+            out.append((mod.modname, ref.id))
+        elif ref.id in mod.from_funcs:
+            src, fn = mod.from_funcs[ref.id]
+            if src in mods:
+                out.append((src, fn))
+        for bound in mod.partials.get(ref.id, ()):
+            out.extend(_resolve_ref(mod, bound, mods, depth + 1))
+    elif isinstance(ref, ast.Attribute):
+        base = ref.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and ref.attr in mod.funcs:
+                out.append((mod.modname, ref.attr))
+            else:
+                target = mod.mod_alias.get(base.id)
+                if target in mods:
+                    out.append((target, ref.attr))
+    return out
+
+
+def _traced_functions(mods: Dict[str, _Module]) -> Set[Tuple[str, str]]:
+    """Fixed point of: seeded-by-tracing-entry-point, closed under
+    name-resolvable calls and nested defs."""
+    traced: Set[Tuple[str, str]] = set()
+    work: List[Tuple[str, str]] = []
+
+    def mark(key: Tuple[str, str]) -> None:
+        if key[0] in mods and key[1] in mods[key[0]].funcs \
+                and key not in traced:
+            traced.add(key)
+            work.append(key)
+
+    for mod in mods.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _trace_callee_kind(node.func):
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    for ref in _callable_refs(arg):
+                        for key in _resolve_ref(mod, ref, mods):
+                            mark(key)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _trace_callee_kind(target):
+                        mark((mod.modname, node.name))
+                    elif isinstance(dec, ast.Call):
+                        for a in dec.args:
+                            if _trace_callee_kind(a):
+                                mark((mod.modname, node.name))
+    for seed in EXTRA_TRACED_SEEDS:
+        mark(seed)
+
+    while work:
+        modname, fname = work.pop()
+        mod = mods[modname]
+        for fnode in mod.funcs.get(fname, ()):
+            for inner in ast.walk(fnode):
+                if isinstance(inner,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and inner is not fnode:
+                    mark((modname, inner.name))
+                elif isinstance(inner, ast.Call):
+                    for key in _resolve_ref(mod, inner.func, mods):
+                        mark(key)
+    return traced
+
+
+# -- rule bodies --------------------------------------------------------------
+
+
+def _has_thread_join(tree: ast.AST) -> bool:
+    """Any ``x.join(...)`` call that could plausibly be a Thread.join —
+    string-literal joins (", ".join) and path joins (os.path.join,
+    posixpath.join) are excluded, so a module full of path handling
+    doesn't silently satisfy the nondaemon-thread rule."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):
+            continue  # ", ".join(...)
+        dotted = _dotted(recv) or ""
+        if dotted.split(".")[-1] in ("path", "posixpath", "ntpath"):
+            continue  # os.path.join(...)
+        return True
+    return False
+
+
+def _check_traced_body(mod: _Module, fnode: ast.AST,
+                       findings: List[Finding]) -> None:
+    def add(rule: str, line: int, msg: str) -> None:
+        findings.append(Finding(
+            "lint", rule, f"{mod.relpath}:{line}", msg,
+            suppressed=mod.suppressed(rule, line),
+        ))
+
+    for node in ast.walk(fnode):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        callee = node.func
+        dotted = _dotted(callee) or ""
+        parts = dotted.split(".")
+        if isinstance(callee, ast.Attribute) \
+                and callee.attr in _HOST_SYNC_ATTRS:
+            add("host-sync", line,
+                f".{callee.attr}() forces a device sync in a traced "
+                "scope")
+        elif len(parts) == 2 and parts[0] in mod.np_aliases \
+                and parts[1] in _NP_SYNC_FUNCS:
+            add("host-sync", line,
+                f"{dotted}() materializes on host inside a traced scope")
+        elif dotted in ("jax.device_get",) or \
+                (len(parts) == 1 and parts[0] == "device_get"
+                 and mod.from_funcs.get("device_get", ("",))[0] == "jax"):
+            add("host-sync", line,
+                "jax.device_get inside a traced scope")
+        elif len(parts) == 2 and parts[0] == "time" \
+                and parts[1] in _TIME_FUNCS:
+            add("traced-impure", line,
+                f"{dotted}() is trace-time-frozen inside a jitted "
+                "program")
+        elif len(parts) >= 2 and "random" in parts[:-1] and (
+                parts[0] in mod.np_aliases or parts[0] == "random"):
+            add("traced-impure", line,
+                f"{dotted}() — host RNG has no meaning under trace; "
+                "use jax.random with a threaded key")
+        elif dotted == "random" or (len(parts) == 2
+                                    and parts[0] == "random"):
+            add("traced-impure", line,
+                f"{dotted}() — host RNG inside a traced scope")
+        elif isinstance(callee, ast.Name) and callee.id == "print":
+            add("traced-impure", line,
+                "print() in a traced scope runs at trace time only "
+                "(use jax.debug.print deliberately)")
+        elif isinstance(callee, ast.Name) and callee.id == "open":
+            add("traced-impure", line,
+                "file I/O in a traced scope runs at trace time only")
+
+
+def _check_module_wide(mod: _Module, findings: List[Finding]) -> None:
+    def add(rule: str, line: int, msg: str) -> None:
+        findings.append(Finding(
+            "lint", rule, f"{mod.relpath}:{line}", msg,
+            suppressed=mod.suppressed(rule, line),
+        ))
+
+    module_has_join = _has_thread_join(mod.tree)
+    is_metric_home = mod.modname == _METRIC_HOME
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            add("bare-except", node.lineno,
+                "bare `except:` also swallows KeyboardInterrupt/"
+                "SystemExit; catch Exception (or narrower)")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                mutable = isinstance(default,
+                                     (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")
+                )
+                if mutable:
+                    add("mutable-default", default.lineno,
+                        f"mutable default argument in {node.name}() is "
+                        "shared across calls")
+        elif isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ""
+            parts = dotted.split(".")
+            if parts[-1] == "Thread" and (
+                parts[0] == "threading" or (
+                    len(parts) == 1
+                    and mod.from_funcs.get("Thread", ("",))[0]
+                    == "threading")
+            ):
+                # an explicit daemon= of ANY value is a deliberate
+                # choice; the rule is about forgetting the kwarg
+                has_daemon = any(
+                    kw.arg == "daemon" for kw in node.keywords
+                )
+                if not has_daemon and not module_has_join:
+                    add("nondaemon-thread", node.lineno,
+                        "non-daemon Thread with no .join() in this "
+                        "module can wedge interpreter shutdown")
+            elif parts[-1] in _METRIC_CLASSES and not is_metric_home:
+                src = ""
+                if len(parts) == 1:
+                    src = mod.from_funcs.get(parts[0], ("",))[0]
+                elif len(parts) == 2:
+                    src = mod.mod_alias.get(parts[0], "")
+                if src.startswith("kubernetes_tpu.metrics") or \
+                        src == "kubernetes_tpu.metrics":
+                    add("metric-outside-registry", node.lineno,
+                        f"{parts[-1]} constructed outside "
+                        "metrics/metrics.py bypasses the central "
+                        "registry (duplicate-name protection, /metrics "
+                        "exposition)")
+
+
+# -- entry points -------------------------------------------------------------
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Lint a dict of {relative path: source text} as one package view
+    (the testable seam: seeded-violation fixtures come through here)."""
+    mods, findings = _build_modules(sources)
+    traced = _traced_functions(mods)
+    for mod in mods.values():
+        _check_module_wide(mod, findings)
+        if mod.modname.startswith(HOT_PREFIXES):
+            seen: Set[int] = set()
+            for modname, fname in traced:
+                if modname != mod.modname:
+                    continue
+                for fnode in mod.funcs.get(fname, ()):
+                    if id(fnode) in seen:
+                        continue
+                    seen.add(id(fnode))
+                    _check_traced_body(mod, fnode, findings)
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+def lint_tree(root: Optional[str] = None) -> List[Finding]:
+    """Lint every module of the installed package tree."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = os.path.dirname(root)  # repo root holding kubernetes_tpu/
+    sources: Dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, base)
+            with open(full, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+    return lint_sources(sources)

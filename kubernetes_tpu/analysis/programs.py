@@ -1,0 +1,412 @@
+"""Registry of the scheduler's device programs at representative shapes.
+
+Every hot program the wave/scan/mesh drivers dispatch is rebuilt here
+exactly the way its driver builds it (same function bodies, same
+jit/shard_map wrapping, same packed-buffer layouts) against a small
+synthetic-but-real cluster snapshot (zoned nodes, two pod templates, a
+grouped-run backlog) produced by the real encoder. The jaxpr auditor
+traces these to enforce lowering/transfer contracts; tracing never
+executes device code, so the registry is cheap enough for CI.
+
+The shapes are representative, not production-sized: contract
+violations of the audited classes (a primitive with no TPU lowering, a
+host callback, an f64 upcast, an extra host-bound output) are
+shape-independent — they appear at N=16 exactly as at N=16384.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    """One registered device program, ready to trace.
+
+    ``carry_out_leaves`` — how many leading output leaves are the carry
+    (device-resident across waves); the rest are host-bound per
+    dispatch. ``expected_host_leaves`` is the transfer contract: the
+    number of arrays this program may ship device->host per dispatch
+    (None = unaudited).
+    """
+
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    allow_f64: bool = False
+    carry_out_leaves: int = 0
+    expected_host_leaves: Optional[int] = None
+    notes: str = ""
+
+
+def _scenario():
+    """A small zoned cluster + two-template backlog through the REAL
+    encoder (the same row/vocab layout production snapshots have)."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from kubernetes_tpu.oracle import ClusterState
+    from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+    zones = ["a", "b", "c"]
+    nodes = [
+        Node(
+            metadata=ObjectMeta(
+                name=f"audit-n{i:02d}",
+                labels={
+                    "kubernetes.io/hostname": f"audit-n{i:02d}",
+                    "failure-domain.beta.kubernetes.io/zone": zones[i % 3],
+                },
+            ),
+            status=NodeStatus(
+                allocatable={"cpu": "8", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(13)  # non-pow2: exercises node padding
+    ]
+    existing = [
+        Pod(
+            metadata=ObjectMeta(name=f"audit-e{i}",
+                                labels={"app": "web"}),
+            spec=PodSpec(
+                node_name=f"audit-n{i % 13:02d}",
+                containers=[Container(requests={"cpu": "500m",
+                                                "memory": "1Gi"})],
+            ),
+        )
+        for i in range(6)
+    ]
+
+    def template(tag: str, cpu: str, n: int) -> List[Pod]:
+        return [
+            Pod(
+                metadata=ObjectMeta(name=f"audit-{tag}-{i:03d}",
+                                    labels={"app": tag}),
+                spec=PodSpec(containers=[Container(
+                    requests={"cpu": cpu, "memory": "200Mi"})]),
+            )
+            for i in range(n)
+        ]
+
+    pending = template("alpha", "100m", 24) + template("beta", "250m", 20)
+    state = ClusterState.build(nodes, assigned_pods=existing)
+    snap, batch = SnapshotEncoder(state, pending).encode()
+    return snap, batch
+
+
+def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
+    """Construct every registered program + its representative args."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.models.batch import BatchScheduler, SchedulerConfig
+    from kubernetes_tpu.models.pack import pack_arrays
+    from kubernetes_tpu.models.probe import WaveProbe
+    from kubernetes_tpu.models.wave import WaveScheduler, group_buffer
+    from kubernetes_tpu.models.zreplay import (
+        _zreplay_fn,
+        _zreplay_group_fn,
+    )
+
+    config = SchedulerConfig()
+    snap, batch = _scenario()
+    N = snap.num_nodes
+    num_zones = max(int(snap.zone_id.max()) + 1, 1)
+    num_values = int(snap.svc_num_values)
+
+    sched = BatchScheduler(config)
+    static = {f: jnp.asarray(getattr(snap, f))
+              for f in BatchScheduler.STATIC_FIELDS}
+    static.update(BatchScheduler.config_static(config, snap))
+    carry = sched.initial_carry(snap)
+    carry_leaves = len(jax.tree_util.tree_leaves(carry))
+    pods = {f: jnp.asarray(getattr(batch, f))
+            for f in BatchScheduler.POD_FIELDS}
+
+    rep = 0  # template-alpha row
+    pod_host = {f: np.asarray(getattr(batch, f))[rep]
+                for f in BatchScheduler.POD_FIELDS}
+    pod = {k: jnp.asarray(v) for k, v in pod_host.items()}
+    layout, buf_host = pack_arrays(pod_host)
+    buf = jnp.asarray(buf_host)
+    counts = jnp.zeros((N,), jnp.int64)
+
+    wave = WaveScheduler(config)
+    probe = WaveProbe(config)
+    J = 128
+
+    specs: List[ProgramSpec] = [
+        ProgramSpec(
+            name="scan",
+            fn=sched._compiled(num_zones, num_values),
+            args=(static, carry, pods),
+            allow_f64=True,  # reference-exact float64 score normalizers
+            carry_out_leaves=carry_leaves,
+            expected_host_leaves=1,  # chosen[P]
+            notes="the serial-equivalent lax.scan fallback path",
+        ),
+        ProgramSpec(
+            name="probe",
+            fn=probe._compiled(num_zones, num_values, J),
+            args=(static, carry, pod),
+            carry_out_leaves=0,
+            expected_host_leaves=1,  # ONE packed array
+            notes="single-run packed probe (models/probe._probe_fn)",
+        ),
+    ]
+
+    fused = probe._compiled_fused(num_zones, num_values, J, layout,
+                                  wave._apply_fn)
+    specs.append(ProgramSpec(
+        name="probe_fused_same",
+        fn=fused["same"],
+        args=(static, carry, buf, counts),
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=1,
+        notes="fold-own-commits + re-probe, one dispatch",
+    ))
+
+    for G in (8, 32):
+        reps = [0, 24] * (G // 2)  # alternate the two templates
+        G_bucket, glayout, gbuf_host = group_buffer(batch, reps[:G])
+        gbuf = jnp.asarray(gbuf_host)
+        grouped = probe._compiled_group(
+            num_zones, num_values, G_bucket, glayout, None,
+            wave._apply_fn, wave._apply_group_fn,
+        )
+        specs.append(ProgramSpec(
+            name=f"group_probe_G{G_bucket}",
+            fn=grouped,
+            args=(static, carry, jnp.zeros(0, jnp.uint8),
+                  jnp.zeros(0, jnp.int64), gbuf),
+            carry_out_leaves=carry_leaves,
+            expected_host_leaves=1,  # headers+usage CONCATENATED
+            notes="grouped header probe: transfer count independent "
+                  "of the template count G",
+        ))
+        if G == 8:
+            gcounts = jnp.zeros((G_bucket, N), jnp.int64)
+
+            def apply_group(static_, carry_, buf_, counts_,
+                            _layout=glayout):
+                return wave._apply_group_fn(_layout, static_, carry_,
+                                            buf_, counts_)
+
+            specs.append(ProgramSpec(
+                name="apply_group",
+                fn=jax.jit(apply_group),
+                args=(static, carry, gbuf, gcounts),
+                carry_out_leaves=carry_leaves,
+                expected_host_leaves=0,  # the fold is carry-only
+                notes="grouped commit fold (wave._apply_group_fn)",
+            ))
+
+    def apply_packed(static_, carry_, buf_, counts_):
+        from kubernetes_tpu.models.pack import unpack as unpack_pod
+
+        return wave._apply_fn(static_, carry_, unpack_pod(layout, buf_),
+                              counts_)
+
+    specs.append(ProgramSpec(
+        name="apply",
+        fn=jax.jit(apply_packed),
+        args=(static, carry, buf, counts),
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=0,
+        notes="single-run commit fold (wave._apply_fn, packed row)",
+    ))
+
+    # zoned device replay: single-run and grouped
+    perm = np.asarray(snap.name_desc_order).astype(np.int64)
+    zone_perm = jnp.asarray(
+        np.ascontiguousarray(np.asarray(snap.zone_id)[perm], np.int32))
+    veto_perm = jnp.asarray(np.zeros(N, bool))
+    K = 64
+    zfn = jax.jit(functools.partial(
+        _zreplay_fn, config, num_zones, num_values, J, K, layout,
+        wave._apply_fn, False,
+    ))
+    specs.append(ProgramSpec(
+        name="zreplay",
+        fn=zfn,
+        args=(static, carry, jnp.zeros(0, jnp.uint8),
+              jnp.zeros(0, jnp.int64), buf, zone_perm, veto_perm,
+              jnp.asarray(True), jnp.asarray(np.int64(32)),
+              jnp.asarray(np.int32(K)), np.int64(0)),
+        allow_f64=True,  # mirrors replay._scores float64 exactly
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=4,  # chosen, counts, L, n_done
+        notes="zoned-spread device replay (models/zreplay)",
+    ))
+    Gz = 8
+    reps = [0, 24] * (Gz // 2)
+    Gz_bucket, gzlayout, gzbuf_host = group_buffer(batch, reps)
+    zgfn = jax.jit(functools.partial(
+        _zreplay_group_fn, config, num_zones, num_values, J, K,
+        Gz_bucket, gzlayout, wave._apply_fn, None, None,
+        wave._apply_group_fn,
+    ))
+    specs.append(ProgramSpec(
+        name="zreplay_group",
+        fn=zgfn,
+        args=(static, carry, jnp.zeros(0, jnp.uint8),
+              jnp.zeros(0, jnp.int64), jnp.asarray(gzbuf_host),
+              zone_perm, jnp.asarray(np.zeros((Gz_bucket, N), bool)),
+              jnp.asarray(np.ones(Gz_bucket, bool)),
+              jnp.asarray(np.full(Gz_bucket, 32, np.int64)),
+              jnp.asarray(np.full(Gz_bucket, K, np.int32)),
+              np.int64(0)),
+        allow_f64=True,
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=3,  # chosen[G,K], n_done[G], L
+        notes="grouped zoned device replay: G runs, one dispatch",
+    ))
+
+    if include_mesh:
+        specs.extend(_mesh_programs(config, snap, batch, layout,
+                                    buf_host, carry_leaves))
+    return specs
+
+
+def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
+                   carry_leaves) -> List[ProgramSpec]:
+    """The shard_map variants, when this host can form a mesh."""
+    import jax
+
+    from kubernetes_tpu.parallel.compat import have_shard_map
+
+    if not have_shard_map() or len(jax.devices()) < 2:
+        return []
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.models.batch import BatchScheduler
+    from kubernetes_tpu.models.probe import N_STK_ROWS  # noqa: F401
+    from kubernetes_tpu.models.wave import group_buffer
+    from kubernetes_tpu.parallel import mesh as M
+    from kubernetes_tpu.parallel.compat import shard_map
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, (M.AXIS,))
+    n_dev = devices.size
+    snap_p = M._pad_snapshot(snap, n_dev)
+    n = len(snap_p.node_names)
+    n_per_shard = n // n_dev
+    num_zones = max(int(snap_p.zone_id.max()) + 1, 1)
+    num_values = int(snap_p.svc_num_values)
+
+    static = {f: jnp.asarray(getattr(snap_p, f))
+              for f in BatchScheduler.STATIC_FIELDS}
+    static.update(BatchScheduler.config_static(config, snap_p))
+    static["name_desc_order_global"] = static.pop("name_desc_order")
+    sched = BatchScheduler(config)
+    carry = sched.initial_carry(snap_p)
+    pods = {f: jnp.asarray(getattr(batch, f))
+            for f in BatchScheduler.POD_FIELDS}
+    pod_buf = jnp.asarray(pod_buf_host)
+    counts_global = jnp.zeros((n,), jnp.int64)
+    J = 128
+    from jax.sharding import PartitionSpec as PSpec
+
+    specs: List[ProgramSpec] = []
+
+    scan_body = functools.partial(
+        M._mesh_scan_fn, config, num_zones, n_per_shard, n, num_values)
+
+    def spmd(static_, carry_, pods_):
+        import jax as _jax
+
+        return _jax.lax.scan(
+            functools.partial(scan_body, static_), carry_, pods_)
+
+    specs.append(ProgramSpec(
+        name="mesh_scan",
+        fn=jax.jit(shard_map(
+            spmd, mesh=mesh,
+            in_specs=(M._static_specs(static), M.CARRY_SPECS,
+                      {k: PSpec() for k in pods}),
+            out_specs=(M.CARRY_SPECS, PSpec()),
+            check_vma=False,
+        )),
+        args=(static, carry, pods),
+        allow_f64=True,
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=1,
+        notes="sharded scan (MeshBatchScheduler._exec)",
+    ))
+    specs.append(ProgramSpec(
+        name="mesh_probe",
+        fn=jax.jit(shard_map(
+            functools.partial(M._mesh_probe_fn, config, num_zones,
+                              num_values, J, n_per_shard, n, pod_layout),
+            mesh=mesh,
+            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec()),
+            out_specs=PSpec(None, M.AXIS),
+            check_vma=False,
+        )),
+        args=(static, carry, pod_buf),
+        carry_out_leaves=0,
+        expected_host_leaves=1,
+        notes="sharded single-run probe (MeshWaveScheduler._probe_run)",
+    ))
+    G_bucket, glayout, gbuf_host = group_buffer(batch, [0, 24, 0, 24])
+    specs.append(ProgramSpec(
+        name="mesh_group_probe",
+        fn=jax.jit(shard_map(
+            functools.partial(M._mesh_group_probe_fn, config, num_zones,
+                              num_values, G_bucket, n_per_shard, n,
+                              glayout),
+            mesh=mesh,
+            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec()),
+            out_specs=PSpec(None, M.AXIS),
+            check_vma=False,
+        )),
+        args=(static, carry, jnp.asarray(gbuf_host)),
+        carry_out_leaves=0,
+        expected_host_leaves=1,
+        notes="sharded grouped header probe: ONE host-bound array",
+    ))
+    specs.append(ProgramSpec(
+        name="mesh_apply",
+        fn=jax.jit(shard_map(
+            functools.partial(M._mesh_apply_fn, config, pod_layout),
+            mesh=mesh,
+            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec(),
+                      PSpec()),
+            out_specs=M.CARRY_SPECS,
+            check_vma=False,
+        )),
+        args=(static, carry, pod_buf, counts_global),
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=0,
+        notes="sharded commit fold (MeshWaveScheduler._apply_run)",
+    ))
+    specs.append(ProgramSpec(
+        name="mesh_apply_group",
+        fn=jax.jit(shard_map(
+            functools.partial(M._mesh_apply_group_fn, config, glayout),
+            mesh=mesh,
+            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec(),
+                      PSpec()),
+            out_specs=M.CARRY_SPECS,
+            check_vma=False,
+        )),
+        args=(static, carry, jnp.asarray(gbuf_host),
+              jnp.zeros((G_bucket, n), jnp.int64)),
+        carry_out_leaves=carry_leaves,
+        expected_host_leaves=0,
+        notes="sharded grouped commit fold",
+    ))
+    return specs

@@ -43,14 +43,48 @@ _PAUSE_BIN = os.path.join(_REPO_ROOT, "build", "pause", "pause")
 _pause_lock = threading.Lock()
 
 
+_pause_validated: Dict[str, bool] = {}  # bin path -> runs on THIS image
+
+
+def _pause_runs_here(path: str) -> bool:
+    """True when the binary actually executes on this image. A cached
+    (or checked-in) pause built against a newer libc exec()s but dies in
+    the dynamic loader ("GLIBC_x.y not found"), leaving every "running"
+    pod a restart-flapping corpse with an empty /proc cmdline — so the
+    mtime cache must be validated by running it once per process."""
+    cached = _pause_validated.get(path)
+    if cached is not None:
+        return cached
+    ok = False
+    try:
+        proc = subprocess.Popen(
+            [path], stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+        )
+        try:
+            # pause blocks forever; surviving the loader for 200ms is
+            # the signal (a loader failure exits within milliseconds)
+            proc.wait(timeout=0.2)
+        except subprocess.TimeoutExpired:
+            ok = True
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    except OSError:
+        ok = False
+    _pause_validated[path] = ok
+    return ok
+
+
 def ensure_pause() -> Optional[str]:
-    """Compile build/pause/pause.c on demand (cached by mtime) — the
-    one native artifact the reference ships too."""
+    """Compile build/pause/pause.c on demand (cached by mtime, validated
+    by execution) — the one native artifact the reference ships too."""
     with _pause_lock:
         try:
             if (os.path.exists(_PAUSE_BIN) and
                     os.path.getmtime(_PAUSE_BIN) >=
-                    os.path.getmtime(_PAUSE_SRC)):
+                    os.path.getmtime(_PAUSE_SRC) and
+                    _pause_runs_here(_PAUSE_BIN)):
                 return _PAUSE_BIN
         except OSError:
             pass
@@ -66,6 +100,9 @@ def ensure_pause() -> Optional[str]:
         if proc.returncode != 0:
             return None
         os.replace(tmp, _PAUSE_BIN)
+        _pause_validated.pop(_PAUSE_BIN, None)
+        if not _pause_runs_here(_PAUSE_BIN):
+            return None  # even a fresh build can't run here: fall back
         return _PAUSE_BIN
 
 

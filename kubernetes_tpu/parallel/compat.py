@@ -1,0 +1,47 @@
+"""jax version compatibility for the mesh scheduler's SPMD surface.
+
+`shard_map` moved twice across the jax versions this repo must run on:
+it lives at `jax.shard_map` (with a `check_vma` kwarg) on current
+releases, and at `jax.experimental.shard_map.shard_map` (where the same
+switch is spelled `check_rep`) on the 0.4.x line this CI image ships.
+Every mesh program routes through this one wrapper so the version probe
+happens exactly once and call sites stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+_IMPL = None  # (callable, uses_check_vma) resolved on first use
+
+
+def _resolve():
+    global _IMPL
+    if _IMPL is None:
+        try:
+            from jax import shard_map as sm  # jax >= 0.6
+
+            _IMPL = (sm, True)
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+
+            _IMPL = (sm, False)
+    return _IMPL
+
+
+def have_shard_map() -> bool:
+    """True when some spelling of shard_map exists in this jax build."""
+    try:
+        _resolve()
+        return True
+    except ImportError:
+        return False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` with the replication-check kwarg mapped to
+    whatever this jax build calls it (`check_vma` vs `check_rep`)."""
+    sm, modern = _resolve()
+    if modern:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_vma)

@@ -940,7 +940,7 @@ class MeshBatchScheduler:
                 )
                 return final, chosen
 
-            from jax import shard_map
+            from kubernetes_tpu.parallel.compat import shard_map
 
             sharded = shard_map(
                 spmd,
@@ -1003,7 +1003,7 @@ class MeshWaveScheduler:
                pod_layout)
         run = self._probe_jit.get(key)
         if run is None:
-            from jax import shard_map
+            from kubernetes_tpu.parallel.compat import shard_map
 
             body = functools.partial(
                 _mesh_probe_fn, self.config, num_zones, num_values, J,
@@ -1027,7 +1027,7 @@ class MeshWaveScheduler:
         key = ("apply", n, n_per_shard, pod_layout)
         run = self._apply_jit.get(key)
         if run is None:
-            from jax import shard_map
+            from kubernetes_tpu.parallel.compat import shard_map
 
             body = functools.partial(
                 _mesh_apply_fn, self.config, pod_layout
@@ -1055,7 +1055,7 @@ class MeshWaveScheduler:
                pod_layout)
         run = self._probe_jit.get(key)
         if run is None:
-            from jax import shard_map
+            from kubernetes_tpu.parallel.compat import shard_map
 
             body = functools.partial(
                 _mesh_group_probe_fn, self.config, num_zones,
@@ -1080,7 +1080,7 @@ class MeshWaveScheduler:
         key = ("gapply", n, n_per_shard, pod_layout)
         run = self._apply_jit.get(key)
         if run is None:
-            from jax import shard_map
+            from kubernetes_tpu.parallel.compat import shard_map
 
             body = functools.partial(
                 _mesh_apply_group_fn, self.config, pod_layout

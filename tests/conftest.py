@@ -68,7 +68,11 @@ def _have_module(name):
 _MISSING_DEPS = []
 if not _have_module("cryptography"):
     _MISSING_DEPS.append("cryptography")
-if not hasattr(jax, "shard_map"):
+# parallel/compat.py bridges `jax.shard_map` to the 0.4.x experimental
+# spelling, so the mesh path only goes missing when NEITHER exists
+from kubernetes_tpu.parallel.compat import have_shard_map
+
+if not have_shard_map():
     _MISSING_DEPS.append("shard_map")
 
 
@@ -117,8 +121,8 @@ def pytest_pycollect_makemodule(module_path, parent):
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """Lazily-imported optional deps fail inside the test call (the
-    mesh path does `from jax import shard_map` at dispatch time); remap
-    those failures to skips the same way."""
+    mesh path resolves shard_map through kubernetes_tpu.parallel.compat
+    at dispatch time); remap those failures to skips the same way."""
     outcome = yield
     rep = outcome.get_result()
     if rep.when in ("setup", "call") and rep.failed and call.excinfo is not None:
@@ -130,6 +134,19 @@ def pytest_runtest_makereport(item, call):
                 item.location[1],
                 f"Skipped: optional dependency {dep!r} not in this image",
             )
+
+
+if os.environ.get("KUBERNETES_TPU_LOCK_SANITIZER"):
+    # opt-in suite-wide arming of the lock-order sanitizer (the chaos
+    # module arms it unconditionally): KUBERNETES_TPU_LOCK_SANITIZER=1
+    # wraps EVERY test, so any suite doubles as an ordering witness
+    from kubernetes_tpu.analysis import locks as _locks
+
+    @pytest.fixture(autouse=True)
+    def _global_lock_sanitizer():
+        with _locks.instrumented():
+            yield
+        _locks.assert_no_cycles("(suite-wide)")
 
 
 def wait_until(cond, timeout=60.0, interval=0.01):

@@ -1,0 +1,383 @@
+"""Static-analysis suite (kubernetes_tpu/analysis): the tree must be
+clean under every pass, AND each pass must catch its seeded violation —
+a gate that can't fail is not a gate.
+
+Seeded violations per the issue: an s64 dot_general (the PR 3 TPU
+lowering incident), a ``.item()`` host sync in a hot module, and a
+lock-order inversion."""
+
+import sys
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.analysis import Finding, render_report
+from kubernetes_tpu.analysis import lint
+from kubernetes_tpu.analysis import jaxpr_audit
+from kubernetes_tpu.analysis import locks
+from kubernetes_tpu.analysis.compile_guard import CompileSentinel
+from kubernetes_tpu.analysis.jaxpr_audit import (
+    audit_jaxpr,
+    registered_programs,
+)
+from kubernetes_tpu.analysis.programs import ProgramSpec
+
+
+# -- pass 1: jaxpr auditor ----------------------------------------------------
+
+
+def _active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def test_tree_jaxpr_audit_clean():
+    """Every registered device program honors the lowering/transfer
+    contracts (this is the `python -m kubernetes_tpu.analysis` body)."""
+    findings = jaxpr_audit.audit_all()
+    assert not _active(findings), render_report(findings)
+
+
+def test_registry_covers_the_wave_programs():
+    names = {s.name for s in registered_programs()}
+    for expect in ("scan", "probe", "probe_fused_same", "apply",
+                   "apply_group", "zreplay", "zreplay_group"):
+        assert expect in names, f"{expect} missing from the registry"
+    assert any(n.startswith("group_probe_G") for n in names)
+    # mesh variants ride when the host can form a mesh (conftest
+    # forces 8 CPU devices, so here they must be present)
+    if len(jax.devices()) >= 2:
+        assert {"mesh_scan", "mesh_probe", "mesh_group_probe",
+                "mesh_apply", "mesh_apply_group"} <= names
+
+
+def test_grouped_wave_transfer_contract_is_static():
+    """The O(1)-dispatch property as a STRUCTURAL invariant: the
+    grouped probe ships exactly ONE host-bound array at every
+    registered G (probe=1 transfer per wave regardless of template
+    count) and the folds ship zero (apply=1 dispatch, 0 transfers)."""
+    specs = {s.name: s for s in registered_programs()}
+    gp = [s for n, s in specs.items() if n.startswith("group_probe_G")]
+    assert len(gp) >= 2, "need two G values to pin G-independence"
+    for s in gp:
+        assert s.expected_host_leaves == 1
+        assert not jaxpr_audit._transfer_findings(s), s.name
+    for n in ("apply", "apply_group"):
+        assert specs[n].expected_host_leaves == 0
+        assert not jaxpr_audit._transfer_findings(specs[n]), n
+
+
+def test_seeded_transfer_contract_violation_is_flagged():
+    """An extra device->host output must trip the transfer audit."""
+    carry = (jnp.zeros(3), jnp.zeros(3))
+
+    def leaky(c, x):
+        return c, x * 2, x + 1  # 2 host-bound outputs
+
+    spec = ProgramSpec(
+        name="seeded_leak", fn=jax.jit(leaky),
+        args=(carry, jnp.zeros(3)),
+        carry_out_leaves=2, expected_host_leaves=1,
+    )
+    found = jaxpr_audit._transfer_findings(spec)
+    assert len(found) == 1 and found[0].rule == "transfer-contract"
+
+
+def test_seeded_s64_dot_general_is_flagged():
+    """Reintroduce the PR 3 incident: an s64 matmul must be denylisted."""
+    bad = jax.jit(lambda a, b: a @ b)
+    jaxpr = jax.make_jaxpr(bad)(
+        jnp.ones((4, 4), jnp.int64), jnp.ones((4, 4), jnp.int64)
+    )
+    found = audit_jaxpr("seeded_s64", jaxpr)
+    assert any(f.rule == "denylisted-primitive" for f in found), found
+    # and the f32 spelling of the same program is fine
+    ok = jax.make_jaxpr(bad)(
+        jnp.ones((4, 4), jnp.float32), jnp.ones((4, 4), jnp.float32)
+    )
+    assert not audit_jaxpr("ok_f32", ok)
+
+
+def test_seeded_callback_and_f64_upcast_are_flagged():
+    def with_cb(x):
+        import numpy as np
+
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), x.dtype), x
+        )
+
+    jaxpr = jax.make_jaxpr(with_cb)(jnp.ones(4))
+    assert any(f.rule == "host-callback"
+               for f in audit_jaxpr("seeded_cb", jaxpr))
+
+    # a weak-type float division promotes int64 -> float64: the classic
+    # silent upcast the probe/apply contract forbids
+    jaxpr2 = jax.make_jaxpr(jax.jit(lambda x: x / 3.0))(
+        jnp.ones(4, jnp.int64))
+    found = audit_jaxpr("seeded_f64", jaxpr2)
+    assert any(f.rule == "f64-upcast" for f in found), found
+    # ...and the same jaxpr passes when the program is registered f64
+    assert not audit_jaxpr("allowed_f64", jaxpr2, allow_f64=True)
+
+
+# -- pass 2: AST lint ---------------------------------------------------------
+
+
+def test_tree_lint_clean():
+    findings = lint.lint_tree()
+    assert not _active(findings), render_report(findings)
+
+
+_HOT_FIXTURE = '''\
+import jax
+import jax.numpy as jnp
+
+
+def _traced_body(x):
+    k = x.sum(){item}  # seeded host sync
+    return x * k
+
+
+def run(x):
+    return jax.jit(_traced_body)(x)
+'''
+
+
+def test_seeded_item_in_hot_module_is_flagged():
+    src = _HOT_FIXTURE.format(item=".item()")
+    found = lint.lint_sources(
+        {"kubernetes_tpu/models/_seeded_fixture.py": src})
+    hs = [f for f in found if f.rule == "host-sync"]
+    assert len(hs) == 1 and not hs[0].suppressed, found
+    assert "_seeded_fixture.py:6" in hs[0].where
+
+
+def test_lint_suppression_syntax():
+    src = _HOT_FIXTURE.format(
+        item=".item()  # lint: allow[host-sync]")
+    found = lint.lint_sources(
+        {"kubernetes_tpu/models/_seeded_fixture.py": src})
+    hs = [f for f in found if f.rule == "host-sync"]
+    assert len(hs) == 1 and hs[0].suppressed, found
+
+
+def test_lint_traced_scope_is_transitive_and_cold_code_is_exempt():
+    src = '''\
+import jax
+import jax.numpy as jnp
+
+
+def helper(x):
+    return x.sum().item()  # reached from a traced body
+
+
+def _traced_body(x):
+    return helper(x)
+
+
+def run(x):
+    return jax.jit(_traced_body)(x)
+
+
+def host_driver(arr):
+    return arr.sum().item()  # NOT traced: no finding here
+'''
+    found = lint.lint_sources(
+        {"kubernetes_tpu/models/_seeded_fixture2.py": src})
+    hs = [f for f in found if f.rule == "host-sync"]
+    assert len(hs) == 1, found
+    assert ":6" in hs[0].where  # helper's .item(), not host_driver's
+
+
+def test_lint_package_wide_rules_fire():
+    src = '''\
+import threading
+from kubernetes_tpu.metrics import Counter
+
+
+def f(x=[]):
+    try:
+        pass
+    except:
+        pass
+    threading.Thread(target=f).start()
+    return Counter("loose_total", "constructed outside the registry")
+'''
+    found = lint.lint_sources({"kubernetes_tpu/client/_seeded3.py": src})
+    rules = {f.rule for f in found}
+    assert {"mutable-default", "bare-except", "nondaemon-thread",
+            "metric-outside-registry"} <= rules, found
+
+
+def test_lint_syntax_error_is_a_finding_not_a_crash():
+    found = lint.lint_sources({
+        "kubernetes_tpu/models/_broken.py": "def f(:\n",
+        "kubernetes_tpu/models/_fine.py": "x = 1\n",
+    })
+    se = [f for f in found if f.rule == "syntax-error"]
+    assert len(se) == 1 and "_broken.py" in se[0].where, found
+
+
+def test_lint_impure_traced_rules_fire():
+    src = '''\
+import time
+
+import jax
+
+
+def _traced_body(x):
+    t = time.time()  # seeded impurity
+    print("trace me")
+    return x
+
+
+def run(x):
+    return jax.jit(_traced_body)(x)
+'''
+    found = lint.lint_sources(
+        {"kubernetes_tpu/ops/_seeded4.py": src})
+    impure = [f for f in found if f.rule == "traced-impure"]
+    assert len(impure) == 2, found
+
+
+# -- pass 3: runtime sanitizers ----------------------------------------------
+
+
+def _fake_component():
+    """Locks created from a module whose __name__ is inside the
+    package, so the instrumented factories track them."""
+    mod = types.ModuleType("kubernetes_tpu._seeded_locks")
+    sys.modules["kubernetes_tpu._seeded_locks"] = mod
+    src = ("import threading\n"
+           "def make_a():\n    return threading.Lock()\n"
+           "def make_b():\n    return threading.Lock()\n")
+    exec(compile(src, "_seeded_locks.py", "exec"), mod.__dict__)
+    return mod
+
+
+def test_seeded_lock_order_inversion_is_flagged():
+    mod = _fake_component()
+    locks.GRAPH.reset()
+    with locks.instrumented():
+        a, b = mod.make_a(), mod.make_b()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        for fn in (t1, t2):
+            th = threading.Thread(target=fn)
+            th.start()
+            th.join()
+    try:
+        cycles = locks.GRAPH.cycles()
+        assert cycles, "inversion not detected"
+        with pytest.raises(AssertionError, match="lock-order"):
+            locks.assert_no_cycles("(seeded)")
+    finally:
+        locks.GRAPH.reset()  # never leak the seeded cycle into chaos
+
+
+def test_consistent_lock_order_stays_clean():
+    mod = _fake_component()
+    locks.GRAPH.reset()
+    with locks.instrumented():
+        a, b = mod.make_a(), mod.make_b()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        with a:
+            pass
+        with b:
+            pass
+    assert not locks.GRAPH.cycles()
+    locks.assert_no_cycles("(ordered)")
+
+
+def test_reentrant_rlock_is_not_a_cycle():
+    mod = _fake_component()
+    src = ("import threading\n"
+           "def make_r():\n    return threading.RLock()\n")
+    exec(compile(src, "_seeded_locks.py", "exec"), mod.__dict__)
+    locks.GRAPH.reset()
+    with locks.instrumented():
+        r = mod.make_r()
+        with r:
+            with r:  # re-entrant: no self-edge
+                pass
+    assert not locks.GRAPH.cycles()
+
+
+def test_untracked_modules_get_raw_locks():
+    with locks.instrumented():
+        lk = threading.Lock()  # caller: tests/, not kubernetes_tpu
+    assert not isinstance(lk, locks.TrackedLock)
+
+
+def test_compile_sentinel_catches_steady_state_compiles():
+    sentinel = CompileSentinel()
+    f = jax.jit(lambda x: x * 2 + 1)
+    f(jnp.ones(7))  # compile happens OUTSIDE the guarded window
+    with sentinel.expect_no_compiles("warm replay"):
+        f(jnp.ones(7))
+    with pytest.raises(AssertionError, match="recompilation"):
+        with sentinel.expect_no_compiles("cold"):
+            jax.jit(lambda x: x * 3 - 1)(jnp.ones(7))
+
+
+# -- the CLI gate -------------------------------------------------------------
+
+
+def test_cli_lint_gate_exits_zero():
+    from kubernetes_tpu.analysis.__main__ import main
+
+    assert main(["--lint-only"]) == 0
+
+
+def test_findings_report_shape():
+    rep = render_report([
+        Finding("lint", "host-sync", "a.py:1", "x", suppressed=False),
+        Finding("lint", "host-sync", "b.py:2", "y", suppressed=True),
+    ], "t:")
+    assert "1 finding(s), 1 suppressed" in rep
+    assert "a.py:1" in rep
+    # suppressed rows stay visible, marked — allowance drift is
+    # auditable from the report itself
+    assert "[suppressed lint/host-sync] b.py:2" in rep
+
+
+def test_nondaemon_thread_rule_ignores_path_and_str_joins():
+    """os.path.join / ', '.join must NOT satisfy the thread-join
+    heuristic — only a plausible Thread.join() does."""
+    base = '''\
+import os
+import threading
+
+
+def f():
+    p = os.path.join("a", "b")
+    s = ", ".join(["x", "y"])
+    threading.Thread(target=print).start()
+    return p, s
+'''
+    found = lint.lint_sources({"kubernetes_tpu/client/_seeded5.py": base})
+    assert any(f.rule == "nondaemon-thread" for f in found), found
+    joined = base.replace(
+        "    return p, s",
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+        "    return p, s",
+    )
+    found2 = lint.lint_sources(
+        {"kubernetes_tpu/client/_seeded5.py": joined})
+    assert not any(f.rule == "nondaemon-thread" for f in found2), found2

@@ -30,6 +30,20 @@ from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOpti
 
 from conftest import wait_until  # noqa: E402
 
+from kubernetes_tpu.analysis import locks as lock_sanitizer
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sanitizer():
+    """The chaos scenarios double as lock-order witnesses: every
+    Lock/RLock created by kubernetes_tpu code during the test is
+    wrapped (analysis/locks) and the cross-thread acquisition-order
+    graph must stay acyclic — a cycle is a latent deadlock even when
+    this run's interleaving got lucky."""
+    with lock_sanitizer.instrumented():
+        yield
+    lock_sanitizer.assert_no_cycles("(chaos suite)")
+
 
 def ready_node(name):
     return Node(

@@ -263,6 +263,43 @@ def test_raw_wave_throughput_floor():
     )
 
 
+def test_wave_steady_state_no_recompilation():
+    """The O(1)-dispatch gate's compile-side sibling: wave N>1 over
+    backlogs that land in the SAME pow2 padding buckets must re-use
+    every compiled program — a jit cache keyed on a per-wave value
+    (python-int leak, layout drift) turns steady-state scheduling into
+    multi-second XLA compiles, which the throughput gates only see as
+    'slow'. The sentinel attributes the exact compile events."""
+    from kubernetes_tpu.analysis.compile_guard import CompileSentinel
+    from kubernetes_tpu.oracle import ClusterState
+    from kubernetes_tpu.scheduler.tpu_algorithm import (
+        TPUScheduleAlgorithm,
+    )
+
+    state = ClusterState.build(_nodes(100))
+    het = []
+    for t in range(8):
+        for i in range(30):
+            het.append(Pod(
+                metadata=ObjectMeta(name=f"nc-{t:02d}-{i:03d}",
+                                    labels={"run": "slo"}),
+                spec=PodSpec(containers=[Container(requests={
+                    "cpu": f"{60 + t * 7}m", "memory": "150Mi"})]),
+            ))
+    algo = TPUScheduleAlgorithm()
+    cold = algo.schedule_backlog(het, state)  # wave 1 compiles freely
+    sentinel = CompileSentinel()
+    algo._last_node_index = 0
+    with sentinel.expect_no_compiles("wave 2 (identical backlog)"):
+        warm = algo.schedule_backlog(het, state)
+    assert warm == cold, "steady-state rerun diverged"
+    # a smaller backlog inside the same padding bucket must also re-use
+    # the compiled programs (the bucket IS the compile-cache key)
+    algo._last_node_index = 0
+    with sentinel.expect_no_compiles("wave 3 (same bucket, fewer pods)"):
+        algo.schedule_backlog(het[: len(het) - 5], state)
+
+
 def test_wave_dispatch_count_gate():
     """STRUCTURAL gate on the grouped dispatch path: a 24-template wave
     must cost O(1) device dispatches (ONE grouped header probe + ONE
