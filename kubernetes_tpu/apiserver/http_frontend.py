@@ -10,14 +10,21 @@ the reference's watch wire shape (pkg/apiserver/watch.go WatchServer):
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
-from kubernetes_tpu.metrics import apiserver_request_latency
+from kubernetes_tpu.metrics import (
+    apiserver_request_latency,
+    apiserver_requests_total,
+    apiserver_watch_events_sent_total,
+)
 from kubernetes_tpu.runtime import binary
+
+_sent_events = apiserver_watch_events_sent_total.child()
 
 
 def _is_long_running(path: str, query: dict) -> bool:
@@ -63,9 +70,29 @@ def start_http_server(api: APIServer, host: str, port: int,
     )
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # an idle keep-alive connection releases its handler thread
+        # after this long; pooled clients transparently retry a fresh
+        # socket on the next request
+        timeout = 120
 
         def log_message(self, fmt, *args):  # quiet; pkg/httplog is V-gated
             pass
+
+        def setup(self):
+            super().setup()
+            # registered so shutdown can close live keep-alive
+            # connections: a "killed" apiserver with pooled client
+            # sockets must go dark, not keep serving as a zombie
+            with self.server._conn_lock:
+                self.server._conns.add(self.connection)
+
+        def finish(self):
+            with self.server._conn_lock:
+                self.server._conns.discard(self.connection)
+            try:
+                super().finish()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
 
         def _dispatch(self, method: str):
             parsed = urlparse(self.path)
@@ -99,6 +126,7 @@ def start_http_server(api: APIServer, host: str, port: int,
             # connection for minutes by design and would drown the
             # histogram in stream lifetimes
             timed = not _is_long_running(parsed.path, query)
+            apiserver_requests_total.inc(verb=method)
             t0 = time.perf_counter() if timed else 0.0
             try:
                 self._dispatch_inner(method, parsed, query)
@@ -159,10 +187,20 @@ def start_http_server(api: APIServer, host: str, port: int,
                     ns, info, _name, _sub, _grp, _ver = api._route(
                         parsed.path
                     )
+                    resource = info.resource if info else ""
+                    if parsed.path.rstrip("/") == "/api/v1/batch":
+                        # the batch endpoint writes pods (bindings +
+                        # status) across namespaces in one request; it
+                        # authorizes as its own resource so admins
+                        # grant it explicitly to scheduler-tier users —
+                        # an empty resource would otherwise deny every
+                        # non-wildcard policy AND let wildcard-only
+                        # rules reach cross-resource writes unnamed
+                        resource = "batchcommits"
                     attrs = Attributes(
                         user=user,
                         verb=method,
-                        resource=info.resource if info else "",
+                        resource=resource,
                         namespace=ns,
                         name=_name or "",
                         api_group=info.group if info else "",
@@ -213,14 +251,27 @@ def start_http_server(api: APIServer, host: str, port: int,
                     except json.JSONDecodeError:
                         self._send_json(400, {"message": "invalid JSON body"})
                         return
-            code, payload = api.handle(
-                method, parsed.path, query, body, obj_mode=wants_binary,
-                body_owned=body_owned,
-            )
+            if wants_binary:
+                # raw_mode: cache-served list/get responses arrive as
+                # stored TLV bytes, spliced into the reply verbatim.
+                # Only passed on the binary path so in-process handle()
+                # stubs with the classic signature keep working.
+                code, payload = api.handle(
+                    method, parsed.path, query, body, obj_mode=True,
+                    body_owned=body_owned, raw_mode=True,
+                )
+            else:
+                code, payload = api.handle(
+                    method, parsed.path, query, body, obj_mode=False,
+                    body_owned=body_owned,
+                )
             if isinstance(payload, WatchResponse):
                 self._stream_watch(payload)
                 return
             if wants_binary:
+                # Raw payloads (watch-cache hits) splice the stored TLV
+                # bytes into the response verbatim — encode() is a byte
+                # concatenation for them, zero re-encode
                 data = binary.encode(payload)
                 self.send_response(code)
                 self.send_header("Content-Type", binary.CONTENT_TYPE)
@@ -288,8 +339,10 @@ def start_http_server(api: APIServer, host: str, port: int,
                             else b"\n"
                         )
                     elif binary_stream:
+                        _sent_events(len(batch))
                         payload = b"".join(batch)  # already frame bytes
                     else:
+                        _sent_events(len(batch))
                         payload = b"".join(
                             json.dumps(ev).encode() + b"\n" for ev in batch
                         )
@@ -342,6 +395,24 @@ def start_http_server(api: APIServer, host: str, port: int,
             for w in watches:
                 w.stop()
 
+        def close_connections(self) -> None:
+            """Hard-close every live connection (keep-alive handlers
+            included): a shut-down apiserver must refuse its pooled
+            clients immediately, not serve them from beyond the grave
+            or strand them in read timeouts."""
+            with self._conn_lock:
+                conns = list(self._conns)
+                self._conns.clear()
+            for c in conns:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
     server = Server((host, port), Handler)
     if tls_cert and tls_key:
         import ssl
@@ -357,6 +428,8 @@ def start_http_server(api: APIServer, host: str, port: int,
     server._watch_lock = threading.Lock()
     server._active_watches = []
     server._watches_closed = False
+    server._conn_lock = threading.Lock()
+    server._conns = set()
     thread = threading.Thread(
         target=server.serve_forever, name="apiserver-http", daemon=True
     )

@@ -189,6 +189,28 @@ class ResourceClient:
         return out.get("items", [])
 
 
+def batch_bind_item(pod_name: str, node_name: str,
+                    namespace: str = "default") -> Dict[str, Any]:
+    """One /api/v1/batch bind item (the wave scheduler's per-pod op)."""
+    return {
+        "op": "bind",
+        "metadata": {"name": pod_name, "namespace": namespace},
+        "target": {"kind": "Node", "name": node_name},
+    }
+
+
+def batch_status_item(resource: str, name: str, status: Dict[str, Any],
+                      namespace: str = "default") -> Dict[str, Any]:
+    """One /api/v1/batch status item (merge-patched into .status)."""
+    return {
+        "op": "status",
+        "resource": resource,
+        "namespace": namespace,
+        "name": name,
+        "status": status,
+    }
+
+
 class WatchExpired(Exception):
     """410: the requested resourceVersion is compacted; relist."""
 
@@ -248,6 +270,17 @@ class RESTClient:
 
     def events(self, namespace: str = "default") -> ResourceClient:
         return self.resource("events", namespace)
+
+    def commit_batch(self, items) -> list:
+        """POST /api/v1/batch: a wave's bindings + status updates as ONE
+        request and one store transaction. `items` are
+        batch_bind_item/batch_status_item dicts; returns the per-item
+        result list (Success/Failure) in order."""
+        out = self.do_raw(
+            "POST", "/api/v1/batch",
+            body={"kind": "BatchRequest", "items": list(items)},
+        )
+        return out.get("items", [])
 
     def do(self, method: str, path: str, query=None, body=None):
         """Request + decode into an API object."""

@@ -18,7 +18,6 @@ from kubernetes_tpu.runtime import binary as bin_codec
 from kubernetes_tpu.trace.profile import phase_timer
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib import parse as urlparse
-from urllib import request as urlrequest
 
 
 class LocalTransport:
@@ -95,12 +94,62 @@ def build_ssl_context(tls_ca: str = "", insecure: bool = False):
     return ssl.create_default_context()
 
 
-class HTTPTransport:
-    """Minimal stdlib HTTP(S) transport (chunked watch streaming).
+class _NoCloseReader:
+    """A read proxy over one shared buffered socket reader: pipelined
+    responses must parse sequentially from the SAME buffer (a fresh
+    makefile per response could buffer-read into the next response and
+    lose those bytes), and HTTPResponse.close() must not close it."""
 
-    tls_ca pins the server certificate (the kubeconfig
-    certificate-authority idiom); insecure skips verification
-    (insecure-skip-tls-verify)."""
+    def __init__(self, fp):
+        self._fp = fp
+
+    def read(self, *a):
+        return self._fp.read(*a)
+
+    def read1(self, *a):
+        return self._fp.read1(*a)
+
+    def readinto(self, b):
+        return self._fp.readinto(b)
+
+    def readline(self, *a):
+        return self._fp.readline(*a)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _is_conn_error(e: BaseException) -> bool:
+    """Connection-level failure: nothing of the response arrived, so
+    the failover/rotation logic may act. Read timeouts are NOT in this
+    set — TimeoutError must propagate (the server may still be
+    processing the write)."""
+    import http.client as _hc
+
+    if isinstance(e, TimeoutError):
+        return False
+    return isinstance(
+        e, (ConnectionError, _hc.BadStatusLine, _hc.RemoteDisconnected,
+            _hc.CannotSendRequest, OSError)
+    )
+
+
+class HTTPTransport:
+    """Stdlib HTTP(S) transport with pooled keep-alive connections,
+    chunked watch streaming, and request pipelining.
+
+    request() and pipeline() draw from one keep-alive connection pool
+    per base URL (a socket per CALL was the old cost: TCP setup + slow
+    start on every request); watch() uses dedicated connections that
+    live for the stream. tls_ca pins the server certificate (the
+    kubeconfig certificate-authority idiom); insecure skips
+    verification (insecure-skip-tls-verify)."""
+
+    #: idle keep-alive connections retained per base URL
+    POOL_MAX = 32
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  tls_ca: str = "", insecure: bool = False,
@@ -123,7 +172,8 @@ class HTTPTransport:
         self._active = 0
         # failover rotation races: watch threads and request threads
         # rotate concurrently, and torn read-modify-writes of _active
-        # could skip a healthy server in the cycle
+        # could skip a healthy server in the cycle; pipelined requests
+        # sample base_url once and must not observe a half-rotated state
         self._active_lock = threading.Lock()
         self.timeout = timeout
         self.bearer_token = bearer_token
@@ -135,16 +185,13 @@ class HTTPTransport:
         # lands on the TLS member
         if any(u.startswith("https") for u in urls):
             self._ssl_ctx = build_ssl_context(tls_ca, insecure)
+        self._pool_lock = threading.Lock()
+        self._pool: Dict[str, list] = {}
 
     @property
     def base_url(self) -> str:
-        return self.base_urls[self._active]
-
-    def _url(self, path: str, query: Optional[Dict[str, str]]) -> str:
-        url = self.base_url + path
-        if query:
-            url += "?" + urlparse.urlencode(query)
-        return url
+        with self._active_lock:
+            return self.base_urls[self._active]
 
     def _rotate(self) -> bool:
         """Advance to the next server; True while untried servers remain
@@ -155,52 +202,143 @@ class HTTPTransport:
             self._active = (self._active + 1) % len(self.base_urls)
         return True
 
-    def request(self, method, path, query=None, body=None):
-        if self.binary:
-            data = bin_codec.encode(body) if body is not None else None
-            content_type = bin_codec.CONTENT_TYPE
-        else:
-            data = json.dumps(body).encode() if body is not None else None
-            content_type = "application/json"
-        for attempt in range(max(len(self.base_urls), 1)):
-            req = urlrequest.Request(
-                self._url(path, query), data=data, method=method.upper()
+    # -- connection pool -----------------------------------------------------
+
+    def _new_conn(self, base: str, timeout):
+        import http.client as _hc
+
+        parts = urlparse.urlsplit(base)
+        if parts.scheme == "https":
+            ctx = self._ssl_ctx or build_ssl_context()
+            return _hc.HTTPSConnection(
+                parts.hostname, parts.port, timeout=timeout, context=ctx
             )
-            req.add_header("Content-Type", content_type)
-            if self.binary:
-                req.add_header("Accept", content_type)
-            if self.bearer_token:
-                req.add_header(
-                    "Authorization", f"Bearer {self.bearer_token}"
-                )
+        return _hc.HTTPConnection(
+            parts.hostname, parts.port, timeout=timeout
+        )
+
+    def _checkout(self, base: str):
+        """-> (connection, reused). Reused connections may be stale
+        (server closed the idle socket); request() retries those once
+        on a fresh socket."""
+        with self._pool_lock:
+            conns = self._pool.get(base)
+            if conns:
+                return conns.pop(), True
+        return self._new_conn(base, self.timeout), False
+
+    def _checkin(self, base: str, conn) -> None:
+        with self._pool_lock:
+            conns = self._pool.setdefault(base, [])
+            if len(conns) < self.POOL_MAX:
+                conns.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        """Drop all pooled connections (tests / daemon shutdown)."""
+        with self._pool_lock:
+            pools, self._pool = self._pool, {}
+        for conns in pools.values():
+            for c in conns:
+                c.close()
+
+    # -- request/response ----------------------------------------------------
+
+    def _headers(self, has_body: bool) -> Dict[str, str]:
+        h: Dict[str, str] = {}
+        if has_body:
+            h["Content-Type"] = (
+                bin_codec.CONTENT_TYPE if self.binary
+                else "application/json"
+            )
+        if self.binary:
+            h["Accept"] = bin_codec.CONTENT_TYPE
+        if self.bearer_token:
+            h["Authorization"] = f"Bearer {self.bearer_token}"
+        return h
+
+    def _encode_body(self, body):
+        if body is None:
+            return None
+        if self.binary:
+            return bin_codec.encode(body)
+        return json.dumps(body).encode()
+
+    @staticmethod
+    def _target(path: str, query: Optional[Dict[str, str]]) -> str:
+        if query:
+            return path + "?" + urlparse.urlencode(query)
+        return path
+
+    def request(self, method, path, query=None, body=None):
+        data = self._encode_body(body)
+        headers = self._headers(data is not None)
+        target = self._target(path, query)
+        method = method.upper()
+        for attempt in range(max(len(self.base_urls), 1)):
+            base = self.base_url
             try:
-                with urlrequest.urlopen(
-                    req, timeout=self.timeout, context=self._ssl_ctx
-                ) as resp:
-                    payload = resp.read()
-                    return resp.status, self._decode_payload(resp, payload)
-            except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
-                payload = e.read()
-                try:
-                    return e.code, self._decode_payload(e, payload)
-                except Exception:
-                    return e.code, {
-                        "message": payload.decode(errors="replace")
-                    }
-            except urlrequest.URLError as e:  # connection-level failure
+                resp, payload = self._roundtrip(
+                    base, method, target, data, headers
+                )
+                return resp.status, self._decode_response(resp, payload)
+            except Exception as e:
+                if not _is_conn_error(e):
+                    raise
                 rotated = self._rotate()  # NEXT request targets a peer
-                if (method.upper() in ("GET", "HEAD") and rotated
+                if (method in ("GET", "HEAD") and rotated
                         and attempt + 1 < len(self.base_urls)):
                     continue  # idempotent: replay on the next server
-                # non-idempotent verbs must NOT auto-replay: the dead
-                # server may have committed (and replicated) the write
-                # before the connection dropped — replaying would
-                # double-execute or 409 the caller's own success. The
-                # caller's retry/requeue logic re-issues against the
-                # already-rotated peer.
+                # non-idempotent verbs must NOT auto-replay across
+                # servers: the dead server may have committed (and
+                # replicated) the write before the connection dropped —
+                # replaying would double-execute or 409 the caller's
+                # own success. The caller's retry/requeue logic
+                # re-issues against the already-rotated peer.
                 raise
         raise AssertionError("unreachable")
 
+    def _roundtrip(self, base, method, target, data, headers):
+        """One request/response on a pooled keep-alive connection. A
+        REUSED connection that dies before any response byte arrives is
+        retried once on a fresh socket — that is the idle-keep-alive
+        race (the server closed the pooled socket between requests),
+        not a server failure."""
+        conn, reused = self._checkout(base)
+        while True:
+            try:
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception as e:
+                conn.close()
+                if reused and _is_conn_error(e):
+                    conn, reused = self._new_conn(base, self.timeout), False
+                    continue
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(base, conn)
+            return resp, payload
+
+    def _decode_response(self, resp, payload):
+        """Decode an http.client response body (4xx/5xx included — the
+        caller maps status codes, never exceptions)."""
+        if not payload:
+            return {}
+        if self.binary:
+            ctype = resp.headers.get("Content-Type", "") or ""
+            if ctype.startswith(bin_codec.CONTENT_TYPE):
+                with phase_timer("wire"):
+                    return bin_codec.decode(payload)
+        try:
+            return json.loads(payload)
+        except ValueError:
+            return {"message": payload.decode(errors="replace")}
+
+    # kept for callers/tests that feed urllib-style response objects
     def _decode_payload(self, resp, payload):
         if not payload:
             return {}
@@ -218,48 +356,121 @@ class HTTPTransport:
                     return bin_codec.decode(payload)
         return json.loads(payload)
 
+    # -- pipelining ----------------------------------------------------------
+
+    def pipeline(self, requests):
+        """HTTP/1.1 request pipelining: write every request of `requests`
+        — [(method, path, query, body)] — onto ONE persistent
+        connection back-to-back, then parse the responses in order.
+        -> [(status, payload)]. One round-trip's latency covers the
+        whole batch instead of one per request.
+
+        Connection-level failure raises after rotating the active
+        server (no partial auto-replay: the caller owns idempotency,
+        and some requests may have committed). The connection is not
+        returned to the pool (response framing after a manual pipeline
+        is not worth re-validating)."""
+        if not requests:
+            return []
+        base = self.base_url
+        conn = self._new_conn(base, self.timeout)
+        parts = urlparse.urlsplit(base)
+        host = parts.netloc
+        try:
+            if conn.sock is None:
+                conn.connect()
+            buf = bytearray()
+            methods = []
+            for method, path, query, body in requests:
+                data = self._encode_body(body)
+                method = method.upper()
+                methods.append(method)
+                lines = [f"{method} {self._target(path, query)} HTTP/1.1",
+                         f"Host: {host}"]
+                for k, v in self._headers(data is not None).items():
+                    lines.append(f"{k}: {v}")
+                lines.append(f"Content-Length: {len(data or b'')}")
+                buf += ("\r\n".join(lines) + "\r\n\r\n").encode()
+                if data:
+                    buf += data
+            conn.sock.sendall(buf)
+            import http.client as _hc
+
+            shared = conn.sock.makefile("rb")
+            out = []
+            try:
+                for method in methods:
+                    resp = _hc.HTTPResponse(conn.sock, method=method)
+                    resp.fp = _NoCloseReader(shared)
+                    resp.begin()
+                    payload = resp.read()
+                    out.append(
+                        (resp.status, self._decode_response(resp, payload))
+                    )
+                    resp.close()
+            finally:
+                shared.close()
+            return out
+        except Exception as e:
+            if _is_conn_error(e):
+                self._rotate()
+            raise
+        finally:
+            conn.close()
+
+    # -- watch ---------------------------------------------------------------
+
     def watch(self, path, query=None):
         query = dict(query or {})
         query["watch"] = "true"
-        last_exc = None
+        target = self._target(path, query)
+        headers = self._headers(False)
         for attempt in range(max(len(self.base_urls), 1)):
-            req = urlrequest.Request(self._url(path, query))
-            if self.binary:
-                req.add_header("Accept", bin_codec.CONTENT_TYPE)
-            if self.bearer_token:
-                req.add_header(
-                    "Authorization", f"Bearer {self.bearer_token}"
-                )
+            base = self.base_url
+            # dedicated connection: a watch holds its socket for the
+            # stream's lifetime (never pooled), with no read timeout
+            conn = self._new_conn(base, None)
             try:
-                resp = urlrequest.urlopen(
-                    req, timeout=None, context=self._ssl_ctx
-                )
-                break
-            except urlrequest.HTTPError as e:  # type: ignore[attr-defined]
-                payload = e.read()
-                try:
-                    status = self._decode_payload(e, payload)
-                except Exception:
-                    status = {"message": payload.decode(errors="replace")}
-                raise WatchError(e.code, status)
-            except urlrequest.URLError as e:
-                last_exc = e
-                if attempt + 1 < len(self.base_urls) and self._rotate():
+                conn.request("GET", target, headers=headers)
+                resp = conn.getresponse()
+            except Exception as e:
+                conn.close()
+                if (_is_conn_error(e) and attempt + 1 < len(self.base_urls)
+                        and self._rotate()):
                     continue
                 raise
-        else:
-            raise last_exc  # pragma: no cover
-        if self.binary:
-            return _BinaryEvents(resp)
-        return _HTTPEvents(resp)
+            if resp.status != 200:
+                payload = resp.read()
+                conn.close()
+                try:
+                    status = self._decode_response(resp, payload)
+                except Exception:
+                    status = {"message": payload.decode(errors="replace")}
+                raise WatchError(resp.status, status)
+            if self.binary:
+                return _BinaryEvents(resp, conn)
+            return _HTTPEvents(resp, conn)
+        raise AssertionError("unreachable")
 
 
 class _BinaryEvents:
     """Length-prefixed binary watch frames (runtime/binary.py)."""
 
-    def __init__(self, resp):
+    def __init__(self, resp, conn=None):
         self._resp = resp
+        self._conn = conn
         self._stopped = False
+
+    def _close(self) -> None:
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
     def __iter__(self):
         try:
@@ -273,22 +484,31 @@ class _BinaryEvents:
             if not self._stopped:
                 raise
         finally:
-            self._resp.close()
+            self._close()
 
     def stop(self) -> None:
         self._stopped = True
-        try:
-            self._resp.close()
-        except Exception:
-            pass
+        self._close()
 
 
 class _HTTPEvents:
     """Newline-delimited JSON watch frames (pkg/apiserver/watch.go)."""
 
-    def __init__(self, resp):
+    def __init__(self, resp, conn=None):
         self._resp = resp
+        self._conn = conn
         self._stopped = False
+
+    def _close(self) -> None:
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
     def __iter__(self):
         try:
@@ -302,11 +522,8 @@ class _HTTPEvents:
             if not self._stopped:
                 raise
         finally:
-            self._resp.close()
+            self._close()
 
     def stop(self) -> None:
         self._stopped = True
-        try:
-            self._resp.close()
-        except Exception:
-            pass
+        self._close()

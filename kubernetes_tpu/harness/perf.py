@@ -67,7 +67,7 @@ def _perf_pod() -> Pod:
     )
 
 
-def make_pods(client: RESTClient, p: int, creators: int = 30,
+def make_pods(client: RESTClient, p: int, creators: int = 12,
               chunk: int = 500) -> None:
     """perf/util.go:143-175 makePodsFromRC: pause pods, parallel
     creation. Batches flow through the bulk-create endpoint (an RC
@@ -78,7 +78,13 @@ def make_pods(client: RESTClient, p: int, creators: int = 30,
     shortfall topped up: a connection dropped mid-request loses the
     reply (pods may or may not exist), parallelize logs worker panics
     without failing (HandleCrash semantics), and a density measurement
-    waiting for a pod that was never created stalls forever."""
+    waiting for a pod that was never created stalls forever.
+
+    creators defaults to 12 (the reference runs 30): the apiserver is
+    GIL-bound, so extra concurrency doesn't add throughput — it only
+    inflates per-request latency until requests trip the client
+    timeout, and every timed-out bulk reply costs a serial top-up
+    reconciliation at the end."""
     chunks = [min(chunk, p - i) for i in range(0, p, chunk)]
 
     def create(ci: int) -> None:
@@ -277,18 +283,58 @@ def schedule_pods(
         sched.stop()
 
 
+def _scrape_counters(client) -> dict:
+    """Sum the apiserver's wire counters from its /metrics text:
+    {metric name -> summed value across label sets}. The bench records
+    these per rep (BENCH JSON) so request-count regressions are visible
+    next to pods/s."""
+    try:
+        code, payload = client.transport.request("GET", "/metrics")
+    except Exception:
+        return {}
+    text = ""
+    if isinstance(payload, dict):
+        text = payload.get("text") or payload.get("message") or ""
+    if code != 200 or not text:
+        return {}
+    want = (
+        "apiserver_requests_total",
+        "apiserver_watch_events_sent_total",
+        "apiserver_watch_cache_hits_total",
+        "apiserver_watch_cache_misses_total",
+        "apiserver_batch_commit_size_objects_count",
+        "apiserver_batch_commit_size_objects_sum",
+        "storage_watch_events_dropped_total",
+    )
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(" ", 1)
+        except ValueError:
+            continue
+        name = name_part.split("{", 1)[0]
+        if name in want:
+            try:
+                out[name] = out.get(name, 0.0) + float(value)
+            except ValueError:
+                pass
+    return out
+
+
 def schedule_pods_separate(
     num_nodes: int, num_pods: int, provider: str = "TPUProvider",
     out=sys.stdout,
-) -> float:
+):
     """The density test across PROCESS boundaries, like the reference's
     real deployment (separate daemons): the apiserver runs in its own
     interpreter (TLV binary wire), pod creation in another, and the
-    scheduler + measurement here. This validates the reference's real
-    deployment shape end-to-end on the TLV binary wire. NOTE: at current
-    pure-Python codec costs the per-event HTTP+decode overhead outweighs
-    the GIL relief, so the in-process mode still measures faster; a
-    C codec / batched watch frames are the path to flipping that."""
+    scheduler + measurement here. Returns a per-rep stats dict:
+    pods_per_sec (the headline window), pipeline_seconds /
+    sustained_pods_per_sec (creation-start -> all-bound — the honest
+    end-to-end number when the headline window is degenerate), and the
+    apiserver's request/watch-event/cache counters."""
     import subprocess
 
     from kubernetes_tpu.client.transport import HTTPTransport
@@ -303,7 +349,12 @@ def schedule_pods_separate(
     try:
         line = api_proc.stdout.readline()
         url = line.strip().rsplit(" ", 1)[-1]
-        client = RESTClient(HTTPTransport(url, binary=True))
+        # patient timeout: a GIL-bound apiserver under a create storm can
+        # answer a bulk request tens of seconds late; timing out loses
+        # the reply (pods exist, client does not know) and forces the
+        # serial top-up reconciliation
+        client = RESTClient(HTTPTransport(url, binary=True,
+                                          timeout=180.0))
         deadline = time.time() + 15
         while not client.healthz():
             if time.time() > deadline:
@@ -330,15 +381,55 @@ def schedule_pods_separate(
                 f"pod creator exited {creator.returncode}; the "
                 "measurement would wait forever"
             )
+        created_secs = time.time() - t0
         print(
-            f"created {num_pods} pods in {time.time() - t0:.1f}s; "
+            f"created {num_pods} pods in {created_secs:.1f}s; "
             "scheduling...",
             file=out,
         )
-        return _measure(count_scheduled, num_nodes, num_pods, out,
+        rate = _measure(count_scheduled, num_nodes, num_pods, out,
                         label=" [separate processes]",
                         pipeline_phases=pipeline_phases,
                         pipeline_start=t0)
+        pipeline_secs = time.time() - t0
+        stats = {
+            "pods_per_sec": rate,
+            "creation_seconds": round(created_secs, 2),
+            "pipeline_seconds": round(pipeline_secs, 2),
+            "sustained_pods_per_sec": round(num_pods / pipeline_secs, 1),
+        }
+        counters = _scrape_counters(client)
+        if counters:
+            hits = counters.get("apiserver_watch_cache_hits_total", 0.0)
+            misses = counters.get(
+                "apiserver_watch_cache_misses_total", 0.0
+            )
+            stats.update({
+                "apiserver_requests": int(counters.get(
+                    "apiserver_requests_total", 0)),
+                "watch_events_sent": int(counters.get(
+                    "apiserver_watch_events_sent_total", 0)),
+                "watch_cache_hits": int(hits),
+                "watch_cache_misses": int(misses),
+                "watch_cache_hit_rate": round(
+                    hits / max(hits + misses, 1.0), 4),
+                "batch_commits": int(counters.get(
+                    "apiserver_batch_commit_size_objects_count", 0)),
+                "batch_objects": int(counters.get(
+                    "apiserver_batch_commit_size_objects_sum", 0)),
+                "watch_events_dropped": int(counters.get(
+                    "storage_watch_events_dropped_total", 0)),
+            })
+            print(
+                f"# apiserver wire: {stats.get('apiserver_requests', 0)} "
+                f"requests, {stats.get('watch_events_sent', 0)} watch "
+                f"events, cache hit rate "
+                f"{stats.get('watch_cache_hit_rate', 0.0):.1%}, "
+                f"{stats.get('batch_commits', 0)} batch commits / "
+                f"{stats.get('batch_objects', 0)} objects",
+                file=out,
+            )
+        return stats
     finally:
         if sched is not None:
             sched.stop()
@@ -374,7 +465,8 @@ def main(argv=None):
     if args.create_only:
         from kubernetes_tpu.client.transport import HTTPTransport
 
-        client = RESTClient(HTTPTransport(args.server, binary=True))
+        client = RESTClient(HTTPTransport(args.server, binary=True,
+                                          timeout=180.0))
         make_pods(client, args.pods)
         return
     if args.separate:

@@ -16,8 +16,14 @@ from kubernetes_tpu.metrics.metrics import (
     HistogramVec,
     Registry,
     apiserver_audit_event_total,
+    apiserver_batch_commit_size_objects,
     apiserver_request_latency,
+    apiserver_requests_total,
+    apiserver_watch_cache_hits_total,
+    apiserver_watch_cache_misses_total,
+    apiserver_watch_events_sent_total,
     client_events_discarded_total,
+    storage_watch_events_dropped_total,
     informer_sync_duration_seconds,
     reflector_list_duration_seconds,
     reflector_lists_total,
@@ -46,8 +52,14 @@ __all__ = [
     "Registry",
     "registry",
     "apiserver_audit_event_total",
+    "apiserver_batch_commit_size_objects",
     "apiserver_request_latency",
+    "apiserver_requests_total",
+    "apiserver_watch_cache_hits_total",
+    "apiserver_watch_cache_misses_total",
+    "apiserver_watch_events_sent_total",
     "client_events_discarded_total",
+    "storage_watch_events_dropped_total",
     "informer_sync_duration_seconds",
     "reflector_list_duration_seconds",
     "reflector_lists_total",

@@ -374,6 +374,67 @@ apiserver_request_latency = registry.register(
     )
 )
 
+#: total REST requests the apiserver handled, labeled by verb — the
+#: numerator of the O(1)-requests-per-wave wire contract (latency
+#: histograms exclude long-running requests, so a plain counter is the
+#: honest request tally)
+apiserver_requests_total = registry.register(
+    Counter(
+        "apiserver_requests_total",
+        "REST requests handled by the apiserver, labeled by verb",
+    )
+)
+
+# -- watch cache (storage/cacher.py, pkg/storage/cacher analogue) -------------
+
+#: list/get/watch requests served from the in-memory watch cache
+#: (commit-time TLV bytes; zero store round-trip, zero re-encode)
+apiserver_watch_cache_hits_total = registry.register(
+    Counter(
+        "apiserver_watch_cache_hits_total",
+        "apiserver reads served from the watch cache",
+    )
+)
+
+#: reads that fell back to the underlying store (cache disabled or
+#: unhealthy, historic resourceVersion outside the ring, uncachable
+#: payload)
+apiserver_watch_cache_misses_total = registry.register(
+    Counter(
+        "apiserver_watch_cache_misses_total",
+        "apiserver reads that fell back from the watch cache to the store",
+    )
+)
+
+#: objects committed per batch request (bulk bind/status commit) — the
+#: amortization factor of the one-request-per-wave wire contract
+apiserver_batch_commit_size_objects = registry.register(
+    Histogram(
+        "apiserver_batch_commit_size_objects",
+        "Objects committed per apiserver batch request",
+        buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                 4096, 8192],
+    )
+)
+
+#: watch events written to clients by the HTTP frontend (all streams)
+apiserver_watch_events_sent_total = registry.register(
+    Counter(
+        "apiserver_watch_events_sent_total",
+        "Watch events streamed to clients by the apiserver frontend",
+    )
+)
+
+#: events dropped by the slow-watcher backpressure policy: a watch
+#: stream that overflows its buffer is terminated with ERROR (the
+#: client relists) and its undelivered backlog is counted here
+storage_watch_events_dropped_total = registry.register(
+    Counter(
+        "storage_watch_events_dropped_total",
+        "Watch events dropped by slow-watcher stream termination",
+    )
+)
+
 # -- audit subsystem (kubernetes_tpu/audit) -----------------------------------
 
 #: one increment per audit event emitted, labeled by policy level and

@@ -29,6 +29,13 @@ CONTENT_TYPE = "application/vnd.kubernetes-tpu.binary"
 # protobuf.go:17-33 magic-prefixed envelope idea; the trailing byte is a
 # format version (0 was the retired pickle envelope)
 MAGIC = b"k8s-tpu\x01"
+# segmented list envelope (version 2): a head TLV value followed by N
+# independently self-contained item TLV values, each length-prefixed.
+# The apiserver splices each item's commit-time bytes verbatim (TLV
+# class-table ids are sequential per VALUE, so items cannot share one
+# outer table — segmentation is what makes zero-re-encode lists sound);
+# the client decodes head + items back into the ordinary List payload.
+MAGIC_SEG = b"k8s-tpu\x02"
 _LEN = struct.Struct("<I")
 
 
@@ -36,17 +43,84 @@ class BinaryDecodeError(Exception):
     pass
 
 
+class RawObject:
+    """A handler payload that is ALREADY the object's commit-time TLV
+    bytes: the frontend writes MAGIC + blob verbatim, re-encoding
+    nothing."""
+
+    __slots__ = ("blob",)
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+
+
+class RawList:
+    """A list payload as (head dict sans items, pre-encoded item
+    blobs): the frontend writes the segmented envelope by
+    concatenation."""
+
+    __slots__ = ("head", "blobs")
+
+    def __init__(self, head: dict, blobs: list):
+        self.head = head
+        self.blobs = blobs
+
+
 def encode(payload: Any) -> bytes:
     """Envelope any handler payload (API object, list dict carrying
-    objects, Status dict)."""
+    objects, Status dict). Raw payloads splice their stored bytes."""
+    if type(payload) is RawObject:
+        return MAGIC + payload.blob
+    if type(payload) is RawList:
+        head = tlv.dumps(payload.head)
+        parts = [MAGIC_SEG, _LEN.pack(len(head)), head,
+                 _LEN.pack(len(payload.blobs))]
+        for blob in payload.blobs:
+            parts.append(_LEN.pack(len(blob)))
+            parts.append(blob)
+        return b"".join(parts)
     return MAGIC + tlv.dumps(payload)
 
 
 def decode(data: bytes) -> Any:
+    if data.startswith(MAGIC_SEG):
+        return _decode_segmented(data)
     if not data.startswith(MAGIC):
         raise BinaryDecodeError("missing binary envelope magic")
     try:
         return tlv.loads(data[len(MAGIC):])
+    except tlv.TLVError as e:
+        raise BinaryDecodeError(str(e)) from e
+
+
+def _decode_segmented(data: bytes) -> Any:
+    pos = len(MAGIC_SEG)
+    try:
+        def take() -> bytes:
+            nonlocal pos
+            if pos + _LEN.size > len(data):
+                raise BinaryDecodeError("truncated segmented envelope")
+            (n,) = _LEN.unpack_from(data, pos)
+            pos += _LEN.size
+            if pos + n > len(data):
+                raise BinaryDecodeError("truncated segmented envelope")
+            out = data[pos:pos + n]
+            pos += n
+            return out
+
+        head = tlv.loads(take())
+        if not isinstance(head, dict):
+            raise BinaryDecodeError("segmented head is not a dict")
+        if pos + _LEN.size > len(data):
+            raise BinaryDecodeError("truncated segmented envelope")
+        (count,) = _LEN.unpack_from(data, pos)
+        pos += _LEN.size
+        if count > len(data) - pos:  # every item is >= 1 byte + prefix
+            raise BinaryDecodeError("segmented count exceeds input")
+        head["items"] = [tlv.loads(take()) for _ in range(count)]
+        if pos != len(data):
+            raise BinaryDecodeError("trailing bytes after segmented list")
+        return head
     except tlv.TLVError as e:
         raise BinaryDecodeError(str(e)) from e
 

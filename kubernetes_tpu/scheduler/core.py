@@ -98,6 +98,19 @@ def _wave_cap() -> int:
     return 4096
 
 
+def _wave_floor() -> int:
+    raw = os.environ.get("KUBERNETES_TPU_WAVE_FLOOR", "")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning(
+                "ignoring malformed KUBERNETES_TPU_WAVE_FLOOR=%r; "
+                "using 1024", raw,
+            )
+    return 1024
+
+
 @dataclass
 class SchedulerConfig:
     """scheduler.go:50 Config — the dependency set scheduleOne needs."""
@@ -106,6 +119,9 @@ class SchedulerConfig:
     algorithm: object = None  # .schedule(pod, state) / .schedule_backlog
     binder: Callable[[Pod, str], None] = None
     pod_condition_updater: Callable[[Pod, str, str], None] = None
+    # batch form: [(pod, status, reason)] in one API request (wave
+    # failure paths must stay O(1) requests in backlog size)
+    pod_condition_updater_many: Callable = None
     next_pod: Callable[[], Pod] = None
     # pop up to this many additional waiting pods per cycle (0 = strictly
     # serial, reference-identical pacing)
@@ -120,6 +136,23 @@ class SchedulerConfig:
     # are sequential-equivalent regardless of the cap).
     # KUBERNETES_TPU_WAVE_CAP overrides, for perf experiments.
     max_batch: int = field(default_factory=lambda: _wave_cap())
+    # Burst-adaptive wave gathering: when a drain catches a burst
+    # mid-arrival (extra pods were already waiting) but the wave is
+    # still under this floor, the driver briefly waits for the queue to
+    # fill before dispatching — per-wave fixed cost (state encode +
+    # device dispatch) amortizes over 10-100x more pods. Decisions are
+    # sequential-equivalent regardless of wave boundaries, so gathering
+    # changes pacing, never placement. An idle-arrival singleton skips
+    # the wait entirely (zero added latency when there is no burst).
+    # KUBERNETES_TPU_WAVE_FLOOR overrides; 0 disables gathering.
+    wave_floor: int = field(default_factory=lambda: _wave_floor())
+    # minimum gather window; the driver scales it adaptively up to
+    # wave_gather_max by the PREVIOUS wave's measured wall cost, so
+    # cheap waves dispatch almost immediately while expensive waves
+    # (big clusters, cold caches) wait long enough for the arrival
+    # stream to amortize their fixed cost
+    wave_gather_seconds: float = 0.02
+    wave_gather_max: float = 1.0
     # bulk binder for wave commits: one API request per wave instead of a
     # per-pod round-trip flood (the per-pod shell was the daemon's
     # throughput ceiling); None falls back to per-pod binder
@@ -162,6 +195,9 @@ class Scheduler:
         self._bind_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="bind"
         )
+        # previous wave's algorithm wall seconds — the adaptive
+        # wave-gather window scales off it
+        self._last_wave_secs = 0.0
 
     def run(self) -> threading.Thread:
         """scheduler.go:89 Run — the loop in a daemon thread."""
@@ -231,6 +267,33 @@ class Scheduler:
             cfg.algorithm, "schedule_backlog"
         ):
             wave += cfg.drain_waiting(cfg.max_batch - 1)
+            floor = min(cfg.wave_floor, cfg.max_batch)
+            if 1 < len(wave) < floor and cfg.wave_gather_seconds > 0:
+                # burst in flight (the drain caught extra pods): give
+                # arrivals a moment to fill the wave so the per-wave
+                # fixed cost amortizes. The window scales with the
+                # previous wave's measured cost — a 100 ms wave is
+                # worth waiting ~2x that to fill, a 5 ms wave is not.
+                # Two consecutive empty probes = the burst ended;
+                # dispatch what we have. Idle singletons never reach
+                # here — no added latency when nothing is arriving.
+                window = min(
+                    max(2.0 * self._last_wave_secs,
+                        cfg.wave_gather_seconds),
+                    cfg.wave_gather_max,
+                )
+                deadline = time.monotonic() + window
+                idle_probes = 0
+                while len(wave) < floor and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                    more = cfg.drain_waiting(cfg.max_batch - len(wave))
+                    if more:
+                        wave += more
+                        idle_probes = 0
+                    else:
+                        idle_probes += 1
+                        if idle_probes >= 2:
+                            break
         cache = cfg.scheduler_cache
         if cache is not None and hasattr(cache, "pod_keys"):
             # duplicate watch deliveries (relist after a broken pipe)
@@ -271,8 +334,9 @@ class Scheduler:
             )
             self._handle_failure(pod, e)
             return
+        self._last_wave_secs = DEFAULT_CLOCK.now() - start
         scheduler_algorithm_latency.observe(
-            (DEFAULT_CLOCK.now() - start) * 1e6
+            self._last_wave_secs * 1e6
         )
         if trace_span.enabled():
             # attribute the wave's algorithm window to every traced
@@ -288,13 +352,47 @@ class Scheduler:
                     )
 
         successes: List[Tuple[Pod, str]] = []
+        failures: List[Tuple[Pod, Exception]] = []
         for i, (p, host) in enumerate(zip(wave, hosts)):
             if host is None:
-                self._handle_failure(p, errors.get(i) or FitError(p, {}))
+                failures.append((p, errors.get(i) or FitError(p, {})))
                 continue
             successes.append((p, host))
+        self._handle_failures(failures)
         if successes:
             self._assume_and_bind_wave(successes, start)
+
+    def _handle_failures(
+        self, failed: List[Tuple[Pod, Exception]],
+        reason: str = "FailedScheduling",
+    ) -> None:
+        """Wave-failure handling with O(1) apiserver requests: the
+        PodScheduled=False condition updates for the whole wave go out
+        as ONE batch request (one PATCH per pod otherwise — O(backlog)
+        requests the moment a cluster fills up); events and re-queues
+        stay per-pod."""
+        if not failed:
+            return
+        cfg = self.config
+        # indexes still needing the per-pod condition update: everything
+        # by default; the batch removes the items it committed. A batch
+        # that raises (connection drop, 403) or returns per-item
+        # failures must NOT silently lose those pods' updates — they
+        # fall back to the per-pod updater, like the pre-batch path.
+        unbatched = set(range(len(failed)))
+        if cfg.pod_condition_updater_many is not None and len(failed) > 1:
+            try:
+                res = cfg.pod_condition_updater_many(
+                    [(p, "False", "Unschedulable") for p, _ in failed]
+                )
+                for i, r in enumerate(res[:len(failed)]):
+                    if isinstance(r, dict) and r.get("status") == "Success":
+                        unbatched.discard(i)
+            except Exception:
+                log.debug("bulk condition update failed", exc_info=True)
+        for i, (p, err) in enumerate(failed):
+            self._handle_failure(p, err, reason=reason,
+                                 update_condition=i in unbatched)
 
     def _schedule_wave(
         self, wave: Sequence[Pod], state: ClusterState
@@ -444,13 +542,14 @@ class Scheduler:
             bind_all()
 
     def _handle_failure(
-        self, pod: Pod, err: Exception, reason: str = "FailedScheduling"
+        self, pod: Pod, err: Exception, reason: str = "FailedScheduling",
+        update_condition: bool = True,
     ) -> None:
         cfg = self.config
         log.debug("failed to schedule %s: %s", pod.metadata.name, err)
         if cfg.recorder is not None:
             cfg.recorder.eventf(pod, "Warning", reason, "%s", err)
-        if cfg.pod_condition_updater is not None:
+        if update_condition and cfg.pod_condition_updater is not None:
             try:
                 cfg.pod_condition_updater(pod, "False", "Unschedulable")
             except Exception:

@@ -264,6 +264,7 @@ class ConfigFactory:
             binder=self._bind,
             binder_many=self._bind_many,
             pod_condition_updater=self._update_pod_condition,
+            pod_condition_updater_many=self._update_pod_conditions_many,
             next_pod=self._next_pod,
             drain_waiting=self._drain_waiting,
             error=self._make_error_handler(),
@@ -314,13 +315,33 @@ class ConfigFactory:
 
     def _bind_many(self, pairs) -> list:
         """Bulk binder for wave commits: [(pod, host)] -> per-item
-        results. One API request replaces a wave's worth of per-pod
-        round-trips."""
-        return self.client.pods().bind_many(
-            [
-                (p.metadata.name, host, p.metadata.namespace)
-                for p, host in pairs
-            ]
+        results. One batch request — one store transaction — replaces a
+        wave's worth of per-pod round-trips."""
+        from kubernetes_tpu.client.rest import batch_bind_item
+
+        return self.client.commit_batch(
+            batch_bind_item(p.metadata.name, host,
+                            p.metadata.namespace or "default")
+            for p, host in pairs
+        )
+
+    def _update_pod_conditions_many(self, updates) -> list:
+        """Batch PodScheduled-condition updates: [(pod, status, reason)]
+        in ONE batch request (a wave with many unschedulable pods used
+        to issue one PATCH per pod — O(backlog) apiserver requests)."""
+        from kubernetes_tpu.client.rest import batch_status_item
+
+        return self.client.commit_batch(
+            batch_status_item(
+                "pods", p.metadata.name,
+                {"conditions": [{
+                    "type": "PodScheduled",
+                    "status": status,
+                    "reason": reason,
+                }]},
+                p.metadata.namespace or "default",
+            )
+            for p, status, reason in updates
         )
 
     def _update_pod_condition(self, pod: Pod, status: str, reason: str) -> None:

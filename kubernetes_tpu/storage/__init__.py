@@ -11,6 +11,7 @@ GuaranteedUpdate, watch streams resumable from a resourceVersion, and
 relist (reflector.go:281 semantics depend on all of these).
 """
 
+from kubernetes_tpu.storage.cacher import Cacher
 from kubernetes_tpu.storage.store import (
     Compacted,
     Conflict,
@@ -23,6 +24,7 @@ from kubernetes_tpu.storage.store import (
 )
 
 __all__ = [
+    "Cacher",
     "MemoryStore",
     "WatchEvent",
     "WatchStream",

@@ -1,0 +1,394 @@
+"""Apiserver watch cache (pkg/storage/cacher analogue).
+
+One Cacher per resource root prefix sits between the apiserver's read
+path and the store: a per-resource in-memory snapshot plus an event
+ring, fed by ONE store watch, so steady-state lists, gets, and all
+watch fan-out are served from the commit-time TLV bytes the store
+already encoded once — the read path never re-enters the store and
+never re-encodes an object per request/watcher.
+
+Consistency is the reference's waitUntilFreshAndBlock contract
+(cacher.go): a read first samples the resourceVersion of the last
+commit under this resource's prefix (stamped lock-free on the feed
+stream by the store — the etcd progress-notify analogue; the GLOBAL
+store rv would strand quiet resources behind other resources' writes)
+and blocks until the cache has processed at least that far, so reads
+through the cache are exactly as fresh as reads through the store —
+serve-from-cache vs serve-from-store equivalence is a test invariant
+(tests/test_cacher.py), not a best effort. Anything the cache cannot serve (historic resourceVersions
+outside the ring, payloads the strict TLV codec can't carry, an
+unhealthy feed) falls back to the store and counts a miss.
+
+Entries hold READ-ONLY references to the store's immutable-after-write
+objects for selector matching, the commit-time TLV blob for zero-copy
+wire splicing, and a per-commit wire-encoding memo shared with the
+watch fan-out — N JSON watchers/listers pay ONE reflective encode per
+commit, binary consumers pay none.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.metrics import (
+    apiserver_watch_cache_hits_total,
+    apiserver_watch_cache_misses_total,
+)
+from kubernetes_tpu.storage.store import (
+    ERROR,
+    Compacted,
+    KeyNotFound,
+    MemoryStore,
+    WatchEvent,
+    WatchStream,
+    _LazyEvent,
+    _tlv_native,
+    deep_copy,
+)
+
+log = logging.getLogger(__name__)
+
+_hit = apiserver_watch_cache_hits_total.child()
+_miss = apiserver_watch_cache_misses_total.child()
+
+
+class _Entry:
+    """One cached object: the store's read-only ref, its commit blob,
+    and the shared wire-encoding memo for this commit."""
+
+    __slots__ = ("rv", "obj", "blob", "wire_cache")
+
+    def __init__(self, rv: int, obj, blob: Optional[bytes],
+                 wire_cache: Optional[dict] = None):
+        self.rv = rv
+        self.obj = obj  # READ-ONLY store ref; never hand to a consumer
+        self.blob = blob  # commit-time TLV bytes (None = uncachable)
+        self.wire_cache = wire_cache if wire_cache is not None else {}
+
+    def isolation_copy(self):
+        """A consumer-owned copy: one decode from the commit blob when
+        possible, else the full deep copy."""
+        if self.blob is not None:
+            c = _tlv_native()
+            if c is not None:
+                try:
+                    return c.loads(self.blob)
+                except Exception:
+                    pass
+        return deep_copy(self.obj)
+
+    def wire(self, codec):
+        """The wire dict for this commit under `codec`, memoized per
+        commit (racing encoders write the same value; the dict is
+        read-only downstream). Versioned codecs key by their
+        group-version NAME: codec_for() builds a fresh codec object per
+        request, so id() would key the long-lived memo by freed
+        addresses — same-gv hits by allocator accident, cross-gv
+        collisions possible by the same accident. The gv-less default
+        scheme is a process singleton, so id() is stable for it."""
+        gv = getattr(codec, "gv", None)
+        key = gv.name if gv is not None else id(codec)
+        w = self.wire_cache.get(key)
+        if w is None:
+            w = codec.encode(self.obj)
+            self.wire_cache[key] = w
+        return w
+
+
+class Cacher:
+    """The per-resource watch cache. `prefix` is the resource's root
+    store prefix (e.g. "/pods/"); reads may narrow to any sub-prefix
+    (per-namespace lists)."""
+
+    def __init__(self, store: MemoryStore, prefix: str,
+                 ring_size: int = 8192):
+        self.store = store
+        self.prefix = prefix
+        self._cond = threading.Condition()
+        self._snap: Dict[str, _Entry] = {}
+        self._rv = 0  # highest resourceVersion processed into the cache
+        self._ring: deque = deque(maxlen=ring_size)  # _LazyEvent protos
+        # events <= this rv are not in the ring (bootstrap point or
+        # evicted); watch-from-older falls back to the store
+        self._ring_horizon = 0
+        self._watchers: List[Tuple[str, WatchStream]] = []
+        self.healthy = False
+        self._stopped = False
+        self._feed_stream = None
+        self._start()
+
+    # -- feed ----------------------------------------------------------------
+
+    def _start(self) -> None:
+        entries, rv, stream = self.store.watch_bootstrap(self.prefix)
+        with self._cond:
+            self._snap = {
+                key: _Entry(mod_rv, obj, blob)
+                for key, obj, mod_rv, blob in entries
+            }
+            self._rv = rv
+            self._ring_horizon = rv
+            self.healthy = True
+        self._feed_stream = stream
+        # the thread holds only a WEAK ref to the cacher: an apiserver
+        # discarded without an explicit stop() (test churn) must not pin
+        # its caches alive forever — once the cacher is collected, the
+        # next idle tick stops the feed stream and exits the thread
+        import weakref
+
+        threading.Thread(
+            target=_feed_entry, args=(weakref.ref(self), stream),
+            daemon=True,
+            name=f"watch-cache{self.prefix.rstrip('/')}",
+        ).start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._feed_stream is not None:
+            self._feed_stream.stop()
+        with self._cond:
+            self.healthy = False
+            watchers = list(self._watchers)
+            del self._watchers[:]
+            self._cond.notify_all()
+        for _p, w in watchers:
+            w.stop()
+
+    def _feed_dead(self) -> None:
+        """Feed gone (store watch overflowed, errored, or stopped):
+        mark unhealthy so reads fall back to the store, and terminate
+        downstream watchers into a relist."""
+        with self._cond:
+            self.healthy = False
+            watchers = list(self._watchers)
+            del self._watchers[:]
+            self._cond.notify_all()
+        for _p, s in watchers:
+            with s._cond:
+                if not s._stopped:
+                    s._overflow_locked(self._rv, 0)
+
+    def _apply_batch(self, batch) -> None:
+        """Apply a burst of store events to the snapshot + ring and fan
+        it out. Runs on the feed thread only."""
+        with self._cond:
+            for ev in batch:
+                if ev.type == ERROR:
+                    raise RuntimeError("store watch overflowed")
+                key = getattr(ev, "key", "")
+                proto = ev if isinstance(ev, _LazyEvent) else None
+                if key:
+                    if ev.type == "DELETED":
+                        self._snap.pop(key, None)
+                    else:
+                        self._snap[key] = _Entry(
+                            ev.resource_version,
+                            ev.match_object if proto is not None
+                            else ev.object,
+                            proto.tlv_obj_blob if proto is not None
+                            else None,
+                            proto.wire_cache if proto is not None
+                            else None,
+                        )
+                if proto is not None:
+                    if len(self._ring) == self._ring.maxlen:
+                        self._ring_horizon = (
+                            self._ring[0].resource_version
+                        )
+                    self._ring.append(proto)
+                else:
+                    # uncachable payload: the ring would replay a shared
+                    # mutable object; advance the horizon past it
+                    self._ring_horizon = ev.resource_version
+                self._rv = batch[-1].resource_version
+            watchers = list(self._watchers)
+            self._cond.notify_all()
+        for prefix, stream in watchers:
+            # per-watcher envelopes: lazy events refan (shared blob,
+            # private decode); plain fallback events get fresh deep
+            # copies so no two watchers share a mutable object
+            burst = [
+                (ev.refan() if isinstance(ev, _LazyEvent)
+                 else WatchEvent(ev.type, deep_copy(ev.object),
+                                 ev.resource_version,
+                                 deep_copy(ev.prev_object), key=ev.key))
+                for ev in batch
+                if getattr(ev, "key", "").startswith(prefix)
+            ]
+            stream._deliver_many(burst)
+
+    # -- consistency ---------------------------------------------------------
+
+    def _fresh_target(self) -> int:
+        """The freshness bar for a read arriving NOW: the rv of the
+        last commit under THIS cacher's prefix (stamped lock-free on
+        the feed stream by the store). NOT the store's global rv — a
+        quiet resource would never catch up to other resources' writes
+        and every read would stall into the fallback."""
+        return self._feed_stream._progress_rv
+
+    def wait_fresh(self, rv: int, timeout: float = 5.0) -> bool:
+        """Block until the cache has processed resourceVersion >= rv
+        (cacher.go waitUntilFreshAndBlock). False = timed out or
+        unhealthy; the caller falls back to the store."""
+        import time as _time
+
+        with self._cond:
+            deadline = _time.monotonic() + timeout
+            while self.healthy and self._rv < rv:
+                left = deadline - _time.monotonic()
+                if left <= 0 or not self._cond.wait(timeout=left):
+                    break
+            return self.healthy and self._rv >= rv
+
+    # -- reads ---------------------------------------------------------------
+
+    def list_entries(self, prefix: str) -> Optional[Tuple[List[_Entry], int]]:
+        """All entries under `prefix` (must extend self.prefix) at a
+        resourceVersion at least as fresh as the store's current one.
+        None = cache can't serve (caller falls back; miss counted)."""
+        if not self.healthy:
+            _miss()
+            return None
+        target = self._fresh_target()
+        if not self.wait_fresh(target):
+            _miss()
+            return None
+        with self._cond:
+            out = [
+                e for k, e in sorted(self._snap.items())
+                if k.startswith(prefix)
+            ]
+            rv = self._rv
+        _hit()
+        return out, rv
+
+    def get_entry(self, key: str) -> Optional[_Entry]:
+        """The entry for `key`, fresh per wait_fresh; raises KeyNotFound
+        for a genuinely absent key, returns None when the cache can't
+        serve (fall back; miss counted)."""
+        if not self.healthy:
+            _miss()
+            return None
+        target = self._fresh_target()
+        if not self.wait_fresh(target):
+            _miss()
+            return None
+        with self._cond:
+            entry = self._snap.get(key)
+            if entry is None:
+                _hit()  # a fresh authoritative absence IS a cache answer
+                raise KeyNotFound(key)
+        _hit()
+        return entry
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, prefix: str, from_rv: int = 0) -> Optional[WatchStream]:
+        """A watch stream served from the cache's ring + fan-out.
+        from_rv==0 means "from now" (freshness-synced with the store so
+        a client that just wrote sees only what follows its write).
+        None = the requested window predates the ring (fall back to the
+        store, which replays its own history or raises Compacted)."""
+        if not self.healthy:
+            _miss()
+            return None
+        if from_rv == 0:
+            # "from now": sync to the store head so no event the store
+            # already committed is double-delivered after registration
+            if not self.wait_fresh(self._fresh_target()):
+                _miss()
+                return None
+        else:
+            # resume-from-rv: the feed must have processed everything
+            # at or below from_rv BEFORE replay+registration, or the
+            # pending backlog would fan out to this watcher afterwards
+            # and deliver events <= from_rv the client already has
+            # (cacher.go waitUntilFreshAndBlock; the min() keeps a
+            # global-rv target from a store-fallback list from waiting
+            # past this prefix's last commit)
+            if not self.wait_fresh(min(from_rv, self._fresh_target())):
+                _miss()
+                return None
+        with self._cond:
+            if not self.healthy:
+                _miss()
+                return None
+            if from_rv and from_rv < self._ring_horizon:
+                if from_rv < self.store._compacted_rv:
+                    # answer directly: the store would say the same
+                    _hit()
+                    raise Compacted(
+                        f"requested {from_rv}, horizon "
+                        f"{self.store._compacted_rv}"
+                    )
+                _miss()
+                return None
+            stream = WatchStream(self)
+            if from_rv:
+                for proto in self._ring:
+                    if (proto.resource_version > from_rv
+                            and proto.key.startswith(prefix)):
+                        stream._deliver(proto.refan())
+            self._watchers.append((prefix, stream))
+        _hit()
+        return stream
+
+    def _remove_watcher(self, stream: WatchStream) -> None:
+        with self._cond:
+            self._watchers = [
+                (p, s) for p, s in self._watchers if s is not stream
+            ]
+
+
+def _feed_entry(ref, stream) -> None:
+    """The feed thread body. Holds the cacher only through `ref`
+    between events, so an orphaned cacher is collectable; gulps event
+    bursts so a batch commit costs one lock round-trip per watcher."""
+    while True:
+        try:
+            ev = stream.next_event(timeout=10.0)
+        except TimeoutError:
+            if ref() is None:
+                stream.stop()
+                return
+            continue
+        if ev is None:  # stream stopped
+            cacher = ref()
+            if cacher is not None and not cacher._stopped:
+                cacher._feed_dead()
+            return
+        batch = [ev]
+        while len(batch) < 4096:
+            try:
+                nxt = stream.next_event(timeout=0)
+            except TimeoutError:
+                break
+            if nxt is None:
+                batch.append(None)
+                break
+            batch.append(nxt)
+        ended = batch[-1] is None
+        if ended:
+            batch.pop()
+        cacher = ref()
+        if cacher is None:
+            stream.stop()
+            return
+        try:
+            if batch:
+                cacher._apply_batch(batch)
+            if ended or cacher._stopped:
+                if not cacher._stopped:
+                    cacher._feed_dead()
+                return
+        except Exception:
+            log.exception("watch cache feed failed for %s",
+                          cacher.prefix)
+            cacher._feed_dead()
+            stream.stop()
+            return
+        del cacher

@@ -196,7 +196,24 @@ class ReplicatedStore(FileStore):
         # a watcher saw must already be on the follower (kill -9 safe)
         rec = tlv.dumps(["rec", ev.type, key, ev.resource_version,
                          ev.object])
-        frame = _frame(rec)
+        self._ship_synced(_frame(rec))
+        super()._record(key, ev)
+
+    def _record_batch(self, items) -> None:
+        # batch commits replicate as one shipment: every record of the
+        # burst goes to the follower in a single sendall and ONE ack
+        # round-trip covers the whole batch (the per-event ack wait was
+        # a sync_timeout-bounded stall per record otherwise)
+        if items:
+            frames = b"".join(
+                _frame(tlv.dumps(["rec", ev.type, key,
+                                  ev.resource_version, ev.object]))
+                for key, ev in items
+            )
+            self._ship_synced(frames)
+        super()._record_batch(items)
+
+    def _ship_synced(self, frame: bytes) -> None:
         conn = self._follower
         if conn is not None:
             stalled = False
@@ -227,7 +244,6 @@ class ReplicatedStore(FileStore):
                 # intact, so it never observes the break, never
                 # re-attaches, and keeps serving stale reads forever
                 self._drop_follower(conn)
-        super()._record(key, ev)
 
     def close(self) -> None:
         self._stopped = True

@@ -103,13 +103,14 @@ class _LazyEvent:
     nothing else. They must never be handed to a consumer."""
 
     __slots__ = ("type", "resource_version", "_blob", "_pair", "_codec",
-                 "match_object", "match_prev", "wire_cache")
+                 "match_object", "match_prev", "wire_cache", "key")
 
     def __init__(self, ev_type: str, rv: int, blob,
                  match_object=None, match_prev=None, wire_cache=None,
-                 codec: str = "pickle"):
+                 codec: str = "pickle", key: str = ""):
         self.type = ev_type
         self.resource_version = rv
+        self.key = key
         # codec "tlv": blob is (obj_tlv_bytes, prev_tlv_bytes|None) —
         # two self-contained TLV values, so binary watch frontends can
         # splice obj_tlv_bytes into the wire verbatim (zero per-watcher
@@ -131,6 +132,23 @@ class _LazyEvent:
         """The object's self-contained TLV bytes, or None (non-TLV
         payload). Read-only wire splice for binary watch frontends."""
         return self._blob[0] if self._codec == "tlv" else None
+
+    @property
+    def tlv_prev_blob(self):
+        """prev_object's self-contained TLV bytes, or None."""
+        return self._blob[1] if self._codec == "tlv" else None
+
+    def refan(self, wire_cache=None):
+        """A fresh per-watcher copy of this event sharing the one
+        commit-time blob (and, by default, the one wire-encoding memo):
+        the cacher's fan-out hands each downstream watcher its own lazy
+        envelope so no two consumers share a decoded object."""
+        return _LazyEvent(
+            self.type, self.resource_version, self._blob,
+            self.match_object, self.match_prev,
+            wire_cache=self.wire_cache if wire_cache is None else wire_cache,
+            codec=self._codec, key=self.key,
+        )
 
     def _unpack(self):
         if self._pair is None:
@@ -169,6 +187,9 @@ class WatchEvent:
     # own encode entirely. None = encode on demand.
     obj_blob: Optional[bytes] = None
     prev_blob: Optional[bytes] = None
+    # the store key the event committed under (the watch cache keys its
+    # snapshot by it; empty on synthetic events like ERROR)
+    key: str = ""
 
 
 class WatchStream:
@@ -187,15 +208,40 @@ class WatchStream:
     # of thousands of writes in one burst; queue entries are tiny (shared
     # lazy blobs), so a deep queue is far cheaper than the relist storm
     # an overflow triggers.
-    def __init__(self, store: "MemoryStore", capacity: int = 65536):
+    def __init__(self, store, capacity: int = 65536):
         from collections import deque
 
         self._dq: deque = deque()
         self._capacity = capacity
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # any owner with a _remove_watcher(stream) method (the store, or
+        # the apiserver watch cache fanning one store watch out)
         self._store = store
         self._stopped = False
+        # resourceVersion of the last commit MATCHING this stream's
+        # prefix (stamped by the store under its lock; read lock-free —
+        # an int attribute write is atomic). The watch cache's
+        # freshness target: a cache is fresh when it has processed up
+        # to here, NOT up to the store's global rv — resources with no
+        # recent writes would otherwise never look fresh (the etcd
+        # progress-notify analogue).
+        self._progress_rv = 0
+
+    def _overflow_locked(self, rv: int, undelivered: int) -> None:
+        """Slow-watcher policy (cacher.go blocked-watcher termination):
+        drop the backlog, count the drops, terminate the stream with
+        ERROR so the client relists. Undelivered events are
+        unrecoverable anyway — the consumer must resync from a fresh
+        List (the reflector translates the ERROR into a relist)."""
+        from kubernetes_tpu.metrics import storage_watch_events_dropped_total
+
+        storage_watch_events_dropped_total.inc(len(self._dq) + undelivered)
+        self._dq.clear()
+        self._dq.append(WatchEvent(ERROR, None, rv))
+        self._dq.append(None)
+        self._stopped = True
+        self._cond.notify_all()
 
     def _deliver(self, ev: WatchEvent) -> None:
         cond = self._cond
@@ -203,22 +249,30 @@ class WatchStream:
             if self._stopped:
                 return
             if len(self._dq) >= self._capacity:
-                # The watcher fell behind: drop its backlog and terminate
-                # the stream with ERROR so it relists (cacher.go
-                # blocked-watcher termination). Undelivered events are
-                # unrecoverable anyway — the client must resync from a
-                # fresh List.
-                self._dq.clear()
-                self._dq.append(
-                    WatchEvent(ERROR, None, ev.resource_version)
-                )
-                self._dq.append(None)
-                self._stopped = True
-                cond.notify_all()
-                self._store._remove_watcher(self)
+                self._overflow_locked(ev.resource_version, 1)
+            else:
+                self._dq.append(ev)
+                cond.notify()
                 return
-            self._dq.append(ev)
-            cond.notify()
+        self._store._remove_watcher(self)
+
+    def _deliver_many(self, evs) -> None:
+        """Deliver a commit burst under ONE lock acquisition — a bulk
+        bind used to pay one condition round-trip per event per watcher,
+        which was a measurable slice of the batch-commit window."""
+        if not evs:
+            return
+        cond = self._cond
+        with cond:
+            if self._stopped:
+                return
+            if len(self._dq) + len(evs) > self._capacity:
+                self._overflow_locked(evs[-1].resource_version, len(evs))
+            else:
+                self._dq.extend(evs)
+                cond.notify()
+                return
+        self._store._remove_watcher(self)
 
     def stop(self) -> None:
         with self._cond:
@@ -317,63 +371,104 @@ class MemoryStore:
         self._rv += 1
         return self._rv
 
-    def _record(self, key: str, ev: WatchEvent) -> None:
+    def _append_history(self, key: str, ev: WatchEvent) -> None:
         self._history.append((key, ev))
         if len(self._history) > self._history_size:
             drop = len(self._history) - self._history_size
             self._compacted_rv = self._history[drop - 1][1].resource_version
             del self._history[:drop]
-        blob = None
-        codec = "pickle"
-        wire_cache = {}  # ONE encode memo shared by all watcher copies
+
+    def _encode_fanout(self, ev: WatchEvent):
+        """-> (blob, codec) for the one shared lazy fan-out payload.
+        codec "tlv": blob is (obj_tlv, prev_tlv|None); "pickle": one
+        pickled pair. Empty blob = unencodable (deliver deep copies).
+        Strict TLV: obj_mode watchers get the same fidelity the pickle
+        path would give; the commit path usually hands the blobs in
+        (encoded once into _tlv_blobs)."""
+        c = _tlv_native()
+        if c is not None:
+            try:
+                oblob = ev.obj_blob
+                if oblob is None:
+                    oblob = c.dumps_strict(ev.object)
+                if ev.prev_object is None:
+                    pblob = None
+                elif ev.prev_object is ev.object:
+                    pblob = oblob  # DELETED: same object
+                elif ev.prev_blob is not None:
+                    pblob = ev.prev_blob
+                else:
+                    pblob = c.dumps_strict(ev.prev_object)
+                return (oblob, pblob), "tlv"
+            except Exception:
+                pass
+        try:
+            return pickle.dumps(
+                (ev.object, ev.prev_object), pickle.HIGHEST_PROTOCOL
+            ), "pickle"
+        except Exception:
+            return b"", "pickle"
+
+    def _fanout_proto(self, key: str, ev: WatchEvent):
+        """The template _LazyEvent every matching watcher gets a refan()
+        of, or None when the payload defies both codecs (the per-watcher
+        deep-copy fallback applies)."""
+        blob, codec = self._encode_fanout(ev)
+        if not blob:
+            return None
+        return _LazyEvent(ev.type, ev.resource_version, blob,
+                          ev.object, ev.prev_object, wire_cache={},
+                          codec=codec, key=key)
+
+    def _fallback_event(self, key: str, ev: WatchEvent) -> WatchEvent:
+        return WatchEvent(ev.type, _dc(ev.object), ev.resource_version,
+                          _dc(ev.prev_object), key=key)
+
+    def _record(self, key: str, ev: WatchEvent) -> None:
+        ev.key = key
+        self._append_history(key, ev)
+        proto = unencodable = None
         for prefix, stream in list(self._watchers):
             if key.startswith(prefix):
-                if blob is None:
-                    c = _tlv_native()
-                    if c is not None:
-                        try:
-                            # strict: obj_mode watchers get the same
-                            # fidelity the pickle path would give. The
-                            # commit path usually hands the blobs in
-                            # (encoded once into _tlv_blobs).
-                            oblob = ev.obj_blob
-                            if oblob is None:
-                                oblob = c.dumps_strict(ev.object)
-                            if ev.prev_object is None:
-                                pblob = None
-                            elif ev.prev_object is ev.object:
-                                pblob = oblob  # DELETED: same object
-                            elif ev.prev_blob is not None:
-                                pblob = ev.prev_blob
-                            else:
-                                pblob = c.dumps_strict(ev.prev_object)
-                            blob = (oblob, pblob)
-                            codec = "tlv"
-                        except Exception:
-                            blob = None
-                    if blob is None:
-                        try:
-                            blob = pickle.dumps(
-                                (ev.object, ev.prev_object),
-                                pickle.HIGHEST_PROTOCOL,
-                            )
-                        except Exception:
-                            blob = b""
-                if blob:
-                    stream._deliver(
-                        _LazyEvent(ev.type, ev.resource_version, blob,
-                                   ev.object, ev.prev_object,
-                                   wire_cache=wire_cache, codec=codec)
+                if proto is None and unencodable is None:
+                    proto = self._fanout_proto(key, ev)
+                    unencodable = proto is None
+                stream._deliver(
+                    proto.refan() if proto is not None
+                    else self._fallback_event(key, ev)
+                )
+                stream._progress_rv = ev.resource_version
+
+    def _record_batch(self, items) -> None:
+        """_record for a commit burst: history appended per event,
+        compaction once, and each watcher receives its whole matching
+        burst in ONE delivery (one lock acquisition per watcher per
+        batch instead of per event)."""
+        protos: List = []
+        for key, ev in items:
+            ev.key = key
+            self._history.append((key, ev))
+            protos.append(
+                (key, self._fanout_proto(key, ev) if self._watchers
+                 else None, ev)
+            )
+        if len(self._history) > self._history_size:
+            drop = len(self._history) - self._history_size
+            self._compacted_rv = self._history[drop - 1][1].resource_version
+            del self._history[:drop]
+        for prefix, stream in list(self._watchers):
+            burst = []
+            last_rv = 0
+            for key, proto, ev in protos:
+                if key.startswith(prefix):
+                    burst.append(
+                        proto.refan() if proto is not None
+                        else self._fallback_event(key, ev)
                     )
-                else:  # unpicklable object: fall back to deep copies
-                    stream._deliver(
-                        WatchEvent(
-                            ev.type,
-                            _dc(ev.object),
-                            ev.resource_version,
-                            _dc(ev.prev_object),
-                        )
-                    )
+                    last_rv = ev.resource_version
+            stream._deliver_many(burst)
+            if last_rv:
+                stream._progress_rv = last_rv
 
     def create(self, key: str, obj: Any, owned: bool = False) -> int:
         """owned=True: the caller transfers ownership of obj (it already
@@ -392,6 +487,37 @@ class MemoryStore:
                                          obj_blob=oblob))
             return rv
 
+    def create_batch(self, items) -> List[Optional[Exception]]:
+        """create() for a list of (key, obj) as ONE transaction: one
+        lock acquisition, one WAL append, one watch-event burst per
+        watcher — the bulk-create endpoint commits hundreds of objects
+        per request, and per-item lock/condition churn under a parallel
+        create storm was a measured convoy. Ownership of every obj
+        transfers to the store (the bulk endpoint's decode boundary
+        qualifies); per-item isolation: each item succeeds or fails
+        (KeyExists) independently."""
+        out: List[Optional[Exception]] = []
+        events: List = []
+        with self._lock:
+            for key, obj in items:
+                try:
+                    if key in self._data:
+                        raise KeyExists(key)
+                    rv = self._next_rv()
+                    self._set_rv(obj, rv)
+                    self._data[key] = (obj, rv)
+                    oblob = self._encode_blob(key, obj)
+                    events.append(
+                        (key, WatchEvent(ADDED, obj, rv,
+                                         obj_blob=oblob, key=key))
+                    )
+                    out.append(None)
+                except Exception as e:
+                    out.append(e)
+            if events:
+                self._record_batch(events)
+        return out
+
     def _encode_blob(self, key: str, stored) -> Optional[bytes]:
         """Encode the committed object once; cache under key. None when
         the strict codec can't carry it (the legacy paths then apply)."""
@@ -406,23 +532,31 @@ class MemoryStore:
         self._tlv_blobs.pop(key, None)
         return None
 
+    def _apply_update(self, key: str, obj: Any,
+                      expect_rv: Optional[int] = None,
+                      owned: bool = False):
+        """Commit an update under the ALREADY-HELD lock without
+        recording it; -> (rv, the MODIFIED WatchEvent). update() records
+        immediately; update_batch() collects a burst first."""
+        if key not in self._data:
+            raise KeyNotFound(key)
+        prev, cur = self._data[key]
+        if expect_rv is not None and expect_rv != cur:
+            raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
+        rv = self._next_rv()
+        stored = obj if owned else _dc(obj)
+        self._set_rv(stored, rv)
+        pblob = self._tlv_blobs.get(key)
+        self._data[key] = (stored, rv)
+        oblob = self._encode_blob(key, stored)
+        return rv, WatchEvent(MODIFIED, stored, rv, prev,
+                              obj_blob=oblob, prev_blob=pblob, key=key)
+
     def update(self, key: str, obj: Any, expect_rv: Optional[int] = None,
                owned: bool = False) -> int:
         with self._lock:
-            if key not in self._data:
-                raise KeyNotFound(key)
-            prev, cur = self._data[key]
-            if expect_rv is not None and expect_rv != cur:
-                raise Conflict(f"{key}: rv {expect_rv} != current {cur}")
-            rv = self._next_rv()
-            stored = obj if owned else _dc(obj)
-            self._set_rv(stored, rv)
-            pblob = self._tlv_blobs.get(key)
-            self._data[key] = (stored, rv)
-            oblob = self._encode_blob(key, stored)
-            self._record(key, WatchEvent(MODIFIED, stored, rv, prev,
-                                         obj_blob=oblob,
-                                         prev_blob=pblob))
+            rv, ev = self._apply_update(key, obj, expect_rv, owned)
+            self._record(key, ev)
             return rv
 
     def guaranteed_update(
@@ -454,14 +588,17 @@ class MemoryStore:
             return self.create(key, new, owned=owned)
 
     def update_batch(self, ops) -> List[Optional[Exception]]:
-        """guaranteed_update semantics for a list of (key, fn) under ONE
-        lock acquisition — the wave-bulk bind commits thousands of
-        per-pod updates back to back, and per-item lock churn was a
-        measurable slice of the window. Per-item isolation: each item
-        succeeds or fails independently — ANY exception (a StorageError
-        or a raising mutation fn) stays with its item, so one bad
-        mutation in a bulk bind can't 500 the whole BindingList."""
+        """guaranteed_update semantics for a list of (key, fn) as ONE
+        transaction: one lock acquisition, one WAL append (FileStore
+        overrides _record_batch), one watch-event burst per watcher —
+        the wave-bulk bind commits thousands of per-pod updates back to
+        back, and per-item lock/condition churn was a measurable slice
+        of the window. Per-item isolation: each item succeeds or fails
+        independently — ANY exception (a StorageError or a raising
+        mutation fn) stays with its item, so one bad mutation in a bulk
+        bind can't 500 the whole BindingList."""
         out: List[Optional[Exception]] = []
+        events: List = []
         with self._lock:
             for key, fn in ops:
                 try:
@@ -472,10 +609,14 @@ class MemoryStore:
                     if new is None:
                         out.append(None)
                         continue
-                    self.update(key, new, owned=new is cur)
+                    _rv, ev = self._apply_update(key, new,
+                                                 owned=new is cur)
+                    events.append((key, ev))
                     out.append(None)
                 except Exception as e:
                     out.append(e)
+            if events:
+                self._record_batch(events)
         return out
 
     def delete(self, key: str, expect_rv: Optional[int] = None) -> Any:
@@ -517,6 +658,25 @@ class MemoryStore:
                         )
             self._watchers.append((prefix, stream))
             return stream
+
+    def watch_bootstrap(self, prefix: str):
+        """Atomic snapshot + watch registration for a cache tier (the
+        watch cache's feed): under ONE lock acquisition returns
+        (entries, rv, stream) where entries are (key, object_ref,
+        mod_rv, tlv_blob|None) tuples and stream delivers every event
+        with resource_version > rv. The object refs are the store's OWN
+        immutable-after-write objects — read-only, never to be handed
+        to a consumer without an isolation copy (decode the blob)."""
+        with self._lock:
+            entries = [
+                (k, obj, rv, self._tlv_blobs.get(k))
+                for k, (obj, rv) in sorted(self._data.items())
+                if k.startswith(prefix)
+            ]
+            stream = WatchStream(self)
+            stream._progress_rv = self._rv
+            self._watchers.append((prefix, stream))
+            return entries, self._rv, stream
 
     def _remove_watcher(self, stream: WatchStream) -> None:
         with self._lock:

@@ -23,7 +23,9 @@ from kubernetes_tpu.metrics.metrics import (
 import kubernetes_tpu.trace  # noqa: F401
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
-_UNIT_SUFFIXES = ("_seconds", "_microseconds", "_milliseconds", "_bytes")
+#  _objects: dimensionless count distributions (batch commit sizes)
+_UNIT_SUFFIXES = ("_seconds", "_microseconds", "_milliseconds", "_bytes",
+                  "_objects")
 
 
 def _registered():

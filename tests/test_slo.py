@@ -333,3 +333,133 @@ def test_wave_dispatch_count_gate():
         f"{total} device dispatches for a 24-template wave "
         f"(must be O(1), not O(templates)): {d}"
     )
+
+
+def test_apiserver_requests_per_wave_o1_gate():
+    """STRUCTURAL gate on the wire path (the r06 overhaul's contract):
+    apiserver requests issued by the scheduling/bind path must be O(1)
+    per wave, NOT O(backlog) — a per-pod bind, per-pod status PATCH, or
+    per-pod relist sneaking back in is a CI failure, like the PR 3
+    device-dispatch gates. Two backlog sizes an order of magnitude
+    apart must cost the same number of write requests per wave."""
+    from kubernetes_tpu.api.types import (
+        Node,
+        NodeCondition,
+        NodeStatus,
+    )
+
+    import threading
+
+    def run(pods: int):
+        api = APIServer()
+        inner = LocalTransport(api)
+        counts = {"writes": 0, "reads": 0}
+        lock = threading.Lock()
+
+        class CountingTransport:
+            object_protocol = True
+
+            def request(self, method, path, query=None, body=None):
+                with lock:
+                    if method.upper() in ("POST", "PUT", "PATCH",
+                                          "DELETE"):
+                        counts["writes"] += 1
+                    else:
+                        counts["reads"] += 1
+                return inner.request(method, path, query, body)
+
+            def watch(self, path, query=None):
+                return inner.watch(path, query)
+
+        client = RESTClient(CountingTransport())
+        for i in range(40):
+            client.nodes().create(Node(
+                metadata=ObjectMeta(name=f"gate-n{i:03d}"),
+                status=NodeStatus(
+                    allocatable={"cpu": "64", "memory": "256Gi",
+                                 "pods": "2000"},
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            ))
+        sched = SchedulerServer(
+            client, SchedulerServerOptions(algorithm_provider="TPUProvider",
+                                           serve_port=None)
+        ).start()
+        try:
+            assert sched.ready.wait(120)
+            with lock:
+                counts["writes"] = 0  # boot traffic is not wave traffic
+            for i in range(pods):
+                client.pods().create(_pod(i))
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                bound = len(
+                    sched.factory.assigned_informer.store.list_keys()
+                )
+                if bound >= pods:
+                    break
+                time.sleep(0.05)
+            assert bound >= pods, f"only {bound}/{pods} bound"
+            with lock:
+                writes = counts["writes"]
+            # writes = pod creates (one POST each, issued by THIS test)
+            # + scheduler wave traffic. Everything beyond the creates
+            # is the scheduler's: binds + events + conditions.
+            sched_writes = writes - pods
+            return sched_writes
+        finally:
+            sched.stop()
+            api.close_cachers()
+
+    small = run(60)
+    large = run(600)
+    # O(1) per wave: a 10x backlog may cost a few more waves (smaller
+    # early waves while the burst ramps), but NOT 10x the requests.
+    # Per-pod traffic would put large >= small + ~540.
+    assert large <= small + 40, (
+        f"scheduler wire requests grew with backlog size: "
+        f"{small} writes @ 60 pods vs {large} @ 600 pods — the wave "
+        "commit path must stay O(1) requests per wave"
+    )
+
+
+def test_watch_cache_hit_rate_gate():
+    """The bench scenario's steady-state reads must be served from the
+    watch cache: hit rate > 90% across a create/schedule/list workload
+    (the acceptance bar for the zero-re-encode wire path)."""
+    from kubernetes_tpu.metrics import (
+        apiserver_watch_cache_hits_total,
+        apiserver_watch_cache_misses_total,
+    )
+
+    h0 = apiserver_watch_cache_hits_total.get()
+    m0 = apiserver_watch_cache_misses_total.get()
+    api = APIServer()
+    client = RESTClient(LocalTransport(api))
+    cluster = HollowCluster(client, 5).run()
+    sched = SchedulerServer(
+        client, SchedulerServerOptions(algorithm_provider="TPUProvider",
+                                       serve_port=None)
+    ).start()
+    try:
+        assert sched.ready.wait(120)
+        for i in range(60):
+            client.pods().create(_pod(i))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            objs, _ = client.pods().list(label_selector="run=slo")
+            if sum(1 for o in objs if o.spec.node_name) >= 60:
+                break
+            time.sleep(0.2)
+        hits = apiserver_watch_cache_hits_total.get() - h0
+        misses = apiserver_watch_cache_misses_total.get() - m0
+        assert hits > 0
+        rate = hits / max(hits + misses, 1)
+        assert rate > 0.9, (
+            f"watch cache hit rate {rate:.1%} (hits {hits:.0f} / misses "
+            f"{misses:.0f}) — steady-state reads regressed to the store"
+        )
+    finally:
+        sched.stop()
+        cluster.stop()
+        api.close_cachers()
