@@ -25,7 +25,9 @@ pods/s for this config (v1.3 kube-scheduler throughput at 1k nodes);
 vs_baseline = measured / 100.
 """
 
+import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -105,19 +107,23 @@ def measure_backlog(state, pods, config=None, reps=3):
     end-to-end schedule of the whole backlog and every rep's decisions
     are asserted identical. The ONE measurement protocol for the
     headline, north-star, and the BASELINE config matrix."""
+    from kubernetes_tpu.models.pack import Packer
     from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
 
     algo = TPUScheduleAlgorithm(config=config)
     cold = algo.schedule_backlog(pods, state)
     n_sched = sum(1 for h in cold if h is not None)
     times = []
+    h2d = []
     for _ in range(reps):
         algo._last_node_index = 0
+        b0 = Packer.total_h2d_bytes
         t0 = time.time()
         warm = algo.schedule_backlog(pods, state)
         times.append(time.time() - t0)
+        h2d.append(Packer.total_h2d_bytes - b0)
         assert warm == cold, "warm rerun diverged"
-    return min(times), statistics.median(times), max(times), n_sched
+    return min(times), statistics.median(times), max(times), n_sched, h2d
 
 
 def _rate_str(n_pods, best, med, worst):
@@ -127,9 +133,10 @@ def _rate_str(n_pods, best, med, worst):
 
 def run_config(num_nodes, num_pods, reps=3):
     state, pods = build(num_nodes, num_pods)
-    best, med, worst, n_sched = measure_backlog(state, pods, reps=reps)
+    best, med, worst, n_sched, h2d = measure_backlog(state, pods,
+                                                     reps=reps)
     assert n_sched == num_pods, f"only {n_sched}/{num_pods} scheduled"
-    return best, med, worst, n_sched
+    return best, med, worst, n_sched, h2d
 
 
 def run_wire_path():
@@ -230,7 +237,7 @@ def run_bench_matrix():
         for prior in (0, 1000):
             try:
                 state, pods = build(n_nodes, 1000, prior_pods=prior)
-                best, med, worst, placed = measure_backlog(
+                best, med, worst, placed, _h2d = measure_backlog(
                     state, pods, reps=3)
                 print(
                     f"# benchmatrix BenchmarkScheduling "
@@ -244,6 +251,154 @@ def run_bench_matrix():
             except Exception as e:
                 print(f"# benchmatrix {n_nodes}/{prior} FAILED: {e}",
                       file=sys.stderr)
+
+
+def run_soak(seconds: int):
+    """Soak smoke: continuous create/delete/reschedule churn against
+    the RESIDENT-STATE MESH path (8 virtual CPU devices), gated on
+    zero steady-state recompilation (CompileSentinel) and flat RSS
+    (+-10%) — the down payment on the ROADMAP soak harness.  Prints one
+    JSON line and exits non-zero on a gate breach.  Protocol: 60s in
+    CI (`python bench.py --soak 60`)."""
+    import copy as _copy
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.analysis.compile_guard import CompileSentinel
+    from kubernetes_tpu.native.build import ensure_all
+    from kubernetes_tpu.scheduler.tpu_algorithm import (
+        TPUScheduleAlgorithm,
+    )
+
+    ensure_all()
+    devices = jax.devices()
+    assert len(devices) >= 2, (
+        "soak needs a multi-device mesh; run with XLA_FLAGS="
+        "--xla_force_host_platform_device_count=8 (the bench re-execs "
+        "itself when possible)"
+    )
+    state, template = build(1000, 1)
+    mesh = Mesh(np.array(devices), ("nodes",))
+    algo = TPUScheduleAlgorithm(mesh=mesh)
+    sentinel = CompileSentinel()
+
+    def rss_mb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        return 0.0
+
+    WAVE = 512
+    serial = 0
+    bound = []  # (pod, node) in bind order
+
+    def make_pods(n):
+        nonlocal serial
+        out = []
+        for _ in range(n):
+            p = _copy.copy(template[0])
+            p.metadata = _copy.copy(p.metadata)
+            p.metadata.name = f"soak-{serial:07d}"
+            serial += 1
+            out.append(p)
+        return out
+
+    def commit(pods, hosts):
+        for p, h in zip(pods, hosts):
+            if h is None:
+                continue
+            q = _copy.copy(p)
+            q.spec = _copy.copy(p.spec)
+            q.spec.node_name = h
+            state.assign(q)
+            bound.append((q, h))
+
+    def evict(n):
+        """Delete the n oldest bound pods (the churn's delete half)."""
+        victims, rest = bound[:n], bound[n:]
+        del bound[:]
+        bound.extend(rest)
+        for q, h in victims:
+            info = state.get_node_info_any(h)
+            if info is not None:
+                info.remove_pod(q)
+        return len(victims)
+
+    # warmup: compile every program shape before arming the sentinel
+    for _ in range(2):
+        pods = make_pods(WAVE)
+        commit(pods, algo.schedule_backlog(pods, state))
+    warm_compiles = sentinel.compile_count()
+    rss0 = rss_mb()
+    resident = algo._mesh_sched.resident
+    waves = scheduled = churned = 0
+    h2d_per_wave = []
+    table_bytes = []
+    evicted_flags = []
+    rss_samples = [rss0]
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        # balanced churn: past the fill threshold, every other wave
+        # deletes as many pods as TWO waves create, so the population
+        # (and therefore honest RSS) is flat in steady state — an
+        # unbounded fill would turn the RSS gate into a workload-growth
+        # detector instead of a leak detector
+        evicted = False
+        if waves % 2 == 0 and len(bound) >= 4 * WAVE:
+            churned += evict(2 * WAVE)
+            evicted = True
+        pods = make_pods(WAVE)
+        hosts = algo.schedule_backlog(pods, state)
+        commit(pods, hosts)
+        scheduled += sum(1 for h in hosts if h is not None)
+        waves += 1
+        evicted_flags.append(evicted)
+        h2d_per_wave.append(resident.stats["wave_h2d_bytes"])
+        table_bytes.append(resident.stats["wave_table_bytes"])
+        rss_samples.append(rss_mb())
+    steady_compiles = sentinel.compile_count() - warm_compiles
+    rss_end = statistics.median(rss_samples[-5:])
+    rss_base = statistics.median(rss_samples[:5])
+    rss_drift = (rss_end - rss_base) / max(rss_base, 1.0)
+    # steady-state waves against an unchanged topology ship no node
+    # tables; only churn (delete) waves may scatter changed rows
+    quiet_tables = [b for b, ev in zip(table_bytes, evicted_flags)
+                    if not ev]
+    record = {
+        "metric": "soak_smoke",
+        "seconds": seconds,
+        "waves": waves,
+        "pods_scheduled": scheduled,
+        "pods_churned": churned,
+        "steady_state_compiles": steady_compiles,
+        "rss_start_mb": round(rss_base, 1),
+        "rss_end_mb": round(rss_end, 1),
+        "rss_drift_frac": round(rss_drift, 4),
+        "h2d_bytes_per_wave_median": int(
+            statistics.median(h2d_per_wave)) if h2d_per_wave else 0,
+        "quiet_wave_table_bytes_max": max(quiet_tables, default=0),
+        # counters only: stats also carries the last-changed-fields
+        # breadcrumb tuple
+        "resident_stats": {k: int(v)
+                           for k, v in resident.stats.items()
+                           if isinstance(v, int)},
+    }
+    ok = (steady_compiles == 0 and abs(rss_drift) <= 0.10
+          and max(quiet_tables, default=0) == 0)
+    record["ok"] = ok
+    print(json.dumps(record))
+    if not ok:
+        print("# SOAK GATE BREACH: "
+              + ("recompilation; " if steady_compiles else "")
+              + (f"rss drift {rss_drift:+.1%}; "
+                 if abs(rss_drift) > 0.10 else "")
+              + ("node-table bytes on a quiet wave"
+                 if max(quiet_tables, default=0) else ""),
+              file=sys.stderr)
+        sys.exit(1)
 
 
 def main():
@@ -261,7 +416,7 @@ def main():
         wire_err = f"{type(e).__name__}: {e}"
         print(f"# wire-path run failed ({wire_err}); falling back to "
               "the raw tensor path as headline", file=sys.stderr)
-    dt, dt_med, dt_worst, _ = run_config(NUM_NODES, NUM_PODS)
+    dt, dt_med, dt_worst, _, raw_h2d = run_config(NUM_NODES, NUM_PODS)
     raw = NUM_PODS / dt
     print(
         f"# raw tensor path: {NUM_PODS} pods / {NUM_NODES} nodes in "
@@ -292,6 +447,10 @@ def main():
             "raw_tensor_path_pods_per_sec": round(raw, 1),
             "raw_tensor_path_floor_pods_per_sec": round(
                 NUM_PODS / dt_worst, 1),
+            # host->device bytes shipped per warm backlog rep (the
+            # O(1)-transfer claim as a number: Packer counts every
+            # byte the single-chip wave path uploads)
+            "raw_tensor_path_h2d_bytes_per_rep": raw_h2d,
             "baseline_kind": "assumed (published v1.3-era ~100 pods/s; "
             "no Go toolchain in this image to measure the reference)",
             # per-rep wire accounting (apiserver requests, watch
@@ -299,11 +458,11 @@ def main():
             "reps": reps,
         }
         try:
-            with open("BENCH_r06.json", "w") as f:
+            with open("BENCH_r07.json", "w") as f:
                 json.dump(record, f, indent=1)
                 f.write("\n")
         except OSError as e:
-            print(f"# BENCH_r06.json write failed: {e}", file=sys.stderr)
+            print(f"# BENCH_r07.json write failed: {e}", file=sys.stderr)
     else:
         record = {
             "metric": "scheduler_perf_1000n_30kp_pods_per_sec",
@@ -318,7 +477,7 @@ def main():
         }
     print(json.dumps(record))
     try:
-        dt5, dt5_med, dt5_worst, _ = run_config(5000, 50000)
+        dt5, dt5_med, dt5_worst, _, _h2d5 = run_config(5000, 50000)
         print(
             f"# north-star 50k pods / 5k nodes: {dt5:.2f}s best "
             f"({_rate_str(50000, dt5, dt5_med, dt5_worst)}; target "
@@ -357,7 +516,7 @@ def run_baseline_configs():
 
     def timeit(label, state, pods, config=None, reps=2):
         try:
-            best, med, worst, placed = measure_backlog(
+            best, med, worst, placed, _h2d = measure_backlog(
                 state, pods, config=config, reps=reps)
             print(
                 f"# {label}: {len(pods)} pods in {best:.2f}s "
@@ -505,5 +664,33 @@ def run_baseline_configs():
            pods4, reps=2)
 
 
+def _cli():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--soak", type=int, default=0, metavar="SECONDS",
+        help="run the resident-mesh soak smoke instead of the bench "
+             "(churn loop gated on zero recompiles + flat RSS; 60s in "
+             "CI). Default off.",
+    )
+    args = ap.parse_args()
+    if args.soak:
+        # the mesh needs >=2 devices; re-exec once with the forced
+        # 8-device CPU platform BEFORE any jax backend initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("host_platform_device_count" not in flags
+                and not os.environ.get("KUBERNETES_TPU_SOAK_CHILD")):
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+            env["JAX_PLATFORMS"] = "cpu"
+            env["KUBERNETES_TPU_SOAK_CHILD"] = "1"
+            os.execve(sys.executable,
+                      [sys.executable] + sys.argv, env)
+        run_soak(args.soak)
+    else:
+        main()
+
+
 if __name__ == "__main__":
-    main()
+    _cli()

@@ -30,6 +30,14 @@ enforcing:
   the apply folds ship ZERO (the apply dispatch's outputs are all
   carry) — so a wave costs one probe transfer + one fold dispatch no
   matter how many templates rode it.
+* ``donation-contract`` / ``donation-unusable`` — the resident-state
+  programs (mesh folds, sharded scan, row scatter) declare
+  ``donate_argnums``; the auditor lowers each one and requires every
+  donated input leaf to carry an input/output alias
+  (``tf.aliasing_output``) and no donation to be dropped with a
+  warning.  A donated carry XLA silently copies would re-allocate
+  O(nodes) buffers per wave — that is a CI failure here, not a perf
+  mystery in production.
 """
 
 from __future__ import annotations
@@ -211,12 +219,53 @@ def _transfer_findings(spec: ProgramSpec) -> List[Finding]:
     return []
 
 
+def _donation_findings(spec: ProgramSpec) -> List[Finding]:
+    """The donation contract: every donated input leaf must alias an
+    output in the lowered program.  A resident-state program whose
+    donated buffer is silently copied (un-donatable layout, shape/dtype
+    drift between carry-in and carry-out) re-allocates O(nodes) memory
+    per wave — a CI failure here, not a perf mystery in production."""
+    import warnings
+
+    if not spec.donate_argnums:
+        return []
+    import jax
+
+    expected = spec.donated_leaves
+    if expected is None:
+        expected = sum(
+            len(jax.tree_util.tree_leaves(spec.args[i]))
+            for i in spec.donate_argnums
+        )
+    findings: List[Finding] = []
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        txt = spec.fn.lower(*spec.args).as_text()
+    for w in caught:
+        msg = str(w.message)
+        if "donat" in msg.lower():
+            findings.append(Finding(
+                "jaxpr", "donation-unusable", spec.name,
+                f"jax dropped a donation while lowering: {msg[:160]}",
+            ))
+    aliased = txt.count("tf.aliasing_output")
+    if aliased != expected:
+        findings.append(Finding(
+            "jaxpr", "donation-contract", spec.name,
+            f"{aliased} input leaf(s) alias an output, contract says "
+            f"{expected} — a donated resident-state buffer is being "
+            "silently copied instead of mutated in place",
+        ))
+    return findings
+
+
 def audit_program(spec: ProgramSpec) -> List[Finding]:
     import jax
 
     jaxpr = jax.make_jaxpr(spec.fn)(*spec.args)
     findings = audit_jaxpr(spec.name, jaxpr, allow_f64=spec.allow_f64)
     findings.extend(_transfer_findings(spec))
+    findings.extend(_donation_findings(spec))
     return findings
 
 

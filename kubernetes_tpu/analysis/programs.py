@@ -32,6 +32,14 @@ class ProgramSpec:
     dispatch. ``expected_host_leaves`` is the transfer contract: the
     number of arrays this program may ship device->host per dispatch
     (None = unaudited).
+
+    ``donate_argnums`` is the DONATION contract: the named args are
+    resident-state buffers the program must mutate in place.  The
+    auditor lowers the program and requires every donated input leaf to
+    alias an output (``donated_leaves`` overrides the expected count;
+    None = all leaves of the donated args) — a donated buffer XLA
+    silently copies (un-donatable layout, shape/dtype drift) is a CI
+    failure, not a perf mystery.
     """
 
     name: str
@@ -40,6 +48,8 @@ class ProgramSpec:
     allow_f64: bool = False
     carry_out_leaves: int = 0
     expected_host_leaves: Optional[int] = None
+    donate_argnums: Tuple[int, ...] = ()
+    donated_leaves: Optional[int] = None
     notes: str = ""
 
 
@@ -281,7 +291,14 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
 
 def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
                    carry_leaves) -> List[ProgramSpec]:
-    """The shard_map variants, when this host can form a mesh."""
+    """The resident pjit variants, when this host can form a mesh.
+
+    Programs come from the DRIVER'S OWN builders
+    (MeshWaveScheduler._probe_program et al. and
+    MeshBatchScheduler._exec's cache), so the audited shardings,
+    donation declarations, and scatter-form commit signatures are the
+    ones production dispatches — the registry cannot drift from the
+    driver."""
     import jax
 
     from kubernetes_tpu.parallel.compat import have_shard_map
@@ -289,14 +306,15 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
     if not have_shard_map() or len(jax.devices()) < 2:
         return []
 
-    import jax.numpy as jnp
     from jax.sharding import Mesh
 
-    from kubernetes_tpu.models.batch import BatchScheduler
-    from kubernetes_tpu.models.probe import N_STK_ROWS  # noqa: F401
     from kubernetes_tpu.models.wave import group_buffer
     from kubernetes_tpu.parallel import mesh as M
-    from kubernetes_tpu.parallel.compat import shard_map
+    from kubernetes_tpu.parallel.resident import (
+        CARRY_FIELDS,
+        host_carry,
+        host_static,
+    )
 
     devices = np.array(jax.devices())
     mesh = Mesh(devices, (M.AXIS,))
@@ -307,106 +325,139 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
     num_zones = max(int(snap_p.zone_id.max()) + 1, 1)
     num_values = int(snap_p.svc_num_values)
 
-    static = {f: jnp.asarray(getattr(snap_p, f))
-              for f in BatchScheduler.STATIC_FIELDS}
-    static.update(BatchScheduler.config_static(config, snap_p))
-    static["name_desc_order_global"] = static.pop("name_desc_order")
-    sched = BatchScheduler(config)
-    carry = sched.initial_carry(snap_p)
-    pods = {f: jnp.asarray(getattr(batch, f))
-            for f in BatchScheduler.POD_FIELDS}
-    pod_buf = jnp.asarray(pod_buf_host)
-    counts_global = jnp.zeros((n,), jnp.int64)
+    static = host_static(config, snap_p)
+    hc = host_carry(snap_p, 0)
+    carry = tuple(hc[f] for f in CARRY_FIELDS)
+    pods = {f: np.asarray(getattr(batch, f))
+            for f in M.BatchScheduler.POD_FIELDS}
     J = 128
-    from jax.sharding import PartitionSpec as PSpec
+    M_bucket = 64
+    wave = M.MeshWaveScheduler(mesh, config=config)
 
-    specs: List[ProgramSpec] = []
+    counts = np.zeros(n, np.int64)
+    counts[: min(3, n)] = 2
+    touch_idx, touch_cnt = M._sparse_counts(counts, floor=M_bucket)
 
-    scan_body = functools.partial(
-        M._mesh_scan_fn, config, num_zones, n_per_shard, n, num_values)
-
-    def spmd(static_, carry_, pods_):
-        import jax as _jax
-
-        return _jax.lax.scan(
-            functools.partial(scan_body, static_), carry_, pods_)
-
-    specs.append(ProgramSpec(
-        name="mesh_scan",
-        fn=jax.jit(shard_map(
-            spmd, mesh=mesh,
-            in_specs=(M._static_specs(static), M.CARRY_SPECS,
-                      {k: PSpec() for k in pods}),
-            out_specs=(M.CARRY_SPECS, PSpec()),
-            check_vma=False,
-        )),
-        args=(static, carry, pods),
-        allow_f64=True,
-        carry_out_leaves=carry_leaves,
-        expected_host_leaves=1,
-        notes="sharded scan (MeshBatchScheduler._exec)",
-    ))
-    specs.append(ProgramSpec(
-        name="mesh_probe",
-        fn=jax.jit(shard_map(
-            functools.partial(M._mesh_probe_fn, config, num_zones,
-                              num_values, J, n_per_shard, n, pod_layout),
-            mesh=mesh,
-            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec()),
-            out_specs=PSpec(None, M.AXIS),
-            check_vma=False,
-        )),
-        args=(static, carry, pod_buf),
-        carry_out_leaves=0,
-        expected_host_leaves=1,
-        notes="sharded single-run probe (MeshWaveScheduler._probe_run)",
-    ))
+    specs: List[ProgramSpec] = [
+        ProgramSpec(
+            name="mesh_scan",
+            fn=wave.scan._jit_for(static, n, n_per_shard, num_zones,
+                                  num_values, batch.num_pods,
+                                  tuple(pods)),
+            args=(static, carry, pods),
+            allow_f64=True,
+            carry_out_leaves=carry_leaves,
+            expected_host_leaves=1,
+            # deliberately NOT donated: donation + lax.scan inside
+            # shard_map miscompiles the SAA path on this jaxlib's CPU
+            # backend (see MeshBatchScheduler._jit_for)
+            notes="sharded scan (MeshBatchScheduler._exec)",
+        ),
+        ProgramSpec(
+            name="mesh_probe",
+            fn=wave._probe_program(static, n, n_per_shard, num_zones,
+                                   num_values, J, pod_layout),
+            args=(static, carry, pod_buf_host),
+            carry_out_leaves=0,
+            expected_host_leaves=1,
+            notes="sharded single-run probe "
+                  "(MeshWaveScheduler._probe_run)",
+        ),
+        ProgramSpec(
+            name="mesh_apply",
+            fn=wave._apply_program(static, n, n_per_shard, pod_layout,
+                                   donate=True),
+            args=(static, carry, pod_buf_host, touch_idx, touch_cnt),
+            carry_out_leaves=carry_leaves,
+            expected_host_leaves=0,
+            donate_argnums=(1,),
+            notes="sharded commit fold, scatter-form counts "
+                  "(O(picks) shipment), donated resident carry",
+        ),
+    ]
     G_bucket, glayout, gbuf_host = group_buffer(batch, [0, 24, 0, 24])
+    gcounts = np.zeros((G_bucket, n), np.int64)
+    gcounts[0, : min(3, n)] = 1
+    g_idx, g_cnt = M._sparse_group_counts(gcounts, floor=M_bucket)
     specs.append(ProgramSpec(
         name="mesh_group_probe",
-        fn=jax.jit(shard_map(
-            functools.partial(M._mesh_group_probe_fn, config, num_zones,
-                              num_values, G_bucket, n_per_shard, n,
-                              glayout),
-            mesh=mesh,
-            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec()),
-            out_specs=PSpec(None, M.AXIS),
-            check_vma=False,
-        )),
-        args=(static, carry, jnp.asarray(gbuf_host)),
+        fn=wave._group_probe_program(static, n, n_per_shard, num_zones,
+                                     num_values, G_bucket, glayout),
+        args=(static, carry, gbuf_host),
         carry_out_leaves=0,
         expected_host_leaves=1,
-        notes="sharded grouped header probe: ONE host-bound array",
-    ))
-    specs.append(ProgramSpec(
-        name="mesh_apply",
-        fn=jax.jit(shard_map(
-            functools.partial(M._mesh_apply_fn, config, pod_layout),
-            mesh=mesh,
-            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec(),
-                      PSpec()),
-            out_specs=M.CARRY_SPECS,
-            check_vma=False,
-        )),
-        args=(static, carry, pod_buf, counts_global),
-        carry_out_leaves=carry_leaves,
-        expected_host_leaves=0,
-        notes="sharded commit fold (MeshWaveScheduler._apply_run)",
+        notes="sharded grouped header probe: ONE host-bound array "
+              "(usage block no longer ships — resident mirror)",
     ))
     specs.append(ProgramSpec(
         name="mesh_apply_group",
-        fn=jax.jit(shard_map(
-            functools.partial(M._mesh_apply_group_fn, config, glayout),
-            mesh=mesh,
-            in_specs=(M._static_specs(static), M.CARRY_SPECS, PSpec(),
-                      PSpec()),
-            out_specs=M.CARRY_SPECS,
-            check_vma=False,
-        )),
-        args=(static, carry, jnp.asarray(gbuf_host),
-              jnp.zeros((G_bucket, n), jnp.int64)),
+        fn=wave._apply_group_program(static, n, n_per_shard, glayout,
+                                     donate=True),
+        args=(static, carry, gbuf_host, g_idx, g_cnt),
         carry_out_leaves=carry_leaves,
         expected_host_leaves=0,
-        notes="sharded grouped commit fold",
+        donate_argnums=(1,),
+        notes="sharded grouped commit fold, scatter-form counts, "
+              "donated resident carry",
     ))
+    specs.append(_resident_scatter_program(mesh, config, snap_p, n,
+                                           n_per_shard))
     return specs
+
+
+def _resident_scatter_program(mesh, config, snap_p, n,
+                              n_per_shard) -> ProgramSpec:
+    """The resident-state row-scatter update (node add/remove inside
+    the padded bucket), built exactly as ResidentClusterState._scatter
+    builds it: donated resident arrays, one packed replicated row
+    buffer."""
+    import numpy as np
+
+    from kubernetes_tpu.models.pack import pack_arrays
+    from kubernetes_tpu.parallel.resident import (
+        CARRY_FIELDS,
+        ResidentClusterState,
+        host_carry,
+        host_static,
+    )
+
+    res = ResidentClusterState(mesh)
+    static, carry = res.sync(config, snap_p, 0)
+    hs = host_static(config, snap_p)
+    hc = host_carry(snap_p, 0)
+    fields = [
+        ("alloc_mcpu", hs["alloc_mcpu"], 0),
+        ("label_kv", hs["label_kv"], 0),
+        ("__res__", hc["__res__"], 1),
+    ]
+    M_rows = 64
+    rows = np.arange(min(3, n), dtype=np.int64)
+    idx = np.full(M_rows, -1, np.int64)
+    idx[: len(rows)] = rows
+    packed = {"__idx__": idx}
+    names, axes, spec_list, arrays = [], [], [], []
+    sspec, cspec = res._specs(hs.keys())
+    for f, host, ax in fields:
+        r = np.moveaxis(host, ax, 0)[rows]
+        pad = np.zeros((M_rows - len(rows),) + r.shape[1:], r.dtype)
+        packed[f] = np.concatenate([r, pad])
+        names.append(f)
+        axes.append(ax)
+        spec_list.append(cspec[f] if f in CARRY_FIELDS else sspec[f])
+        arrays.append(carry[CARRY_FIELDS.index(f)]
+                      if f in CARRY_FIELDS else static[f])
+    layout, buf = pack_arrays(packed)
+    run = res._scatter_program(tuple(names), tuple(axes),
+                               tuple(spec_list), layout,
+                               tuple(a.shape for _f, a, _x in fields),
+                               n_per_shard, donate=True)
+    return ProgramSpec(
+        name="resident_scatter",
+        fn=run,
+        args=((tuple(arrays)), buf),
+        carry_out_leaves=len(arrays),
+        expected_host_leaves=0,
+        donate_argnums=(0,),
+        notes="resident node add/remove row scatter: donated in-place "
+              "update, O(changed rows) shipment",
+    )

@@ -677,15 +677,18 @@ class BatchScheduler:
     def config_static(cls, config: "SchedulerConfig", snap: ClusterSnapshot):
         """Per-node static arrays for config-parameterized entries
         (NodeLabel predicates/priorities), resolved from the snapshot's
-        host-side key vocab."""
+        host-side key vocab.  Returned as HOST arrays: every consumer
+        feeds a jit boundary (which places them) or the mesh resident
+        placement (which shards them) — the one resolution site serves
+        both."""
         out = {}
         for entry in config.predicates:
             if isinstance(entry, tuple) and entry[0] == NODE_LABEL_PREDICATE:
                 for lbl in entry[1]:
-                    out[f"nl_pred_{lbl}"] = jnp.asarray(snap.node_has_key(lbl))
+                    out[f"nl_pred_{lbl}"] = np.asarray(snap.node_has_key(lbl))
         for name, _w in config.priorities:
             if isinstance(name, tuple) and name[0] == NODE_LABEL_PRIORITY:
-                out[f"nl_prio_{name[1]}"] = jnp.asarray(snap.node_has_key(name[1]))
+                out[f"nl_prio_{name[1]}"] = np.asarray(snap.node_has_key(name[1]))
         return out
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
@@ -712,16 +715,11 @@ class BatchScheduler:
         return fn
 
     def initial_carry(self, snap: ClusterSnapshot, last_node_index: int = 0):
+        from kubernetes_tpu.snapshot.encode import RES_CARRY_FIELDS
+
         return (
             jnp.stack(
-                [
-                    jnp.asarray(snap.req_mcpu),
-                    jnp.asarray(snap.req_mem),
-                    jnp.asarray(snap.req_gpu),
-                    jnp.asarray(snap.nz_mcpu),
-                    jnp.asarray(snap.nz_mem),
-                    jnp.asarray(snap.pod_count),
-                ]
+                [jnp.asarray(getattr(snap, f)) for f in RES_CARRY_FIELDS]
             ),
             jnp.asarray(snap.port_mask),
             jnp.asarray(snap.class_count),

@@ -79,10 +79,18 @@ def unpack(layout, buf):
 
 
 class Packer:
-    """Ships dicts of numpy arrays to the device in one transfer."""
+    """Ships dicts of numpy arrays to the device in one transfer.
+
+    ``h2d_bytes`` counts every byte shipped (class-wide total plus a
+    per-instance tally) so the bench can report per-wave host->device
+    transfer as a measured number — the single-chip counterpart of the
+    mesh resident state's stats."""
+
+    total_h2d_bytes = 0  # class-wide: all packers, process lifetime
 
     def __init__(self):
         self._unpack = {}
+        self.h2d_bytes = 0
 
     def ship(self, arrays: dict) -> dict:
         """-> {name: device array}, one host->device transfer total."""
@@ -90,6 +98,8 @@ class Packer:
         # every wave's shipping funnels through here
         with phase_timer("transfer"):
             key, buf = pack_arrays(arrays)
+            self.h2d_bytes += buf.nbytes
+            Packer.total_h2d_bytes += buf.nbytes
             fn = self._unpack.get(key)
             if fn is None:
                 fn = jax.jit(functools.partial(_unpack, key))

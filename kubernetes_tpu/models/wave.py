@@ -148,16 +148,20 @@ def run_pure(config: SchedulerConfig, batch: PodBatch, i: int,
     return True
 
 
-def group_buffer(batch: PodBatch, reps):
+def group_buffer(batch: PodBatch, reps, floor: int = 8):
     """Pack a group's run representatives (padded to a pow2 run bucket
     by repeating the LAST rep — padded slots schedule nothing and their
     commit counts stay zero) into ONE stacked buffer:
     -> (G_bucket, layout, uint8 host buffer). Shared by the single-chip
     and mesh wave drivers: the padding rule is part of the
-    host_group_replay / grouped-fold contract."""
+    host_group_replay / grouped-fold contract.  The mesh resident
+    driver passes floor=1: its exact host usage mirror lets even a
+    SINGLETON pure run ride the header-only probe (the j-table is a
+    host rebuild, models/hosttab), so padding the run bucket to 8 would
+    octuple the header shipment for nothing."""
     from kubernetes_tpu.models.pack import pack_arrays
 
-    G_bucket = next_pow2(len(reps), floor=8)
+    G_bucket = next_pow2(len(reps), floor=floor)
     reps = list(reps) + [reps[-1]] * (G_bucket - len(reps))
     seg = gather_batch(batch, np.asarray(reps, np.int64))
     layout, buf = pack_arrays({
@@ -411,6 +415,60 @@ def svc_run_context(config: SchedulerConfig, snap: ClusterSnapshot,
             # pin-staleness analysis needs the ord -> node row map
             ctx["ord_node"] = np.asarray(snap.svc_ord_node)
     return ctx
+
+
+def split_runs(rep_idx: np.ndarray) -> List[Tuple[int, int, int]]:
+    """Maximal runs of consecutive equal representative rows:
+    -> [(rep, start, length)]. Shared by the single-chip and mesh
+    drivers."""
+    runs: List[Tuple[int, int, int]] = []
+    i, P = 0, len(rep_idx)
+    while i < P:
+        r = rep_idx[i]
+        s = i
+        while i < P and rep_idx[i] == r:
+            i += 1
+        runs.append((int(r), s, i - s))
+    return runs
+
+
+def classify_runs(config: SchedulerConfig, snap: ClusterSnapshot,
+                  batch: PodBatch, runs, num_values: int, min_run: int,
+                  *, device_zoned: bool = False,
+                  zoned: bool = False) -> List[dict]:
+    """Classify every run once: eligibility, the self-anti veto, the
+    service context, the device-replay route, and commit purity
+    (whether a grouped probe's host adjustments can cover its commits).
+    Shared by the single-chip and mesh wave drivers — the classification
+    IS the dispatch-shape contract, so the two drivers can never drift."""
+    from kubernetes_tpu.snapshot.encode import service_config_labels
+
+    config_ok = config_eligible(config)
+    svc_free = not service_config_labels(config)
+    infos: List[dict] = []
+    for rep, start, length in runs:
+        eligible, veto = (False, None)
+        if length >= min_run:
+            eligible, veto = run_eligible(
+                config, batch, rep, snap, config_ok=config_ok,
+            )
+        svc_ctx = svc_run_context(
+            config, snap, batch, rep, num_values
+        ) if eligible else None
+        device = bool(
+            eligible and device_zoned and zoned
+            and bool(batch.has_selectors[rep]) and svc_ctx is None
+        )
+        pure = bool(
+            eligible and veto is None and svc_ctx is None
+            and run_pure(config, batch, rep, svc_free=svc_free)
+        )
+        infos.append({
+            "rep": rep, "start": start, "length": length,
+            "eligible": eligible, "veto": veto, "svc_ctx": svc_ctx,
+            "device": device, "pure": pure,
+        })
+    return infos
 
 
 def gather_batch(batch: PodBatch, rows: np.ndarray) -> PodBatch:
@@ -791,7 +849,14 @@ class WaveScheduler:
                    "__lidx__": np.int64(last_node_index)},
         )
         static = {f: dev[f] for f in BatchScheduler.STATIC_FIELDS}
-        static.update(BatchScheduler.config_static(self.config, snap))
+        # config-resolved node masks are HOST arrays: place them once
+        # per wave (a numpy leaf in `static` would re-upload at every
+        # per-run probe/apply dispatch)
+        static.update({
+            k: jnp.asarray(v)
+            for k, v in BatchScheduler.config_static(
+                self.config, snap).items()
+        })
         num_zones = max(
             int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
         )
@@ -802,14 +867,7 @@ class WaveScheduler:
         N = snap.num_nodes
 
         # maximal runs of consecutive equal reps
-        runs: List[Tuple[int, int, int]] = []  # (rep, start, length)
-        i = 0
-        while i < P:
-            r = rep_idx[i]
-            s = i
-            while i < P and rep_idx[i] == r:
-                i += 1
-            runs.append((int(r), s, i - s))
+        runs = split_runs(rep_idx)
 
         pending: List[int] = []
         # lastNodeIndex is tracked host-side (the replay computes it
@@ -857,39 +915,14 @@ class WaveScheduler:
             pending.clear()
             return new_carry
 
-        config_ok = config_eligible(self.config)
         zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
-        from kubernetes_tpu.snapshot.encode import service_config_labels
-
-        svc_free = not service_config_labels(self.config)
         from kubernetes_tpu.models.pack import pack_arrays
 
-        # classify every run once: eligibility, the self-anti veto, the
-        # service context, the replay path, and commit purity (whether
-        # a grouped probe's host adjustments can cover its commits)
-        infos: List[dict] = []
-        for rep, start, length in runs:
-            eligible, veto = (False, None)
-            if length >= self.min_run:
-                eligible, veto = run_eligible(
-                    self.config, batch, rep, snap, config_ok=config_ok,
-                )
-            svc_ctx = svc_run_context(
-                self.config, snap, batch, rep, num_values
-            ) if eligible else None
-            device = bool(
-                eligible and self._device_zoned and zoned
-                and bool(batch.has_selectors[rep]) and svc_ctx is None
-            )
-            pure = bool(
-                eligible and veto is None and svc_ctx is None
-                and run_pure(self.config, batch, rep, svc_free=svc_free)
-            )
-            infos.append({
-                "rep": rep, "start": start, "length": length,
-                "eligible": eligible, "veto": veto, "svc_ctx": svc_ctx,
-                "device": device, "pure": pure,
-            })
+        # classify every run once (shared with the mesh driver)
+        infos = classify_runs(
+            self.config, snap, batch, runs, num_values, self.min_run,
+            device_zoned=self._device_zoned, zoned=zoned,
+        )
 
         def run_single(carry, info, done0=0):
             """The per-run fast path: probe_fused (or the single-run

@@ -10,8 +10,16 @@ reductions ride ICI collectives:
 - host selection: all_gather of the int64 score vector (~N bytes) then a
   replicated deterministic selectHost — every chip picks the same node
 - commit: the owning shard folds the pod into its slice of the carry
+
+Round 7: the cluster state is DEVICE-RESIDENT across waves
+(parallel/resident) — node tables placed once as NamedSharding arrays,
+pjit programs with donated carries, scatter-form commits, host mirrors
+proving freshness — so steady-state per-wave host->device transfer is
+O(pending pods), not O(nodes).
 """
 
-from kubernetes_tpu.parallel.mesh import MeshBatchScheduler
+from kubernetes_tpu.parallel.mesh import MeshBatchScheduler, MeshWaveScheduler
+from kubernetes_tpu.parallel.resident import ResidentClusterState
 
-__all__ = ["MeshBatchScheduler"]
+__all__ = ["MeshBatchScheduler", "MeshWaveScheduler",
+           "ResidentClusterState"]

@@ -1,10 +1,26 @@
-"""shard_map SPMD implementation of the batch scheduler.
+"""SPMD mesh implementation of the batch scheduler: resident pjit path.
 
 Node-axis arrays are sharded P("nodes"); pod-batch arrays are replicated.
-The scan runs inside shard_map so per-step collectives (pmax/psum for the
-filtered-normalization maxes, all_gather for selection) ride ICI. Results
-are bit-identical to the single-chip BatchScheduler: every reduction here
-computes exactly the same integers, just distributed.
+The scan/probe/fold bodies run inside shard_map so per-step collectives
+(pmax/psum for the filtered-normalization maxes, all_gather for
+selection) ride ICI.  Results are bit-identical to the single-chip
+BatchScheduler: every reduction here computes exactly the same integers,
+just distributed.
+
+Round 7: the cluster state is DEVICE-RESIDENT across waves
+(parallel/resident.ResidentClusterState).  Every program is pjit-shaped
+— ``jax.jit`` with explicit ``in_shardings``/``out_shardings`` built
+from the same PartitionSpecs the shard_map bodies declare — and the
+commit folds DONATE their carry input (``donate_argnums``, gated by
+``runtime_donation()``: on accelerator backends wave-to-wave commits
+mutate the resident sharded buffers in place, zero host round trips
+and zero realloc; this jaxlib's CPU client has a donation race, so CPU
+runs undonated while the auditor still enforces the donation contract
+on the lowered form).  Commit counts ship in scatter form (touched
+node ids + amounts, O(pending pods)) instead of dense O(nodes) rows;
+steady-state waves ship no node table bytes at all (the jaxpr
+auditor's donation/transfer contract and tests/test_resident.py
+enforce both properties structurally).
 """
 
 from __future__ import annotations
@@ -16,6 +32,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from kubernetes_tpu.parallel.compat import shard_map
+from kubernetes_tpu.parallel.resident import (
+    AXIS,
+    CARRY_FIELDS,
+    ResidentClusterState,
+    carry_specs,
+    host_carry,
+    host_static,
+    static_specs,
+)
 
 from kubernetes_tpu.models.batch import (
     CHECK_NODE_MEMORY_PRESSURE,
@@ -40,8 +67,6 @@ from kubernetes_tpu.ops import priorities as R
 from kubernetes_tpu.ops import services as SV
 from kubernetes_tpu.ops import volumes as V
 from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch, service_config_labels
-
-AXIS = "nodes"
 
 
 def _pad_snapshot(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
@@ -130,9 +155,18 @@ def _shard_fit(config, n_per_shard, n_global, static, carry, pod,
     want_ip_prio = any(n == INTER_POD_AFFINITY for n, _ in config.priorities)
     cnt_lt = topo_local = None
     if want_ip_pred or want_ip_prio:
-        topo_local = jax.lax.dynamic_slice_in_dim(
-            static["ip_topo_dom"], offset, n_per_shard, axis=1
-        )
+        dom_tab = static["ip_topo_dom"]
+        if dom_tab.size:
+            topo_local = jax.lax.dynamic_slice_in_dim(
+                dom_tab, offset, n_per_shard, axis=1
+            )
+        else:
+            # no interpod terms in the cluster: the incremental encoder
+            # emits a (0, 0) domain table (the full encoder (0, N));
+            # slicing either would trip — the empty per-shard window is
+            # exact
+            topo_local = jnp.zeros((dom_tab.shape[0], n_per_shard),
+                                   dom_tab.dtype)
         cnt_lt = IP.expand_lt(
             IP.gather_counts(ip_term_count, static["ip_u_topo"], topo_local),
             static["ip_lt_u"],
@@ -682,10 +716,11 @@ def _mesh_group_probe_fn(config, num_zones, num_values, G, n_per_shard,
                          n_global, pod_layout, static, carry, group_buf):
     """The grouped header probe, sharded: vmap of _mesh_probe_rows over
     G stacked run representatives (J=1 — the host rebuilds the resource
-    j-axis from the shipped usage block, models/hosttab). The run axis
-    rides as a leading axis on every shard; the node axis stays sharded,
-    and the out_spec concatenates shards so the host sees the same
-    (G*N_STK_ROWS + 6, N) array the single-chip grouped probe ships."""
+    j-axis against the resident state's exact host usage mirror,
+    models/hosttab, so unlike the single-chip grouped probe NO resource
+    block ships device->host). The run axis rides as a leading axis on
+    every shard; the node axis stays sharded, and the out_spec
+    concatenates shards into one (G*N_STK_ROWS, N) host-bound array."""
     from kubernetes_tpu.models.pack import unpack as _unpack_pod
     from kubernetes_tpu.models.probe import N_STK_ROWS
 
@@ -699,21 +734,23 @@ def _mesh_group_probe_fn(config, num_zones, num_values, G, n_per_shard,
         return stk
 
     stk = jax.vmap(one)(pods)  # (G, N_STK_ROWS, n_per_shard)
-    return jnp.concatenate(
-        [stk.reshape(G * N_STK_ROWS, n_per_shard), carry[0]], axis=0
-    )
+    return stk.reshape(G * N_STK_ROWS, n_per_shard)
 
 
-def _mesh_apply_group_fn(config, pod_layout, static, carry, group_buf,
-                         counts_global):
-    """The grouped commit fold, sharded: node-axis tables take this
-    shard's slice of the per-run global commit counts [G, N]. Valid for
-    PURE runs only (models/wave.run_pure): resource block, port masks,
-    spread class counts, and the round-robin counter — the replicated
-    ip/svc tables pass through untouched."""
+def _mesh_apply_group_fn(config, pod_layout, n_global, static, carry,
+                         group_buf, touch_idx, touch_cnt):
+    """The grouped commit fold, sharded and donated: commits arrive in
+    scatter form (per-run touched node ids + amounts, O(picks) bytes);
+    node-axis tables take this shard's slice of the rebuilt per-run
+    global counts [G, N]. Valid for PURE runs only
+    (models/wave.run_pure): resource block, port masks, spread class
+    counts, and the round-robin counter — the replicated ip/svc tables
+    pass through untouched."""
     from kubernetes_tpu.models.pack import unpack as _unpack_pod
 
     pods = _unpack_pod(pod_layout, group_buf)
+    counts_global = _group_counts_from_touch(n_global, touch_idx,
+                                             touch_cnt)
     (res, port_mask, class_count, last_idx), rest = carry[:4], carry[4:]
     n_per_shard = port_mask.shape[0]
     shard = jax.lax.axis_index(AXIS)
@@ -744,15 +781,17 @@ def _mesh_apply_group_fn(config, pod_layout, static, carry, group_buf,
     return (res, port_mask, class_count, last_idx) + tuple(rest)
 
 
-def _mesh_apply_fn(config, pod_layout, static, carry, pod_buf,
-                   counts_global):
-    """The wave commit fold, sharded: node-axis tables take this shard's
-    slice of the global per-node commit counts; the replicated interpod
-    tables take the identical global fold on every shard (the pattern
-    interpod_commit uses in the mesh scan)."""
+def _mesh_apply_fn(config, pod_layout, n_global, static, carry, pod_buf,
+                   touch_idx, touch_cnt):
+    """The wave commit fold, sharded and donated: commits arrive in
+    scatter form (touched node ids + amounts); node-axis tables take
+    this shard's slice of the rebuilt global counts; the replicated
+    interpod tables take the identical global fold on every shard (the
+    pattern interpod_commit uses in the mesh scan)."""
     from kubernetes_tpu.models.pack import unpack as _unpack_pod
 
     pod = _unpack_pod(pod_layout, pod_buf)
+    counts_global = _counts_from_touch(n_global, touch_idx, touch_cnt)
     (
         res, port_mask, class_count, last_idx,
         ip_term_count, ip_own_anti, ip_rev_hard, ip_rev_pref,
@@ -841,42 +880,66 @@ def _mesh_apply_fn(config, pod_layout, static, carry, pod_buf,
 
 
 def _static_specs(static: dict) -> dict:
-    """PartitionSpec per static snapshot field (shared by the mesh scan
-    and the mesh wave probe)."""
-    return {
-        k: (
-            PSpec(AXIS)
-            if k
-            in (
-                "alloc_mcpu", "alloc_mem", "alloc_gpu", "alloc_pods",
-                "has_taints", "taint_bad", "mem_pressure", "zone_id",
-                "ebs_bad", "gce_bad", "vz_zone", "vz_region", "vz_has",
-            )
-            or k.startswith("nl_")  # config-resolved node-label masks
-            else PSpec(AXIS, None)
-            if k
-            in (
-                "label_kv", "label_key", "numval", "taint_mask",
-                "taint_count", "img_size",
-            )
-            else PSpec()  # replicated vocab tables + global order
-        )
-        for k in static
-    }
+    """PartitionSpec per static snapshot field (single-sourced in
+    parallel/resident so placement and programs can never drift)."""
+    return static_specs(static)
 
 
-CARRY_SPECS = (
-    # stacked resources: node axis is axis 1
-    PSpec(None, AXIS), PSpec(AXIS, None), PSpec(AXIS, None), PSpec(),
-    # interpod count tables: replicated (domain-indexed, not node)
-    PSpec(), PSpec(), PSpec(), PSpec(), PSpec(), PSpec(),
-    # volume masks: node-axis sharded
-    PSpec(AXIS, None), PSpec(AXIS, None), PSpec(AXIS, None),
-    PSpec(AXIS, None),
-    # service-group tables: replicated (small: groups x labels);
-    # every shard applies identical commits with global indices
-    PSpec(), PSpec(), PSpec(),
-)
+CARRY_SPECS = carry_specs()
+
+
+def _ns_tree(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PSpec),
+    )
+
+
+def runtime_donation() -> bool:
+    """Whether the fold programs DONATE their carry at runtime.
+
+    On real accelerator backends donation is the point of the resident
+    design: the commit folds mutate the sharded carry in place, zero
+    realloc.  This jaxlib's CPU client, however, intermittently
+    corrupts the heap when a donated buffer is repossessed across
+    repeated aliased executions (reproduced as a ~1/3 segfault in the
+    daemon churn loop; a post-fold block_until_ready narrows but does
+    NOT close the window) — so on the CPU backend the folds run
+    undonated and pay a per-fold realloc instead.  The donation
+    CONTRACT is still enforced on every backend: the jaxpr auditor
+    lowers the donated form of each fold and requires every donated
+    leaf to alias an output (analysis/jaxpr_audit).
+    ``KUBERNETES_TPU_MESH_DONATE=1|0`` overrides the platform policy.
+    """
+    import os
+
+    env = os.environ.get("KUBERNETES_TPU_MESH_DONATE")
+    if env is not None:
+        return env not in ("0", "false", "off")
+    return jax.default_backend() != "cpu"
+
+
+def _counts_from_touch(n_global, touch_idx, touch_cnt):
+    """Dense i64[N] commit counts from the scatter-form shipment
+    (touched node ids padded with -1 + per-node amounts): the per-wave
+    host->device commit transfer is O(pending pods), not O(nodes)."""
+    valid = touch_idx >= 0
+    safe = jnp.clip(touch_idx, 0, n_global - 1)
+    return jnp.zeros((n_global,), jnp.int64).at[safe].add(
+        jnp.where(valid, touch_cnt, 0)
+    )
+
+
+def _group_counts_from_touch(n_global, touch_idx, touch_cnt):
+    """Scatter-form -> dense i64[G, N] per-run commit counts."""
+    G, M = touch_idx.shape
+    valid = touch_idx >= 0
+    safe = jnp.clip(touch_idx, 0, n_global - 1)
+    g_i = jnp.arange(G, dtype=jnp.int64)[:, None]
+    return jnp.zeros((G, n_global), jnp.int64).at[
+        jnp.broadcast_to(g_i, (G, M)), safe
+    ].add(jnp.where(valid, touch_cnt, 0))
 
 
 class MeshBatchScheduler:
@@ -905,28 +968,31 @@ class MeshBatchScheduler:
         n = len(snap.node_names)
         n_per_shard = n // n_dev
 
-        static = {
-            f: jnp.asarray(getattr(snap, f)) for f in BatchScheduler.STATIC_FIELDS
-        }
-        static.update(BatchScheduler.config_static(self.config, snap))
-        static["name_desc_order_global"] = static.pop("name_desc_order")
-        pods = {f: jnp.asarray(getattr(batch, f)) for f in BatchScheduler.POD_FIELDS}
+        static = host_static(self.config, snap)
+        pods = {f: np.asarray(getattr(batch, f))
+                for f in BatchScheduler.POD_FIELDS}
         num_zones = max(int(snap.zone_id.max()) + 1, 1)
 
         num_values = int(snap.svc_num_values)
-        sched = BatchScheduler(self.config)
-        carry = sched.initial_carry(snap, last_node_index)
+        hc = host_carry(snap, last_node_index)
+        carry = tuple(hc[f] for f in CARRY_FIELDS)
         final, chosen = self._exec(
             static, carry, pods, n, n_per_shard, num_zones, num_values,
             batch.num_pods,
         )
         return np.asarray(chosen), final
 
-    def _exec(self, static, carry, pods, n, n_per_shard, num_zones,
-              num_values, num_pods):
-        """Run the sharded scan with an EXTERNAL carry (the mesh wave's
-        fallback flush threads its carry through here)."""
-        key = (n, n_per_shard, num_pods, num_zones, num_values)
+    def _jit_for(self, static, n, n_per_shard, num_zones, num_values,
+                 num_pods, pods_keys):
+        """The pjit-shaped sharded-scan program for one shape class:
+        explicit in/out shardings, carry deliberately UNDONATED (see the
+        NB below — donation + lax.scan inside shard_map miscompiles on
+        this jaxlib's CPU backend, so a scan flush re-allocates its
+        carry); host numpy inputs are placed per in_shardings on call.
+        Shared with analysis/programs so the audited program IS the
+        dispatched one."""
+        key = (n, n_per_shard, num_pods, num_zones, num_values,
+               tuple(sorted(static)))
         run = self._jitted.get(key)
         if run is None:
             body = functools.partial(
@@ -940,20 +1006,39 @@ class MeshBatchScheduler:
                 )
                 return final, chosen
 
-            from kubernetes_tpu.parallel.compat import shard_map
-
+            specs = (
+                _static_specs(static), CARRY_SPECS,
+                {k: PSpec() for k in pods_keys},
+            )
             sharded = shard_map(
                 spmd,
                 mesh=self.mesh,
-                in_specs=(
-                    _static_specs(static), CARRY_SPECS,
-                    {k: PSpec() for k in pods},
-                ),
+                in_specs=specs,
                 out_specs=(CARRY_SPECS, PSpec()),
                 check_vma=False,
             )
-            run = jax.jit(sharded)
+            # NB: the scan does NOT donate its carry. On this jaxlib's
+            # CPU backend, donation + lax.scan inside shard_map
+            # miscompiles the ServiceAntiAffinity path (aliased carry
+            # buffers corrupt the all_gather'd peer tables mid-scan;
+            # reproduced and pinned by test_parallel's SAA tests — the
+            # fold programs, whose bodies are scan-free, alias
+            # correctly and keep their donation). The scan is the
+            # fallback path, so the realloc cost is off the hot wave.
+            run = jax.jit(
+                sharded,
+                in_shardings=_ns_tree(self.mesh, specs),
+                out_shardings=_ns_tree(self.mesh, (CARRY_SPECS, PSpec())),
+            )
             self._jitted[key] = run
+        return run
+
+    def _exec(self, static, carry, pods, n, n_per_shard, num_zones,
+              num_values, num_pods):
+        """Run the sharded scan with an EXTERNAL carry (the mesh wave's
+        fallback flush threads its resident carry through here)."""
+        run = self._jit_for(static, n, n_per_shard, num_zones,
+                            num_values, num_pods, tuple(pods))
         with self.mesh:
             final, chosen = run(static, carry, pods)
         return final, chosen
@@ -964,15 +1049,68 @@ class MeshBatchScheduler:
         return [names[i] if i >= 0 else None for i in chosen]
 
 
+def _opaque_blocks(config) -> tuple:
+    """Resident carry blocks this config's scan/impure folds can touch
+    in ways the host mirrors cannot track (they resync from the next
+    snapshot instead)."""
+    blocks = []
+    if MATCH_INTER_POD_AFFINITY in config.predicates or any(
+        n == INTER_POD_AFFINITY for n, _ in config.priorities
+    ):
+        blocks.append("ip")
+    if any(k in config.predicates for k in (
+        NO_DISK_CONFLICT, MAX_EBS_VOLUME_COUNT, MAX_GCE_PD_VOLUME_COUNT,
+    )):
+        blocks.append("vol")
+    if service_config_labels(config):
+        blocks.append("svc")
+    return tuple(blocks)
+
+
+def _sparse_counts(counts: np.ndarray, floor: int = 64):
+    """Dense i64[N] commit counts -> (idx i64[M], cnt i64[M]) scatter
+    form, M pow2-bucketed (compile reuse) and padded with idx=-1: the
+    commit shipment is O(touched nodes) <= O(picks), never O(N)."""
+    from kubernetes_tpu.snapshot.pad import next_pow2
+
+    ids = np.nonzero(counts)[0]
+    M = next_pow2(max(len(ids), 1), floor)
+    idx = np.full(M, -1, np.int64)
+    cnt = np.zeros(M, np.int64)
+    idx[: len(ids)] = ids
+    cnt[: len(ids)] = counts[ids]
+    return idx, cnt
+
+
+def _sparse_group_counts(counts_mat: np.ndarray, floor: int = 64):
+    """Dense i64[G, N] -> (idx i64[G, M], cnt i64[G, M]) scatter form
+    with a shared pow2 M bucket."""
+    from kubernetes_tpu.snapshot.pad import next_pow2
+
+    G = counts_mat.shape[0]
+    nz = [np.nonzero(row)[0] for row in counts_mat]
+    width = max((len(i) for i in nz), default=0)
+    M = next_pow2(max(width, 1), floor)
+    idx = np.full((G, M), -1, np.int64)
+    cnt = np.zeros((G, M), np.int64)
+    for g, ids in enumerate(nz):
+        idx[g, : len(ids)] = ids
+        cnt[g, : len(ids)] = counts_mat[g, ids]
+    return idx, cnt
+
+
 class MeshWaveScheduler:
-    """The wave fast path over a device mesh: probe tables computed per
-    shard (node axis sharded, one shard per chip), the replay on the
-    host exactly as single-chip, and the commit fold applied per shard.
-    Ineligible pods flush through the sharded scan with the SAME carry,
-    so the combined output is bit-identical to both the single-chip wave
-    and the serial oracle. This is the multi-chip scaling of the
-    reference's 16-worker node fan-out (generic_scheduler.go:161) —
-    except the fan-out here is across chips, not goroutines."""
+    """The wave fast path over a device mesh, resident-state edition:
+    probe tables computed per shard against the DEVICE-RESIDENT sharded
+    cluster state (node axis sharded, one shard per chip), the replay on
+    the host exactly as single-chip, and the commit fold applied per
+    shard through a donated pjit program whose scatter-form input is
+    O(picks).  Ineligible pods flush through the sharded scan with the
+    SAME resident carry, so the combined output is bit-identical to both
+    the single-chip wave and the serial oracle.  Wave-to-wave the node
+    tables never leave the device: ``resident`` holds them, its host
+    mirrors prove freshness, and only deltas (node add/remove scatter,
+    invalidated blocks) ever re-ship."""
 
     def __init__(self, mesh: Optional[Mesh] = None,
                  config: Optional[SchedulerConfig] = None,
@@ -991,111 +1129,173 @@ class MeshWaveScheduler:
         self._replay = replay or replay_fast
         self._probe_jit = {}
         self._apply_jit = {}
+        # the device-resident sharded cluster state (+ transfer stats)
+        self.resident = ResidentClusterState(mesh)
+        # reuse mode when the caller passes none: "auto" mirror-compares
+        # (the daemon), "carry" trusts the resident carry, "reship"
+        # re-places per wave (the r05-equivalent A/B baseline)
+        self.reuse_default = "auto"
         # per-wave device-dispatch tally (tests assert the grouped path
         # keeps this independent of the template count)
         self.dispatches: dict = {}
 
-    # -- sharded programs ----------------------------------------------------
+    # -- pjit programs (builders shared with analysis/programs) --------------
+
+    def _pjit_program(self, cache, key, body, arg_specs, out_specs,
+                      donate_carry=False):
+        """One compile-cache slot for every mesh program: shard_map(body)
+        wrapped pjit-shaped (jit with in/out shardings built from the
+        SAME PartitionSpecs the shard_map declares), the carry (argnum
+        1) donated when asked.  The four program families below differ
+        only in body/specs/donation — one builder keeps their wrapping
+        from drifting."""
+        run = cache.get(key)
+        if run is None:
+            run = jax.jit(
+                shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=arg_specs,
+                    out_specs=out_specs,
+                    check_vma=False,
+                ),
+                in_shardings=_ns_tree(self.mesh, arg_specs),
+                out_shardings=_ns_tree(self.mesh, out_specs),
+                donate_argnums=(1,) if donate_carry else (),
+            )
+            cache[key] = run
+        return run
+
+    def _probe_program(self, static, n, n_per_shard, num_zones,
+                       num_values, J, pod_layout):
+        # out spec P(None, AXIS): shard slices concatenate along the
+        # node axis into the same global packed array the single-chip
+        # probe ships
+        return self._pjit_program(
+            self._probe_jit,
+            ("probe", n, n_per_shard, num_zones, num_values, J,
+             pod_layout, tuple(sorted(static))),
+            functools.partial(_mesh_probe_fn, self.config, num_zones,
+                              num_values, J, n_per_shard, n, pod_layout),
+            (_static_specs(static), CARRY_SPECS, PSpec()),
+            PSpec(None, AXIS),
+        )
+
+    def _group_probe_program(self, static, n, n_per_shard, num_zones,
+                             num_values, G, pod_layout):
+        return self._pjit_program(
+            self._probe_jit,
+            ("gprobe", n, n_per_shard, num_zones, num_values, G,
+             pod_layout, tuple(sorted(static))),
+            functools.partial(_mesh_group_probe_fn, self.config,
+                              num_zones, num_values, G, n_per_shard, n,
+                              pod_layout),
+            (_static_specs(static), CARRY_SPECS, PSpec()),
+            PSpec(None, AXIS),
+        )
+
+    def _apply_program(self, static, n, n_per_shard, pod_layout,
+                       donate=None):
+        """The commit fold: with donation the carry input aliases the
+        output (resident buffers mutate in place — runtime_donation()
+        decides per backend); scatter-form counts ride replicated.
+        Different idx/cnt bucket sizes compile per shape under this one
+        wrapper (jit's shape cache keys them)."""
+        if donate is None:
+            donate = runtime_donation()
+        return self._pjit_program(
+            self._apply_jit,
+            ("apply", n, n_per_shard, pod_layout, donate,
+             tuple(sorted(static))),
+            functools.partial(_mesh_apply_fn, self.config, pod_layout,
+                              n),
+            (_static_specs(static), CARRY_SPECS, PSpec(), PSpec(),
+             PSpec()),
+            CARRY_SPECS,
+            donate_carry=donate,
+        )
+
+    def _apply_group_program(self, static, n, n_per_shard, pod_layout,
+                             donate=None):
+        if donate is None:
+            donate = runtime_donation()
+        return self._pjit_program(
+            self._apply_jit,
+            ("gapply", n, n_per_shard, pod_layout, donate,
+             tuple(sorted(static))),
+            functools.partial(_mesh_apply_group_fn, self.config,
+                              pod_layout, n),
+            (_static_specs(static), CARRY_SPECS, PSpec(), PSpec(),
+             PSpec()),
+            CARRY_SPECS,
+            donate_carry=donate,
+        )
+
+    # -- dispatch wrappers ---------------------------------------------------
+
+    def _place_replicated(self, buf):
+        """Commit a packed pod/group buffer once per run: both the
+        probe and the fold consume the SAME device copy (a host numpy
+        arg would re-upload at every dispatch), and the shipment is
+        counted once."""
+        dev = jax.device_put(
+            buf, NamedSharding(self.mesh, PSpec()))
+        self.resident.count_h2d(buf.nbytes)
+        return dev
 
     def _probe_run(self, static, carry, pod_layout, pod_buf, n,
                    n_per_shard, num_zones, num_values, J):
-        key = ("probe", n, n_per_shard, num_zones, num_values, J,
-               pod_layout)
-        run = self._probe_jit.get(key)
-        if run is None:
-            from kubernetes_tpu.parallel.compat import shard_map
-
-            body = functools.partial(
-                _mesh_probe_fn, self.config, num_zones, num_values, J,
-                n_per_shard, n, pod_layout,
-            )
-            run = jax.jit(shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(_static_specs(static), CARRY_SPECS, PSpec()),
-                # shard slices concatenate along the node axis into the
-                # same global packed array the single-chip probe ships
-                out_specs=PSpec(None, AXIS),
-                check_vma=False,
-            ))
-            self._probe_jit[key] = run
+        run = self._probe_program(static, n, n_per_shard, num_zones,
+                                  num_values, J, pod_layout)
         with self.mesh:
             return run(static, carry, pod_buf)
 
     def _apply_run(self, static, carry, pod_layout, pod_buf, counts, n,
                    n_per_shard):
-        key = ("apply", n, n_per_shard, pod_layout)
-        run = self._apply_jit.get(key)
-        if run is None:
-            from kubernetes_tpu.parallel.compat import shard_map
-
-            body = functools.partial(
-                _mesh_apply_fn, self.config, pod_layout
-            )
-            run = jax.jit(shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(_static_specs(static), CARRY_SPECS, PSpec(),
-                          PSpec()),
-                out_specs=CARRY_SPECS,
-                check_vma=False,
-            ))
-            self._apply_jit[key] = run
+        idx, cnt = _sparse_counts(counts)
+        run = self._apply_program(static, n, n_per_shard, pod_layout)
+        self.resident.count_h2d(idx.nbytes + cnt.nbytes)
         with self.mesh:
-            return run(static, carry, pod_buf, counts)
+            carry = run(static, carry, pod_buf, idx, cnt)
+        if runtime_donation():
+            # drain the donated fold before anything can re-donate its
+            # aliased buffers (the fold is the last dispatch of its
+            # run, so only fold-vs-host bookkeeping overlap is lost)
+            jax.block_until_ready(carry)
+        self.resident.set_carry(carry)
+        return carry
 
     def _group_probe_run(self, static, carry, pod_layout, group_buf, n,
                          n_per_shard, num_zones, num_values, G):
-        """-> (headers [G, N_STK_ROWS, N], usage i64[6, N]) — the
-        grouped header probe for G stacked runs, ONE sharded dispatch
-        and ONE device->host transfer."""
+        """-> headers i64[G, N_STK_ROWS, N] — the grouped header probe
+        for G stacked runs, ONE sharded dispatch and ONE device->host
+        transfer (the resource block no longer ships: the resident
+        host mirror supplies the replay's usage exactly)."""
         from kubernetes_tpu.models.probe import N_STK_ROWS
 
-        key = ("gprobe", n, n_per_shard, num_zones, num_values, G,
-               pod_layout)
-        run = self._probe_jit.get(key)
-        if run is None:
-            from kubernetes_tpu.parallel.compat import shard_map
-
-            body = functools.partial(
-                _mesh_group_probe_fn, self.config, num_zones,
-                num_values, G, n_per_shard, n, pod_layout,
-            )
-            run = jax.jit(shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(_static_specs(static), CARRY_SPECS, PSpec()),
-                out_specs=PSpec(None, AXIS),
-                check_vma=False,
-            ))
-            self._probe_jit[key] = run
+        run = self._group_probe_program(static, n, n_per_shard,
+                                        num_zones, num_values, G,
+                                        pod_layout)
         with self.mesh:
             raw = run(static, carry, group_buf)
         arr = np.ascontiguousarray(jax.device_get(raw))
-        headers = arr[: G * N_STK_ROWS].reshape(G, N_STK_ROWS, n)
-        return headers, arr[G * N_STK_ROWS:]
+        return arr.reshape(G, N_STK_ROWS, n)
 
     def _apply_group_run(self, static, carry, pod_layout, group_buf,
-                         counts, n, n_per_shard):
-        key = ("gapply", n, n_per_shard, pod_layout)
-        run = self._apply_jit.get(key)
-        if run is None:
-            from kubernetes_tpu.parallel.compat import shard_map
-
-            body = functools.partial(
-                _mesh_apply_group_fn, self.config, pod_layout
-            )
-            run = jax.jit(shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(_static_specs(static), CARRY_SPECS, PSpec(),
-                          PSpec()),
-                out_specs=CARRY_SPECS,
-                check_vma=False,
-            ))
-            self._apply_jit[key] = run
+                         counts_mat, G_bucket, n, n_per_shard):
+        cm = np.zeros((G_bucket, n), np.int64)
+        cm[: counts_mat.shape[0]] = counts_mat
+        idx, cnt = _sparse_group_counts(cm)
+        run = self._apply_group_program(static, n, n_per_shard,
+                                        pod_layout)
+        self.resident.count_h2d(idx.nbytes + cnt.nbytes)
         with self.mesh:
-            return run(static, carry, group_buf, counts)
+            carry = run(static, carry, group_buf, idx, cnt)
+        if runtime_donation():
+            # see _apply_run: donated folds drain before re-donation
+            jax.block_until_ready(carry)
+        self.resident.set_carry(carry)
+        return carry
 
     # -- backlog driver ------------------------------------------------------
 
@@ -1105,121 +1305,128 @@ class MeshWaveScheduler:
         batch: PodBatch,
         rep_idx: np.ndarray,
         last_node_index: int = 0,
+        reuse: Optional[str] = None,
     ):
         """Single-chip WaveScheduler.schedule_backlog semantics over the
         mesh: -> (chosen i32[P] node ids, final carry, lastNodeIndex).
-        snap must already be padded to a mesh multiple."""
+        snap must already be padded to a mesh multiple.  `reuse` governs
+        the resident state: "auto" mirror-compares against the snapshot
+        and ships only deltas; "carry" trusts the resident carry
+        outright (steady loops whose snapshot is the stale wave-0 view);
+        "reship" re-places everything (the r05-equivalent baseline kept
+        for A/B measurement)."""
         from kubernetes_tpu.models.probe import tables_from_packed
         from kubernetes_tpu.models.replay import ReplayResult
+        from kubernetes_tpu.models.pack import pack_arrays
         from kubernetes_tpu.models.wave import (
             _host_group_cap,
-            config_eligible,
+            _permute_tables,
+            classify_runs,
             gather_batch,
             group_buffer,
             host_group_replay,
-            run_eligible,
-            run_pure,
-            svc_run_context,
-            _permute_tables,
+            split_runs,
         )
         from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
 
+        if reuse is None:
+            reuse = self.reuse_default
         n_dev = self.mesh.devices.size
         snap = _pad_snapshot(snap, n_dev)
         N = len(snap.node_names)
         n_per_shard = N // n_dev
         P = len(rep_idx)
 
-        static = {
-            f: jnp.asarray(getattr(snap, f))
-            for f in BatchScheduler.STATIC_FIELDS
-        }
-        static.update(BatchScheduler.config_static(self.config, snap))
-        static["name_desc_order_global"] = static.pop("name_desc_order")
+        self.resident.begin_wave()
+        static, carry = self.resident.sync(
+            self.config, snap, last_node_index, reuse=reuse
+        )
         num_zones = max(int(snap.zone_id.max()) + 1, 1)
         num_values = int(snap.svc_num_values)
-        sched = BatchScheduler(self.config)
-        carry = sched.initial_carry(snap, last_node_index)
         zoned = bool(np.any(np.asarray(snap.zone_id) > 0))
-
         out = np.full(P, -1, np.int32)
         perm = np.asarray(snap.name_desc_order).astype(np.int64)
-        runs = []
-        i = 0
-        while i < P:
-            r = rep_idx[i]
-            s = i
-            while i < P and rep_idx[i] == r:
-                i += 1
-            runs.append((int(r), s, i - s))
-
+        runs = split_runs(rep_idx)
+        self.dispatches = {}
         pending: list = []
         L_host = int(last_node_index)
+        blocks = _opaque_blocks(self.config)
 
-        # the probe's static dict keeps the mesh's global-order key; the
-        # static used by the mesh scan flush is identical
+        def count(key):
+            self.dispatches[key] = self.dispatches.get(key, 0) + 1
+
         def flush(carry):
             nonlocal L_host
             if not pending:
                 return carry
             rows = np.asarray(pending, np.int64)
             seg = gather_batch(batch, rep_idx[rows])
-            seg = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
+            segp = pad_batch(seg, next_pow2(len(rows), self.pod_floor))
             pods = {
-                f: jnp.asarray(getattr(seg, f))
+                f: np.asarray(getattr(segp, f))
                 for f in BatchScheduler.POD_FIELDS
             }
-            self.dispatches["scan"] = self.dispatches.get("scan", 0) + 1
+            count("scan")
+            self.resident.count_h2d(
+                sum(v.nbytes for v in pods.values()))
             carry, chosen = self.scan._exec(
                 static, carry, pods, N, n_per_shard, num_zones,
-                num_values, seg.num_pods,
+                num_values, segp.num_pods,
             )
-            out[rows] = np.asarray(chosen)[: len(rows)]
+            self.resident.set_carry(carry)
+            chosen_host = np.asarray(chosen)[: len(rows)]
+            out[rows] = chosen_host
             L_host = int(jax.device_get(carry[BatchScheduler.LAST_IDX]))
+            # host-visible pure-channel commits keep the mirrors exact;
+            # the opaque feature blocks resync from the next snapshot
+            segf = {
+                f: np.asarray(getattr(seg, f))
+                for f in ("commit_mcpu", "commit_mem", "commit_gpu",
+                          "nz_mcpu", "nz_mem", "port_mask", "class_id")
+            }
+            self.resident.note_scan(
+                [{k: v[i] for k, v in segf.items()}
+                 for i in range(len(rows))],
+                chosen_host,
+            )
+            # invalidate only the blocks these pods can actually have
+            # folded on device: a featureless scan wave (the daemon's
+            # small mixed waves) must not force a next-wave resync
+            inv = []
+            if "ip" in blocks and any(
+                np.asarray(getattr(seg, f)).size
+                and np.asarray(getattr(seg, f)).any()
+                for f in ("ip_match_spec", "ip_own_hard", "ip_own_pref",
+                          "ip_own_anti_hard", "ip_own_anti_pref")
+            ):
+                inv.append("ip")
+            if "vol" in blocks and any(
+                np.asarray(getattr(seg, f)).any()
+                for f in ("vp_vol_rw", "vp_vol_ro", "vp_ebs", "vp_gce")
+            ):
+                inv.append("vol")
+            if "svc" in blocks and np.asarray(seg.svc_member).any():
+                inv.append("svc")
+            if inv:
+                self.resident.invalidate(*inv)
             pending.clear()
             return carry
 
-        from kubernetes_tpu.models.pack import pack_arrays
-        from kubernetes_tpu.snapshot.encode import service_config_labels
-
-        self.dispatches = {}
-
-        def count(key):
-            self.dispatches[key] = self.dispatches.get(key, 0) + 1
-
-        config_ok = config_eligible(self.config)
-        svc_free = not service_config_labels(self.config)
-        infos = []
-        for rep, start, length in runs:
-            eligible, veto = (False, None)
-            if length >= self.min_run:
-                eligible, veto = run_eligible(
-                    self.config, batch, rep, snap, config_ok=config_ok,
-                )
-            svc_ctx = svc_run_context(
-                self.config, snap, batch, rep, num_values
-            ) if eligible else None
-            pure = bool(
-                eligible and veto is None and svc_ctx is None
-                and run_pure(self.config, batch, rep, svc_free=svc_free)
-            )
-            infos.append({
-                "rep": rep, "start": start, "length": length,
-                "eligible": eligible, "veto": veto, "svc_ctx": svc_ctx,
-                "pure": pure,
-            })
+        infos = classify_runs(
+            self.config, snap, batch, runs, num_values, self.min_run,
+            device_zoned=False, zoned=zoned,
+        )
 
         def run_single(carry, info, done0=0):
             nonlocal L_host
             rep, start, length = (info["rep"], info["start"],
                                   info["length"])
-            self_anti_veto = info["veto"]
-            svc_ctx = info["svc_ctx"]
-            pod_layout, pod_buf = pack_arrays({
+            pod_host = {
                 f: np.asarray(getattr(batch, f)[rep])
                 for f in BatchScheduler.POD_FIELDS
-            })
-            pod_buf = jnp.asarray(pod_buf)
+            }
+            pod_layout, pod_buf = pack_arrays(pod_host)
+            pod_buf = self._place_replicated(pod_buf)
             done = done0
             while done < length:
                 K = length - done
@@ -1234,8 +1441,8 @@ class MeshWaveScheduler:
                     self.config, arr, num_zones, J, rows_n,
                     has_selectors=bool(batch.has_selectors[rep]),
                     zone_id=np.asarray(snap.zone_id) if zoned else None,
-                    self_anti_veto=self_anti_veto,
-                    svc_ctx=svc_ctx,
+                    self_anti_veto=info["veto"],
+                    svc_ctx=info["svc_ctx"],
                 )
                 if tables.sa_bail:
                     # ServiceAffinity dynamics the tables can't express
@@ -1256,29 +1463,35 @@ class MeshWaveScheduler:
                 counts[perm] = res.counts
                 count("apply")
                 carry = self._apply_run(
-                    static, carry, pod_layout, pod_buf,
-                    jnp.asarray(counts), N, n_per_shard,
+                    static, carry, pod_layout, pod_buf, counts, N,
+                    n_per_shard,
                 )
+                self.resident.note_commit(pod_host, counts)
+                if blocks and not info["pure"]:
+                    # impure-but-eligible runs fold ip/svc tables on
+                    # device; those mirrors go opaque until resynced
+                    self.resident.invalidate(*blocks)
                 L_host = res.last_node_index
                 done += res.n_done
             return carry
 
         def run_group(carry, group):
             """K pure runs through ONE sharded header probe + ONE
-            sharded grouped fold; the host replay (shared with the
+            donated grouped fold; the host replay (shared with the
             single-chip driver) rebuilds each run's j-axis against the
-            accumulating usage and replays in FIFO order."""
+            resident usage mirror and replays in FIFO order."""
             nonlocal L_host
             G = len(group)
             G_bucket, glayout, gbuf = group_buffer(
-                batch, [g["rep"] for g in group]
+                batch, [g["rep"] for g in group], floor=1
             )
-            gbuf = jnp.asarray(gbuf)
+            gbuf = self._place_replicated(gbuf)
             count("group_probe")
-            headers, usage = self._group_probe_run(
+            headers = self._group_probe_run(
                 static, carry, glayout, gbuf, N, n_per_shard,
                 num_zones, num_values, G_bucket,
             )
+            usage = self.resident.usage()
             counts_mat, n_full, partial_done, L_host = host_group_replay(
                 self.config, snap, batch,
                 [(g["rep"], g["start"], g["length"]) for g in group],
@@ -1286,13 +1499,21 @@ class MeshWaveScheduler:
                 zoned, self.max_j, num_zones,
             )
             if counts_mat.any():
-                cm = np.zeros((G_bucket, N), np.int64)
-                cm[:G] = counts_mat
                 count("apply")
                 carry = self._apply_group_run(
-                    static, carry, glayout, gbuf, jnp.asarray(cm), N,
-                    n_per_shard,
+                    static, carry, glayout, gbuf, counts_mat, G_bucket,
+                    N, n_per_shard,
                 )
+                for g, info_g in enumerate(group):
+                    if counts_mat[g].any():
+                        pod_host = {
+                            f: np.asarray(getattr(batch, f)[info_g["rep"]])
+                            for f in ("commit_mcpu", "commit_mem",
+                                      "commit_gpu", "nz_mcpu", "nz_mem",
+                                      "port_mask", "class_id")
+                        }
+                        self.resident.note_commit(pod_host,
+                                                  counts_mat[g])
             if n_full == G:
                 return carry, G, None
             return carry, n_full, (n_full, partial_done)
@@ -1313,7 +1534,14 @@ class MeshWaveScheduler:
                    and len(group) < host_cap and infos[jdx]["pure"]):
                 group.append(infos[jdx])
                 jdx += 1
-            if len(group) >= 2:
+            # resident modes route even SINGLETON pure runs through the
+            # header-only probe: the exact host usage mirror rebuilds
+            # the j-table (models/hosttab), so the full [J, N] probe —
+            # its on-device j-axis compute AND its O(J*N) device->host
+            # shipment — drops out of the steady-state wave entirely.
+            # The r05 dispatch shape (full probe per singleton run) is
+            # kept under reuse="reship" as the A/B baseline.
+            if len(group) >= 2 or (info["pure"] and reuse != "reship"):
                 carry, consumed, partial = run_group(carry, group)
                 if partial is not None:
                     g_idx, done = partial
@@ -1325,6 +1553,7 @@ class MeshWaveScheduler:
             carry = run_single(carry, info)
             idx += 1
         carry = flush(carry)
+        self.resident.finish_wave(carry, L_host)
         return out, carry, L_host
 
     def _pick_j(self, snap: ClusterSnapshot, batch: PodBatch, rep: int,

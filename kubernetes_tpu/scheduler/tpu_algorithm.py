@@ -52,24 +52,29 @@ class TPUScheduleAlgorithm:
                 mesh, config=config, min_run=min_run
             )
             self._sched = self._mesh_sched.scan
+            algo_config = self._mesh_sched.config
         else:
             from kubernetes_tpu.models.wave import WaveScheduler
 
             self._wave = WaveScheduler(config=config, min_run=min_run,
                                        replay=replay)
             self._sched = self._wave.scan
-            if cache is not None:
-                # daemon mode: maintain the snapshot incrementally from
-                # cache deltas instead of re-encoding the cluster per wave
-                from kubernetes_tpu.snapshot.incremental import (
-                    IncrementalEncoder,
-                )
+            algo_config = self._wave.config
+        if cache is not None:
+            # daemon mode: maintain the snapshot incrementally from
+            # cache deltas instead of re-encoding the cluster per wave
+            # (both drivers: the mesh resident state additionally
+            # content-compares the view against its host mirrors, so an
+            # unchanged incremental view ships zero node-table bytes)
+            from kubernetes_tpu.snapshot.incremental import (
+                IncrementalEncoder,
+            )
 
-                self._inc = IncrementalEncoder(config=self._wave.config)
-                cache.add_listener(self._inc.on_cache_event)
-                self._service_lister = service_lister
-                self._controller_lister = controller_lister
-                self._replica_set_lister = replica_set_lister
+            self._inc = IncrementalEncoder(config=algo_config)
+            cache.add_listener(self._inc.on_cache_event)
+            self._service_lister = service_lister
+            self._controller_lister = controller_lister
+            self._replica_set_lister = replica_set_lister
         # selectHost's round-robin counter persists across waves, like the
         # reference's genericScheduler.lastNodeIndex persists across pods
         self._last_node_index = 0
@@ -252,14 +257,28 @@ class TPUScheduleAlgorithm:
                 for t in range(2) for i in range(n)
             ]
         with self._sched_lock:
-            saved_last = self._last_node_index
+            saved_last, saved_inc = self._last_node_index, self._inc
             try:
+                if saved_inc is not None:
+                    # daemon mode: warm through a throwaway incremental
+                    # encoder fed the synthetic cluster (same seam as
+                    # _warm_one) so the REAL view is never consulted
+                    from kubernetes_tpu.snapshot.incremental import (
+                        IncrementalEncoder,
+                    )
+
+                    inc = IncrementalEncoder(
+                        config=self._mesh_sched.config)
+                    for n in nodes:
+                        inc.on_cache_event("node_set", n)
+                    self._inc = inc
                 self._schedule_backlog_mesh(backlog, state)
                 if grouped is not None:
                     self._schedule_backlog_mesh(grouped, state)
             except Exception:
                 log.debug("mesh warmup failed", exc_info=True)
             finally:
+                self._inc = saved_inc
                 self._last_node_index = saved_last
 
     def _warm_one(self, backlog, state, nodes) -> None:
@@ -359,20 +378,35 @@ class TPUScheduleAlgorithm:
         self, pods: Sequence[Pod], state: ClusterState
     ) -> List[Optional[str]]:
         """Mesh daemon path: the sharded WAVE driver (probe tables per
-        shard, host replay, per-shard commit fold) with the sharded scan
-        as the in-carry fallback — the multi-chip selection is no longer
-        scan-only (VERDICT r4 §2.3)."""
+        shard, host replay, per-shard donated commit fold) against the
+        DEVICE-RESIDENT sharded cluster state, with the sharded scan as
+        the in-carry fallback.  With a cache the incremental encoder
+        supplies the per-wave view; either way the resident state
+        content-compares the snapshot against its host mirrors and ships
+        only deltas — steady-state waves upload O(pending pods)."""
         from kubernetes_tpu.parallel.mesh import _pad_snapshot
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
         from kubernetes_tpu.snapshot.pad import next_pow2
 
         with trace_profile.phase_timer("encode"):
             reps, rep_idx = self._dedup(pods)
-            enc = SnapshotEncoder(
-                state, reps, config=self._mesh_sched.config
-            )
-            snap = enc.encode_nodes()
-            batch = enc.encode_pods()
+            snap = batch = None
+            if self._inc is not None:
+                def ls(l):
+                    return l.list() if l is not None else ()
+
+                snap, batch, _keep = self._inc.wave_view(
+                    reps,
+                    services=ls(self._service_lister),
+                    controllers=ls(self._controller_lister),
+                    replica_sets=ls(self._replica_set_lister),
+                )
+            if snap is None:
+                enc = SnapshotEncoder(
+                    state, reps, config=self._mesh_sched.config
+                )
+                snap = enc.encode_nodes()
+                batch = enc.encode_pods()
             n_real = snap.num_nodes
             if n_real == 0:
                 return [None] * len(pods)

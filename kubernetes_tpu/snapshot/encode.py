@@ -201,6 +201,13 @@ def build_set_table(set_members, kv_ids, lw: int) -> np.ndarray:
     return out
 
 
+#: snapshot fields that seed the scheduler carry's stacked resource
+#: block, in initial_carry row order (models/batch stacks them; the
+#: mesh resident state mirrors them host-side across waves)
+RES_CARRY_FIELDS = ("req_mcpu", "req_mem", "req_gpu", "nz_mcpu",
+                    "nz_mem", "pod_count")
+
+
 @dataclass
 class ClusterSnapshot:
     """Node-axis arrays + vocabulary tables (numpy, host-resident; the

@@ -50,7 +50,52 @@ def test_registry_covers_the_wave_programs():
     # forces 8 CPU devices, so here they must be present)
     if len(jax.devices()) >= 2:
         assert {"mesh_scan", "mesh_probe", "mesh_group_probe",
-                "mesh_apply", "mesh_apply_group"} <= names
+                "mesh_apply", "mesh_apply_group",
+                "resident_scatter"} <= names
+
+
+def test_donation_contract_is_audited():
+    """Every registered resident-state program declares donation and
+    passes the aliasing audit; the donated folds cover the carry."""
+    specs = {s.name: s for s in registered_programs()}
+    if "mesh_apply" not in specs:
+        import pytest
+
+        pytest.skip("no mesh on this host")
+    donated = [n for n, s in specs.items() if s.donate_argnums]
+    assert {"mesh_apply", "mesh_apply_group",
+            "resident_scatter"} <= set(donated)
+    for n in donated:
+        assert not jaxpr_audit._donation_findings(specs[n]), n
+
+
+def test_seeded_broken_donation_is_flagged():
+    """A donated input the program cannot alias (shape/dtype drift —
+    XLA would silently copy it) must trip the donation audit."""
+    def drops_donated(a, b):
+        return b[:2] * 2  # output shape matches neither donated leaf
+
+    fn = jax.jit(drops_donated, donate_argnums=(0,))
+    spec = ProgramSpec(
+        name="seeded_drop", fn=fn,
+        args=(jnp.zeros(7, jnp.float32), jnp.zeros(5, jnp.float32)),
+        carry_out_leaves=1, expected_host_leaves=None,
+        donate_argnums=(0,),
+    )
+    found = jaxpr_audit._donation_findings(spec)
+    assert any(f.rule in ("donation-contract", "donation-unusable")
+               for f in found), found
+
+    def keeps_donated(a, b):
+        return a + b.sum()
+
+    good = ProgramSpec(
+        name="seeded_keep", fn=jax.jit(keeps_donated, donate_argnums=(0,)),
+        args=(jnp.zeros(7, jnp.float32), jnp.zeros(5, jnp.float32)),
+        carry_out_leaves=1, expected_host_leaves=None,
+        donate_argnums=(0,),
+    )
+    assert not jaxpr_audit._donation_findings(good)
 
 
 def test_grouped_wave_transfer_contract_is_static():
