@@ -402,6 +402,23 @@ def run_soak(seconds: int):
 
 
 def main():
+    # Perf runs measure the PRODUCT, not the sanitizers: the race
+    # detector instruments every tracked attribute access and the lock
+    # sanitizer wraps every package lock — either armed here would
+    # silently deflate the headline. Hard-fail instead of warn.
+    # explicit raise, not assert: `python -O` strips asserts and would
+    # silently publish an instrumented headline
+    for _var in ("KUBERNETES_TPU_RACE_SANITIZER",
+                 "KUBERNETES_TPU_LOCK_SANITIZER"):
+        if os.environ.get(_var):
+            raise SystemExit(
+                f"{_var} is set: sanitizers must be OFF in perf runs "
+                "(arm them in the separate witness CI invocation instead)")
+    from kubernetes_tpu.analysis import races as _races
+
+    if _races._armed:
+        raise SystemExit(
+            "race sanitizer armed in-process: perf numbers would be bogus")
     # Self-provision the C engines (cached by mtime): without them the
     # wave fast path degrades ~10x to the Python spec replay and the
     # wire rides the slow codec — the number stops containing the work.

@@ -1,31 +1,48 @@
 """Static analysis & sanitizer suite — machine-checked contracts for
 the tensor-program scheduler.
 
-Three passes, runnable standalone (``python -m kubernetes_tpu.analysis``)
-and as tier-1 tests (tests/test_analysis.py):
+Four pass families, runnable standalone
+(``python -m kubernetes_tpu.analysis``, ``--json`` for the
+machine-readable CI artifact) and as tier-1 tests
+(tests/test_analysis.py):
 
   1. **Jaxpr auditor** (jaxpr_audit / programs): traces every registered
      device program (scan, probe, group probe, apply / group apply,
-     zreplay run / run_group, the mesh shard_map variants) at
-     representative padded shapes and walks the jaxprs to enforce
-     contracts a TPU deployment needs — no primitives lacking TPU
-     lowerings (the s64 ``dot_general`` class that broke PR 3), no host
-     callbacks or dynamic shapes in hot programs, no unintended float64
-     upcasts, and a statically counted device-transfer budget per wave
-     (grouped probe ships exactly ONE host-bound array regardless of the
-     template count; the apply fold ships zero).
+     zreplay run / run_group, the mesh shard_map variants, the resident
+     row scatter) at representative padded shapes and walks the jaxprs
+     to enforce contracts a TPU deployment needs — no primitives lacking
+     TPU lowerings (the s64 ``dot_general`` class that broke PR 3), no
+     host callbacks or dynamic shapes in hot programs, no unintended
+     float64 upcasts, a statically counted device-transfer budget per
+     wave, the donation/aliasing contract on resident-state programs,
+     the **sharding-drift audit** (the in/out shardings each pjit
+     program carries must equal the PartitionSpecs
+     ``parallel.resident.carry_specs()``/``static_specs()`` declare),
+     and the **scatter contract** (commit folds may contain only their
+     registry-declared commutative scatter forms; an overwrite scatter
+     must assert unique indices).
 
   2. **AST lint** (lint): custom rules over the whole package — host
      syncs and impurity inside traced scopes of the hot packages, bare
      ``except:``, mutable default args, non-daemon threads without
-     joins, metrics constructed outside the registry module — with a
-     ``# lint: allow[rule]`` suppression syntax.
+     joins, metrics constructed outside the registry module — plus the
+     static concurrency rules: ``# guarded-by: self._lock`` annotated
+     fields written without the named lock held, and unguarded writes
+     to fields of thread-escaping classes. Suppression:
+     ``# lint: allow[rule]``.
 
   3. **Runtime sanitizers** (locks / compile_guard): an instrumented
      lock wrapper recording the cross-thread acquisition-order graph
      with cycle detection (armed under the chaos tests), and a
      jax.monitoring compile-event sentinel that fails when a
      steady-state wave triggers recompilation.
+
+  4. **Data-race detector** (races): Eraser-style locksets + per-thread
+     vector-clock happens-before over ``track``-ed shared objects,
+     armed per-test (``races.instrumented()``) or suite-wide
+     (``KUBERNETES_TPU_RACE_SANITIZER=1``); findings dump as a JSONL
+     artifact the CLI merges via ``--race-report``. Suppression:
+     ``# race: allow[reason]`` at an access site.
 
 Each pass emits ``Finding`` rows; the CLI exits non-zero when any
 unsuppressed finding survives, which is the CI gate.
@@ -41,7 +58,7 @@ from typing import List, Optional
 class Finding:
     """One violation (or suppressed would-be violation) from any pass."""
 
-    pass_name: str  # "jaxpr" | "lint" | "locks"
+    pass_name: str  # "jaxpr" | "lint" | "locks" | "races"
     rule: str  # stable rule id, the token a suppression names
     where: str  # "module.py:123" or a program name
     message: str

@@ -2,9 +2,11 @@
 
 Runs the static passes (AST lint + jaxpr audit) over the installed tree
 and exits non-zero when any unsuppressed finding survives — the CI
-gate. The runtime sanitizers (lock-order graph, compile sentinel) arm
-under the chaos/SLO tests instead; see tests/test_chaos.py and
-tests/test_slo.py.
+gate. The runtime sanitizers (lock-order graph, compile sentinel, data
+races) arm under the test suites instead; an armed run's race findings
+land in a JSONL artifact (``races.dump_jsonl`` /
+``KUBERNETES_TPU_RACE_REPORT``) that ``--race-report`` merges back into
+this gate so one invocation carries the whole verdict.
 
 Flags:
     --lint-only     skip the jaxpr audit (no program tracing; jax is
@@ -13,23 +15,85 @@ Flags:
     --no-mesh       audit single-chip programs only (without it, an
                     unformable mesh is a `mesh-unavailable` finding,
                     never a silent coverage shrink)
+    --json          machine-readable report: one JSON object per
+                    finding on stdout (fields: pass, rule, where,
+                    message, suppressed) — lint, jaxpr audit, and
+                    merged race-witness rows uniformly; the CI
+                    artifact-upload format
+    --race-report PATH
+                    merge a race-witness JSONL artifact (written by an
+                    armed suite run) into the report; its unsuppressed
+                    rows fail the gate like any other finding.
+                    Repeatable.
 """
 
 from __future__ import annotations
 
+import json
 import sys
+
+from kubernetes_tpu.analysis import Finding
+
+
+def _load_race_report(path: str):
+    """JSONL rows (races.dump_jsonl format) -> Findings. A row that
+    does not parse is itself a finding: a corrupt artifact must never
+    silently pass the gate."""
+    findings = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+                findings.append(Finding(
+                    row["pass"], row["rule"], row["where"],
+                    row["message"], suppressed=bool(row["suppressed"]),
+                ))
+            except (ValueError, KeyError, TypeError) as e:
+                findings.append(Finding(
+                    "races", "malformed-report", f"{path}:{lineno}",
+                    f"unparseable race-report row: {e!r}",
+                ))
+    return findings
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     from kubernetes_tpu.analysis import render_report, run_static_passes
 
+    race_reports = []
+    while "--race-report" in argv:
+        i = argv.index("--race-report")
+        if i + 1 >= len(argv):
+            print("--race-report needs a PATH", file=sys.stderr)
+            return 2
+        race_reports.append(argv[i + 1])
+        del argv[i:i + 2]
+
     findings = run_static_passes(
         include_jaxpr="--lint-only" not in argv,
         include_lint="--jaxpr-only" not in argv,
         include_mesh="--no-mesh" not in argv,
     )
-    print(render_report(findings, "kubernetes_tpu static analysis:"))
+    for path in race_reports:
+        try:
+            findings.extend(_load_race_report(path))
+        except OSError as e:
+            findings.append(Finding(
+                "races", "malformed-report", path,
+                f"race report unreadable: {e!r}",
+            ))
+
+    if "--json" in argv:
+        for f in findings:
+            print(json.dumps({
+                "pass": f.pass_name, "rule": f.rule, "where": f.where,
+                "message": f.message, "suppressed": f.suppressed,
+            }))
+    else:
+        print(render_report(findings, "kubernetes_tpu static analysis:"))
     return 1 if any(not f.suppressed for f in findings) else 0
 
 

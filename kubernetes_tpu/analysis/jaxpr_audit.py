@@ -38,6 +38,20 @@ enforcing:
   warning.  A donated carry XLA silently copies would re-allocate
   O(nodes) buffers per wave — that is a CI failure here, not a perf
   mystery in production.
+* ``sharding-drift`` — for every registered pjit program that declares
+  ``arg_shardings``/``out_shardings_decl`` (built from
+  ``parallel.resident.carry_specs()``/``static_specs()``, the single
+  source the placement shares), the in/out shardings the driver's jit
+  wrapper actually carries must match leaf-for-leaf.  A program whose
+  carry drifts to a different PartitionSpec than the resident
+  placement would silently reshard O(nodes) buffers on EVERY dispatch.
+* ``scatter-contract`` — the scatter-form commit programs (PR 6's
+  O(picks) shipment) are correct only because their updates commute:
+  the registry declares the exact (primitive, scatter dims) forms each
+  may contain, and any other scatter — in particular a plain
+  overwrite ``scatter`` without ``unique_indices`` — is a finding.
+  Collision-freedom is the host's job (deduped indices); this keeps
+  the device side order-independent so that contract is sufficient.
 """
 
 from __future__ import annotations
@@ -88,6 +102,13 @@ ALLOWED_F64_SOURCES = (
     "kubernetes_tpu/ops/priorities.py",
     "kubernetes_tpu/ops/interpod.py",
 )
+
+
+#: scatter primitives whose update function commutes (order-independent
+#: under colliding indices); plain `scatter` (overwrite) is NOT here —
+#: it is only safe with unique indices
+COMMUTATIVE_SCATTER = {"scatter-add", "scatter-mul", "scatter-min",
+                       "scatter-max"}
 
 
 def _f64_provenance_ok(eqn) -> bool:
@@ -259,6 +280,159 @@ def _donation_findings(spec: ProgramSpec) -> List[Finding]:
     return findings
 
 
+def _normspec(spec) -> tuple:
+    """PartitionSpec -> canonical tuple (trailing Nones stripped, so
+    P('nodes') == P('nodes', None) the way placement treats them)."""
+    t = tuple(spec)
+    while t and t[-1] is None:
+        t = t[:-1]
+    return t
+
+
+def _flatten_decl(decl) -> List[Any]:
+    """Flatten a declared sharding pytree with PartitionSpec leaves in
+    the same order jax flattens the matching argument."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    return jax.tree_util.tree_leaves(
+        decl, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec)
+    )
+
+
+def _pjit_eqn(jaxpr):
+    """The top-level pjit equation carrying concrete shardings."""
+    from jax.sharding import NamedSharding
+
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name == "pjit":
+            shardings = eqn.params.get("in_shardings", ())
+            if any(isinstance(s, NamedSharding) for s in shardings):
+                return eqn
+    return None
+
+
+def _sharding_findings(spec: ProgramSpec, jaxpr) -> List[Finding]:
+    """The sharding-spec drift audit: the in/out shardings the driver's
+    jit wrapper carries must equal the PartitionSpecs the resident
+    placement declares (resident.carry_specs()/static_specs())."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if spec.arg_shardings is None and spec.out_shardings_decl is None:
+        return []
+    findings: List[Finding] = []
+    eqn = _pjit_eqn(jaxpr)
+    if eqn is None:
+        return [Finding(
+            "jaxpr", "sharding-drift", spec.name,
+            "program declares expected shardings but traces to no pjit "
+            "equation with concrete shardings — the driver stopped "
+            "declaring in_shardings/out_shardings",
+        )]
+
+    def compare(kind, actual, expected_flat, label_of):
+        if len(actual) != len(expected_flat):
+            findings.append(Finding(
+                "jaxpr", "sharding-drift", spec.name,
+                f"{kind}: {len(actual)} sharded leaf(s) in the traced "
+                f"program, declaration covers {len(expected_flat)} — "
+                "the registry declaration drifted from the driver",
+            ))
+            return
+        for i, (act, exp) in enumerate(zip(actual, expected_flat)):
+            if exp is None:
+                continue  # leaf explicitly unaudited
+            if not isinstance(act, NamedSharding):
+                findings.append(Finding(
+                    "jaxpr", "sharding-drift", spec.name,
+                    f"{kind} leaf {i} ({label_of(i)}): expected "
+                    f"PartitionSpec{tuple(exp)} but the program leaves "
+                    "the sharding unspecified — pjit would choose its "
+                    "own and reshard the resident buffer per dispatch",
+                ))
+            elif _normspec(act.spec) != _normspec(exp):
+                findings.append(Finding(
+                    "jaxpr", "sharding-drift", spec.name,
+                    f"{kind} leaf {i} ({label_of(i)}): program uses "
+                    f"PartitionSpec{tuple(act.spec)}, resident declares "
+                    f"PartitionSpec{tuple(exp)} — an O(nodes) reshard "
+                    "rides every dispatch until these agree",
+                ))
+
+    if spec.arg_shardings is not None:
+        expected: List[Any] = []
+        labels: List[str] = []
+        for argnum, decl in enumerate(spec.arg_shardings):
+            n_leaves = len(jax.tree_util.tree_leaves(spec.args[argnum]))
+            if decl is None:
+                expected.extend([None] * n_leaves)
+                labels.extend(
+                    [f"arg{argnum}[{j}]" for j in range(n_leaves)])
+                continue
+            flat = _flatten_decl(decl)
+            if len(flat) != n_leaves:
+                findings.append(Finding(
+                    "jaxpr", "sharding-drift", spec.name,
+                    f"arg {argnum}: declaration has {len(flat)} spec "
+                    f"leaf(s) for {n_leaves} array leaf(s) — a field "
+                    "was added/removed without updating the declared "
+                    "PartitionSpecs",
+                ))
+                expected.extend([None] * n_leaves)
+            else:
+                expected.extend(flat)
+            labels.extend([f"arg{argnum}[{j}]" for j in range(n_leaves)])
+        compare("in_shardings", tuple(eqn.params["in_shardings"]),
+                expected, lambda i: labels[i])
+    if spec.out_shardings_decl is not None:
+        flat_out = _flatten_decl(spec.out_shardings_decl)
+        compare("out_shardings", tuple(eqn.params["out_shardings"]),
+                flat_out, lambda i: f"out[{i}]")
+    return findings
+
+
+def _scatter_findings(spec: ProgramSpec, jaxpr) -> List[Finding]:
+    """The commit-fold commutativity contract: every scatter-family
+    equation must be one of the registry-declared (primitive, dims)
+    forms, and non-commutative forms must assert unique indices."""
+    if spec.scatter_allowed is None:
+        return []
+    allowed = {(p, tuple(d)) for p, d in spec.scatter_allowed}
+    findings: List[Finding] = []
+    seen: set = set()
+    for eqn in iter_eqns(jaxpr.jaxpr):
+        prim = eqn.primitive.name
+        if not prim.startswith("scatter"):
+            continue
+        dn = eqn.params.get("dimension_numbers")
+        dims = tuple(dn.scatter_dims_to_operand_dims) \
+            if dn is not None else ()
+        key = (prim, dims)
+        if key in seen:
+            continue
+        seen.add(key)
+        if key not in allowed:
+            findings.append(Finding(
+                "jaxpr", "scatter-contract", spec.name,
+                f"{prim} on operand dims {dims} is not in this "
+                f"program's declared scatter forms {sorted(allowed)} — "
+                "a new scatter crept into a commit fold; prove it "
+                "commutative/collision-free and add it to the registry "
+                "declaration",
+            ))
+        elif prim not in COMMUTATIVE_SCATTER \
+                and not eqn.params.get("unique_indices"):
+            findings.append(Finding(
+                "jaxpr", "scatter-contract", spec.name,
+                f"overwrite {prim} on dims {dims} without "
+                "unique_indices: colliding indices make the result "
+                "order-dependent — the serial-oracle equivalence the "
+                "scatter-form commits rely on breaks",
+            ))
+    return findings
+
+
 def audit_program(spec: ProgramSpec) -> List[Finding]:
     import jax
 
@@ -266,6 +440,8 @@ def audit_program(spec: ProgramSpec) -> List[Finding]:
     findings = audit_jaxpr(spec.name, jaxpr, allow_f64=spec.allow_f64)
     findings.extend(_transfer_findings(spec))
     findings.extend(_donation_findings(spec))
+    findings.extend(_sharding_findings(spec, jaxpr))
+    findings.extend(_scatter_findings(spec, jaxpr))
     return findings
 
 

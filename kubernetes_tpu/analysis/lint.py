@@ -27,6 +27,29 @@ Two rule families:
                             outside metrics/metrics.py bypass the
                             duplicate-name registry
 
+* **Concurrency rules** (the static companion of analysis/races):
+
+    - ``guarded-by``        a field annotated ``# guarded-by: self._lock``
+                            at its ``__init__`` assignment must only be
+                            written (attribute rebinds, ``self.x[k] = v``
+                            subscript stores, known container-mutator
+                            calls, ``heapq.heappush`` on it) inside a
+                            ``with <that lock>:`` scope. A
+                            ``threading.Condition(self._lock)`` aliases
+                            its lock (either guard satisfies the other);
+                            a method carrying the annotation on its
+                            ``def`` line declares the guard held on
+                            entry (the caller's contract), and methods
+                            named ``*_locked`` are exempt by the repo's
+                            naming convention.
+    - ``unguarded-shared-write``  in a class that escapes to a thread
+                            (``Thread(target=...)`` / executor
+                            ``submit`` in its methods), a field written
+                            both inside and outside ``with``-lock scopes
+                            is inconsistently guarded — the classic
+                            static lockset signal; every unlocked write
+                            site is a finding.
+
 Suppression: append ``# lint: allow[rule]`` (comma-separate several
 rule ids) on the offending line or the line directly above it.
 Suppressed findings still appear in the report, marked, so allowance
@@ -72,6 +95,17 @@ _TRACE_LAX = {"scan", "while_loop", "cond", "fori_loop", "map",
               "associative_scan", "switch"}
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+
+#: container-mutator method names that count as WRITES to the receiver
+#: field for the guarded-by / thread-escape checks
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse",
+}
+#: function-form mutators: fn(self.field, ...) mutates arg 0
+_MUTATOR_FUNCS = {"heappush", "heappop", "heapify", "heapreplace"}
 
 _METRIC_CLASSES = {"Counter", "Gauge", "GaugeVec", "Histogram",
                    "HistogramVec"}
@@ -106,12 +140,18 @@ class _Module:
         self.lines = text.splitlines()
         # line -> set of allowed rule ids (same line or one above)
         self.allow: Dict[int, Set[str]] = {}
+        # line -> guard name declared by a `# guarded-by: self._lock`
+        # trailing comment (looked up at the line or the line above)
+        self.guard_at: Dict[int, str] = {}
         for i, line in enumerate(self.lines, start=1):
             m = _SUPPRESS_RE.search(line)
             if m:
                 rules = {r.strip() for r in m.group(1).split(",")}
                 self.allow.setdefault(i, set()).update(rules)
                 self.allow.setdefault(i + 1, set()).update(rules)
+            g = _GUARDED_RE.search(line)
+            if g:
+                self.guard_at[i] = g.group(1)
         # import resolution
         self.mod_alias: Dict[str, str] = {}  # local name -> module path
         self.from_funcs: Dict[str, Tuple[str, str]] = {}  # name -> (mod, fn)
@@ -430,6 +470,205 @@ def _check_module_wide(mod: _Module, findings: List[Finding]) -> None:
                         "exposition)")
 
 
+# -- concurrency rules: guarded-by + thread-escape ----------------------------
+
+
+def _self_field(node: ast.AST) -> Optional[str]:
+    """'x' when `node` is the attribute `self.x`."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _write_fields(node: ast.AST) -> List[str]:
+    """Fields of ``self`` this single node writes: attribute rebinds,
+    subscript stores (``self.x[k] = v``), deletes, container-mutator
+    method calls, and heapq function-form mutators."""
+    out: List[str] = []
+
+    def tgt(t: ast.AST) -> None:
+        f = _self_field(t)
+        if f is not None:
+            out.append(f)
+            return
+        if isinstance(t, ast.Subscript):
+            f = _self_field(t.value)
+            if f is not None:
+                out.append(f)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                tgt(e)
+        elif isinstance(t, ast.Starred):
+            tgt(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            tgt(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        tgt(node.target)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            tgt(t)
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATOR_METHODS:
+            f = _self_field(fn.value)
+            if f is not None:
+                out.append(f)
+        else:
+            d = _dotted(fn) or ""
+            if d.split(".")[-1] in _MUTATOR_FUNCS and node.args:
+                f = _self_field(node.args[0])
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+class _GuardSets:
+    """Union-find over guard names so a Condition constructed over a
+    lock (`self._cond = threading.Condition(self._lock)`) satisfies
+    the lock's annotation and vice versa."""
+
+    def __init__(self):
+        self._parent: Dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        p = self._parent.get(x, x)
+        if p == x:
+            return x
+        root = self.find(p)
+        self._parent[x] = root
+        return root
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self._parent[ra] = rb
+
+
+def _check_class_concurrency(mod: _Module, cls: ast.ClassDef,
+                             findings: List[Finding]) -> None:
+    def add(rule: str, line: int, msg: str) -> None:
+        findings.append(Finding(
+            "lint", rule, f"{mod.relpath}:{line}", msg,
+            suppressed=mod.suppressed(rule, line),
+        ))
+
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    aliases = _GuardSets()
+    escapes = False
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                callee = _dotted(node.value.func) or ""
+                if callee.split(".")[-1] == "Condition" \
+                        and node.value.args:
+                    src = _self_field(node.value.args[0])
+                    for t in node.targets:
+                        dst = _self_field(t)
+                        if src and dst:
+                            aliases.union(f"self.{dst}", f"self.{src}")
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d.split(".")[-1] == "Thread" and any(
+                        kw.arg == "target" for kw in node.keywords):
+                    escapes = True
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit":
+                    escapes = True
+
+    def guard_annotation(line: int) -> Optional[str]:
+        return mod.guard_at.get(line) or mod.guard_at.get(line - 1)
+
+    # field -> canonical declared guard (declared at an __init__-time
+    # attribute assignment carrying the trailing annotation)
+    guards: Dict[str, str] = {}
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                g = guard_annotation(node.lineno)
+                if g is None:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    f = _self_field(t)
+                    if f is not None:
+                        guards[f] = aliases.find(g)
+
+    locked_writes: Dict[str, List[int]] = {}
+    unlocked_writes: Dict[str, List[int]] = {}
+
+    def record(field: str, line: int, held: frozenset) -> None:
+        declared = guards.get(field)
+        if declared is not None:
+            if declared not in held:
+                add("guarded-by", line,
+                    f"{cls.name}.{field} is declared `# guarded-by: "
+                    f"{declared}` but this write holds "
+                    f"{sorted(held) or 'no lock'} — take the lock or "
+                    "annotate the declaration site")
+            return
+        (locked_writes if held else unlocked_writes).setdefault(
+            field, []).append(line)
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            got = set(held)
+            for item in node.items:
+                d = _dotted(item.context_expr)
+                if d and d.startswith("self."):
+                    got.add(aliases.find(d))
+                visit(item.context_expr, held)
+            for b in node.body:
+                visit(b, frozenset(got))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later: assume nothing is held unless
+            # its own def line carries a guard annotation
+            g = guard_annotation(node.lineno)
+            inner = frozenset({aliases.find(g)} if g else ())
+            for b in node.body:
+                visit(b, inner)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        for field in _write_fields(node):
+            record(field, node.lineno, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for m in methods:
+        if m.name in ("__init__", "__new__") or m.name.endswith("_locked"):
+            continue  # construction is single-threaded; *_locked helpers
+            # run under the caller's guard by convention
+        g = guard_annotation(m.lineno)
+        entry = frozenset({aliases.find(g)} if g else ())
+        for b in m.body:
+            visit(b, entry)
+
+    if escapes:
+        for field, lines in unlocked_writes.items():
+            if field not in locked_writes:
+                continue  # consistently unguarded: likely thread-local
+            for line in lines:
+                add("unguarded-shared-write", line,
+                    f"{cls.name}.{field} is written under a lock at "
+                    f"line(s) {locked_writes[field][:3]} but written "
+                    "bare here, and this class hands itself to a "
+                    "thread — guard the write, or declare the field "
+                    "`# guarded-by:` to make the contract checkable")
+
+
+def _check_concurrency(mod: _Module, findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class_concurrency(mod, node, findings)
+
+
 # -- entry points -------------------------------------------------------------
 
 
@@ -440,6 +679,7 @@ def lint_sources(sources: Dict[str, str]) -> List[Finding]:
     traced = _traced_functions(mods)
     for mod in mods.values():
         _check_module_wide(mod, findings)
+        _check_concurrency(mod, findings)
         if mod.modname.startswith(HOT_PREFIXES):
             seen: Set[int] = set()
             for modname, fname in traced:

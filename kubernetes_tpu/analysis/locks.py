@@ -43,6 +43,13 @@ from kubernetes_tpu.analysis import Finding
 _real_lock = threading.Lock
 _real_rlock = threading.RLock
 
+# race-detector happens-before hooks (analysis/races installs them
+# while armed): release publishes the releasing thread's vector clock
+# on the lock, acquire adopts it — the release→acquire edge. None =
+# detector disarmed, zero overhead beyond one global read.
+race_acquire_hook = None
+race_release_hook = None
+
 
 class _TLS(threading.local):
     def __init__(self):
@@ -125,7 +132,9 @@ GRAPH = LockGraph()
 class TrackedLock:
     """A Lock/RLock wrapper recording acquisition-order edges."""
 
-    __slots__ = ("_lock", "site", "_reentrant")
+    # __weakref__ so the race detector's per-lock clock registry can
+    # finalize-clean without ever pinning a lock alive
+    __slots__ = ("_lock", "site", "_reentrant", "__weakref__")
 
     def __init__(self, real, site: str, reentrant: bool):
         self._lock = real
@@ -135,6 +144,9 @@ class TrackedLock:
     # -- tracking core -------------------------------------------------------
 
     def _note_acquired(self) -> None:
+        hook = race_acquire_hook
+        if hook is not None:
+            hook(self)
         held = _tls.held
         if any(h is self for h in held):
             held.append(self)  # re-entrant: no new ordering info
@@ -144,6 +156,9 @@ class TrackedLock:
         held.append(self)
 
     def _note_released(self) -> None:
+        hook = race_release_hook
+        if hook is not None:
+            hook(self)
         held = _tls.held
         for i in range(len(held) - 1, -1, -1):
             if held[i] is self:

@@ -50,6 +50,18 @@ class ProgramSpec:
     expected_host_leaves: Optional[int] = None
     donate_argnums: Tuple[int, ...] = ()
     donated_leaves: Optional[int] = None
+    #: SHARDING contract (sharding-drift audit): per-arg pytrees of the
+    #: PartitionSpecs the program must declare as in_shardings (None =
+    #: that arg unaudited), and the out_shardings pytree. Built from
+    #: resident.carry_specs()/static_specs() so the audited placement
+    #: is the one source the drivers share.
+    arg_shardings: Optional[Tuple[Any, ...]] = None
+    out_shardings_decl: Any = None
+    #: SCATTER contract (scatter-contract audit): the (primitive,
+    #: scatter_dims_to_operand_dims) forms a commit fold may contain.
+    #: None = unaudited; anything outside the set — notably an
+    #: overwrite `scatter` without unique indices — is a finding.
+    scatter_allowed: Optional[Tuple[Tuple[str, Tuple[int, ...]], ...]] = None
     notes: str = ""
 
 
@@ -184,6 +196,7 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
         args=(static, carry, buf, counts),
         carry_out_leaves=carry_leaves,
         expected_host_leaves=1,
+        scatter_allowed=(("scatter-add", (1,)),),
         notes="fold-own-commits + re-probe, one dispatch",
     ))
 
@@ -219,6 +232,7 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
                 args=(static, carry, gbuf, gcounts),
                 carry_out_leaves=carry_leaves,
                 expected_host_leaves=0,  # the fold is carry-only
+                scatter_allowed=(("scatter-add", (1,)),),
                 notes="grouped commit fold (wave._apply_group_fn)",
             ))
 
@@ -234,6 +248,7 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
         args=(static, carry, buf, counts),
         carry_out_leaves=carry_leaves,
         expected_host_leaves=0,
+        scatter_allowed=(("scatter-add", (1,)),),
         notes="single-run commit fold (wave._apply_fn, packed row)",
     ))
 
@@ -325,6 +340,10 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
     num_zones = max(int(snap_p.zone_id.max()) + 1, 1)
     num_values = int(snap_p.svc_num_values)
 
+    from jax.sharding import PartitionSpec as PSpec
+
+    from kubernetes_tpu.parallel.resident import carry_specs, static_specs
+
     static = host_static(config, snap_p)
     hc = host_carry(snap_p, 0)
     carry = tuple(hc[f] for f in CARRY_FIELDS)
@@ -333,6 +352,12 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
     J = 128
     M_bucket = 64
     wave = M.MeshWaveScheduler(mesh, config=config)
+
+    # the sharding-drift declarations: the SAME single-source specs the
+    # resident placement uses — the audit fails if the driver's jit
+    # wrappers ever stop agreeing with them
+    sspec = static_specs(static.keys())
+    cspec = carry_specs()
 
     counts = np.zeros(n, np.int64)
     counts[: min(3, n)] = 2
@@ -351,6 +376,13 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
             # deliberately NOT donated: donation + lax.scan inside
             # shard_map miscompiles the SAA path on this jaxlib's CPU
             # backend (see MeshBatchScheduler._jit_for)
+            arg_shardings=(sspec, cspec, {k: PSpec() for k in pods}),
+            out_shardings_decl=(cspec, PSpec()),
+            # the scan's one overwrite scatter (the chosen-index write)
+            # asserts unique indices; every accumulation is scatter-add
+            scatter_allowed=(("scatter", (0,)), ("scatter-add", (0,)),
+                             ("scatter-add", (0, 1)),
+                             ("scatter-add", (1,))),
             notes="sharded scan (MeshBatchScheduler._exec)",
         ),
         ProgramSpec(
@@ -360,6 +392,8 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
             args=(static, carry, pod_buf_host),
             carry_out_leaves=0,
             expected_host_leaves=1,
+            arg_shardings=(sspec, cspec, PSpec()),
+            out_shardings_decl=PSpec(None, M.AXIS),
             notes="sharded single-run probe "
                   "(MeshWaveScheduler._probe_run)",
         ),
@@ -371,6 +405,10 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
             carry_out_leaves=carry_leaves,
             expected_host_leaves=0,
             donate_argnums=(1,),
+            arg_shardings=(sspec, cspec, PSpec(), PSpec(), PSpec()),
+            out_shardings_decl=cspec,
+            scatter_allowed=(("scatter-add", (0,)),
+                             ("scatter-add", (1,))),
             notes="sharded commit fold, scatter-form counts "
                   "(O(picks) shipment), donated resident carry",
         ),
@@ -386,6 +424,8 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
         args=(static, carry, gbuf_host),
         carry_out_leaves=0,
         expected_host_leaves=1,
+        arg_shardings=(sspec, cspec, PSpec()),
+        out_shardings_decl=PSpec(None, M.AXIS),
         notes="sharded grouped header probe: ONE host-bound array "
               "(usage block no longer ships — resident mirror)",
     ))
@@ -397,6 +437,10 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
         carry_out_leaves=carry_leaves,
         expected_host_leaves=0,
         donate_argnums=(1,),
+        arg_shardings=(sspec, cspec, PSpec(), PSpec(), PSpec()),
+        out_shardings_decl=cspec,
+        scatter_allowed=(("scatter-add", (0, 1)),
+                         ("scatter-add", (1,))),
         notes="sharded grouped commit fold, scatter-form counts, "
               "donated resident carry",
     ))
@@ -451,6 +495,8 @@ def _resident_scatter_program(mesh, config, snap_p, n,
                                tuple(spec_list), layout,
                                tuple(a.shape for _f, a, _x in fields),
                                n_per_shard, donate=True)
+    from jax.sharding import PartitionSpec as PSpec
+
     return ProgramSpec(
         name="resident_scatter",
         fn=run,
@@ -458,6 +504,11 @@ def _resident_scatter_program(mesh, config, snap_p, n,
         carry_out_leaves=len(arrays),
         expected_host_leaves=0,
         donate_argnums=(0,),
+        arg_shardings=(tuple(spec_list), PSpec()),
+        out_shardings_decl=tuple(spec_list),
+        # row replacement is add-into-zeroed-rows: commutative, and
+        # collision-free by the host's packed unique row indices
+        scatter_allowed=(("scatter-add", (0,)),),
         notes="resident node add/remove row scatter: donated in-place "
               "update, O(changed rows) shipment",
     )

@@ -835,11 +835,14 @@ class APIServer:
             return None
         root = info.list_prefix("")
         cacher = self._cachers.get(root)
-        if cacher is not None and cacher.healthy:
+        # racy healthy reads (here and under the lock below): a stale
+        # True serves one request from a dying cacher, whose own reads
+        # re-check and fall back; a stale False only rebuilds early
+        if cacher is not None and cacher.healthy:  # race: allow[racy healthy fast-path]
             return cacher
         with self._cacher_lock:
             cacher = self._cachers.get(root)
-            if cacher is not None and cacher.healthy:
+            if cacher is not None and cacher.healthy:  # race: allow[racy healthy fast-path]
                 return cacher
             now = _time.monotonic()
             if cacher is not None:

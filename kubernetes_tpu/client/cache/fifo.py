@@ -13,6 +13,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from kubernetes_tpu.analysis import races as _races
 from kubernetes_tpu.client.cache.store import KeyFunc, meta_namespace_key_func
 
 
@@ -34,10 +35,10 @@ class FIFO:
         self.key_func = key_func
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._items: Dict[str, Any] = {}
+        self._items: Dict[str, Any] = {}  # guarded-by: self._cond
         # deque: list.pop(0) shifts the whole backlog per pop — at a
         # 30k-pod density backlog that turned the queue quadratic
-        self._queue: deque = deque()
+        self._queue: deque = deque()  # guarded-by: self._cond
         self._closed = False
         self.name = name
         self._metrics = None
@@ -53,9 +54,13 @@ class FIFO:
                 _time.monotonic,
             )
             self._added_at: Dict[str, float] = {}
+        _races.track(self, "cache.FIFO")
 
     def add(self, obj: Any) -> None:
         key = self.key_func(obj)
+        # put→get happens-before: producer-side mutations of the object
+        # are ordered before the popping consumer's reads
+        _races.note_put(self)
         with self._cond:
             if key not in self._items:
                 self._queue.append(key)
@@ -104,6 +109,7 @@ class FIFO:
                                 ts - self._added_at.pop(key, ts)
                             )
                             depth.set(len(self._items) - 1)
+                        _races.note_get(self)
                         return self._items.pop(key)
                     elif self._metrics is not None:
                         self._added_at.pop(key, None)  # deleted entry
@@ -158,13 +164,14 @@ class DeltaFIFO:
         self.known_objects = known_objects
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._items: Dict[str, List[Delta]] = {}
+        self._items: Dict[str, List[Delta]] = {}  # guarded-by: self._cond
         # deque + membership set: `key in list` and list.pop(0) are both
         # O(queue) — quadratic exactly when a density burst backs the
         # informer up (measured 21us/add at 30k-event backlogs)
-        self._queue: deque = deque()
-        self._queued: set = set()
+        self._queue: deque = deque()  # guarded-by: self._cond
+        self._queued: set = set()  # guarded-by: self._cond
         self._closed = False
+        _races.track(self, "cache.DeltaFIFO")
 
     def _key_of(self, obj: Any) -> str:
         if isinstance(obj, Delta):
@@ -175,6 +182,7 @@ class DeltaFIFO:
 
     def _queue_delta(self, obj: Any, dtype: str) -> None:
         key = self._key_of(obj)
+        _races.note_put(self)
         with self._cond:
             deltas = self._items.setdefault(key, [])
             deltas.append(Delta(dtype, obj))
@@ -216,6 +224,7 @@ class DeltaFIFO:
                     self._queued.discard(key)
                     deltas = self._items.pop(key, None)
                     if deltas:
+                        _races.note_get(self)
                         if process is not None:
                             process(key, deltas)
                         return key, deltas

@@ -169,8 +169,12 @@ class LeaderElector:
                 self.observed_record is None
                 or self.observed_record != existing
             ):
-                self.observed_record = existing
-                self.observed_time = now
+                # the observation cache is read by stop()'s lease
+                # release on another thread: same lock as every other
+                # observed_* write (race found by the armed detector)
+                with self._write_lock:
+                    self.observed_record = existing
+                    self.observed_time = now
             if (
                 existing.holder_identity != self.identity
                 and self.observed_time + existing.lease_duration_seconds > now

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 
+from kubernetes_tpu.analysis import races as _races
 from kubernetes_tpu.runtime import binary as bin_codec
 from kubernetes_tpu.trace.profile import phase_timer
 from typing import Any, Dict, Iterator, Optional, Tuple
@@ -169,7 +170,7 @@ class HTTPTransport:
         urls = [u.strip().rstrip("/") for u in base_url.split(",")
                 if u.strip()]
         self.base_urls = urls
-        self._active = 0
+        self._active = 0  # guarded-by: self._active_lock
         # failover rotation races: watch threads and request threads
         # rotate concurrently, and torn read-modify-writes of _active
         # could skip a healthy server in the cycle; pipelined requests
@@ -186,7 +187,8 @@ class HTTPTransport:
         if any(u.startswith("https") for u in urls):
             self._ssl_ctx = build_ssl_context(tls_ca, insecure)
         self._pool_lock = threading.Lock()
-        self._pool: Dict[str, list] = {}
+        self._pool: Dict[str, list] = {}  # guarded-by: self._pool_lock
+        _races.track(self, "client.HTTPTransport")
 
     @property
     def base_url(self) -> str:

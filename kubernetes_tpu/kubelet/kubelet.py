@@ -289,7 +289,7 @@ class Kubelet:
 
     # -- config handling ------------------------------------------------------
 
-    def _worker_for(self, uid: str) -> _PodWorker:
+    def _worker_for(self, uid: str) -> _PodWorker:  # guarded-by: self._lock
         w = self._workers.get(uid)
         if w is None:
             w = _PodWorker(self._sync_pod)
@@ -310,8 +310,10 @@ class Kubelet:
         self.runtime.kill_pod(pod.metadata.uid)
         self.status_manager.forget(pod.metadata.uid)
         self._start_times.pop(pod.metadata.uid, None)
-        self._pod_ips.pop(pod.metadata.uid, None)
         with self._lock:
+            # _pod_ips is mutated under the lock by every per-pod
+            # worker's _pod_ip(); the delete must hold it too
+            self._pod_ips.pop(pod.metadata.uid, None)
             for key in [k for k in self._restarts if k[0] == pod.metadata.uid]:
                 del self._restarts[key]
         for key in [

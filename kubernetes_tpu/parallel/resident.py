@@ -217,6 +217,8 @@ class ResidentClusterState:
     SCATTER_FRAC = 0.25
 
     def __init__(self, mesh):
+        from kubernetes_tpu.analysis import races as _races
+
         self.mesh = mesh
         self._key = None  # topology signature (shapes/dtypes/field set)
         self._static: Dict[str, object] = {}
@@ -231,6 +233,10 @@ class ResidentClusterState:
             "h2d_bytes_total": 0, "wave_h2d_bytes": 0,
             "wave_table_bytes": 0,
         }
+        # the resident mirrors are wave-driver-private state; tracking
+        # them makes any cross-thread touch (a future async driver, a
+        # stats scraper) a detector finding instead of a corrupt mirror
+        _races.track(self, "parallel.ResidentClusterState")
 
     # -- accounting ----------------------------------------------------------
 

@@ -20,6 +20,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from kubernetes_tpu.analysis import races as _races
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.oracle.state import ClusterState, NodeInfo
 from kubernetes_tpu.utils.clock import DEFAULT_CLOCK, Clock
@@ -48,12 +49,13 @@ class SchedulerCache:
         self.ttl = ttl
         self.clock = clock
         self._lock = threading.Lock()
-        self._assumed: set = set()
-        self._pod_states: Dict[str, _PodState] = {}
-        self._nodes: Dict[str, NodeInfo] = {}
+        self._assumed: set = set()  # guarded-by: self._lock
+        self._pod_states: Dict[str, _PodState] = {}  # guarded-by: self._lock
+        self._nodes: Dict[str, NodeInfo] = {}  # guarded-by: self._lock
         self._stop = threading.Event()
         self._cleanup_thread: Optional[threading.Thread] = None
-        self._listeners: List = []
+        self._listeners: List = []  # guarded-by: self._lock
+        _races.track(self, "scheduler.SchedulerCache")
 
     def add_listener(self, fn) -> None:
         """Subscribe to cache mutations: fn(kind, obj) called under the

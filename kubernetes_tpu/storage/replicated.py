@@ -94,23 +94,27 @@ class ReplicatedStore(FileStore):
         super().__init__(data_dir, **kw)
         self.sync_timeout = sync_timeout
         self._repl_lock = threading.Lock()
-        self._follower: Optional[socket.socket] = None
-        self._acked = 0  # bytes acked by the follower
-        self._shipped = 0
+        self._follower: Optional[socket.socket] = None  # guarded-by: self._repl_lock
+        # bytes acked by the follower
+        self._acked = 0  # guarded-by: self._repl_lock
+        self._shipped = 0  # guarded-by: self._repl_lock
         self._ack_cond = threading.Condition(self._repl_lock)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, repl_port))
         self._srv.listen(2)
         self.repl_address = self._srv.getsockname()
-        self._stopped = False
+        self._stopped = False  # guarded-by: self._repl_lock
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="repl-accept").start()
 
     # -- follower attach -----------------------------------------------------
 
     def _accept_loop(self) -> None:
-        while not self._stopped:
+        while True:
+            with self._repl_lock:
+                if self._stopped:
+                    return
             try:
                 conn, _addr = self._srv.accept()
             except OSError:
@@ -246,7 +250,8 @@ class ReplicatedStore(FileStore):
                 self._drop_follower(conn)
 
     def close(self) -> None:
-        self._stopped = True
+        with self._repl_lock:
+            self._stopped = True
         try:
             self._srv.close()
         except OSError:

@@ -19,6 +19,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from kubernetes_tpu.analysis import races as _races
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -308,19 +310,20 @@ class MemoryStore:
 
     def __init__(self, history_size: int = 8192):
         self._lock = threading.RLock()
-        self._data: Dict[str, Tuple[Any, int]] = {}  # key -> (object, mod_rv)
-        self._rv = 0
-        self._history: List[Tuple[str, WatchEvent]] = []  # (key, event)
+        self._data: Dict[str, Tuple[Any, int]] = {}  # guarded-by: self._lock
+        self._rv = 0  # guarded-by: self._lock
+        self._history: List[Tuple[str, WatchEvent]] = []  # guarded-by: self._lock
         self._history_size = history_size
-        self._compacted_rv = 0  # events <= this are gone
-        self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+        self._compacted_rv = 0  # guarded-by: self._lock
+        self._watchers: List[Tuple[str, WatchStream]] = []  # guarded-by: self._lock
         # key -> TLV bytes of the stored object, encoded ONCE at commit.
         # Serves three consumers that each used to encode on their own:
         # watch fan-out (the event's obj blob), the NEXT commit's
         # prev-object blob, and read-path isolation copies (loads(blob)
         # instead of a dumps+loads round trip). Entries exist only for
         # objects the strict codec can carry; absent = legacy path.
-        self._tlv_blobs: Dict[str, bytes] = {}
+        self._tlv_blobs: Dict[str, bytes] = {}  # guarded-by: self._lock
+        _races.track(self, f"storage.{type(self).__name__}")
 
     # -- reads ---------------------------------------------------------------
 
@@ -367,11 +370,11 @@ class MemoryStore:
 
     # -- writes --------------------------------------------------------------
 
-    def _next_rv(self) -> int:
+    def _next_rv(self) -> int:  # guarded-by: self._lock
         self._rv += 1
         return self._rv
 
-    def _append_history(self, key: str, ev: WatchEvent) -> None:
+    def _append_history(self, key: str, ev: WatchEvent) -> None:  # guarded-by: self._lock
         self._history.append((key, ev))
         if len(self._history) > self._history_size:
             drop = len(self._history) - self._history_size
@@ -424,7 +427,7 @@ class MemoryStore:
         return WatchEvent(ev.type, _dc(ev.object), ev.resource_version,
                           _dc(ev.prev_object), key=key)
 
-    def _record(self, key: str, ev: WatchEvent) -> None:
+    def _record(self, key: str, ev: WatchEvent) -> None:  # guarded-by: self._lock
         ev.key = key
         self._append_history(key, ev)
         proto = unencodable = None
@@ -439,7 +442,7 @@ class MemoryStore:
                 )
                 stream._progress_rv = ev.resource_version
 
-    def _record_batch(self, items) -> None:
+    def _record_batch(self, items) -> None:  # guarded-by: self._lock
         """_record for a commit burst: history appended per event,
         compaction once, and each watcher receives its whole matching
         burst in ONE delivery (one lock acquisition per watcher per
@@ -518,7 +521,7 @@ class MemoryStore:
                 self._record_batch(events)
         return out
 
-    def _encode_blob(self, key: str, stored) -> Optional[bytes]:
+    def _encode_blob(self, key: str, stored) -> Optional[bytes]:  # guarded-by: self._lock
         """Encode the committed object once; cache under key. None when
         the strict codec can't carry it (the legacy paths then apply)."""
         c = _tlv_native()
@@ -532,7 +535,7 @@ class MemoryStore:
         self._tlv_blobs.pop(key, None)
         return None
 
-    def _apply_update(self, key: str, obj: Any,
+    def _apply_update(self, key: str, obj: Any,  # guarded-by: self._lock
                       expect_rv: Optional[int] = None,
                       owned: bool = False):
         """Commit an update under the ALREADY-HELD lock without

@@ -20,6 +20,7 @@ import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
+from kubernetes_tpu.analysis import races as _races
 from kubernetes_tpu.utils.clock import Clock, DEFAULT_CLOCK
 from kubernetes_tpu.utils.flowcontrol import Backoff
 
@@ -39,9 +40,9 @@ class WorkQueue:
     def __init__(self, name: str = ""):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue: List[Hashable] = []
-        self._dirty: set = set()
-        self._processing: set = set()
+        self._queue: List[Hashable] = []  # guarded-by: self._cond
+        self._dirty: set = set()  # guarded-by: self._cond
+        self._processing: set = set()  # guarded-by: self._cond
         self._shutting_down = False
         self.name = name
         self._metrics = None
@@ -56,6 +57,7 @@ class WorkQueue:
             )
             self._added_at: Dict[Hashable, float] = {}
             self._started_at: Dict[Hashable, float] = {}
+        _races.track(self, f"workqueue.{type(self).__name__}")
 
     # metric helpers — called with self._cond held
     def _note_queued(self, item: Hashable) -> None:
@@ -66,6 +68,9 @@ class WorkQueue:
             depth.set(len(self._queue))
 
     def add(self, item: Hashable) -> None:
+        # put→get happens-before: work done before the enqueue is
+        # ordered before whatever the draining worker does with it
+        _races.note_put(self)
         with self._cond:
             if self._shutting_down or item in self._dirty:
                 return
@@ -93,6 +98,7 @@ class WorkQueue:
                 queue_dur.observe(now - self._added_at.pop(item, now))
                 self._started_at[item] = now
                 depth.set(len(self._queue))
+            _races.note_get(self)
             return item
 
     def done(self, item: Hashable) -> None:
@@ -130,14 +136,27 @@ class DelayingQueue(WorkQueue):
     def __init__(self, clock: Optional[Clock] = None, name: str = ""):
         super().__init__(name=name)
         self._clock = clock or DEFAULT_CLOCK
-        self._heap: List[Tuple[float, int, Hashable]] = []
-        self._waiting: Dict[Hashable, float] = {}  # item -> ready time
-        self._seq = 0
-        self._heap_cond = threading.Condition()
+        self._heap: List[Tuple[float, int, Hashable]] = []  # guarded-by: self._heap_cond
+        # item -> ready time
+        self._waiting: Dict[Hashable, float] = {}  # guarded-by: self._heap_cond
+        self._seq = 0  # guarded-by: self._heap_cond
+        # explicit Lock: a bare Condition()'s implicit RLock is built
+        # inside the threading module, invisible to the lock sanitizer
+        # and so to the race detector's lockset/HB analyses
+        self._heap_cond = threading.Condition(threading.Lock())
+        # the waiter's own shutdown signal: _shutting_down belongs to
+        # the base queue's _cond, and the armed race detector flagged
+        # the waiter's _heap_cond-guarded read of it (two different
+        # guards on one field is exactly the inconsistency that turns
+        # into a lost-wakeup under reordering)
+        self._waiter_stop = False  # guarded-by: self._heap_cond
         self._waiter = threading.Thread(target=self._wait_loop, daemon=True)
         self._waiter.start()
 
     def add_after(self, item: Hashable, delay: float) -> None:
+        # the eventual get must happen-after THIS caller, not just the
+        # waiter thread that moves the item when its delay expires
+        _races.note_put(self)
         if delay <= 0:
             with self._heap_cond:
                 # an immediate add supersedes any pending delayed entry
@@ -162,7 +181,7 @@ class DelayingQueue(WorkQueue):
     def _wait_loop(self) -> None:
         while True:
             with self._heap_cond:
-                if self._shutting_down:
+                if self._waiter_stop:
                     return
                 if not self._heap:
                     self._heap_cond.wait(timeout=0.5)
@@ -181,6 +200,7 @@ class DelayingQueue(WorkQueue):
     def shut_down(self) -> None:
         super().shut_down()
         with self._heap_cond:
+            self._waiter_stop = True
             self._heap_cond.notify_all()
 
 

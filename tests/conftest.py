@@ -149,6 +149,36 @@ if os.environ.get("KUBERNETES_TPU_LOCK_SANITIZER"):
         _locks.assert_no_cycles("(suite-wide)")
 
 
+if os.environ.get("KUBERNETES_TPU_RACE_SANITIZER"):
+    # opt-in suite-wide arming of the DATA-RACE sanitizer (lockset +
+    # vector-clock happens-before, analysis/races), mirroring the lock
+    # sanitizer: KUBERNETES_TPU_RACE_SANITIZER=1 wraps every test so
+    # any suite doubles as a race witness. Findings accumulate into the
+    # KUBERNETES_TPU_RACE_REPORT JSONL artifact (when set) that
+    # `python -m kubernetes_tpu.analysis --race-report` merges back
+    # into the CI gate; an unsuppressed race also fails the exposing
+    # test directly. This is a SEPARATE CI invocation, not the default
+    # tier-1 run — the detector's instrumentation overhead rides every
+    # tracked attribute access (see README "Static analysis").
+    from kubernetes_tpu.analysis import races as _races
+
+    # truncate the artifact once per session: dump_jsonl appends per
+    # test, and stale rows from a PREVIOUS run (races since fixed)
+    # would keep failing the --race-report gate forever
+    _report = os.environ.get("KUBERNETES_TPU_RACE_REPORT")
+    if _report:
+        open(_report, "w").close()
+
+    @pytest.fixture(autouse=True)
+    def _global_race_sanitizer():
+        with _races.instrumented(reset=True):
+            yield
+        report = os.environ.get("KUBERNETES_TPU_RACE_REPORT")
+        if report:
+            _races.dump_jsonl(report)
+        _races.assert_no_races("(suite-wide)")
+
+
 def wait_until(cond, timeout=60.0, interval=0.01):
     """Poll `cond` until truthy or `timeout` elapses. The single shared
     copy (each test file used to carry its own, and the defaults

@@ -220,9 +220,9 @@ class TestCacherEquivalence:
         assert c1 is not None and c1.healthy
         c1._feed_stream.stop()  # simulate a store-watch break
         deadline = time.time() + 5
-        while c1.healthy and time.time() < deadline:
+        while c1.healthy and time.time() < deadline:  # race: allow[test poll]
             time.sleep(0.02)
-        assert not c1.healthy
+        assert not c1.healthy  # race: allow[test poll]
         # expire the backoff so the next read rebuilds immediately
         api._cacher_built[info.list_prefix("")] = 0.0
         c2 = api._cacher_for(info)
