@@ -41,10 +41,7 @@ bit-for-bit. The heavy mask work stays int32/uint32.
 
 import gc
 import os
-
-import jax
-
-jax.config.update("jax_enable_x64", True)
+import sys as _sys_mod
 
 # Cycle-GC pacing for control-plane workloads: the default gen-0
 # threshold (700 allocations) makes the collector scan an ever-growing
@@ -74,24 +71,57 @@ if _gil != "":  # explicit empty string opts out entirely
             f"{_gil!r} ({_e}); running at the interpreter default"
         )
 
-# Persistent XLA compilation cache: a fresh daemon facing a large cluster
-# pays tens of seconds of compile per (node, pod, width) bucket on a
-# tunneled chip; caching them on disk makes every start after the first
-# warm (VERDICT round-1 weak #7). Opt out with KUBERNETES_TPU_NO_XLA_CACHE.
+# JAX configuration WITHOUT importing jax: the import costs ~1.1s, and
+# half the control plane (apiserver, creator, kubectl, hollow kubelets)
+# never touches a tensor. Environment-variable config is jax's own
+# first-class mechanism — jax.config reads JAX_ENABLE_X64 /
+# JAX_COMPILATION_CACHE_DIR / JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS
+# at import, so processes that DO use jax get exactly the old settings
+# the moment they import it, and everyone else skips the 1.1s tax.
+#
+# - x64: the reference computes scores with int64 arithmetic
+#   (priorities.go:33) and memory is int64 bytes, so device arithmetic
+#   must match bit-for-bit.
+# - persistent compile cache: a fresh daemon facing a large cluster
+#   pays tens of seconds of compile per (node, pod, width) bucket on a
+#   tunneled chip; caching on disk makes every start after the first
+#   warm (VERDICT round-1 weak #7). Opt out with
+#   KUBERNETES_TPU_NO_XLA_CACHE.
+# forced, not setdefault: an ambient JAX_ENABLE_X64=false would
+# silently break the bit-for-bit int64 contract the old
+# jax.config.update enforced unconditionally
+os.environ["JAX_ENABLE_X64"] = "true"
 if not os.environ.get("KUBERNETES_TPU_NO_XLA_CACHE"):
-    try:
-        _cache_dir = os.environ.get(
-            "KUBERNETES_TPU_XLA_CACHE_DIR",
-            os.path.join(
-                os.path.expanduser("~"), ".cache", "kubernetes_tpu_xla"
-            ),
-        )
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        # persist even fast compiles: the small pack/unpack and apply
-        # programs each cost ~0.5-2s on a tunneled chip per process
-        # start, which is exactly the daemon cold-start we are cutting
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:  # older jax without the knobs: run uncached
-        pass
+    _cache_dir = os.environ.get(
+        "KUBERNETES_TPU_XLA_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "kubernetes_tpu_xla"
+        ),
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    # persist even fast compiles: the small pack/unpack and apply
+    # programs each cost ~0.5-2s on a tunneled chip per process start
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+if "jax" in _sys_mod.modules:
+    # jax beat us to import: env vars were already read — apply the
+    # same settings through the live config instead. Read the POST-
+    # setdefault environment, not our defaults, so an ambient
+    # JAX_COMPILATION_CACHE_DIR wins here exactly as it does on the
+    # env-var path (cache selection must not depend on import order).
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+    if not os.environ.get("KUBERNETES_TPU_NO_XLA_CACHE"):
+        try:
+            _jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ["JAX_COMPILATION_CACHE_DIR"])
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(os.environ[
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+        except Exception:  # older jax without the knobs: run uncached
+            pass
 
 __version__ = "0.1.0"

@@ -1442,3 +1442,14 @@ class Scale:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ScaleSpec = field(default_factory=ScaleSpec)
     status: ScaleStatus = field(default_factory=ScaleStatus)
+
+
+def shallow_copy(obj):
+    """One-layer copy of one of these plain-__dict__ dataclasses
+    without the copy.copy detour through __reduce_ex__ (~25us ->
+    ~1us for pod+spec — real money at 30k copies per wave burst).
+    Callers must re-copy exactly the nested layers they mutate; the
+    rest stays shared with the source object."""
+    new = obj.__class__.__new__(obj.__class__)
+    new.__dict__.update(obj.__dict__)
+    return new

@@ -11,6 +11,17 @@ with two frontends:
   (the httptest in-process master idiom, master_utils.go:320).
 """
 
-from kubernetes_tpu.apiserver.server import APIServer, APIError, WatchResponse
-
+# Lazy re-exports (PEP 562): the storage cacher imports
+# apiserver.fields (a leaf module) for server-side field-selector
+# evaluation, and an eager `from .server import ...` here would close
+# the cycle storage -> cacher -> apiserver -> server -> storage.
 __all__ = ["APIServer", "APIError", "WatchResponse"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from kubernetes_tpu.apiserver import server as _server
+
+        return getattr(_server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

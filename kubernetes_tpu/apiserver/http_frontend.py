@@ -20,11 +20,25 @@ from kubernetes_tpu.apiserver.server import APIServer, WatchResponse
 from kubernetes_tpu.metrics import (
     apiserver_request_latency,
     apiserver_requests_total,
+    apiserver_watch_coalesced_frame_bytes,
+    apiserver_watch_coalesced_frame_objects,
     apiserver_watch_events_sent_total,
 )
 from kubernetes_tpu.runtime import binary
 
 _sent_events = apiserver_watch_events_sent_total.child()
+
+# watch-burst coalescing (one segmented frame — one write syscall — per
+# burst per connection); KUBERNETES_TPU_WATCH_COALESCE=0 reverts to
+# per-event frames. Read per watch connection, not at import: the
+# equivalence fuzz drives both modes against one live server.
+import os as _os
+
+
+def _coalesce_enabled() -> bool:
+    return _os.environ.get(
+        "KUBERNETES_TPU_WATCH_COALESCE", "1"
+    ).lower() not in ("0", "false", "off")
 
 
 def _is_long_running(path: str, query: dict) -> bool:
@@ -327,7 +341,10 @@ def start_http_server(api: APIServer, host: str, port: int,
                 # a wave-bulk bind emits tens of thousands of events
                 # back-to-back, and per-event write+flush was the
                 # frontend's throughput ceiling.
-                if binary_stream:
+                coalesce = binary_stream and _coalesce_enabled()
+                if coalesce:
+                    batches = watch.burst_frames(idle_timeout=3.0)
+                elif binary_stream:
                     batches = watch.frame_batches(idle_timeout=3.0)
                 else:
                     batches = watch.event_batches(idle_timeout=3.0)
@@ -337,6 +354,16 @@ def start_http_server(api: APIServer, host: str, port: int,
                         payload = (
                             binary.encode_frame(None) if binary_stream
                             else b"\n"
+                        )
+                    elif coalesce:
+                        # the burst IS one frame already
+                        payload, n_events = batch
+                        _sent_events(n_events)
+                        apiserver_watch_coalesced_frame_objects.observe(
+                            n_events
+                        )
+                        apiserver_watch_coalesced_frame_bytes.observe(
+                            len(payload)
                         )
                     elif binary_stream:
                         _sent_events(len(batch))

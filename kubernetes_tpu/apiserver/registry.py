@@ -8,10 +8,7 @@ prepare/validate strategy hooks (strategy.go idiom).
 
 from __future__ import annotations
 
-import os
-import threading as _threading
 import time as _time
-import uuid
 from dataclasses import dataclass
 from datetime import datetime, timezone
 from typing import Any, Callable, Dict, Optional
@@ -20,27 +17,10 @@ from kubernetes_tpu.api import types as t
 
 _NOW_CACHE = (0, "")
 
-# Buffered urandom, one buffer PER THREAD: a 4096-byte read amortizes
-# the syscall across ~200 objects, and thread-locality removes the lock
-# convoy a shared buffer creates under parallel bulk creates (a dozen
-# handler threads each minting uids serialized on one lock measured as
-# ~1/3 of create-storm CPU). The bytes are still kernel entropy
-# (create.go's rand.String(5) contract: unpredictable, not RFC-4122);
-# only the syscall count changes.
-_RAND_TLS = _threading.local()
-
-
-def rand_hex(nbytes: int) -> str:
-    """Hex string of `nbytes` of buffered kernel entropy."""
-    tls = _RAND_TLS
-    buf = getattr(tls, "buf", None)
-    pos = getattr(tls, "pos", 0)
-    if buf is None or pos + nbytes > len(buf):
-        buf = tls.buf = os.urandom(4096)
-        pos = 0
-    out = buf[pos:pos + nbytes]
-    tls.pos = pos + nbytes
-    return out.hex()
+# Buffered fork-safe urandom lives in utils/entropy (the trace layer
+# mints span ids from the same buffers); re-exported here because the
+# uid/generateName minting is this module's hot path.
+from kubernetes_tpu.utils.entropy import rand_hex  # noqa: F401
 
 
 def now_rfc3339() -> str:

@@ -211,6 +211,17 @@ def batch_status_item(resource: str, name: str, status: Dict[str, Any],
     }
 
 
+def batch_delete_item(resource: str, name: str,
+                      namespace: str = "default") -> Dict[str, Any]:
+    """One /api/v1/batch delete item (the soak's churn half)."""
+    return {
+        "op": "delete",
+        "resource": resource,
+        "namespace": namespace,
+        "name": name,
+    }
+
+
 class WatchExpired(Exception):
     """410: the requested resourceVersion is compacted; relist."""
 

@@ -263,6 +263,10 @@ class HTTPTransport:
     def _encode_body(self, body):
         if body is None:
             return None
+        if isinstance(body, (bytes, bytearray)):
+            # pre-encoded by the caller (bulk-create storms encode one
+            # repeated-template body ONCE instead of per request)
+            return bytes(body)
         if self.binary:
             return bin_codec.encode(body)
         return json.dumps(body).encode()

@@ -15,118 +15,15 @@ scheduler daemon binding through the API, and the reference's per-second
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
-from kubernetes_tpu.api.types import (
-    Container,
-    Node,
-    NodeCondition,
-    NodeStatus,
-    ObjectMeta,
-    Pod,
-    PodSpec,
-)
 from kubernetes_tpu.apiserver.server import APIServer
 from kubernetes_tpu.client.rest import RESTClient
 from kubernetes_tpu.client.transport import LocalTransport
+from kubernetes_tpu.harness.creator import make_nodes, make_pods
 from kubernetes_tpu.scheduler.server import SchedulerServer, SchedulerServerOptions
-from kubernetes_tpu.utils.workqueue import parallelize
-
-
-def make_nodes(client: RESTClient, n: int) -> None:
-    """perf/util.go:88-118 node shape."""
-    for i in range(n):
-        client.nodes().create(
-            Node(
-                metadata=ObjectMeta(name=f"node-{i:05d}"),
-                status=NodeStatus(
-                    capacity={"cpu": "4", "memory": "32Gi", "pods": "110"},
-                    allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
-                    conditions=[NodeCondition("Ready", "True")],
-                ),
-            )
-        )
-
-
-def _perf_pod() -> Pod:
-    return Pod(
-        metadata=ObjectMeta(
-            generate_name="sched-perf-pod-",
-            labels={"name": "sched-perf"},
-        ),
-        spec=PodSpec(
-            containers=[
-                Container(
-                    name="pause",
-                    image="kubernetes/pause:go",
-                    requests={"cpu": "100m", "memory": "500Mi"},
-                )
-            ]
-        ),
-    )
-
-
-def make_pods(client: RESTClient, p: int, creators: int = 12,
-              chunk: int = 500) -> None:
-    """perf/util.go:143-175 makePodsFromRC: pause pods, parallel
-    creation. Batches flow through the bulk-create endpoint (an RC
-    manager burst-creates its whole replica delta too); generateName
-    collisions retry like the reference's RC manager self-heal.
-
-    The count is VERIFIED against the server afterwards and any
-    shortfall topped up: a connection dropped mid-request loses the
-    reply (pods may or may not exist), parallelize logs worker panics
-    without failing (HandleCrash semantics), and a density measurement
-    waiting for a pod that was never created stalls forever.
-
-    creators defaults to 12 (the reference runs 30): the apiserver is
-    GIL-bound, so extra concurrency doesn't add throughput — it only
-    inflates per-request latency until requests trip the client
-    timeout, and every timed-out bulk reply costs a serial top-up
-    reconciliation at the end."""
-    chunks = [min(chunk, p - i) for i in range(0, p, chunk)]
-
-    def create(ci: int) -> None:
-        want = chunks[ci]
-        for _ in range(5):
-            res = client.pods().create_many([_perf_pod() for _ in range(want)])
-            want = 0
-            for r in res:
-                if r.get("status") == "Success":
-                    continue
-                msg = r.get("message", "")
-                if "already exists" in msg:
-                    want += 1  # generateName collision: retry that one
-                else:
-                    raise RuntimeError(f"pod create failed: {msg}")
-            if want == 0:
-                return
-        raise RuntimeError("pod create kept colliding")
-
-    parallelize(min(creators, len(chunks)), len(chunks), create)
-
-    def count() -> int:
-        return len(client.pods().list(label_selector="name=sched-perf")[0])
-
-    have = count()
-    for _ in range(10):
-        if have >= p:
-            return
-        missing = p - have
-        print(f"pod creation shortfall: {missing} lost to dropped "
-              "connections; topping up", file=sys.stderr)
-        chunks[:] = [min(chunk, missing - i)
-                     for i in range(0, missing, chunk)]
-        # reuse the chunk worker: collision retries + loud non-collision
-        # failures (a validation error must surface, not read as a
-        # shortfall)
-        for ci in range(len(chunks)):
-            create(ci)
-        have = count()
-    raise RuntimeError(
-        f"pod creation kept falling short: {have}/{p} after top-ups"
-    )
 
 
 def _pipeline_snapshot():
@@ -305,6 +202,11 @@ def _scrape_counters(client) -> dict:
         "apiserver_batch_commit_size_objects_count",
         "apiserver_batch_commit_size_objects_sum",
         "storage_watch_events_dropped_total",
+        "apiserver_watch_coalesced_frame_objects_count",
+        "apiserver_watch_coalesced_frame_objects_sum",
+        "apiserver_watch_coalesced_frame_bytes_sum",
+        "storage_watch_fanout_pruned_total",
+        "storage_watch_cache_ring_evictions_total",
     )
     out: dict = {}
     for line in text.splitlines():
@@ -339,6 +241,9 @@ def schedule_pods_separate(
 
     from kubernetes_tpu.client.transport import HTTPTransport
 
+    # continuous arrivals never give the daemon the 5s idle window the
+    # deferred scan warm waits for; compile it up front instead
+    os.environ.setdefault("KUBERNETES_TPU_WARM_SCAN", "1")
     api_proc = subprocess.Popen(
         [sys.executable, "-m", "kubernetes_tpu.hyperkube", "apiserver",
          "--port", "0", "--enable-binary-wire"],
@@ -372,8 +277,8 @@ def schedule_pods_separate(
         t0 = time.time()
         pipeline_phases = _pipeline_snapshot()
         creator = subprocess.Popen(
-            [sys.executable, "-m", "kubernetes_tpu.harness.perf",
-             "--create-only", "--server", url, "--pods", str(num_pods)],
+            [sys.executable, "-m", "kubernetes_tpu.harness.creator",
+             "--server", url, "--pods", str(num_pods)],
         )
         creator.wait()
         if creator.returncode != 0:
@@ -419,6 +324,18 @@ def schedule_pods_separate(
                     "apiserver_batch_commit_size_objects_sum", 0)),
                 "watch_events_dropped": int(counters.get(
                     "storage_watch_events_dropped_total", 0)),
+                # coalesced-frame shape: how many events (and bytes)
+                # each segmented burst frame carried on the wire
+                "coalesced_frames": int(counters.get(
+                    "apiserver_watch_coalesced_frame_objects_count", 0)),
+                "coalesced_frame_objects": int(counters.get(
+                    "apiserver_watch_coalesced_frame_objects_sum", 0)),
+                "coalesced_frame_bytes": int(counters.get(
+                    "apiserver_watch_coalesced_frame_bytes_sum", 0)),
+                "fanout_pruned": int(counters.get(
+                    "storage_watch_fanout_pruned_total", 0)),
+                "ring_evictions": int(counters.get(
+                    "storage_watch_cache_ring_evictions_total", 0)),
             })
             print(
                 f"# apiserver wire: {stats.get('apiserver_requests', 0)} "

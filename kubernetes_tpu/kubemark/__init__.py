@@ -4,8 +4,21 @@ A HollowNode runs the REAL kubelet and kube-proxy code against fake
 runtime/dataplane seams (hollow-node.go:102-120 wires the real kubelet
 to FakeDockerClient + fake cadvisor + stub container manager), so a
 single process can host hundreds of nodes and exercise the control
-plane at scale with ~1% of the hardware."""
+plane at scale with ~1% of the hardware. HollowFleet multiplexes
+thousands of hollow kubelets onto a few threads + one pooled transport
+for the soak-scale load shape; start_kubemark picks the right one."""
 
-from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode
+from kubernetes_tpu.kubemark.fleet import FleetConfig, HollowFleet
+from kubernetes_tpu.kubemark.hollow import (
+    HollowCluster,
+    HollowNode,
+    start_kubemark,
+)
 
-__all__ = ["HollowCluster", "HollowNode"]
+__all__ = [
+    "FleetConfig",
+    "HollowCluster",
+    "HollowFleet",
+    "HollowNode",
+    "start_kubemark",
+]

@@ -1,6 +1,19 @@
 """Hollow nodes (pkg/kubemark/hollow_kubelet.go, hollow_proxy.go) and the
 start-kubemark launcher (test/kubemark/start-kubemark.sh reduced to an
-in-process API)."""
+in-process API).
+
+Two deployment shapes, one launcher (`start_kubemark`):
+
+* ``faithful`` — HollowNode/HollowCluster: the REAL kubelet (and
+  optionally the real proxier) per node on fake runtime seams, exactly
+  hollow-node.go:102-120. Highest fidelity, ~6 threads per node;
+  hundreds of nodes per process.
+* ``fleet`` — kubemark/fleet.HollowFleet: thousands of hollow kubelets
+  multiplexed onto a few threads + ONE pooled transport (timer-wheel
+  heartbeats, shard watches pinned by ``spec.nodeName in (...)``,
+  every ack through /api/v1/batch). The wire surface of a node fleet
+  at the cost of a handful of threads — the soak harness's shape.
+"""
 
 from __future__ import annotations
 
@@ -92,3 +105,24 @@ class HollowCluster:
 
     def __len__(self) -> int:
         return len(self.nodes)
+
+
+def start_kubemark(client: RESTClient, num_nodes: int,
+                   mode: str = "auto", **kw):
+    """start-kubemark.sh as one call: run `num_nodes` hollow nodes in
+    the right shape and return the running cluster/fleet (both expose
+    run()/stop()/__len__).
+
+    mode: "faithful" (real kubelet per node), "fleet" (multiplexed
+    HollowFleet), or "auto" — faithful up to 64 nodes, fleet beyond
+    (the real kubelet's thread cost melts a box near a thousand).
+    Extra kwargs flow to the chosen constructor."""
+    if mode == "auto":
+        mode = "faithful" if num_nodes <= 64 else "fleet"
+    if mode == "faithful":
+        return HollowCluster(client, num_nodes, **kw).run()
+    if mode == "fleet":
+        from kubernetes_tpu.kubemark.fleet import HollowFleet
+
+        return HollowFleet(client, num_nodes=num_nodes, **kw).run()
+    raise ValueError(f"unknown kubemark mode {mode!r}")

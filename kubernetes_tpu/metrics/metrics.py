@@ -43,6 +43,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (the all-verbs request count the
+        soak harness diffs; get() reads one label set only)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def child(self, **labels: str) -> "Callable[..., None]":
         """A bound fast-path incrementer with the label key pre-built —
         per-event hot paths (workqueue adds, watch events) pay one dict
@@ -432,6 +438,69 @@ storage_watch_events_dropped_total = registry.register(
     Counter(
         "storage_watch_events_dropped_total",
         "Watch events dropped by slow-watcher stream termination",
+    )
+)
+
+#: watch-cache ring evictions: an event aged out of the bounded ring
+#: before any resumer asked for it. A watch resuming from BELOW the
+#: evicted horizon falls back to the store (or relists on Compacted) —
+#: never silent loss; a hot counter here says the ring is undersized
+#: for the churn rate (KUBERNETES_TPU_WATCH_CACHE_SIZES)
+storage_watch_cache_ring_evictions_total = registry.register(
+    Counter(
+        "storage_watch_cache_ring_evictions_total",
+        "Events evicted from per-resource watch-cache rings",
+    )
+)
+
+#: fan-out deliveries skipped by the cacher's server-side field-clause
+#: pre-filter (events a watcher's selector could never emit): wasted
+#: queue puts that O(nodes x pods) watch fan-out used to pay
+storage_watch_fanout_pruned_total = registry.register(
+    Counter(
+        "storage_watch_fanout_pruned_total",
+        "Watch fan-out deliveries pruned by server-side field filtering",
+    )
+)
+
+#: events carried per coalesced binary watch frame (one segmented
+#: frame — one write syscall — per burst per connection)
+apiserver_watch_coalesced_frame_objects = registry.register(
+    Histogram(
+        "apiserver_watch_coalesced_frame_objects",
+        "Watch events carried per coalesced binary frame",
+        buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                 4096, 8192],
+    )
+)
+
+#: bytes per coalesced binary watch frame
+apiserver_watch_coalesced_frame_bytes = registry.register(
+    Histogram(
+        "apiserver_watch_coalesced_frame_bytes",
+        "Bytes per coalesced binary watch frame",
+        buckets=[256, 1024, 4096, 16384, 65536, 262144, 1048576,
+                 4194304, 16777216],
+    )
+)
+
+# -- kubemark hollow fleet (kubemark/fleet.py) --------------------------------
+
+#: node heartbeats the hollow fleet committed (batched onto
+#: /api/v1/batch — N heartbeats per interval, O(1) requests)
+kubemark_fleet_heartbeats_total = registry.register(
+    Counter(
+        "kubemark_fleet_heartbeats_total",
+        "NodeStatus heartbeats committed by the hollow fleet",
+    )
+)
+
+#: pod lifecycle transitions the fleet acked (Pending->Running),
+#: batched the same way; deletions are observed locally only
+kubemark_fleet_pod_transitions_total = registry.register(
+    Counter(
+        "kubemark_fleet_pod_transitions_total",
+        "Pod lifecycle transitions committed by the hollow fleet",
     )
 )
 

@@ -36,7 +36,15 @@ MAGIC = b"k8s-tpu\x01"
 # outer table — segmentation is what makes zero-re-encode lists sound);
 # the client decodes head + items back into the ordinary List payload.
 MAGIC_SEG = b"k8s-tpu\x02"
+# coalesced watch burst (version 3): ONE length-prefixed frame carrying
+# N watch events — per event a 1-byte-length type string and a
+# length-prefixed self-contained object TLV value (spliced verbatim
+# from the commit-time bytes). A bind storm's whole burst becomes one
+# frame and one write syscall per connection; the client fans it back
+# out into ordinary {"type","object"} events.
+MAGIC_BURST = b"k8s-tpu\x03"
 _LEN = struct.Struct("<I")
+_U8 = struct.Struct("<B")
 
 
 class BinaryDecodeError(Exception):
@@ -146,6 +154,49 @@ def splice_frame(ev_type: str, obj_tlv: bytes) -> bytes:
     return b"".join((_LEN.pack(body_len), MAGIC, head, obj_tlv))
 
 
+def coalesce_burst(items) -> bytes:
+    """ONE length-prefixed burst frame from [(ev_type, obj_tlv_bytes)]:
+    the whole watch burst is a single frame (single write syscall), and
+    each object's TLV bytes are spliced verbatim — the splice_frame
+    zero-re-encode contract, amortized over the burst."""
+    parts = [MAGIC_BURST, _LEN.pack(len(items))]
+    size = len(MAGIC_BURST) + _LEN.size
+    for ev_type, ob in items:
+        tb = ev_type.encode()
+        parts.append(_U8.pack(len(tb)))
+        parts.append(tb)
+        parts.append(_LEN.pack(len(ob)))
+        parts.append(ob)
+        size += 1 + len(tb) + _LEN.size + len(ob)
+    return b"".join([_LEN.pack(size)] + parts)
+
+
+def iter_burst(body: bytes):
+    """Yield the {"type", "object"} events of one burst frame body
+    (everything after the frame's length prefix)."""
+    pos = len(MAGIC_BURST)
+    try:
+        (count,) = _LEN.unpack_from(body, pos)
+        pos += _LEN.size
+        for _ in range(count):
+            tlen = body[pos]
+            pos += 1
+            ev_type = body[pos:pos + tlen].decode()
+            pos += tlen
+            (n,) = _LEN.unpack_from(body, pos)
+            pos += _LEN.size
+            if pos + n > len(body):
+                raise BinaryDecodeError("truncated burst frame")
+            yield {"type": ev_type, "object": tlv.loads(body[pos:pos + n])}
+            pos += n
+    except (struct.error, IndexError) as e:
+        raise BinaryDecodeError(f"malformed burst frame: {e}") from e
+    except tlv.TLVError as e:
+        raise BinaryDecodeError(str(e)) from e
+    if pos != len(body):
+        raise BinaryDecodeError("trailing bytes after burst frame")
+
+
 def read_frames(fp):
     """Yield decoded frames from a binary watch stream until EOF.
 
@@ -167,6 +218,13 @@ def read_frames(fp):
                 # "wire" phase: the CPU cost of the TLV watch ingest
                 # (decode only — the blocking read below is idle time,
                 # not work, and must not inflate the attribution)
+                if body.startswith(MAGIC_BURST):
+                    # coalesced burst: one frame fans back out into its
+                    # individual events
+                    with phase_timer("wire"):
+                        events = list(iter_burst(body))
+                    yield from events
+                    continue
                 with phase_timer("wire"):
                     obj = decode(body)
                 yield obj

@@ -23,6 +23,18 @@ from kubernetes_tpu.trace import profile as trace_profile
 log = logging.getLogger(__name__)
 
 
+def _eager_scan_warm() -> bool:
+    """KUBERNETES_TPU_WARM_SCAN=1: compile the scan-path programs during
+    the run-phase warmup instead of waiting for 5s of daemon idleness.
+    Off by default — a tunneled-chip cold start pays tens of seconds per
+    program, and the idle-deferred scan warm exists exactly for that."""
+    import os
+
+    return os.environ.get(
+        "KUBERNETES_TPU_WARM_SCAN", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
 def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
     """Device node ids -> names; -1 and padded ids mean unschedulable."""
     return [
@@ -181,6 +193,38 @@ class TPUScheduleAlgorithm:
                 + [pod(f"wh{i}", "150m") for i in range(n)],
                 state, nodes,
             )
+            # every pod-axis pow2 bucket a daemon wave can land in:
+            # burst-adaptive gathering produces waves anywhere in
+            # [pod_floor, wave cap], and each bucket is its own compiled
+            # shape. Left cold, those compiles land MID-STORM — measured
+            # ~4.5s of trace + compile-cache-read CPU interleaved with
+            # the first minutes of a 30k-pod create burst, all of it
+            # removable by compiling here, before the loop opens.
+            from kubernetes_tpu.scheduler.core import _wave_cap
+
+            cap = _wave_cap()
+            bucket = max(self._wave.pod_floor, self._wave.min_run, 2)
+            while bucket <= cap:
+                self._warm_one(
+                    [pod(f"wb{bucket}-{i}", "100m")
+                     for i in range(bucket)],
+                    state, nodes,
+                )
+                bucket *= 2
+            if _eager_scan_warm():
+                # sub-min_run trickle waves hit the SCAN program, whose
+                # warm normally waits for 5s of sustained idleness — a
+                # window a continuous-arrival storm never opens, so the
+                # scan compiles landed mid-storm (~2s of trace CPU
+                # interleaved with creation). Opt-in because a tunneled
+                # chip pays tens of seconds here before the loop opens;
+                # the wire bench and soak harness set it.
+                for k in (2, bucket // 2):
+                    self._warm_one(
+                        [pod(f"wsb{k}-{i}", f"{200 + i}m")
+                         for i in range(k)],
+                        state, nodes,
+                    )
         if phase in ("all", "scan"):
             self._warm_one([pod("w-scan", "200m"),
                             pod("w-scan2", "300m")], state, nodes)

@@ -13,6 +13,7 @@ relist (reflector.go:281 semantics depend on all of these).
 
 from kubernetes_tpu.storage.cacher import Cacher
 from kubernetes_tpu.storage.store import (
+    DELETE_OBJECT,
     Compacted,
     Conflict,
     KeyExists,
@@ -25,6 +26,7 @@ from kubernetes_tpu.storage.store import (
 
 __all__ = [
     "Cacher",
+    "DELETE_OBJECT",
     "MemoryStore",
     "WatchEvent",
     "WatchStream",

@@ -34,9 +34,16 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.analysis import races as _races
+from kubernetes_tpu.apiserver.fields import (
+    interest_values,
+    lookup_field,
+    matches_fields,
+)
 from kubernetes_tpu.metrics import (
     apiserver_watch_cache_hits_total,
     apiserver_watch_cache_misses_total,
+    storage_watch_cache_ring_evictions_total,
+    storage_watch_fanout_pruned_total,
 )
 from kubernetes_tpu.storage.store import (
     ERROR,
@@ -54,6 +61,8 @@ log = logging.getLogger(__name__)
 
 _hit = apiserver_watch_cache_hits_total.child()
 _miss = apiserver_watch_cache_misses_total.child()
+_evicted = storage_watch_cache_ring_evictions_total.child()
+_pruned = storage_watch_fanout_pruned_total.child()
 
 
 class _Entry:
@@ -105,9 +114,16 @@ class Cacher:
     (per-namespace lists)."""
 
     def __init__(self, store: MemoryStore, prefix: str,
-                 ring_size: int = 8192):
+                 ring_size: int = 8192, index_field: str = ""):
+        """index_field: a dotted wire path (e.g. "spec.nodeName" for
+        pods) whose equality/in-pinned watchers are fanned out via an
+        interest index — each event is delivered only to the watchers
+        pinned to its current or previous field value, so one kubelet's
+        stream costs O(its own pods), not O(all pods), and a 5k-node
+        hollow fleet doesn't turn every commit into 5k queue puts."""
         self.store = store
         self.prefix = prefix
+        self.index_field = index_field
         # explicit Lock: a bare Condition() builds its RLock inside the
         # threading module, where the lock sanitizer's creation hook
         # can't see it — the guard would be invisible to the lockset
@@ -121,7 +137,16 @@ class Cacher:
         # events <= this rv are not in the ring (bootstrap point or
         # evicted); watch-from-older falls back to the store
         self._ring_horizon = 0  # guarded-by: self._cond
-        self._watchers: List[Tuple[str, WatchStream]] = []  # guarded-by: self._cond
+        # unindexed watchers: (prefix, stream, clauses|None). Clauses,
+        # when present, pre-filter fan-out (deliver only events whose
+        # current OR previous object matches — a superset of what the
+        # downstream WatchResponse translation emits, so correctness is
+        # unchanged; only wasted queue puts disappear).
+        self._watchers: List[Tuple[str, WatchStream, Optional[list]]] = []  # guarded-by: self._cond
+        # interest index: index-field value -> [(prefix, stream)].
+        # Registered here instead of _watchers when the watcher's
+        # selector pins index_field to a known value set.
+        self._interest: Dict[str, List[Tuple[str, WatchStream]]] = {}  # guarded-by: self._cond
         self.healthy = False
         self._stopped = False
         self._feed_stream = None
@@ -156,6 +181,20 @@ class Cacher:
             name=f"watch-cache{self.prefix.rstrip('/')}",
         ).start()
 
+    def _drain_watchers_locked(self) -> List[WatchStream]:
+        """Detach and return every downstream stream (both registries),
+        deduplicated. Caller holds self._cond."""
+        streams = [s for _p, s, _c in self._watchers]
+        seen = set(map(id, streams))
+        for entries in self._interest.values():
+            for _p, s in entries:
+                if id(s) not in seen:
+                    seen.add(id(s))
+                    streams.append(s)
+        del self._watchers[:]
+        self._interest.clear()
+        return streams
+
     def stop(self) -> None:
         # monotonic shutdown flag: the feed thread polls it unlocked
         # and tolerates one stale batch  # race: allow[monotonic shutdown flag]
@@ -164,10 +203,9 @@ class Cacher:
             self._feed_stream.stop()
         with self._cond:
             self.healthy = False
-            watchers = list(self._watchers)
-            del self._watchers[:]
+            watchers = self._drain_watchers_locked()
             self._cond.notify_all()
-        for _p, w in watchers:
+        for w in watchers:
             w.stop()
 
     def _feed_dead(self) -> None:
@@ -176,17 +214,67 @@ class Cacher:
         downstream watchers into a relist."""
         with self._cond:
             self.healthy = False
-            watchers = list(self._watchers)
-            del self._watchers[:]
+            watchers = self._drain_watchers_locked()
             self._cond.notify_all()
-        for _p, s in watchers:
+        for s in watchers:
             with s._cond:
                 if not s._stopped:
                     s._overflow_locked(self._rv, 0)
 
+    @staticmethod
+    def _event_refs(ev):
+        """-> (cur, prev) read-only object refs for fan-out routing.
+        Uses the store's match refs when present so a routing decision
+        never pays a lazy decode."""
+        cur = getattr(ev, "match_object", None)
+        if cur is None:
+            cur = ev.object
+        prev = getattr(ev, "match_prev", None)
+        if prev is None and ev.type != "ADDED":
+            prev = ev.prev_object
+        return cur, prev
+
+    @classmethod
+    def _event_matches(cls, ev, clauses) -> bool:
+        """Fan-out pre-filter: does the event's current OR previous
+        object match the field clauses? A superset of what the
+        downstream selector-transition translation emits (entering and
+        leaving the filter both touch one side), so pruning on it drops
+        only events the WatchResponse would have discarded anyway."""
+        cur, prev = cls._event_refs(ev)
+        if cur is not None and matches_fields(cur, clauses):
+            return True
+        return prev is not None and matches_fields(prev, clauses)
+
+    @classmethod
+    def _event_field_values(cls, ev, field):
+        """-> (current value, previous value) of the index field."""
+        cur, prev = cls._event_refs(ev)
+        vc = lookup_field(cur, field) if cur is not None else ""
+        if prev is None or prev is cur:
+            return vc, vc
+        return vc, lookup_field(prev, field)
+
+    @staticmethod
+    def _route(targets, prefix, stream, ev) -> None:
+        """Append ev to stream's pending burst (order-preserving; a
+        stream indexed under both the event's current and previous
+        field values must still receive the event once)."""
+        ent = targets.get(id(stream))
+        if ent is None:
+            targets[id(stream)] = (stream, [ev])
+        else:
+            evs = ent[1]
+            if not evs or evs[-1] is not ev:
+                evs.append(ev)
+
     def _apply_batch(self, batch) -> None:
         """Apply a burst of store events to the snapshot + ring and fan
-        it out. Runs on the feed thread only."""
+        it out. Runs on the feed thread only. Routing happens under the
+        lock (index lookups + match-ref reads only); envelope building
+        and delivery happen after release."""
+        evicted = pruned = 0
+        targets: Dict[int, tuple] = {}  # id(stream) -> (stream, [ev])
         with self._cond:
             for ev in batch:
                 if ev.type == ERROR:
@@ -211,15 +299,43 @@ class Cacher:
                         self._ring_horizon = (
                             self._ring[0].resource_version
                         )
+                        evicted += 1
                     self._ring.append(proto)
                 else:
                     # uncachable payload: the ring would replay a shared
                     # mutable object; advance the horizon past it
                     self._ring_horizon = ev.resource_version
                 self._rv = batch[-1].resource_version
-            watchers = list(self._watchers)
+                # -- routing --
+                for prefix, stream, clauses in self._watchers:
+                    if not key.startswith(prefix):
+                        continue
+                    if clauses is not None and not self._event_matches(
+                        ev, clauses
+                    ):
+                        pruned += 1
+                        continue
+                    self._route(targets, prefix, stream, ev)
+                if self._interest:
+                    vc, vp = self._event_field_values(ev, self.index_field)
+                    hit = self._interest.get(vc)
+                    if hit:
+                        for prefix, stream in hit:
+                            if key.startswith(prefix):
+                                self._route(targets, prefix, stream, ev)
+                    if vp != vc:
+                        hit = self._interest.get(vp)
+                        if hit:
+                            for prefix, stream in hit:
+                                if key.startswith(prefix):
+                                    self._route(targets, prefix, stream,
+                                                ev)
             self._cond.notify_all()
-        for prefix, stream in watchers:
+        if evicted:
+            _evicted(evicted)
+        if pruned:
+            _pruned(pruned)
+        for stream, evs in targets.values():
             # per-watcher envelopes: lazy events refan (shared blob,
             # private decode); plain fallback events get fresh deep
             # copies so no two watchers share a mutable object
@@ -228,8 +344,7 @@ class Cacher:
                  else WatchEvent(ev.type, deep_copy(ev.object),
                                  ev.resource_version,
                                  deep_copy(ev.prev_object), key=ev.key))
-                for ev in batch
-                if getattr(ev, "key", "").startswith(prefix)
+                for ev in evs
             ]
             stream._deliver_many(burst)
 
@@ -305,12 +420,21 @@ class Cacher:
 
     # -- watch ---------------------------------------------------------------
 
-    def watch(self, prefix: str, from_rv: int = 0) -> Optional[WatchStream]:
+    def watch(self, prefix: str, from_rv: int = 0,
+              clauses: Optional[list] = None) -> Optional[WatchStream]:
         """A watch stream served from the cache's ring + fan-out.
         from_rv==0 means "from now" (freshness-synced with the store so
         a client that just wrote sees only what follows its write).
         None = the requested window predates the ring (fall back to the
-        store, which replays its own history or raises Compacted)."""
+        store, which replays its own history or raises Compacted).
+
+        clauses (parsed field-selector clauses) turn on fan-out
+        pre-filtering: when they pin index_field to a known value set
+        the stream registers in the interest index (delivery cost
+        O(matching events)); otherwise events are pre-matched against
+        the clauses on the feed thread. Either way the stream receives
+        a SUPERSET of what the downstream translation emits — the
+        WatchResponse filter stays authoritative."""
         if not self.healthy:  # race: allow[racy healthy fast-path]
             _miss()
             return None
@@ -350,16 +474,47 @@ class Cacher:
                 for proto in self._ring:
                     if (proto.resource_version > from_rv
                             and proto.key.startswith(prefix)):
+                        if clauses and not self._event_matches(proto,
+                                                               clauses):
+                            continue
                         stream._deliver(proto.refan())
-            self._watchers.append((prefix, stream))
+            interest = (
+                interest_values(clauses, self.index_field)
+                if clauses and self.index_field else None
+            )
+            if interest is not None:
+                # remembered on the stream so removal touches only its
+                # own buckets, not the whole index
+                stream._interest_keys = interest
+                for v in interest:
+                    self._interest.setdefault(v, []).append(
+                        (prefix, stream)
+                    )
+            else:
+                self._watchers.append(
+                    (prefix, stream, list(clauses) if clauses else None)
+                )
         _hit()
         return stream
 
     def _remove_watcher(self, stream: WatchStream) -> None:
         with self._cond:
-            self._watchers = [
-                (p, s) for p, s in self._watchers if s is not stream
-            ]
+            keys = getattr(stream, "_interest_keys", None)
+            if keys is not None:
+                for v in keys:
+                    entries = self._interest.get(v)
+                    if not entries:
+                        continue
+                    kept = [(p, s) for p, s in entries if s is not stream]
+                    if kept:
+                        self._interest[v] = kept
+                    else:
+                        del self._interest[v]
+            else:
+                self._watchers = [
+                    (p, s, c) for p, s, c in self._watchers
+                    if s is not stream
+                ]
 
 
 def _feed_entry(ref, stream) -> None:
@@ -368,27 +523,17 @@ def _feed_entry(ref, stream) -> None:
     bursts so a batch commit costs one lock round-trip per watcher."""
     while True:
         try:
-            ev = stream.next_event(timeout=10.0)
+            batch = stream.next_events(max_n=4096, timeout=10.0)
         except TimeoutError:
             if ref() is None:
                 stream.stop()
                 return
             continue
-        if ev is None:  # stream stopped
+        if batch is None:  # stream stopped
             cacher = ref()
             if cacher is not None and not cacher._stopped:
                 cacher._feed_dead()
             return
-        batch = [ev]
-        while len(batch) < 4096:
-            try:
-                nxt = stream.next_event(timeout=0)
-            except TimeoutError:
-                break
-            if nxt is None:
-                batch.append(None)
-                break
-            batch.append(nxt)
         ended = batch[-1] is None
         if ended:
             batch.pop()

@@ -24,10 +24,11 @@ import json
 import os
 import threading
 import time
-import uuid
 from collections import deque
 from contextvars import ContextVar
 from typing import Any, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.utils.entropy import rand_hex
 
 #: the annotation carrying the trace id across the wire (v1.3-era alpha
 #: annotation idiom, api/types.py: affinity travels the same way)
@@ -58,11 +59,13 @@ def set_enabled(on: bool) -> None:
 
 
 def new_trace_id() -> str:
-    return uuid.uuid4().hex
+    # buffered thread-local entropy, not uuid4: a urandom syscall per
+    # span id was ~0.6s of a 30k-pod wire rep under gVisor
+    return rand_hex(16)
 
 
 def _new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return rand_hex(8)
 
 
 def current_trace_id() -> Optional[str]:

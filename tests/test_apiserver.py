@@ -780,3 +780,279 @@ class TestScaleSubresource:
         assert code == 404
         assert client.resource(
             "replicasets", "default").get("g").spec.replicas == 4
+
+
+class TestInterestSelectors:
+    """The `in` field-selector extension + the fan-out interest index
+    plumbing (round 10: one hollow-fleet shard watches its whole node
+    group on ONE stream)."""
+
+    def test_in_clause_parse_and_match(self):
+        from kubernetes_tpu.apiserver.fields import (
+            format_in_clause,
+            interest_values,
+            matches_fields,
+            parse_field_selector,
+        )
+
+        text = format_in_clause("spec.nodeName", ["n1", "n2"])
+        clauses = parse_field_selector(text + ",metadata.namespace=default")
+        assert ("spec.nodeName", "in", "(n1,n2)") in clauses
+        p = Pod(
+            metadata=ObjectMeta(name="p"),
+            spec=PodSpec(containers=[Container()], node_name="n2"),
+        )
+        assert matches_fields(p, clauses)
+        p.spec.node_name = "n9"
+        assert not matches_fields(p, clauses)
+        # interest extraction: equality and `in` pin; '!=' does not
+        assert interest_values(clauses, "spec.nodeName") == {"n1", "n2"}
+        assert interest_values(
+            parse_field_selector("spec.nodeName!=n1"), "spec.nodeName"
+        ) is None
+        # intersecting pins narrow the set
+        both = parse_field_selector(
+            "spec.nodeName in (n1,n2),spec.nodeName=n2")
+        assert interest_values(both, "spec.nodeName") == {"n2"}
+
+    def test_in_selector_list_and_watch(self, api):
+        for name, node in (("a", "n1"), ("b", "n2"), ("c", "n3")):
+            api.handle(
+                "POST", "/api/v1/namespaces/default/pods",
+                body=pod_body(name, node=node),
+            )
+        code, out = api.handle(
+            "GET", "/api/v1/pods",
+            {"fieldSelector": "spec.nodeName in (n1,n3)"},
+        )
+        assert sorted(i["metadata"]["name"] for i in out["items"]) == [
+            "a", "c"]
+        # watch: only events for the pinned node set flow
+        code, watch = api.handle(
+            "GET", "/api/v1/pods",
+            {"watch": "true", "fieldSelector": "spec.nodeName in (n1,n3)"},
+        )
+        assert code == 200
+        api.handle(
+            "POST", "/api/v1/namespaces/default/pods",
+            body=pod_body("d", node="n3"),
+        )
+        api.handle(
+            "POST", "/api/v1/namespaces/default/pods",
+            body=pod_body("e", node="n2"),
+        )
+        api.handle(
+            "POST", "/api/v1/namespaces/default/pods",
+            body=pod_body("f", node="n1"),
+        )
+        seen = []
+        for ev in watch.events():
+            seen.append(ev["object"]["metadata"]["name"])
+            if len(seen) == 2:
+                break
+        assert seen == ["d", "f"]
+        watch.stop()
+
+    def test_interest_indexed_watch_registration(self, api):
+        """A spec.nodeName-pinned watch registers in the cacher's
+        interest index, not the broadcast list."""
+        api.handle(
+            "POST", "/api/v1/namespaces/default/pods",
+            body=pod_body("seed", node="n1"),
+        )
+        cacher = api._cacher_for(api.resources["pods"])
+        assert cacher is not None
+        code, watch = api.handle(
+            "GET", "/api/v1/pods",
+            {"watch": "true", "fieldSelector": "spec.nodeName=n1"},
+        )
+        assert code == 200
+        with cacher._cond:
+            assert len(cacher._watchers) == 0
+            assert set(cacher._interest) == {"n1"}
+        watch.stop()
+        # removal cleans the index bucket
+        import time as _t
+        deadline = _t.time() + 5
+        while _t.time() < deadline:
+            with cacher._cond:
+                if not cacher._interest:
+                    break
+            _t.sleep(0.05)
+        with cacher._cond:
+            assert not cacher._interest
+
+
+class TestBatchDelete:
+    def test_batch_delete_op(self, api):
+        from kubernetes_tpu.client.rest import (
+            RESTClient,
+            batch_delete_item,
+        )
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        client = RESTClient(LocalTransport(api))
+        for name in ("a", "b", "c"):
+            api.handle(
+                "POST", "/api/v1/namespaces/default/pods",
+                body=pod_body(name),
+            )
+        res = client.commit_batch([
+            batch_delete_item("pods", "a"),
+            batch_delete_item("pods", "b"),
+            batch_delete_item("pods", "nope"),
+        ])
+        assert [r["status"] for r in res] == [
+            "Success", "Success", "Failure"]
+        code, out = api.handle("GET", "/api/v1/pods")
+        assert [i["metadata"]["name"] for i in out["items"]] == ["c"]
+
+    def test_batch_delete_emits_deleted_events(self, api):
+        api.handle(
+            "POST", "/api/v1/namespaces/default/pods", body=pod_body("a")
+        )
+        code, watch = api.handle(
+            "GET", "/api/v1/pods", {"watch": "true"}
+        )
+        from kubernetes_tpu.client.rest import (
+            RESTClient,
+            batch_delete_item,
+        )
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        client = RESTClient(LocalTransport(api))
+        client.commit_batch([batch_delete_item("pods", "a")])
+        for ev in watch.events():
+            assert ev["type"] == "DELETED"
+            assert ev["object"]["metadata"]["name"] == "a"
+            break
+        watch.stop()
+
+
+class TestEventTTL:
+    """kube-apiserver --event-ttl analogue: per-bind Events expire, so
+    a sustained-traffic store can't grow without bound on Events."""
+
+    def _event_body(self, name):
+        return {
+            "kind": "Event",
+            "metadata": {"name": name},
+            "involvedObject": {"kind": "Pod", "name": "p"},
+            "reason": "Scheduled",
+            "message": "test",
+        }
+
+    def test_expired_events_swept_on_write(self, api):
+        assert api._event_ttl == 3600.0  # default 1h, like the flag
+        for nm in ("old-ev", "fresh-ev"):
+            code, _ = api.handle(
+                "POST", "/api/v1/namespaces/default/events",
+                body=self._event_body(nm),
+            )
+            assert code == 201
+        # age one event past the TTL (admission stamps now, so expiry
+        # is injected at the store) and force the sweep deadline due
+        with api.store._lock:
+            obj = api.store._data["/events/default/old-ev"][0]
+            obj.metadata.creation_timestamp = "2000-01-01T00:00:00Z"
+            # reads serve the commit-time TLV bytes; drop them so the
+            # sweep sees the aged timestamp
+            api.store._tlv_blobs.pop("/events/default/old-ev", None)
+        api._event_gc_next = 0.0
+        code, _ = api.handle(
+            "POST", "/api/v1/namespaces/default/events",
+            body=self._event_body("trigger-ev"),
+        )
+        assert code == 201
+        names = {e["metadata"]["name"] for e in api.handle(
+            "GET", "/api/v1/namespaces/default/events")[1]["items"]}
+        assert names == {"fresh-ev", "trigger-ev"}
+
+    def test_sweep_rides_bulk_create(self, api):
+        """The broadcaster's storm path is record_many -> create_many
+        (one bulk POST, not N singles): the sweep must fire there too,
+        or sustained traffic never expires anything."""
+        api.handle(
+            "POST", "/api/v1/namespaces/default/events",
+            body=self._event_body("old-ev"),
+        )
+        with api.store._lock:
+            obj = api.store._data["/events/default/old-ev"][0]
+            obj.metadata.creation_timestamp = "2000-01-01T00:00:00Z"
+            api.store._tlv_blobs.pop("/events/default/old-ev", None)
+        api._event_gc_next = 0.0
+        code, out = api.handle(
+            "POST", "/api/v1/namespaces/default/events",
+            body={"kind": "List", "items": [
+                self._event_body("bulk-0"), self._event_body("bulk-1"),
+            ]},
+        )
+        assert code == 201
+        assert all(r["status"] == "Success" for r in out["items"])
+        names = {e["metadata"]["name"] for e in api.handle(
+            "GET", "/api/v1/namespaces/default/events")[1]["items"]}
+        assert names == {"bulk-0", "bulk-1"}
+
+    def test_ttl_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_TPU_EVENT_TTL", "0")
+        api = APIServer()
+        try:
+            api.handle(
+                "POST", "/api/v1/namespaces/default/events",
+                body=self._event_body("ancient-ev"),
+            )
+            with api.store._lock:
+                obj = api.store._data["/events/default/ancient-ev"][0]
+                obj.metadata.creation_timestamp = "2000-01-01T00:00:00Z"
+                api.store._tlv_blobs.pop(
+                    "/events/default/ancient-ev", None)
+            api._event_gc_next = 0.0
+            api.handle(
+                "POST", "/api/v1/namespaces/default/events",
+                body=self._event_body("trigger-ev"),
+            )
+            items = api.handle(
+                "GET", "/api/v1/namespaces/default/events")[1]["items"]
+            assert {e["metadata"]["name"] for e in items} == {
+                "ancient-ev", "trigger-ev"}
+        finally:
+            api.close_cachers()
+
+    def test_rfc3339_epoch_rejects_garbage(self):
+        assert APIServer._rfc3339_epoch("") is None
+        assert APIServer._rfc3339_epoch("not-a-time") is None
+        assert APIServer._rfc3339_epoch(
+            "2026-08-03T10:00:00Z") == 1785751200
+
+
+def test_rand_hex_fork_reseeds():
+    """The buffered-urandom pool is fork-unsafe without the pid check:
+    a forked child inherits the parent's unconsumed buffer and would
+    mint the parent's EXACT uid/generateName stream."""
+    import os
+
+    from kubernetes_tpu.apiserver import registry as reg
+
+    # prime this thread's buffer so the child inherits unconsumed bytes
+    reg.rand_hex(8)
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child: emit what it mints, then hard-exit
+        try:
+            os.close(r)
+            os.write(w, reg.rand_hex(16).encode())
+            os.close(w)
+        finally:
+            os._exit(0)
+    os.close(w)
+    child = b""
+    while True:
+        chunk = os.read(r, 64)
+        if not chunk:
+            break
+        child += chunk
+    os.close(r)
+    os.waitpid(pid, 0)
+    parent = reg.rand_hex(16)
+    assert len(child) == 32
+    assert child.decode() != parent

@@ -647,3 +647,262 @@ class TestTransport:
         assert obj.metadata.labels == {"x": "y"}
         items, _rv = client.pods().list()
         assert any(p.metadata.name == "raw0" for p in items)
+
+
+def mkboundpod(name: str, node: str, labels=None) -> Pod:
+    p = mkpod(name, labels=labels)
+    p.spec.node_name = node
+    return p
+
+
+class TestRound10Fanout:
+    """Round 10: per-resource ring sizing + eviction accounting,
+    interest-filtered fan-out, and coalesced-burst equivalence."""
+
+    def test_ring_size_per_resource_config(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_TPU_WATCH_CACHE_SIZES",
+                           "pods=16, nodes=32, default=8, junk, bad=x")
+        api = APIServer()
+        try:
+            api.handle("POST", "/api/v1/namespaces/default/pods",
+                       body=None) if False else None
+            pods_cacher = api._cacher_for(api.resources["pods"])
+            nodes_cacher = api._cacher_for(api.resources["nodes"])
+            svc_cacher = api._cacher_for(api.resources["services"])
+            assert pods_cacher._ring.maxlen == 16
+            assert nodes_cacher._ring.maxlen == 32
+            assert svc_cacher._ring.maxlen == 8  # default= fallback
+        finally:
+            api.close_cachers()
+
+    def test_undersized_ring_evicts_counts_and_forces_relist(self):
+        """A watch storm larger than the ring must EVICT (counted) and
+        force a resuming watcher into the store fallback / relist path
+        — never a silently truncated replay."""
+        from kubernetes_tpu.metrics import (
+            storage_watch_cache_ring_evictions_total,
+        )
+
+        store = MemoryStore()
+        store.create("/pods/default/seed", mkpod("seed"))
+        cacher = Cacher(store, "/pods/", ring_size=8)
+        assert cacher.list_entries("/pods/") is not None  # bootstrap
+        rv0 = store.list("/pods/")[1]
+        assert rv0 >= 1
+        before = storage_watch_cache_ring_evictions_total.get()
+        for i in range(40):
+            store.create(f"/pods/default/storm-{i:03d}",
+                         mkpod(f"storm-{i:03d}"))
+        # wait for the feed to absorb the burst (read _rv under its
+        # guard: the feed thread writes it under _cond)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with cacher._cond:
+                if cacher._rv >= rv0 + 40:
+                    break
+            time.sleep(0.01)
+        assert storage_watch_cache_ring_evictions_total.get() - before >= 32
+        # resuming from before the evicted horizon: cacher refuses
+        # (None -> store fallback), it must not replay a truncated ring
+        assert cacher.watch("/pods/", from_rv=rv0) is None
+        # the store fallback path surfaces Compacted when ITS window is
+        # also gone -> the reflector relists; either way the final
+        # state is complete
+        try:
+            stream = store.watch("/pods/", from_rv=rv0)
+            got = drain(stream, 40)
+            assert len(got) == 40
+            stream.stop()
+        except Compacted:
+            pass
+        objs, _rv = store.list("/pods/")
+        assert len(objs) == 41
+        cacher.stop()
+
+    def _fuzz_ops(self, rng, client, nodes, serial):
+        """One randomized writer step through the REAL doors: bulk
+        create (bound or pending), batch status merge, batch delete."""
+        from kubernetes_tpu.client.rest import batch_delete_item
+
+        op = rng.random()
+        if op < 0.45:
+            names = [f"fz-{serial:04d}-{j}" for j in range(rng.randrange(1, 4))]
+            objs = []
+            for nm in names:
+                node = rng.choice(nodes + [""])
+                objs.append(mkboundpod(nm, node) if node else mkpod(nm))
+            client.pods().create_many(objs)
+            return names
+        existing, _rv = client.pods().list()
+        if not existing:
+            return []
+        if op < 0.75:
+            victims = rng.sample(existing, min(len(existing),
+                                               rng.randrange(1, 3)))
+            client.commit_batch([
+                batch_status_item("pods", p.metadata.name,
+                                  {"phase": rng.choice(["Running",
+                                                        "Pending"])})
+                for p in victims
+            ])
+        else:
+            victims = rng.sample(existing, min(len(existing),
+                                               rng.randrange(1, 3)))
+            client.commit_batch([
+                batch_delete_item("pods", p.metadata.name)
+                for p in victims
+            ])
+        return []
+
+    def _drain_to_sentinel(self, stream, sentinel):
+        """Consume watch events into a name -> (phase, node) dict until
+        the sentinel pod arrives; DELETED removes."""
+        state = {}
+        for ev_type, obj in stream:
+            name = obj.metadata.name
+            if ev_type == "DELETED":
+                state.pop(name, None)
+            else:
+                state[name] = (obj.status.phase, obj.spec.node_name)
+            if name == sentinel:
+                break
+        return state
+
+    def test_fuzz_coalesced_vs_per_event_frames(self, monkeypatch):
+        """Coalescing ON and OFF streams reconstruct IDENTICAL final
+        states from an identical randomized writer interleaving — the
+        burst envelope is transport, not semantics."""
+        rng = random.Random(42)
+        api = APIServer()
+        host, port = api.serve_http(enable_binary=True)
+        client = RESTClient(HTTPTransport(f"http://{host}:{port}",
+                                          binary=True))
+        try:
+            from kubernetes_tpu.metrics import (
+                apiserver_watch_coalesced_frame_objects as _frames,
+            )
+
+            monkeypatch.setenv("KUBERNETES_TPU_WATCH_COALESCE", "1")
+            w_on = client.pods().watch(resource_version="0")
+            # prime w_on past the handler's env read: the server
+            # evaluates KUBERNETES_TPU_WATCH_COALESCE on its own
+            # thread after the response headers, so flipping the var
+            # immediately could land before w_on's handler sampled it
+            # (both streams would silently run uncoalesced). A priming
+            # pod must produce a COALESCED frame (w_on is the only
+            # watcher) before the flip; the prime event stays queued on
+            # the stream — the drain consumes it later.
+            c0 = _frames.count
+            client.pods().create(mkpod("aa-prime"))
+            deadline = time.time() + 10
+            while _frames.count == c0 and time.time() < deadline:
+                time.sleep(0.01)
+            assert _frames.count > c0, (
+                "w_on never emitted a coalesced frame — coalescing is "
+                "off at the server or the handler has not sampled env"
+            )
+            monkeypatch.setenv("KUBERNETES_TPU_WATCH_COALESCE", "0")
+            w_off = client.pods().watch(resource_version="0")
+            # deleted before the fuzz: neither reconstruction nor the
+            # server's final state should carry the priming pod (w_on
+            # drains ADDED then DELETED — a net no-op; w_off sees at
+            # most the DELETED, also a no-op)
+            client.pods().delete("aa-prime")
+            for step in range(40):
+                self._fuzz_ops(rng, client, ["n1", "n2", "n3"], step)
+            client.pods().create(mkpod("zz-sentinel"))
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(2) as ex:
+                f_on = ex.submit(self._drain_to_sentinel, w_on,
+                                 "zz-sentinel")
+                f_off = ex.submit(self._drain_to_sentinel, w_off,
+                                  "zz-sentinel")
+                state_on = f_on.result(timeout=30)
+                state_off = f_off.result(timeout=30)
+            assert state_on == state_off
+            # and both converge to the server's final state
+            final = {
+                p.metadata.name: (p.status.phase, p.spec.node_name)
+                for p in client.pods().list()[0]
+            }
+            assert state_on == final
+            w_on.stop()
+            w_off.stop()
+        finally:
+            client.transport.close()
+            api.shutdown_http()
+            api.close_cachers()
+
+    def test_fuzz_server_filtered_vs_client_filtered(self):
+        """A spec.nodeName-in-(...) server-filtered stream must
+        reconstruct exactly the state a client filtering the FULL
+        stream reconstructs, across randomized interleavings that move
+        pods in and out of the interest set."""
+        rng = random.Random(7)
+        api = APIServer()
+        host, port = api.serve_http(enable_binary=True)
+        client = RESTClient(HTTPTransport(f"http://{host}:{port}",
+                                          binary=True))
+        want = {"n1", "n2"}
+        try:
+            w_filt = client.pods().watch(
+                resource_version="0",
+                field_selector="spec.nodeName in (n1,n2)",
+            )
+            w_full = client.pods().watch(resource_version="0")
+            for step in range(40):
+                self._fuzz_ops(rng, client, ["n1", "n2", "n3", "n4"],
+                               step)
+            client.pods().create(mkboundpod("zz-sentinel", "n1"))
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(2) as ex:
+                f_filt = ex.submit(self._drain_to_sentinel, w_filt,
+                                   "zz-sentinel")
+                f_full = ex.submit(self._drain_to_sentinel, w_full,
+                                   "zz-sentinel")
+                state_filt = f_filt.result(timeout=30)
+                state_full = f_full.result(timeout=30)
+            client_filtered = {
+                nm: st for nm, st in state_full.items() if st[1] in want
+            }
+            assert state_filt == client_filtered
+            final = {
+                p.metadata.name: (p.status.phase, p.spec.node_name)
+                for p in client.pods().list()[0]
+                if p.spec.node_name in want
+            }
+            assert state_filt == final
+            w_filt.stop()
+            w_full.stop()
+        finally:
+            client.transport.close()
+            api.shutdown_http()
+            api.close_cachers()
+
+    def test_burst_frame_roundtrip(self):
+        """coalesce_burst/iter_burst invert each other and reject
+        truncation/trailing garbage."""
+        from kubernetes_tpu.runtime import binary, tlv
+
+        items = [
+            ("ADDED", tlv.dumps({"metadata": {"name": "a"}})),
+            ("MODIFIED", tlv.dumps({"metadata": {"name": "b"},
+                                    "status": {"phase": "Running"}})),
+            ("DELETED", tlv.dumps({"metadata": {"name": "c"}})),
+        ]
+        frame = binary.coalesce_burst(items)
+        import struct
+
+        (size,) = struct.unpack_from("<I", frame, 0)
+        body = frame[4:]
+        assert len(body) == size
+        assert body.startswith(binary.MAGIC_BURST)
+        evs = list(binary.iter_burst(body))
+        assert [e["type"] for e in evs] == ["ADDED", "MODIFIED", "DELETED"]
+        assert evs[1]["object"]["status"]["phase"] == "Running"
+        with pytest.raises(binary.BinaryDecodeError):
+            list(binary.iter_burst(body[:-3]))
+        with pytest.raises(binary.BinaryDecodeError):
+            list(binary.iter_burst(body + b"xx"))

@@ -463,3 +463,100 @@ def test_watch_cache_hit_rate_gate():
         sched.stop()
         cluster.stop()
         api.close_cachers()
+
+
+def test_hollow_kubelet_stream_o_own_pods_gate():
+    """STRUCTURAL gate on watch fan-out (the round-10 interest index):
+    events DELIVERED to one hollow kubelet's stream scale with ITS OWN
+    pods — doubling unrelated pods may not grow its stream. Counted at
+    the raw stream (pre-filter), so a regression to broadcast fan-out
+    + per-watcher filtering fails even though the filtered output
+    would still look right."""
+    from kubernetes_tpu.api.types import Node, NodeCondition, NodeStatus
+
+    api = APIServer()
+    client = RESTClient(LocalTransport(api))
+    for nm in ("own-node", "other-0", "other-1"):
+        client.nodes().create(Node(
+            metadata=ObjectMeta(name=nm),
+            status=NodeStatus(
+                allocatable={"cpu": "64", "memory": "256Gi",
+                             "pods": "2000"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+
+    def bound_pod(name, node):
+        p = _pod(0)
+        p.metadata.name = name
+        p.spec.node_name = node
+        return p
+
+    code, watch = api.handle(
+        "GET", "/api/v1/pods",
+        {"watch": "true", "fieldSelector": "spec.nodeName=own-node"},
+    )
+    assert code == 200
+    raw = {"n": 0}
+    orig_next = watch.stream.next_events
+
+    def counting_next(max_n=0, timeout=None):
+        evs = orig_next(max_n=max_n, timeout=timeout)
+        # count raw DELIVERIES into this stream's queue (None entries
+        # are stop markers, not deliveries)
+        if evs is not None:
+            raw["n"] += sum(1 for e in evs if e is not None)
+        return evs
+
+    watch.stream.next_events = counting_next
+
+    def drain_until(sentinel, deadline=15.0):
+        t0 = time.time()
+        for ev in watch.events(idle_timeout=0.2):
+            if ev is None:
+                if time.time() - t0 > deadline:
+                    raise AssertionError(f"never saw {sentinel}")
+                continue
+            if ev["object"]["metadata"]["name"] == sentinel:
+                return
+
+    try:
+        OWN, UNRELATED = 8, 100
+        for i in range(OWN):
+            client.pods().create(bound_pod(f"own-{i:03d}", "own-node"))
+        for i in range(UNRELATED):
+            client.pods().create(
+                bound_pod(f"noise-a-{i:03d}", f"other-{i % 2}"))
+        client.pods().create(bound_pod("own-sentinel-a", "own-node"))
+        drain_until("own-sentinel-a")
+        raw_a = raw["n"]
+        # anti-vacuity: the counter must have seen the own pods — if
+        # the consumption path stops routing through next_events the
+        # hook goes dead and this gate would pass on a frozen zero
+        assert raw_a >= OWN + 1, (
+            f"raw-delivery counter saw only {raw_a} events for "
+            f"{OWN}+1 own pods — the counting hook is not on the "
+            "stream's consumption path"
+        )
+        # DOUBLE the unrelated pods: the stream may not grow
+        for i in range(2 * UNRELATED):
+            client.pods().create(
+                bound_pod(f"noise-b-{i:03d}", f"other-{i % 2}"))
+        client.pods().create(bound_pod("own-sentinel-b", "own-node"))
+        drain_until("own-sentinel-b")
+        raw_b = raw["n"] - raw_a
+        # phase A delivered the OWN pods (+ sentinel + idle probes);
+        # broadcast fan-out would have delivered ~109
+        assert raw_a <= OWN + 1 + 10, (
+            f"{raw_a} raw deliveries for {OWN} own pods — fan-out is "
+            "not interest-filtered"
+        )
+        # phase B created 200 unrelated pods and ONE own pod: only the
+        # own sentinel (+ idle probes) may reach this stream
+        assert raw_b <= 1 + 10, (
+            f"{raw_b} raw deliveries after doubling unrelated pods — "
+            "one kubelet's stream must cost O(its own pods)"
+        )
+    finally:
+        watch.stop()
+        api.close_cachers()
