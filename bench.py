@@ -450,7 +450,8 @@ def _assert_sanitizers_off():
 
 
 def run_wire_soak(seconds: int, num_nodes: int = 1000,
-                  rate: float = 300.0, slo: float = 5.0):
+                  rate: float = 300.0, slo: float = 5.0,
+                  store_profile: str = "memory"):
     """Sustained-traffic WIRE soak (ROADMAP scale-out item (b)):
     Poisson continuous arrivals through the full wire path —
     apiserver (TLV/HTTP) -> scheduler daemon -> batched bind ->
@@ -515,11 +516,48 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     )
 
 
-    api = APIServer()
-    host, port = api.serve_http(enable_binary=True)
-    url = f"http://{host}:{port}"
-    print(f"# wire-soak: apiserver (in-process TLV/HTTP wire) at {url}",
-          file=sys.stderr)
+    quorum_stores = []
+    api2 = None
+    if store_profile == "quorum":
+        # multi-apiserver HA profile: a 3-member consensus store with
+        # TWO apiservers over it — one on the leader member (the hot
+        # path), one on a follower (every write it takes is forwarded
+        # to the leader; reads barrier through read-index). The
+        # creator drives the follower so the forwarding path carries
+        # the arrival stream; scheduler + fleet ride the leader.
+        import tempfile
+
+        from kubernetes_tpu.storage.quorum import build_cluster
+
+        qdir = tempfile.mkdtemp(prefix="quorum-soak-")
+        quorum_stores = build_cluster(qdir, 3)
+        deadline_q = time.time() + 30
+        leader_store = None
+        while time.time() < deadline_q and leader_store is None:
+            leader_store = next(
+                (s for s in quorum_stores if s.node.is_leader()), None)
+            time.sleep(0.05)
+        if leader_store is None:
+            raise RuntimeError("quorum never elected a leader")
+        follower_store = next(s for s in quorum_stores
+                              if s is not leader_store)
+        api = APIServer(store=leader_store)
+        api2 = APIServer(store=follower_store)
+        host, port = api.serve_http(enable_binary=True)
+        h2, p2 = api2.serve_http(enable_binary=True)
+        url = f"http://{host}:{port},http://{h2}:{p2}"
+        creator_url = f"http://{h2}:{p2},http://{host}:{port}"
+        print(f"# wire-soak: QUORUM store ({len(quorum_stores)} "
+              f"members, leader {leader_store.node_id}); apiservers "
+              f"at {url} (scheduler/fleet -> leader, creator -> "
+              "forwarding follower)", file=sys.stderr)
+    else:
+        api = APIServer()
+        host, port = api.serve_http(enable_binary=True)
+        url = f"http://{host}:{port}"
+        creator_url = url
+        print(f"# wire-soak: apiserver (in-process TLV/HTTP wire) at "
+              f"{url}", file=sys.stderr)
     sentinel = CompileSentinel()
     # fleet first: the scheduler's warmup compiles against the node
     # count its informer sees, so the hollow nodes must be registered
@@ -543,7 +581,8 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     if not sched.ready.wait(600):
         raise RuntimeError("scheduler daemon never became ready")
 
-    client = RESTClient(HTTPTransport(url, binary=True, timeout=180.0))
+    client = RESTClient(HTTPTransport(creator_url, binary=True,
+                                      timeout=180.0))
     stop = threading.Event()
     lock = threading.Lock()
     created: dict = {}          # name -> create time (unbound pods)
@@ -705,7 +744,21 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     ]
 
     def snap_counters():
+        if quorum_stores:
+            from kubernetes_tpu.metrics import (
+                quorum_leader_changes_total,
+                quorum_snapshot_installs_total,
+            )
+
+            quorum_extra = {
+                "leader_changes": quorum_leader_changes_total.total(),
+                "snapshot_installs":
+                    quorum_snapshot_installs_total.get(),
+            }
+        else:
+            quorum_extra = {}
         return {
+            "quorum": quorum_extra,
             "requests": apiserver_requests_total.total(),
             "events_sent": apiserver_watch_events_sent_total.get(),
             "cache_hits": apiserver_watch_cache_hits_total.get(),
@@ -725,7 +778,8 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     record = {"metric": "wire_soak", "seconds": seconds,
               "hollow_nodes": num_nodes,
               "arrival_rate_pods_per_sec": rate,
-              "slo_p99_seconds": slo}
+              "slo_p99_seconds": slo,
+              "store_profile": store_profile}
     try:
         for th in threads:
             th.start()
@@ -780,6 +834,14 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
         sched.stop()
         api.shutdown_http()
         api.close_cachers()
+        if api2 is not None:
+            api2.shutdown_http()
+            api2.close_cachers()
+        for qs in quorum_stores:
+            try:
+                qs.close()
+            except Exception:
+                pass
         for c in (sched_client, fleet_client, client):
             try:
                 c.transport.close()
@@ -799,7 +861,8 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
                                     int(q * len(steady_lat)))], 4)
 
     p50, p99 = pct(0.50), pct(0.99)
-    d = {k: end[k] - base[k] for k in end if k != "fleet"}
+    d = {k: end[k] - base[k] for k in end
+         if k not in ("fleet", "quorum")}
     fleet_d = {k: end["fleet"][k] - base["fleet"][k]
                for k in end["fleet"]}
     rss_base = statistics.median(rss_samples[:5])
@@ -843,6 +906,23 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
             "fleet_relists": int(fleet_d["relists"]),
         },
     })
+    if quorum_stores:
+        from kubernetes_tpu.metrics import quorum_append_rtt_seconds
+
+        record["quorum_accounting"] = {
+            "members": len(quorum_stores),
+            "steady_leader_changes": int(
+                end["quorum"]["leader_changes"]
+                - base["quorum"]["leader_changes"]),
+            "steady_snapshot_installs": int(
+                end["quorum"]["snapshot_installs"]
+                - base["quorum"]["snapshot_installs"]),
+            "append_rtt_p50_seconds":
+                quorum_append_rtt_seconds.percentile(0.50),
+            "append_rtt_p99_seconds":
+                quorum_append_rtt_seconds.percentile(0.99),
+            "statuses": [s.quorum_status() for s in quorum_stores],
+        }
     gates = {
         "p99_within_slo": bool(steady_lat) and p99 <= slo,
         "zero_steady_state_compiles": d["compiles"] == 0,
@@ -852,7 +932,11 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     record["gates"] = gates
     record["ok"] = all(gates.values())
     print(json.dumps(record))
-    _bench_merge({"wire_soak": record})
+    # each store profile owns its key: the quorum HA record must not
+    # clobber the single-store baseline (or vice versa)
+    soak_key = ("wire_soak" if store_profile == "memory"
+                else f"wire_soak_{store_profile}")
+    _bench_merge({soak_key: record})
     if not record["ok"]:
         breached = [k for k, v in gates.items() if not v]
         print(f"# WIRE-SOAK GATE BREACH: {', '.join(breached)}",
@@ -1150,10 +1234,19 @@ def _cli():
         help="steady-state p99 created->bound SLO for --wire-soak "
              "(default 5.0s)",
     )
+    ap.add_argument(
+        "--wire-soak-store", default="memory",
+        choices=["memory", "quorum"],
+        help="store profile for --wire-soak: 'memory' (single "
+             "apiserver, in-process store) or 'quorum' (3-member "
+             "consensus store behind TWO apiservers — leader + "
+             "forwarding follower; the multi-apiserver HA smoke)",
+    )
     args = ap.parse_args()
     if args.wire_soak:
         run_wire_soak(args.wire_soak, num_nodes=args.wire_soak_nodes,
-                      rate=args.wire_soak_rate, slo=args.wire_soak_slo)
+                      rate=args.wire_soak_rate, slo=args.wire_soak_slo,
+                      store_profile=args.wire_soak_store)
         return
     if args.soak:
         # the mesh needs >=2 devices; re-exec once with the forced

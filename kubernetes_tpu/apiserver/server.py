@@ -628,6 +628,12 @@ class APIServer:
     def _handle(self, method, path, query, body, obj_mode=False,
                 raw_mode=False):
         if path == "/healthz":
+            # quorum-backed servers surface their member's identity
+            # (role / leader / term) so operators and probes can tell
+            # WHICH member answered — the etcd /health + leader idiom
+            status_fn = getattr(self.store, "quorum_status", None)
+            if status_fn is not None:
+                return 200, {"ok": True, "quorum": status_fn()}
             return 200, {"ok": True}
         if path in ("/ui", "/ui/"):
             from kubernetes_tpu.apiserver.ui import UI_HTML

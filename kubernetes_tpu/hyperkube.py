@@ -46,7 +46,44 @@ def run_apiserver(args) -> None:
 
     store = None
     monitor = None
-    if getattr(args, "standby_of", ""):
+    if getattr(args, "store", "") == "quorum":
+        # HA profile: this apiserver embeds ONE member of a 3+ node
+        # majority-ack consensus store; any member takes client
+        # traffic (followers forward writes / barrier reads)
+        from kubernetes_tpu.storage.quorum import NodeConfig, QuorumStore
+
+        if not args.data_dir:
+            raise SystemExit("--store=quorum requires --data-dir")
+        if not args.quorum_id:
+            raise SystemExit("--store=quorum requires --quorum-id")
+        peers = {}
+        for part in (args.quorum_peers or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            pid, _, addr = part.partition("=")
+            pid = pid.strip()
+            if pid == args.quorum_id:
+                # operators naturally deploy ONE symmetric member
+                # list; a node must not count itself as its own peer
+                # (majority math and a self-replicator would break)
+                continue
+            phost, _, pport = addr.rpartition(":")
+            peers[pid] = (phost, int(pport))
+        store = QuorumStore(NodeConfig(
+            node_id=args.quorum_id,
+            data_dir=args.data_dir,
+            peers=peers,
+            listen_port=args.quorum_listen,
+        )).start()
+        print(f"quorum member {args.quorum_id} peering on "
+              f"{store.address[0]}:{store.address[1]} "
+              f"({len(peers)} peers)", flush=True)
+        if not store.wait_leader(60):
+            print("warning: no quorum leader emerged within 60s "
+                  "(serving anyway; writes 503 until a majority "
+                  "connects)", flush=True)
+    elif getattr(args, "standby_of", ""):
         # HA standby: WAL-shipped follower + promotion on primary loss
         from kubernetes_tpu.storage.replicated import (
             FollowerStore,
@@ -372,6 +409,28 @@ def main(argv=None):
         help="comma-separated admission plugin chain (e.g. "
         "NamespaceLifecycle,AlwaysPullImages,SecurityContextDeny,"
         "LimitRanger,InitialResources,ResourceQuota)",
+    )
+    p.add_argument(
+        "--store", default="", choices=["", "quorum"],
+        help="storage profile: '' = single-node (memory, or durable "
+        "with --data-dir); 'quorum' = one member of a 3+ node "
+        "majority-ack consensus store (leader election, log "
+        "replication, linearizable reads; requires --data-dir, "
+        "--quorum-id and --quorum-peers)",
+    )
+    p.add_argument(
+        "--quorum-id", default="",
+        help="this member's node id in the quorum (e.g. q0)",
+    )
+    p.add_argument(
+        "--quorum-listen", type=int, default=0, metavar="PORT",
+        help="peer-RPC listen port for --store=quorum (0 = ephemeral; "
+        "fixed ports let peers find each other across restarts)",
+    )
+    p.add_argument(
+        "--quorum-peers", default="", metavar="ID=HOST:PORT,...",
+        help="the OTHER quorum members' peer-RPC addresses, e.g. "
+        "q1=127.0.0.1:7001,q2=127.0.0.1:7002",
     )
     p.add_argument(
         "--replicate-listen", type=int, default=None, metavar="PORT",

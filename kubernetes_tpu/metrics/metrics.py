@@ -617,3 +617,52 @@ client_events_discarded_total = registry.register(
         "Events discarded by the client event spam filter",
     )
 )
+
+# -- quorum consensus store (storage/quorum, the etcd3 cluster analogue) ------
+
+#: current raft term per quorum member (several members can share one
+#: process in tests/bench, so the family is keyed by node id)
+quorum_term = registry.register(
+    GaugeVec(
+        "quorum_term",
+        "Current raft term of each quorum store member",
+        label="node",
+    )
+)
+
+#: highest log index known committed (majority-replicated) per member
+quorum_commit_index = registry.register(
+    GaugeVec(
+        "quorum_commit_index",
+        "Highest committed raft log index of each quorum store member",
+        label="node",
+    )
+)
+
+#: elections won, labeled by the winning node — a hot counter means
+#: the cluster is churning leaders (timeouts too tight for the link,
+#: or a flapping partition)
+quorum_leader_changes_total = registry.register(
+    Counter(
+        "quorum_leader_changes_total",
+        "Quorum leader elections won, labeled by the winning node",
+    )
+)
+
+#: one AppendEntries round trip (leader -> follower -> reply), the
+#: replication half of every acked write's latency
+quorum_append_rtt_seconds = registry.register(
+    Histogram(
+        "quorum_append_rtt_seconds",
+        "AppendEntries round-trip seconds from leader to one follower",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: snapshot installs shipped to lagging or fresh followers
+quorum_snapshot_installs_total = registry.register(
+    Counter(
+        "quorum_snapshot_installs_total",
+        "Raft snapshots installed onto lagging or fresh quorum members",
+    )
+)

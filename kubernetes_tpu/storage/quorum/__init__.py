@@ -1,0 +1,47 @@
+"""Quorum consensus control-plane store (the etcd3 cluster analogue).
+
+The reference runs its whole control plane on a raft quorum: every
+write is replicated to a majority of etcd members before it is
+acknowledged, leader election happens INSIDE the store, and reads can
+be made linearizable by confirming leadership first (etcd's ReadIndex).
+storage/replicated.py approximated this with a 2-node WAL-shipping
+pair and an *external* promotion monitor — which leaves a split-brain
+window under partition. This package closes it with a 3+ node
+majority-ack consensus store (Raft-shaped):
+
+  * ``RaftLog`` (log.py): durable term/vote + entry log + state
+    snapshot, reusing the durable store's length+CRC+TLV record
+    framing and torn-tail recovery contract.
+  * ``QuorumNode`` (node.py): randomized-timeout leader election with
+    persisted votes, per-follower next/match replication with
+    commit-on-majority-ack, snapshot install for lagging or fresh
+    followers, and read-index leadership confirmation.
+  * ``QuorumStore`` (store.py): the storage.Interface facade — slots
+    in behind the MemoryStore contract so the apiserver, cacher,
+    scheduler and kubectl run against it unchanged; any node takes
+    client traffic (followers forward writes and barrier reads to the
+    leader).
+  * ``linearize`` : the Jepsen-lite op-history recorder + checker the
+    chaos suite asserts with.
+
+`build_cluster` / `build_store` are the convenience constructors the
+hyperkube --store=quorum profile and the bench wire-soak use.
+"""
+
+from kubernetes_tpu.storage.quorum.node import (
+    NodeConfig,
+    QuorumNode,
+    QuorumUnavailable,
+)
+from kubernetes_tpu.storage.quorum.store import (
+    QuorumStore,
+    build_cluster,
+)
+
+__all__ = [
+    "NodeConfig",
+    "QuorumNode",
+    "QuorumStore",
+    "QuorumUnavailable",
+    "build_cluster",
+]

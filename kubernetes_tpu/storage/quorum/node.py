@@ -1,0 +1,748 @@
+"""QuorumNode: the Raft-shaped consensus member under QuorumStore.
+
+One node = one RaftLog (durable term/vote/entries/snapshot), one
+PeerServer (votes, appends, snapshot installs, forwarded client ops),
+and four kinds of threads:
+
+  * a **ticker** that fires elections on randomized timeouts
+    (follower/candidate silence -> candidacy; terms + persisted votes
+    guarantee at most one leader per term),
+  * one **replicator per peer** (leader only): AppendEntries with
+    per-follower next/match indices, decrement-on-conflict backoff,
+    and a snapshot install when the follower's next index has been
+    compacted out of the log window,
+  * an **apply loop**, the only mutator of the state machine: applies
+    committed entries in order via ``apply_fn``, installs leader-sent
+    snapshots via ``install_fn``, and compacts the raft log through
+    ``state_fn`` every ``snapshot_every`` applied entries,
+  * the PeerServer's per-connection handler threads.
+
+Commit = majority match on an entry of the current term (the leader's
+own durable append counts). Linearizable reads ride ``read_barrier``:
+the leader captures commit_index as the read index, confirms it still
+leads with one round of heartbeats carrying a confirm sequence number,
+then waits until the read index is applied — a read served after the
+barrier can never be a deposed leader's stale view (the etcd3
+ReadIndex protocol). Followers forward the barrier and then wait for
+their own apply position to pass the returned index.
+
+The node knows nothing about the storage.Interface: payloads are
+opaque bytes the store evaluates and applies. That keeps every
+consensus decision testable with byte payloads and fault-injected
+sockets, independent of the object model above it.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.analysis import races as _races
+from kubernetes_tpu.metrics import (
+    quorum_append_rtt_seconds,
+    quorum_commit_index,
+    quorum_leader_changes_total,
+    quorum_snapshot_installs_total,
+    quorum_term,
+)
+from kubernetes_tpu.storage.quorum.log import Entry, RaftLog
+from kubernetes_tpu.storage.quorum.rpc import PeerClient, PeerServer, RPCError
+from kubernetes_tpu.storage.replicated import NotPrimary
+
+log = logging.getLogger(__name__)
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class QuorumUnavailable(NotPrimary):
+    """No leader reachable / no majority: the write or linearizable
+    read cannot be served right now. Subclasses NotPrimary so the
+    apiserver's existing 503 mapping applies — clients retry through
+    transport failover onto a node that can reach the leader."""
+
+
+class NotLeader(QuorumUnavailable):
+    """This node is not the leader; carries the best leader hint."""
+
+    def __init__(self, msg: str, leader_id: str = ""):
+        super().__init__(msg)
+        self.leader_id = leader_id
+
+
+@dataclass
+class NodeConfig:
+    node_id: str
+    data_dir: str
+    #: peer id -> (host, port) of the peer's RPC listener; does NOT
+    #: include this node. May be rewired (nemesis proxies) before start.
+    peers: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0
+    #: base election timeout; each reset re-rolls uniform [T, 2T].
+    #: etcd's defaults (1s election / 100ms heartbeat): tight enough
+    #: for sub-2s failover, loose enough that GIL stalls under a
+    #: co-located traffic burst don't read as leader death (tests
+    #: that WANT fast elections pass ~0.15-0.2 explicitly)
+    election_timeout: float = 1.0
+    heartbeat_interval: float = 0.1
+    rpc_timeout: float = 1.0
+    #: applied entries between raft-log compactions
+    snapshot_every: int = 4096
+    fsync: bool = False
+
+
+class QuorumNode:
+    def __init__(self, config: NodeConfig,
+                 apply_fn: Callable[[bytes, int], None],
+                 install_fn: Callable[[bytes], None],
+                 state_fn: Callable[[], bytes],
+                 client_fn: Optional[Callable[[Any], Any]] = None):
+        self.config = config
+        self.node_id = config.node_id
+        self.apply_fn = apply_fn
+        self.install_fn = install_fn
+        self.state_fn = state_fn
+        #: handler for forwarded client ops (set by QuorumStore)
+        self.client_fn = client_fn
+        self.raft_log = RaftLog(config.data_dir, fsync=config.fsync)
+
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.role = FOLLOWER  # guarded-by: self._mu
+        self.leader_id = ""  # guarded-by: self._mu
+        self.commit_index = self.raft_log.snap_index  # guarded-by: self._mu
+        self.applied_index = self.raft_log.snap_index  # guarded-by: self._mu
+        self._next_index: Dict[str, int] = {}  # guarded-by: self._mu
+        self._match_index: Dict[str, int] = {}  # guarded-by: self._mu
+        #: read-index confirmation round: barrier bumps the seq, every
+        #: heartbeat carries the latest, replies record it per peer
+        self._confirm_seq = 0  # guarded-by: self._mu
+        self._confirm_acked: Dict[str, int] = {}  # guarded-by: self._mu
+        #: first index of the current leadership term (the no-op);
+        #: read barriers wait for it to commit (Raft §8: a new leader
+        #: may not know the commit frontier until its own term commits)
+        self._term_start_index = 0  # guarded-by: self._mu
+        self._votes: set = set()  # guarded-by: self._mu
+        self._last_contact = time.monotonic()  # guarded-by: self._mu
+        self._timeout = self._roll_timeout()  # guarded-by: self._mu
+        self._force_compact = False  # guarded-by: self._mu
+        self._pending_snap: Optional[Tuple[int, bytes]] = None  # guarded-by: self._mu
+        #: terms in which THIS node won an election (chaos suite
+        #: aggregates across nodes: a term may appear on at most one)
+        self.terms_led: List[int] = []  # guarded-by: self._mu
+        self._stopped = threading.Event()
+        self._killed = False  # guarded-by: self._mu
+
+        # restore the state machine from the raft snapshot before any
+        # entry applies (a restarted node replays committed entries on
+        # top of this; commit_index itself is not persisted — the next
+        # leader's term commit re-establishes the frontier)
+        _si, _st, blob = self.raft_log.snapshot()
+        if blob is not None:
+            self.install_fn(blob)
+
+        self._server = PeerServer(self._dispatch, host=config.listen_host,
+                                  port=config.listen_port)
+        self.address = self._server.address
+        self._repl_clients: Dict[str, PeerClient] = {}
+        self._vote_clients: Dict[str, PeerClient] = {}
+        self._threads: List[threading.Thread] = []
+        _races.track(self, "quorum.QuorumNode")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def set_peers(self, peers: Dict[str, Tuple[str, int]]) -> None:
+        """Wire (or re-wire) peer addresses before start(). Separate
+        from __init__ so a cluster can bind all listeners first, then
+        exchange addresses — and so tests can splice a nemesis proxy
+        into any edge."""
+        self.config.peers = {
+            pid: tuple(addr) for pid, addr in peers.items()
+            if pid != self.node_id
+        }
+
+    def start(self) -> "QuorumNode":
+        to = self.config.rpc_timeout
+        self._repl_clients = {
+            pid: PeerClient(addr, timeout=to)
+            for pid, addr in self.config.peers.items()
+        }
+        # elections must not queue behind an in-flight replication
+        # call on the shared per-peer socket: separate ballot clients
+        self._vote_clients = {
+            pid: PeerClient(addr, timeout=to)
+            for pid, addr in self.config.peers.items()
+        }
+        # only now may peer/client messages arrive: every owner
+        # (node AND the store wrapping it) finished construction
+        self._server.serve()
+        self._threads = [
+            threading.Thread(target=self._ticker, daemon=True,
+                             name=f"quorum-tick-{self.node_id}"),
+            threading.Thread(target=self._apply_loop, daemon=True,
+                             name=f"quorum-apply-{self.node_id}"),
+        ]
+        for pid in self.config.peers:
+            self._threads.append(threading.Thread(
+                target=self._replicator, args=(pid,), daemon=True,
+                name=f"quorum-repl-{self.node_id}-{pid}"))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def kill(self) -> None:
+        """Simulated kill -9: sever every socket and stop every thread
+        without flushing anything beyond what is already durable. A
+        fresh node on the same data_dir is the restart."""
+        with self._mu:
+            self._killed = True
+            self._cv.notify_all()
+        self._stopped.set()
+        self._server.close()
+        for c in list(self._repl_clients.values()) + \
+                list(self._vote_clients.values()):
+            c.close()
+        self.raft_log.close()
+
+    def close(self) -> None:
+        """Graceful stop (same surface; the raft log is append-durable
+        at every commit, so there is nothing extra to flush)."""
+        self.kill()
+
+    # -- observers -----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._mu:
+            return self.role == LEADER
+
+    def leader_hint(self) -> str:
+        with self._mu:
+            return self.leader_id if self.role != LEADER else self.node_id
+
+    def status(self) -> Dict[str, Any]:
+        """Identity block for /healthz and debugging."""
+        with self._mu:
+            return {
+                "node": self.node_id,
+                "role": self.role,
+                "term": self.raft_log.term,
+                "leader": (self.node_id if self.role == LEADER
+                           else self.leader_id),
+                "commit_index": self.commit_index,
+                "applied_index": self.applied_index,
+                "peers": len(self.config.peers),
+            }
+
+    def wait_applied(self, index: int, timeout: float) -> bool:
+        """Block until the local apply position reaches `index` (the
+        follower half of a read barrier)."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            while self.applied_index < index:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._killed:
+                    return False
+                self._cv.wait(left)
+            return True
+
+    # -- client surface ------------------------------------------------------
+
+    def propose(self, payload: bytes, timeout: float = 5.0) -> int:
+        """Leader-only: append `payload` as one log entry, replicate,
+        and return its index once it is committed AND applied locally.
+        Raises NotLeader immediately on a non-leader, and
+        QuorumUnavailable when the entry cannot reach a majority (or
+        was truncated by a competing leader) within `timeout` — the
+        outcome is then indeterminate and the caller must not treat
+        the write as acknowledged."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(
+                    f"{self.node_id} is {self.role}", self.leader_id)
+            term = self.raft_log.term
+            index = self.raft_log.last_index + 1
+            self.raft_log.append([Entry(term, index, payload)])
+            self._maybe_commit_locked()  # single-node: majority of 1
+            self._cv.notify_all()
+            while self.applied_index < index:
+                if self.raft_log.term_at(index) != term:
+                    # a competing leader truncated our suffix: the
+                    # entry is definitively lost, never acked
+                    raise QuorumUnavailable(
+                        f"entry {index} (term {term}) superseded")
+                left = deadline - time.monotonic()
+                if left <= 0 or self._killed:
+                    raise QuorumUnavailable(
+                        f"entry {index} not committed within {timeout}s "
+                        "(no majority reachable?)")
+                self._cv.wait(left)
+            return index
+
+    def apply_barrier(self, timeout: float = 5.0) -> None:
+        """Leader-only: block until this term's start entry has
+        committed and every committed entry is applied locally. A
+        fresh leader holds all previously-ACKED writes in its LOG
+        (election restriction) but may not have applied them yet —
+        evaluating a proposal before this barrier would let a write
+        land on a state missing its predecessors."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(
+                    f"{self.node_id} is {self.role}", self.leader_id)
+            term = self.raft_log.term
+            while (self.commit_index < self._term_start_index
+                   or self.applied_index < self.commit_index):
+                if not self._wait_leader_locked(term, deadline):
+                    raise QuorumUnavailable(
+                        "leader state never caught up to the commit "
+                        "frontier (no majority reachable?)")
+
+    def read_barrier(self, timeout: float = 2.0) -> int:
+        """Linearizable read point (etcd ReadIndex): capture the
+        commit index, confirm leadership with a heartbeat round, wait
+        until it is applied, return it. Raises NotLeader/
+        QuorumUnavailable when this node cannot prove leadership."""
+        deadline = time.monotonic() + timeout
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(
+                    f"{self.node_id} is {self.role}", self.leader_id)
+            term = self.raft_log.term
+            # a fresh leader's commit frontier is unknown until its own
+            # no-op commits (Raft §8)
+            while self.commit_index < self._term_start_index:
+                if not self._wait_leader_locked(term, deadline):
+                    raise QuorumUnavailable("term-start entry never "
+                                            "committed (no majority?)")
+            read_index = self.commit_index
+            if self.config.peers:
+                self._confirm_seq += 1
+                seq = self._confirm_seq
+                self._cv.notify_all()  # wake replicators to carry it
+                while not self._confirm_majority_locked(seq):
+                    if not self._wait_leader_locked(term, deadline):
+                        raise QuorumUnavailable(
+                            "leadership not confirmed by a majority "
+                            "(partitioned from the quorum?)")
+            while self.applied_index < read_index:
+                if not self._wait_leader_locked(term, deadline):
+                    raise QuorumUnavailable("read index never applied")
+            return read_index
+
+    def _wait_leader_locked(self, term: int, deadline: float) -> bool:
+        """One bounded wait tick; False on deadline. Raises NotLeader
+        the moment this node stops leading `term` — a barrier or
+        commit wait must never survive deposition."""
+        if self.role != LEADER or self.raft_log.term != term:
+            raise NotLeader(f"{self.node_id} deposed", self.leader_id)
+        left = deadline - time.monotonic()
+        if left <= 0 or self._killed:
+            return False
+        self._cv.wait(min(left, 0.05))
+        return True
+
+    def _confirm_majority_locked(self, seq: int) -> bool:
+        acked = 1 + sum(1 for v in self._confirm_acked.values()
+                        if v >= seq)
+        return acked >= self._majority()
+
+    def compact_now(self) -> None:
+        """Force a raft-log compaction at the current applied index
+        (test hook for the snapshot-install path)."""
+        with self._mu:
+            self._force_compact = True
+            self._cv.notify_all()
+
+    # -- RPC dispatch --------------------------------------------------------
+
+    def _dispatch(self, msg: Any) -> Any:
+        kind = msg[0]
+        if kind == "vote":
+            return self._on_vote(msg)
+        if kind == "append":
+            return self._on_append(msg)
+        if kind == "snap":
+            return self._on_snapshot(msg)
+        if kind == "barrier":
+            # forwarded linearizable-read barrier from a follower
+            try:
+                return ["barrierrep", True,
+                        self.read_barrier(timeout=msg[1]), ""]
+            except NotPrimary as e:
+                return ["barrierrep", False, 0, str(e)]
+        if kind == "who":
+            return ["whorep", self.leader_hint()]
+        if kind == "fwd":
+            if self.client_fn is None:
+                return ["fwdrep", False, "no client handler", None]
+            return self.client_fn(msg)
+        return ["err", f"unknown message kind {kind!r}"]
+
+    def _on_vote(self, msg: Any) -> Any:
+        _, term, cand, last_idx, last_term = msg
+        with self._mu:
+            if self._killed:
+                return ["voterep", self.raft_log.term, False]
+            if term > self.raft_log.term:
+                self._step_down_locked(term, "")
+            cur = self.raft_log.term
+            granted = False
+            if term == cur and self.raft_log.voted_for in ("", cand):
+                mine = (self.raft_log.last_term, self.raft_log.last_index)
+                if (last_term, last_idx) >= mine:
+                    granted = True
+                    # persist the ballot BEFORE it leaves: a forgotten
+                    # vote re-cast after restart elects two leaders
+                    self.raft_log.save_hardstate(cur, cand)
+                    self._touch_locked()
+            return ["voterep", cur, granted]
+
+    def _on_append(self, msg: Any) -> Any:
+        _, term, leader, prev_idx, prev_term, raw_entries, \
+            leader_commit, seq = msg
+        with self._mu:
+            if self._killed:
+                return ["apprep", self.raft_log.term, False, 0, 0]
+            if term < self.raft_log.term:
+                return ["apprep", self.raft_log.term, False, 0, seq]
+            if term > self.raft_log.term or self.role != FOLLOWER:
+                self._step_down_locked(term, leader)
+            self.leader_id = leader
+            self._touch_locked()
+            rl = self.raft_log
+            if prev_idx > rl.last_index:
+                # gap: tell the leader where our log actually ends
+                return ["apprep", rl.term, False, rl.last_index, seq]
+            if prev_idx >= rl.snap_index:
+                have = rl.term_at(prev_idx)
+                if have is not None and have != prev_term:
+                    # conflicting suffix: back the leader up past it
+                    return ["apprep", rl.term, False,
+                            max(rl.snap_index, prev_idx - 1), seq]
+            match = prev_idx + len(raw_entries)
+            new: List[Entry] = []
+            for t, i, payload in raw_entries:
+                if i <= rl.snap_index:
+                    continue  # already folded into our snapshot
+                have = rl.term_at(i)
+                if have is None and i > rl.last_index:
+                    new.append(Entry(t, i, payload))
+                elif have != t:
+                    rl.truncate_from(i)
+                    new.append(Entry(t, i, payload))
+                # have == t: duplicate delivery of an entry we hold
+            if new:
+                rl.append(new)
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, rl.last_index)
+                quorum_commit_index.labels(self.node_id).set(
+                    self.commit_index)
+                self._cv.notify_all()
+            return ["apprep", rl.term, True, match, seq]
+
+    def _on_snapshot(self, msg: Any) -> Any:
+        _, term, leader, last_idx, last_term, blob = msg
+        with self._mu:
+            if self._killed or term < self.raft_log.term:
+                return ["snaprep", self.raft_log.term, False]
+            if term > self.raft_log.term or self.role != FOLLOWER:
+                self._step_down_locked(term, leader)
+            self.leader_id = leader
+            self._touch_locked()
+            if last_idx > self.raft_log.snap_index:
+                # durable before the reply: an acked install the
+                # follower then loses would strand the leader's
+                # next_index beyond reality
+                self.raft_log.install_snapshot(last_idx, last_term, blob)
+                self._pending_snap = (last_idx, blob)
+                self.commit_index = max(self.commit_index, last_idx)
+                self._cv.notify_all()
+            return ["snaprep", self.raft_log.term, True]
+
+    # -- role machinery ------------------------------------------------------
+
+    def _roll_timeout(self) -> float:
+        t = self.config.election_timeout
+        return random.uniform(t, 2 * t)
+
+    def _touch_locked(self) -> None:
+        self._last_contact = time.monotonic()
+
+    def _majority(self) -> int:
+        return (len(self.config.peers) + 1) // 2 + 1
+
+    def _step_down_locked(self, term: int, leader: str) -> None:
+        if term > self.raft_log.term:
+            self.raft_log.save_hardstate(term, "")
+            quorum_term.labels(self.node_id).set(term)
+        was = self.role
+        self.role = FOLLOWER
+        self.leader_id = leader
+        self._timeout = self._roll_timeout()
+        self._touch_locked()
+        if was != FOLLOWER:
+            log.info("%s: stepped down to follower at term %s",
+                     self.node_id, term)
+        self._cv.notify_all()
+
+    def _ticker(self) -> None:
+        while not self._stopped.wait(0.01):
+            with self._mu:
+                if self._killed:
+                    return
+                if self.role == LEADER:
+                    continue
+                if (time.monotonic() - self._last_contact
+                        < self._timeout):
+                    continue
+                # silence past the randomized timeout: stand for
+                # election in the next term
+                term = self.raft_log.term + 1
+                self.raft_log.save_hardstate(term, self.node_id)
+                quorum_term.labels(self.node_id).set(term)
+                self.role = CANDIDATE
+                self.leader_id = ""
+                self._votes = {self.node_id}
+                self._timeout = self._roll_timeout()
+                self._touch_locked()
+                last_idx = self.raft_log.last_index
+                last_term = self.raft_log.last_term
+                if self._votes_win_locked():
+                    continue  # single-node cluster: instant leader
+            msg = ["vote", term, self.node_id, last_idx, last_term]
+            for pid in list(self.config.peers):
+                threading.Thread(
+                    target=self._solicit_vote, args=(pid, term, msg),
+                    daemon=True,
+                    name=f"quorum-ballot-{self.node_id}-{pid}",
+                ).start()
+
+    def _solicit_vote(self, pid: str, term: int, msg: Any) -> None:
+        client = self._vote_clients.get(pid)
+        if client is None:
+            return
+        try:
+            reply = client.call(
+                msg, timeout=min(self.config.rpc_timeout,
+                                 self.config.election_timeout))
+        except RPCError:
+            return
+        if not reply or reply[0] != "voterep":
+            return
+        _, rterm, granted = reply
+        with self._mu:
+            if self._killed:
+                return
+            if rterm > self.raft_log.term:
+                self._step_down_locked(rterm, "")
+                return
+            if (self.role != CANDIDATE
+                    or self.raft_log.term != term or not granted):
+                return
+            self._votes.add(pid)
+            self._votes_win_locked()
+
+    def _votes_win_locked(self) -> bool:
+        if len(self._votes) < self._majority():
+            return False
+        term = self.raft_log.term
+        self.role = LEADER
+        self.leader_id = self.node_id
+        self.terms_led.append(term)
+        last = self.raft_log.last_index
+        self._next_index = {p: last + 1 for p in self.config.peers}
+        self._match_index = {p: 0 for p in self.config.peers}
+        self._confirm_acked = {p: 0 for p in self.config.peers}
+        # the term-start no-op: commits the new leader's view of the
+        # log prefix and anchors read barriers (empty payload; the
+        # apply loop skips it)
+        self._term_start_index = last + 1
+        self.raft_log.append([Entry(term, last + 1, b"")])
+        self._maybe_commit_locked()
+        quorum_leader_changes_total.inc(node=self.node_id)
+        log.info("%s: LEADER at term %s (log at %s)",
+                 self.node_id, term, last + 1)
+        self._cv.notify_all()
+        return True
+
+    # -- replication (leader) ------------------------------------------------
+
+    def _replicator(self, pid: str) -> None:
+        client = self._repl_clients[pid]
+        hb = self.config.heartbeat_interval
+        while not self._stopped.is_set():
+            with self._mu:
+                if self._killed:
+                    return
+                if self.role != LEADER:
+                    self._cv.wait(0.1)
+                    continue
+                term = self.raft_log.term
+                nxt = self._next_index.get(pid, 1)
+                prev = nxt - 1
+                prev_term = self.raft_log.term_at(prev)
+                seq = self._confirm_seq
+                commit = self.commit_index
+                if prev_term is None:
+                    # the follower's next entry was compacted away:
+                    # ship the whole snapshot instead
+                    snap_idx, snap_term, blob = self.raft_log.snapshot()
+                    entries = None
+                else:
+                    entries = self.raft_log.entries_from(nxt)
+            if prev_term is None:
+                if blob is None:
+                    time.sleep(hb)
+                    continue
+                try:
+                    reply = client.call(
+                        ["snap", term, self.node_id, snap_idx,
+                         snap_term, blob],
+                        timeout=max(5.0, self.config.rpc_timeout))
+                except RPCError:
+                    time.sleep(hb)
+                    continue
+                installed = False
+                with self._mu:
+                    if reply[0] == "snaprep" and \
+                            reply[1] > self.raft_log.term:
+                        self._step_down_locked(reply[1], "")
+                    elif reply[0] == "snaprep" and reply[2]:
+                        self._next_index[pid] = snap_idx + 1
+                        self._match_index[pid] = max(
+                            self._match_index.get(pid, 0), snap_idx)
+                        installed = True
+                if installed:
+                    quorum_snapshot_installs_total.inc()
+                continue
+            msg = ["append", term, self.node_id, prev, prev_term,
+                   [[e.term, e.index, e.payload] for e in entries],
+                   commit, seq]
+            t0 = time.monotonic()
+            try:
+                reply = client.call(msg)
+            except RPCError:
+                # peer unreachable: retry at heartbeat cadence (the
+                # election timer on the OTHER side decides liveness)
+                with self._mu:
+                    self._cv.wait(hb)
+                continue
+            quorum_append_rtt_seconds.observe(time.monotonic() - t0)
+            if not reply or reply[0] != "apprep":
+                time.sleep(hb)
+                continue
+            _, rterm, ok, match, rseq = reply
+            with self._mu:
+                if rterm > self.raft_log.term:
+                    self._step_down_locked(rterm, "")
+                    continue
+                if self.role != LEADER or self.raft_log.term != term:
+                    continue
+                if ok:
+                    if match > self._match_index.get(pid, 0):
+                        self._match_index[pid] = match
+                        self._maybe_commit_locked()
+                    self._next_index[pid] = match + 1
+                    if rseq > self._confirm_acked.get(pid, 0):
+                        self._confirm_acked[pid] = rseq
+                        self._cv.notify_all()  # barrier waiters
+                    # idle (nothing new, seq current): heartbeat pace;
+                    # a fresh append or barrier notifies us awake
+                    if (self.raft_log.last_index < self._next_index[pid]
+                            and self._confirm_seq == rseq):
+                        self._cv.wait(hb)
+                else:
+                    # conflict hint: jump next_index straight to just
+                    # past the follower's usable log end
+                    self._next_index[pid] = max(
+                        1, min(self._next_index.get(pid, 1) - 1,
+                               match + 1))
+
+    def _maybe_commit_locked(self) -> None:
+        """Advance commit_index to the highest index replicated on a
+        majority whose entry is of the CURRENT term (Raft §5.4.2: a
+        leader never counts replicas of older-term entries)."""
+        if self.role != LEADER:
+            return
+        matches = sorted(
+            [self.raft_log.last_index]
+            + [self._match_index.get(p, 0) for p in self.config.peers],
+            reverse=True)
+        candidate = matches[self._majority() - 1]
+        if candidate > self.commit_index and \
+                self.raft_log.term_at(candidate) == self.raft_log.term:
+            self.commit_index = candidate
+            quorum_commit_index.labels(self.node_id).set(candidate)
+            self._cv.notify_all()
+
+    # -- apply loop ----------------------------------------------------------
+
+    def _apply_loop(self) -> None:
+        while not self._stopped.is_set():
+            with self._mu:
+                if self._killed:
+                    return
+                snap = self._pending_snap
+                self._pending_snap = None
+                batch: List[Entry] = []
+                if snap is None:
+                    # strictly up to commit_index: the log routinely
+                    # holds entries BEYOND it (a follower receives
+                    # appends before the commit frontier advances;
+                    # the leader appends its own proposal before the
+                    # majority acks) and applying one would ack a
+                    # write no majority holds
+                    while (self.applied_index + len(batch)
+                           < self.commit_index):
+                        e = self.raft_log.entry(self.applied_index
+                                                + len(batch) + 1)
+                        if e is None:
+                            break
+                        batch.append(e)
+                        if len(batch) >= 256:
+                            break
+                    if not batch and not self._force_compact:
+                        self._cv.wait(0.2)
+                        continue
+                force = self._force_compact
+                self._force_compact = False
+            if snap is not None:
+                idx, blob = snap
+                self.install_fn(blob)
+                with self._mu:
+                    if idx > self.applied_index:
+                        self.applied_index = idx
+                    self._cv.notify_all()
+                continue
+            for e in batch:
+                if e.payload:
+                    try:
+                        self.apply_fn(e.payload, e.index)
+                    except Exception:
+                        # an apply error is a state-machine bug, not a
+                        # consensus event; surface loudly but keep the
+                        # node participating (skipping would diverge)
+                        log.exception("%s: apply of entry %s failed",
+                                      self.node_id, e.index)
+                with self._mu:
+                    self.applied_index = e.index
+                    self._cv.notify_all()
+            with self._mu:
+                applied = self.applied_index
+                due = force or (applied - self.raft_log.snap_index
+                                >= self.config.snapshot_every)
+                snap_term = self.raft_log.term_at(applied)
+            if due and snap_term is not None and \
+                    applied > self.raft_log.snap_index:
+                # the apply thread is the only state-machine mutator,
+                # so the blob is exactly the state at `applied`
+                blob = self.state_fn()
+                self.raft_log.compact(applied, snap_term, blob)

@@ -59,6 +59,12 @@ class NotPrimary(Exception):
 
 _MAGIC = b"KTREPL01"
 _ACK = struct.Struct("<Q")
+#: fencing token on the ack channel (2^64-1: impossible byte offset).
+#: A follower sends it as its LAST word before promoting: the primary
+#: must stop accepting writes — a stale primary that merely lost its
+#: replication socket degrades and keeps serving, but one whose
+#: standby PROMOTED is the split-brain half and must stand down.
+_FENCE = (1 << 64) - 1
 
 
 def _frame(payload: bytes) -> bytes:
@@ -98,6 +104,12 @@ class ReplicatedStore(FileStore):
         # bytes acked by the follower
         self._acked = 0  # guarded-by: self._repl_lock
         self._shipped = 0  # guarded-by: self._repl_lock
+        # True once a promoted standby fenced us: every subsequent
+        # write raises NotPrimary (503 -> clients fail over). Before
+        # this flag, only the socket close "protected" the promotion
+        # window — a stale primary with pooled client connections kept
+        # acking writes the new primary would never see.
+        self._fenced = False  # guarded-by: self._repl_lock
         self._ack_cond = threading.Condition(self._repl_lock)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -177,8 +189,24 @@ class ReplicatedStore(FileStore):
                         # guarantee for writes the new follower hasn't
                         # durably applied yet
                         return
+                    if n == _FENCE:
+                        # the standby promoted: WE are now the stale
+                        # half. Fence every future write and unblock
+                        # any commit waiting on acks (it fails with
+                        # NotPrimary instead of timing out)
+                        self._fenced = True
+                        self._follower = None
+                        self._ack_cond.notify_all()
+                        log.warning(
+                            "FENCED by promoted standby: this store "
+                            "rejects all writes from now on")
+                        break
                     self._acked = n
                     self._ack_cond.notify_all()
+            try:
+                conn.close()
+            except OSError:
+                pass
         except (ConnectionError, OSError):
             self._drop_follower(conn)
 
@@ -194,6 +222,50 @@ class ReplicatedStore(FileStore):
             pass
 
     # -- commit path ---------------------------------------------------------
+
+    def _reject_if_fenced(self) -> None:
+        with self._repl_lock:
+            if self._fenced:
+                raise NotPrimary(
+                    "store was fenced by its promoted standby (this "
+                    "is the stale primary of a completed failover)"
+                )
+
+    @property
+    def fenced(self) -> bool:
+        with self._repl_lock:
+            return self._fenced
+
+    # every public mutator checks the fence FIRST — before the local
+    # commit, so a fenced primary's state stops moving at the moment
+    # the new primary took over (the term boundary, in quorum terms)
+
+    def create(self, key, obj, owned=False):
+        self._reject_if_fenced()
+        return super().create(key, obj, owned=owned)
+
+    def create_batch(self, items):
+        self._reject_if_fenced()
+        return super().create_batch(items)
+
+    def update(self, key, obj, expect_rv=None, owned=False):
+        self._reject_if_fenced()
+        return super().update(key, obj, expect_rv=expect_rv,
+                              owned=owned)
+
+    def update_batch(self, ops):
+        self._reject_if_fenced()
+        return super().update_batch(ops)
+
+    def guaranteed_update(self, key, fn, ignore_not_found=False):
+        self._reject_if_fenced()
+        return super().guaranteed_update(
+            key, fn, ignore_not_found=ignore_not_found
+        )
+
+    def delete(self, key, expect_rv=None):
+        self._reject_if_fenced()
+        return super().delete(key, expect_rv=expect_rv)
 
     def _record(self, key: str, ev: WatchEvent) -> None:
         # ship BEFORE the local WAL append + watcher delivery: an event
@@ -239,6 +311,15 @@ class ReplicatedStore(FileStore):
                             stalled = True
                             break
                         self._ack_cond.wait(left)
+                    if self._fenced:
+                        # the fence arrived while this commit waited
+                        # for acks: fail it loudly — the new primary
+                        # may or may not have the record, and a silent
+                        # degraded-mode success here would double-ack
+                        raise NotPrimary(
+                            "fenced while awaiting replication ack "
+                            "(outcome owned by the promoted standby)"
+                        )
             except OSError:
                 self._drop_follower(conn)
             if stalled:
@@ -275,8 +356,12 @@ class FollowerStore(FileStore):
         super().__init__(data_dir, **kw)
         self._promoted = threading.Event()
         self._primary_addr = tuple(primary_addr)
-        self._conn: Optional[socket.socket] = None
-        self._applied = 0
+        self._applied = 0  # follow-loop thread only
+        # the live replication socket: written by the follow loop,
+        # read by promote() to deliver the fence token — a real
+        # cross-thread handoff, so locked, not just close-protected
+        self._conn_mu = threading.Lock()
+        self._conn: Optional[socket.socket] = None  # guarded-by: self._conn_mu
         self._sync_once = threading.Event()
         self._thread = threading.Thread(
             target=self._follow_loop, daemon=True, name="repl-follow"
@@ -299,7 +384,8 @@ class FollowerStore(FileStore):
                 time.sleep(0.2 if self._sync_once.is_set() else 0.1)
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conn = conn
+            with self._conn_mu:
+                self._conn = conn
             try:
                 conn.sendall(_MAGIC)
                 body = _read_frame(conn)
@@ -322,7 +408,8 @@ class FollowerStore(FileStore):
                 if not self._promoted.is_set():
                     log.warning("replication stream broke: %s", e)
             finally:
-                self._conn = None
+                with self._conn_mu:
+                    self._conn = None
                 try:
                     conn.close()
                 except OSError:
@@ -365,12 +452,25 @@ class FollowerStore(FileStore):
 
     def promote(self) -> None:
         """Become the writable store (RV sequence continues where the
-        stream stopped). Idempotent."""
+        stream stopped). Idempotent. If the old primary is merely
+        DEEMED dead (slow, not gone) and still holds pooled client
+        connections, the fence token sent here makes it reject every
+        subsequent write — before it, only the socket close protected
+        the promotion window, and a live stale primary kept acking
+        writes the promoted store would never see."""
         if self._promoted.is_set():
             return
         self._promoted.set()
-        conn = self._conn
+        with self._conn_mu:
+            conn = self._conn
         if conn is not None:
+            try:
+                # last word on the ack channel: FENCE, then hang up.
+                # Best-effort by design — a truly dead primary has
+                # nobody to fence
+                conn.sendall(_ACK.pack(_FENCE))
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:

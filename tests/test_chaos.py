@@ -234,42 +234,177 @@ def test_apiserver_restart_mid_backlog(tmp_path):
         sched.stop()
 
 
-def test_replicated_store_failover_zero_lost_bindings(tmp_path):
-    """Kill the PRIMARY apiserver mid-density (no graceful close — the
-    store object is abandoned, like kill -9 severing its sockets) and
-    assert: the standby's WAL-shipped state holds EVERY acknowledged
-    write, the promotion monitor promotes it, clients fail over through
-    the multi-server transport, and the scheduler drains the remaining
-    backlog against the promoted standby. The etcd-cluster property
-    (VERDICT r4 missing #1) at primary/standby scale."""
+class _ReplicatedHA:
+    """The 2-node WAL-shipping profile: primary + WAL-shipped standby
+    with an external PromotionMonitor (storage/replicated.py)."""
+
+    name = "replicated"
+
+    def start(self, tmp_path):
+        from kubernetes_tpu.client.transport import HTTPTransport
+        from kubernetes_tpu.storage.replicated import (
+            FollowerStore,
+            PromotionMonitor,
+            ReplicatedStore,
+        )
+
+        self.primary = ReplicatedStore(str(tmp_path / "primary"))
+        self.api1 = APIServer(store=self.primary)
+        host, port1 = self.api1.serve_http()
+        self.follower = FollowerStore(
+            str(tmp_path / "standby"), self.primary.repl_address
+        )
+        assert self.follower.synced(10), (
+            "standby never completed initial sync")
+        self.api2 = APIServer(store=self.follower)
+        # the standby SERVES already (reads + 503 writes); promotion
+        # makes it writable — clients reach it via transport failover
+        _h2, port2 = self.api2.serve_http()
+        url1 = f"http://{host}:{port1}"
+        probe = RESTClient(HTTPTransport(url1, timeout=2.0))
+        self.monitor = PromotionMonitor(
+            self.follower, probe=probe.healthz, interval=0.1,
+            failures=3)
+        return f"{url1},http://{host}:{port2}"
+
+    def arm(self):
+        self.monitor.run()
+
+    def kill_primary(self):
+        # kill -9: HTTP torn down, store abandoned without close()
+        # (no final snapshot, no WAL truncation)
+        self.api1.shutdown_http()
+        self.api1 = None
+        self.primary = None
+
+    def wait_failover(self):
+        assert wait_until(lambda: self.follower.promoted, timeout=15), (
+            "standby was never promoted")
+
+    def survivor_store(self):
+        return self.follower
+
+    def assert_acked_replicated(self, prefix, n):
+        """acked == already durably on the standby, synchronously."""
+        with self.follower._lock:
+            have = sum(1 for k in self.follower._data
+                       if k.startswith(prefix))
+        assert have == n, f"follower behind acked writes: {have}/{n}"
+
+    def promote_now(self):
+        if self.primary is not None:
+            self.primary.close()
+            self.primary = None
+        self.follower.promote()
+        return self.api2
+
+    def close(self):
+        self.monitor.stop()
+        if self.api1 is not None:
+            self.api1.shutdown_http()
+        self.api2.shutdown_http()
+        if self.primary is not None:
+            self.primary.close()
+        self.follower.close()
+
+
+class _QuorumHA:
+    """The 3-member majority-ack consensus profile: every member
+    serves an apiserver; election is INSIDE the store (storage/
+    quorum), so there is no promotion monitor to arm."""
+
+    name = "quorum"
+
+    def start(self, tmp_path):
+        from kubernetes_tpu.storage.quorum import build_cluster
+
+        # 0.5s base: fast failover for the test, but wide enough that
+        # a GIL stall under the armed sanitizers (~3x slowdown) never
+        # reads as leader death mid-propose (a spurious deposition
+        # 503s the bare test client, which has no retry loop)
+        self.stores = build_cluster(
+            str(tmp_path), 3, election_timeout=0.5)
+        self.killed = []
+        self.apis = [APIServer(store=s) for s in self.stores]
+        urls = []
+        for api in self.apis:
+            host, port = api.serve_http()
+            urls.append(f"http://{host}:{port}")
+        return ",".join(urls)
+
+    def arm(self):
+        pass  # the quorum elects from INSIDE the store
+
+    # generous: during a mid-density failover the scheduler's retry
+    # storm shares the GIL with the election itself
+    def _leader(self, timeout=60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for s in self.stores:
+                if s not in self.killed and s.node.is_leader():
+                    return s
+            time.sleep(0.02)
+        raise AssertionError("no quorum leader")
+
+    def kill_primary(self):
+        lead = self._leader()
+        self.apis[self.stores.index(lead)].shutdown_http()
+        lead.kill()
+        self.killed.append(lead)
+
+    def wait_failover(self):
+        self._leader()  # a new leader IS the failover
+
+    def survivor_store(self):
+        return self._leader()
+
+    def assert_acked_replicated(self, prefix, n):
+        """acked == durably in a MAJORITY's raft log (applied state
+        follows at the next commit notification)."""
+        lead = self._leader()
+        need = lead.node.status()["applied_index"]
+        followers = [s for s in self.stores
+                     if s is not lead and s not in self.killed]
+        logged = [f for f in followers
+                  if f.node.raft_log.last_index >= need]
+        assert logged, (
+            f"no follower's log reached index {need} at ack time: "
+            f"{[(f.node_id, f.node.raft_log.last_index) for f in followers]}")
+
+    def promote_now(self):
+        """kill the leader; the surviving majority elects — return an
+        apiserver over the new leader."""
+        self.kill_primary()
+        lead = self._leader()
+        return self.apis[self.stores.index(lead)]
+
+    def close(self):
+        for api in self.apis:
+            api.shutdown_http()
+        for s in self.stores:
+            s.close()
+
+
+@pytest.fixture(params=["replicated", "quorum"])
+def ha_profile(request):
+    return {"replicated": _ReplicatedHA, "quorum": _QuorumHA}[
+        request.param]()
+
+
+def test_replicated_store_failover_zero_lost_bindings(tmp_path,
+                                                      ha_profile):
+    """Kill the PRIMARY mid-density (no graceful close — the store is
+    abandoned, like kill -9 severing its sockets) and assert: the
+    surviving replica(s) hold EVERY acknowledged write, failover
+    happens (external promotion for the 2-node profile, internal
+    election for the quorum), clients fail over through the
+    multi-server transport, and the scheduler drains the remaining
+    backlog. The etcd-cluster property, at both HA scales."""
     from kubernetes_tpu.client.transport import HTTPTransport
-    from kubernetes_tpu.storage.replicated import (
-        FollowerStore,
-        PromotionMonitor,
-        ReplicatedStore,
-    )
 
-    primary_store = ReplicatedStore(str(tmp_path / "primary"))
-    api1 = APIServer(store=primary_store)
-    host, port1 = api1.serve_http()
-    url1 = f"http://{host}:{port1}"
-
-    follower = FollowerStore(
-        str(tmp_path / "standby"), primary_store.repl_address
-    )
-    assert follower.synced(10), "standby never completed initial sync"
-    api2 = APIServer(store=follower)
-    # the standby SERVES already (reads + 503 writes); promotion makes
-    # it writable — clients reach it via transport failover
-    _h2, port2 = api2.serve_http()
-    url2 = f"http://{host}:{port2}"
-
-    probe_client = RESTClient(HTTPTransport(url1, timeout=2.0))
-    monitor = PromotionMonitor(
-        follower, probe=probe_client.healthz, interval=0.1, failures=3
-    )
-
-    client = RESTClient(HTTPTransport(f"{url1},{url2}", timeout=5.0))
+    profile = ha_profile
+    urls = profile.start(tmp_path)
+    client = RESTClient(HTTPTransport(urls, timeout=5.0))
     for i in range(4):
         client.nodes().create(ready_node(f"n{i}"))
     sched = SchedulerServer(
@@ -279,70 +414,102 @@ def test_replicated_store_failover_zero_lost_bindings(tmp_path):
         for i in range(30):
             client.pods().create(pending_pod(f"pre-{i:03d}"))
         assert wait_until(lambda: n_bound(client) >= 10)
-        monitor.run()
+        profile.arm()
 
-        # --- kill -9 the primary: HTTP torn down, store abandoned
-        # without close() (no final snapshot, no WAL truncation) ---
         bound_acked = n_bound(client)
-        api1.shutdown_http()
-        del api1, primary_store
+        profile.kill_primary()
+        profile.wait_failover()
 
-        # promotion fires on probe silence; writes drain to the standby
-        assert wait_until(lambda: follower.promoted, timeout=15), (
-            "standby was never promoted"
-        )
         objs, _ = client.pods().list()
         assert len(objs) == 30, (
-            f"standby lost pods: {len(objs)}/30"
+            f"survivors lost pods: {len(objs)}/30"
         )
         bound_after = sum(1 for p in objs if p.spec.node_name)
         assert bound_after >= bound_acked, (
-            f"standby lost acknowledged bindings: {bound_after} < "
+            f"survivors lost acknowledged bindings: {bound_after} < "
             f"{bound_acked}"
         )
-        # the scheduler finishes the density against the promoted
-        # standby (its reflectors relist through transport failover)
+        # the scheduler finishes the density against the survivors
+        # (its reflectors relist through transport failover)
+        # the sanitizer witnesses run this suite instrumented (~3x
+        # slower), so the drain deadline is generous
         for i in range(10):
             client.pods().create(pending_pod(f"post-{i:02d}"))
-        assert wait_until(lambda: n_bound(client) == 40, timeout=50), (
+        assert wait_until(lambda: n_bound(client) == 40, timeout=120), (
             f"stuck at {n_bound(client)}/40 bound after failover"
         )
     finally:
-        monitor.stop()
         sched.stop()
-        api2.shutdown_http()
-        follower.close()
+        profile.close()
 
 
-def test_replicated_store_sync_semantics(tmp_path):
-    """Every write acked by the primary is on the follower BEFORE any
-    watcher sees it: commit N objects, sever the replication socket
-    abruptly, and the follower's recovered state must hold exactly the
-    committed prefix (nothing torn, nothing phantom)."""
+def test_replicated_store_sync_semantics(tmp_path, ha_profile):
+    """Every write acked by the primary is durably replicated BEFORE
+    the ack: commit N objects, kill the primary abruptly, and the
+    survivors must hold exactly the committed prefix (nothing torn,
+    nothing phantom), then accept writes with RV continuity."""
+    profile = ha_profile
+    urls = profile.start(tmp_path)
+    from kubernetes_tpu.client.transport import HTTPTransport
+
+    client = RESTClient(HTTPTransport(urls, timeout=5.0))
+    for i in range(50):
+        client.pods().create(pending_pod(f"w-{i:03d}"))
+    # replication is synchronous with the ack
+    profile.assert_acked_replicated("/pods/", 50)
+    api2 = profile.promote_now()
+    profile.wait_failover()
+    c2 = RESTClient(LocalTransport(api2))
+    objs, _ = c2.pods().list()
+    assert len(objs) == 50
+    # and the surviving store accepts writes with RV continuity
+    survivor = profile.survivor_store()
+    rv_before = survivor.current_rv
+    c2.pods().create(pending_pod("post-promote"))
+    assert survivor.current_rv > rv_before
+    profile.close()
+
+
+def test_replicated_store_promotion_fences_stale_primary(tmp_path):
+    """The fencing regression (quorum terms subsume this; the 2-node
+    profile needs it explicitly): a follower promoted while the
+    primary is still ALIVE — deemed dead by the monitor, e.g. just
+    slow — must fence the old term's writes. A client holding pooled
+    connections to the stale primary gets NotPrimary/503 instead of a
+    silently-diverging ack, and fails over to the promoted store."""
     from kubernetes_tpu.storage.replicated import (
         FollowerStore,
+        NotPrimary,
         ReplicatedStore,
     )
 
     primary = ReplicatedStore(str(tmp_path / "p"))
     follower = FollowerStore(str(tmp_path / "f"), primary.repl_address)
     assert follower.synced(10)
-    api = APIServer(store=primary)
-    client = RESTClient(LocalTransport(api))
-    for i in range(50):
-        client.pods().create(pending_pod(f"w-{i:03d}"))
-    # the follower holds all 50 the moment the creates returned
-    with follower._lock:
-        n = sum(1 for k in follower._data if k.startswith("/pods/"))
-    assert n == 50, f"follower behind acked writes: {n}/50"
-    primary.close()
+    api1 = APIServer(store=primary)
+    c_stale = RESTClient(LocalTransport(api1))  # the pooled client
+    c_stale.pods().create(pending_pod("pre-fence"))
+
+    # promotion fires while the primary is alive and connected
     follower.promote()
+    assert wait_until(lambda: primary.fenced, timeout=10), (
+        "fence never reached the stale primary")
+
+    # the stale primary rejects every verb of the old term
+    with pytest.raises(Exception) as exc:
+        primary.create("/pods/default/stale", pending_pod("stale"))
+    assert isinstance(exc.value, NotPrimary)
+    # ...and the pooled client's write surfaces as a 503, the signal
+    # transports use to fail over
+    from kubernetes_tpu.client.rest import APIStatusError
+
+    with pytest.raises(APIStatusError) as aerr:
+        c_stale.pods().create(pending_pod("stale-via-client"))
+    assert aerr.value.code == 503
+    # the promoted store is the live half
     api2 = APIServer(store=follower)
-    c2 = RESTClient(LocalTransport(api2))
-    objs, _ = c2.pods().list()
-    assert len(objs) == 50
-    # and the promoted store accepts writes with RV continuity
-    rv_before = follower.current_rv
-    c2.pods().create(pending_pod("post-promote"))
-    assert follower.current_rv > rv_before
+    c_new = RESTClient(LocalTransport(api2))
+    c_new.pods().create(pending_pod("post-fence"))
+    assert len(c_new.pods().list()[0]) == 2  # pre-fence + post-fence
+    primary.close()
     follower.close()

@@ -1,0 +1,566 @@
+"""Quorum consensus store: elections, replication, linearizable reads,
+snapshot install, restart recovery, and the storage.Interface contract
+through consensus (tests/test_chaos.py and test_quorum_chaos.py carry
+the fault-injection gates; this file is the sunny-day correctness
+tier plus the Jepsen-lite checker's own unit tests)."""
+
+import os
+import time
+
+import pytest
+
+from conftest import wait_until  # noqa: E402
+
+from kubernetes_tpu.analysis import locks as lock_sanitizer
+from kubernetes_tpu.storage.quorum import (
+    NodeConfig,
+    QuorumStore,
+    QuorumUnavailable,
+    build_cluster,
+)
+from kubernetes_tpu.storage.quorum import linearize
+from kubernetes_tpu.storage.quorum.log import Entry, RaftLog
+from kubernetes_tpu.storage.store import (
+    DELETE_OBJECT,
+    Conflict,
+    KeyExists,
+    KeyNotFound,
+)
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sanitizer():
+    """Every quorum test doubles as a lock-order witness over the new
+    node/store/rpc locks (the chaos-suite convention)."""
+    with lock_sanitizer.instrumented():
+        yield
+    lock_sanitizer.assert_no_cycles("(quorum suite)")
+
+
+def wait_leader(stores, exclude=(), timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for s in stores:
+            if s not in exclude and s.node.is_leader():
+                return s
+        time.sleep(0.02)
+    raise AssertionError("no leader elected within %ss" % timeout)
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    stores = build_cluster(str(tmp_path), 3, election_timeout=0.15)
+    try:
+        yield stores
+    finally:
+        for s in stores:
+            s.close()
+
+
+def state_fingerprint(store):
+    """Canonical bytes of a member's applied contents (the
+    bit-identical comparison the snapshot-install gate uses)."""
+    from kubernetes_tpu.runtime import tlv
+
+    with store._lock:
+        return tlv.dumps(sorted(
+            (k, tlv.dumps([o, rv])) for k, (o, rv) in
+            store._data.items()
+        ))
+
+
+# -- RaftLog persistence ------------------------------------------------------
+
+
+class TestRaftLog:
+    def test_hardstate_survives_restart(self, tmp_path):
+        d = str(tmp_path)
+        rl = RaftLog(d)
+        rl.save_hardstate(7, "q1")
+        rl.close()
+        rl2 = RaftLog(d)
+        assert (rl2.term, rl2.voted_for) == (7, "q1")
+        rl2.close()
+
+    def test_append_recover_truncate(self, tmp_path):
+        d = str(tmp_path)
+        rl = RaftLog(d)
+        rl.append([Entry(1, 1, b"a"), Entry(1, 2, b"b"),
+                   Entry(2, 3, b"c")])
+        rl.close()
+        rl2 = RaftLog(d)
+        assert rl2.last_index == 3 and rl2.last_term == 2
+        assert rl2.entry(2).payload == b"b"
+        # conflict truncation drops the suffix durably
+        rl2.truncate_from(2)
+        assert rl2.last_index == 1
+        rl2.append([Entry(3, 2, b"B")])
+        rl2.close()
+        rl3 = RaftLog(d)
+        assert rl3.last_index == 2 and rl3.entry(2).payload == b"B"
+        assert rl3.term_at(2) == 3
+        rl3.close()
+
+    def test_torn_tail_discarded(self, tmp_path):
+        d = str(tmp_path)
+        rl = RaftLog(d)
+        rl.append([Entry(1, 1, b"keep")])
+        rl.close()
+        with open(os.path.join(d, "raft.log"), "ab") as f:
+            f.write(b"\x40\x00\x00\x00TORN")  # mid-append crash
+        rl2 = RaftLog(d)
+        assert rl2.last_index == 1
+        # appends after recovery land where the torn bytes were
+        rl2.append([Entry(1, 2, b"next")])
+        rl2.close()
+        rl3 = RaftLog(d)
+        assert [e.payload for e in rl3.entries_from(1)] == \
+            [b"keep", b"next"]
+        rl3.close()
+
+    def test_compact_and_recover_from_snapshot(self, tmp_path):
+        d = str(tmp_path)
+        rl = RaftLog(d)
+        rl.append([Entry(1, i, b"e%d" % i) for i in range(1, 6)])
+        rl.compact(3, 1, b"STATE@3")
+        assert rl.snap_index == 3
+        assert rl.entries_from(1) == []  # compacted out of the window
+        assert [e.index for e in rl.entries_from(4)] == [4, 5]
+        rl.close()
+        rl2 = RaftLog(d)
+        assert rl2.snapshot() == (3, 1, b"STATE@3")
+        assert rl2.last_index == 5
+        rl2.close()
+
+
+# -- consensus basics ---------------------------------------------------------
+
+
+class TestQuorumConsensus:
+    def test_exactly_one_leader_and_terms_recorded(self, cluster3):
+        lead = wait_leader(cluster3)
+        time.sleep(0.3)  # heartbeats hold the others back
+        leaders = [s for s in cluster3 if s.node.is_leader()]
+        assert leaders == [lead]
+        claimed = {}
+        for s in cluster3:
+            for t in s.node.terms_led:
+                claimed.setdefault(t, []).append(s.node_id)
+        assert all(len(v) == 1 for v in claimed.values()), (
+            f"two leaders claimed one term: {claimed}")
+
+    def test_write_replicates_to_majority_and_all(self, cluster3):
+        lead = wait_leader(cluster3)
+        for i in range(10):
+            lead.create(f"/pods/p{i}", {"i": i})
+        # acked == committed: every member converges (apply is async
+        # on followers, so wait, but convergence must be fast)
+        for s in cluster3:
+            assert wait_until(
+                lambda s=s: len(s.scan_refs("/pods/")) == 10,
+                timeout=10), f"{s.node_id} never converged"
+
+    def test_follower_forwards_writes_and_serves_reads(self, cluster3):
+        lead = wait_leader(cluster3)
+        follower = next(s for s in cluster3 if s is not lead)
+        rv = follower.create("/pods/via-follower", {"x": 1})
+        assert rv > 0
+        # linearizable read from the OTHER follower sees it at once
+        other = next(s for s in cluster3
+                     if s is not lead and s is not follower)
+        obj, _ = other.get("/pods/via-follower")
+        assert obj == {"x": 1}
+
+    def test_leader_kill_elects_new_and_loses_nothing(self, cluster3):
+        lead = wait_leader(cluster3)
+        for i in range(25):
+            lead.create(f"/pods/p{i:02d}", {"i": i})
+        lead.kill()
+        lead2 = wait_leader(cluster3, exclude=(lead,))
+        objs, _ = lead2.list("/pods/")
+        assert len(objs) == 25, "acked writes lost across failover"
+        # and the new leader takes writes with RV continuity
+        rv_before = lead2.current_rv
+        assert lead2.create("/pods/post", {"i": 99}) > rv_before
+
+    def test_stale_leader_cannot_serve_linearizable_reads(
+            self, tmp_path):
+        """The read-index regression: isolate the leader (its lease of
+        silence), write through the majority side, and the deposed
+        leader must REFUSE a linearizable read rather than serve its
+        stale state."""
+        from kubernetes_tpu.harness.nemesis import Nemesis
+
+        stores = [QuorumStore(NodeConfig(
+            node_id=f"q{i}",
+            data_dir=str(tmp_path / f"n{i}"),
+            election_timeout=0.15,
+        )) for i in range(3)]
+        nem = None
+        try:
+            nem = Nemesis({s.node_id: s.address for s in stores})
+            for s in stores:
+                s.set_peers(nem.peer_view(s.node_id))
+                s.start()
+            lead = wait_leader(stores)
+            lead.create("/k/a", {"v": "old"})
+            nem.isolate(lead.node_id)
+            lead2 = wait_leader(stores, exclude=(lead,))
+            lead2.update("/k/a", {"v": "new"})
+            with pytest.raises(QuorumUnavailable):
+                lead.get("/k/a")  # must NOT return {"v": "old"}
+            nem.heal()
+            # after healing, the old leader rejoins and serves the
+            # committed value
+            assert wait_until(
+                lambda: not lead.node.is_leader(), timeout=10)
+            obj, _ = lead.get("/k/a")
+            assert obj == {"v": "new"}
+        finally:
+            for s in stores:
+                s.close()
+            if nem is not None:
+                nem.close()
+
+    def test_lagging_follower_catches_up_via_snapshot_install(
+            self, tmp_path):
+        """Partition one follower, write + compact past its position,
+        heal: it must catch up through InstallSnapshot and end
+        bit-identical to the leader."""
+        from kubernetes_tpu.harness.nemesis import Nemesis
+        from kubernetes_tpu.metrics import quorum_snapshot_installs_total
+
+        stores = [QuorumStore(NodeConfig(
+            node_id=f"q{i}", data_dir=str(tmp_path / f"n{i}"),
+            election_timeout=0.15,
+        )) for i in range(3)]
+        nem = Nemesis({s.node_id: s.address for s in stores})
+        for s in stores:
+            s.set_peers(nem.peer_view(s.node_id))
+            s.start()
+        try:
+            lead = wait_leader(stores)
+            laggard = next(s for s in stores if s is not lead)
+            lead.create("/k/pre", {"v": 0})
+            assert wait_until(
+                lambda: laggard.scan_refs("/k/"), timeout=10)
+            nem.isolate(laggard.node_id)
+            installs_before = quorum_snapshot_installs_total.get()
+            for i in range(30):
+                lead.create(f"/k/during-{i:02d}", {"v": i})
+            # compact EVERY surviving member's log so the laggard's
+            # next index is off the retained window no matter which
+            # member leads after the heal (the isolated laggard's
+            # term bumps can depose and re-elect — no pre-vote yet)
+            survivors = [s for s in stores if s is not laggard]
+            for s in survivors:
+                s.node.compact_now()
+            for s in survivors:
+                assert wait_until(
+                    lambda s=s: s.node.raft_log.snap_index
+                    >= s.node.status()["applied_index"] - 1,
+                    timeout=10)
+            nem.heal()
+            assert wait_until(
+                lambda: len(laggard.scan_refs("/k/")) == 31,
+                timeout=15), "laggard never caught up"
+            assert wait_until(
+                lambda: quorum_snapshot_installs_total.get()
+                > installs_before, timeout=5), (
+                "catch-up did not go through the snapshot path")
+            assert wait_until(
+                lambda: state_fingerprint(laggard)
+                == state_fingerprint(lead), timeout=10), (
+                "laggard state not bit-identical to the leader's")
+        finally:
+            for s in stores:
+                s.close()
+            nem.close()
+
+    def test_restart_recovers_committed_state(self, tmp_path):
+        stores = build_cluster(str(tmp_path), 3, election_timeout=0.15)
+        try:
+            lead = wait_leader(stores)
+            for i in range(12):
+                lead.create(f"/k/{i:02d}", {"v": i})
+            victim = next(s for s in stores if s is not lead)
+            vid = victim.node_id
+            vdir = victim.node.config.data_dir
+            vport = victim.address[1]
+            peers = dict(victim.node.config.peers)
+            assert wait_until(
+                lambda: len(victim.scan_refs("/k/")) == 12, timeout=10)
+            victim.kill()
+            # more writes while it is down
+            for i in range(12, 20):
+                lead.create(f"/k/{i:02d}", {"v": i})
+            # the restart rebinds the SAME peer port — that is how its
+            # peers keep finding it (the deployment contract of
+            # --quorum-listen)
+            reborn = QuorumStore(NodeConfig(
+                node_id=vid, data_dir=vdir, peers=peers,
+                listen_port=vport, election_timeout=0.15))
+            reborn.set_peers(peers)
+            reborn.start()
+            stores.append(reborn)
+            assert wait_until(
+                lambda: len(reborn.scan_refs("/k/")) == 20,
+                timeout=15), "restarted member never converged"
+            assert wait_until(
+                lambda: state_fingerprint(reborn)
+                == state_fingerprint(lead), timeout=10)
+        finally:
+            for s in stores:
+                s.close()
+
+
+# -- storage.Interface contract through consensus -----------------------------
+
+
+class TestQuorumStoreContract:
+    def test_contract_single_member(self, tmp_path):
+        """A 1-member quorum is an instant leader: run the core
+        MemoryStore contract through the full propose/apply path."""
+        store = QuorumStore(NodeConfig(
+            node_id="solo", data_dir=str(tmp_path),
+            election_timeout=0.05))
+        store.start()
+        try:
+            wait_leader([store])
+            rv1 = store.create("/pods/a", {"v": 1})
+            with pytest.raises(KeyExists):
+                store.create("/pods/a", {"v": 1})
+            with pytest.raises(Conflict):
+                store.update("/pods/a", {"v": 2}, expect_rv=rv1 + 99)
+            rv2 = store.update("/pods/a", {"v": 2}, expect_rv=rv1)
+            assert rv2 > rv1
+            assert store.get("/pods/a")[0] == {"v": 2}
+            with pytest.raises(KeyNotFound):
+                store.delete("/pods/missing")
+            gone = store.delete("/pods/a", expect_rv=rv2)
+            assert gone == {"v": 2}
+            # guaranteed_update create-on-missing + mutate
+            store.guaranteed_update(
+                "/pods/b", lambda cur: {"n": 1} if cur is None
+                else cur, ignore_not_found=True)
+            store.guaranteed_update(
+                "/pods/b", lambda cur: dict(cur, n=cur["n"] + 1))
+            assert store.get("/pods/b")[0] == {"n": 2}
+        finally:
+            store.close()
+
+    def test_watchers_see_only_committed_writes(self, cluster3):
+        lead = wait_leader(cluster3)
+        follower = next(s for s in cluster3 if s is not lead)
+        stream = follower.watch("/pods/")
+        lead.create("/pods/w1", {"v": 1})
+        ev = stream.next_event(timeout=10)
+        assert ev.type == "ADDED" and ev.object == {"v": 1}
+        # events carry the rv the write acked with
+        assert ev.resource_version == lead.get("/pods/w1")[1]
+        stream.stop()
+
+    def test_update_batch_and_delete_through_consensus(self, cluster3):
+        lead = wait_leader(cluster3)
+        follower = next(s for s in cluster3 if s is not lead)
+        for i in range(6):
+            lead.create(f"/pods/b{i}", {"v": i})
+        # the batch door FROM A FOLLOWER: mutations + a batch delete
+        # in one conditional batch (the forwarded-cas path)
+        results = follower.update_batch(
+            [(f"/pods/b{i}",
+              (lambda o: DELETE_OBJECT) if i % 2
+              else (lambda o: dict(o, bumped=True)))
+             for i in range(6)]
+            + [("/pods/missing", lambda o: o)]
+        )
+        assert results[:6] == [None] * 6
+        assert isinstance(results[6], KeyNotFound)
+        objs, _ = lead.list("/pods/")
+        assert len(objs) == 3
+        assert all(o.get("bumped") for o in objs)
+
+    def test_create_batch_per_item_isolation(self, cluster3):
+        lead = wait_leader(cluster3)
+        lead.create("/pods/dup", {"v": 0})
+        res = lead.create_batch([
+            ("/pods/n1", {"v": 1}),
+            ("/pods/dup", {"v": 2}),  # KeyExists, isolated
+            ("/pods/n2", {"v": 3}),
+        ])
+        assert res[0] is None and res[2] is None
+        assert isinstance(res[1], KeyExists)
+        assert lead.get("/pods/dup")[0] == {"v": 0}
+
+    def test_guaranteed_update_conflict_retry_across_members(
+            self, cluster3):
+        """Two members racing guaranteed_update on one key must both
+        land (the CAS retry loop absorbs the Conflict)."""
+        lead = wait_leader(cluster3)
+        f1 = next(s for s in cluster3 if s is not lead)
+        lead.create("/pods/ctr", {"n": 0})
+        import threading
+
+        def bump(store, times):
+            for _ in range(times):
+                store.guaranteed_update(
+                    "/pods/ctr", lambda cur: dict(cur, n=cur["n"] + 1))
+
+        ths = [threading.Thread(target=bump, args=(s, 10))
+               for s in (lead, f1)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert lead.get("/pods/ctr")[0]["n"] == 20
+
+
+# -- multi-apiserver smoke ----------------------------------------------------
+
+
+class TestMultiAPIServer:
+    def test_two_apiservers_one_quorum(self, tmp_path):
+        """Two APIServer instances over the same 3-member quorum:
+        writes through the FORWARDING FOLLOWER's server land for
+        readers of the leader's server, watches fan out on both, and
+        /healthz names the member identity (the horizontally-scaled
+        apiserver shape the wire-soak protocol documents)."""
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTClient
+        from kubernetes_tpu.client.transport import LocalTransport
+
+        stores = build_cluster(str(tmp_path), 3, election_timeout=0.15)
+        try:
+            lead = wait_leader(stores)
+            follower = next(s for s in stores if s is not lead)
+            api_lead = APIServer(store=lead)
+            api_follow = APIServer(store=follower)
+            c_lead = RESTClient(LocalTransport(api_lead))
+            c_follow = RESTClient(LocalTransport(api_follow))
+
+            from kubernetes_tpu.api.types import (
+                Container, ObjectMeta, Pod, PodSpec,
+            )
+
+            def pod(name):
+                return Pod(metadata=ObjectMeta(name=name),
+                           spec=PodSpec(containers=[Container(
+                               requests={"cpu": "50m"})]))
+
+            # watch on the leader's server, write through the follower
+            stream = c_lead.pods().watch()
+            for i in range(20):
+                c_follow.pods().create(pod(f"fwd-{i:02d}"))
+            objs, _ = c_lead.pods().list()
+            assert len(objs) == 20
+            seen = set()
+            for ev_type, obj in stream:
+                if ev_type == "ADDED":
+                    seen.add(obj.metadata.name)
+                if len(seen) >= 20:
+                    break
+            stream.stop()
+            # healthz identity: the two servers answer as different
+            # members of the same quorum, exactly one leading
+            h1 = api_lead.handle("GET", "/healthz", {}, None)[1]
+            h2 = api_follow.handle("GET", "/healthz", {}, None)[1]
+            assert h1["quorum"]["node"] != h2["quorum"]["node"]
+            assert h1["quorum"]["leader"] == h2["quorum"]["leader"]
+            assert {h1["quorum"]["role"], h2["quorum"]["role"]} == \
+                {"leader", "follower"}
+        finally:
+            for s in stores:
+                s.close()
+
+
+# -- Jepsen-lite checker unit tests -------------------------------------------
+
+
+class TestLinearizeChecker:
+    def _history(self, events):
+        h = linearize.HistoryRecorder()
+        ids = {}
+        for ev in events:
+            if ev[0] == "invoke":
+                _, name, proc, kind, key, value = ev
+                ids[name] = h.invoke(proc, kind, key, value)
+            elif ev[0] == "ok":
+                _, name, rv, value = ev
+                h.ok(ids[name], rv=rv, value=value)
+            elif ev[0] == "fail":
+                h.fail(ids[ev[1]])
+            else:
+                h.info(ids[ev[1]])
+        return h
+
+    def test_accepts_clean_history(self):
+        h = self._history([
+            ("invoke", "w1", "p0", "write", "k", "a"),
+            ("ok", "w1", 1, None),
+            ("invoke", "r1", "p1", "read", "k", None),
+            ("ok", "r1", 1, "a"),
+            ("invoke", "w2", "p0", "write", "k", "b"),
+            ("ok", "w2", 2, None),
+            ("invoke", "r2", "p1", "read", "k", None),
+            ("ok", "r2", 2, "b"),
+        ])
+        res = linearize.check(h, final_state={"k": ("b", 2)})
+        assert res.ok, res.errors
+        assert res.checked_writes == 2 and res.checked_reads == 2
+
+    def test_rejects_lost_acknowledged_write(self):
+        h = self._history([
+            ("invoke", "w1", "p0", "write", "k", "a"),
+            ("ok", "w1", 5, None),
+        ])
+        res = linearize.check(h, final_state={})
+        assert not res.ok
+        assert any("LOST" in e for e in res.errors)
+
+    def test_rejects_stale_read(self):
+        h = self._history([
+            ("invoke", "w1", "p0", "write", "k", "a"),
+            ("ok", "w1", 1, None),
+            ("invoke", "w2", "p0", "write", "k", "b"),
+            ("ok", "w2", 2, None),
+            # read invoked AFTER w2 completed but observing rv 1
+            ("invoke", "r1", "p1", "read", "k", None),
+            ("ok", "r1", 1, "a"),
+        ])
+        res = linearize.check(h)
+        assert not res.ok
+        assert any("stale read" in e for e in res.errors)
+
+    def test_rejects_value_model_mismatch(self):
+        h = self._history([
+            ("invoke", "w1", "p0", "write", "k", "a"),
+            ("ok", "w1", 1, None),
+            ("invoke", "r1", "p1", "read", "k", None),
+            ("ok", "r1", 1, "WRONG"),
+        ])
+        res = linearize.check(h)
+        assert not res.ok
+
+    def test_indeterminate_write_may_or_may_not_land(self):
+        h = self._history([
+            ("invoke", "w1", "p0", "write", "k", "a"),
+            ("ok", "w1", 1, None),
+            ("invoke", "w2", "p0", "write", "k", "b"),
+            ("info", "w2"),  # timeout: unknown outcome
+        ])
+        # either final state is linearizable
+        assert linearize.check(h, final_state={"k": ("a", 1)}).ok
+        assert linearize.check(h, final_state={"k": ("b", 2)}).ok
+        # but a state OLDER than the acked write is still a loss
+        assert not linearize.check(h, final_state={}).ok
+
+    def test_rejects_real_time_inversion(self):
+        h = linearize.HistoryRecorder()
+        a = h.invoke("p0", "write", "k1", "a")
+        h.ok(a, rv=9)  # completed, serialized at 9
+        time.sleep(0.002)
+        b = h.invoke("p1", "write", "k2", "b")  # invoked after a ok'd
+        h.ok(b, rv=3)  # ...but claims an earlier point
+        res = linearize.check(h)
+        assert not res.ok
+        assert any("inversion" in e for e in res.errors)

@@ -1,0 +1,257 @@
+"""Quorum fault-injection gates: under each injected single fault —
+kill -9 of the leader or a follower, a symmetric partition, an
+asymmetric one-way delay with message-reordering jitter — a 3-member
+quorum must lose ZERO acknowledged writes, elect at most one leader
+per term, and produce an op history the Jepsen-lite linearizability
+checker accepts (storage/quorum/linearize.py) — an assertion, not a
+log line. The lock-order sanitizer is armed over every scenario."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from conftest import wait_until  # noqa: E402
+
+from kubernetes_tpu.analysis import locks as lock_sanitizer
+from kubernetes_tpu.harness.nemesis import Nemesis
+from kubernetes_tpu.storage.quorum import NodeConfig, QuorumStore
+from kubernetes_tpu.storage.quorum import linearize
+from kubernetes_tpu.storage.store import KeyExists, KeyNotFound, Conflict
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sanitizer():
+    with lock_sanitizer.instrumented():
+        yield
+    lock_sanitizer.assert_no_cycles("(quorum chaos suite)")
+
+
+KEYS = [f"/reg/k{i:02d}" for i in range(12)]
+
+
+@pytest.fixture
+def chaos_cluster(tmp_path):
+    stores = [QuorumStore(
+        NodeConfig(
+            node_id=f"q{i}",
+            data_dir=str(tmp_path / f"q{i}"),
+            election_timeout=0.2,
+        ),
+        write_timeout=3.0, read_timeout=3.0,
+    ) for i in range(3)]
+    nem = Nemesis({s.node_id: s.address for s in stores})
+    for s in stores:
+        s.set_peers(nem.peer_view(s.node_id))
+        s.start()
+    try:
+        yield stores, nem
+    finally:
+        for s in stores:
+            s.close()
+        nem.close()
+
+
+def wait_leader(stores, exclude=(), timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for s in stores:
+            if s not in exclude and s.node.is_leader():
+                return s
+        time.sleep(0.02)
+    raise AssertionError("no leader within %ss" % timeout)
+
+
+class Workload:
+    """Writer + reader threads against random members, every op
+    recorded in the linearizability history. Indeterminate outcomes
+    (unavailable/timeout) are `info`; definite store errors are
+    `fail`."""
+
+    def __init__(self, stores, writers=3, readers=2):
+        self.stores = stores
+        self.history = linearize.HistoryRecorder()
+        self.stop = threading.Event()
+        self._serial = [0] * writers
+        self.threads = [
+            threading.Thread(target=self._writer, args=(i,),
+                             daemon=True, name=f"chaos-writer-{i}")
+            for i in range(writers)
+        ] + [
+            threading.Thread(target=self._reader, args=(i,),
+                             daemon=True, name=f"chaos-reader-{i}")
+            for i in range(readers)
+        ]
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def finish(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in self.threads), (
+            "workload thread wedged past the write deadline")
+
+    def _writer(self, wid):
+        rng = random.Random(1000 + wid)
+        h = self.history
+        proc = f"w{wid}"
+        while not self.stop.is_set():
+            store = rng.choice(self.stores)
+            key = rng.choice(KEYS)
+            self._serial[wid] += 1
+            value = f"{proc}:{self._serial[wid]}"
+            op = h.invoke(proc, "write", key, value)
+            try:
+                try:
+                    rv = store.update(key, value)
+                except KeyNotFound:
+                    rv = store.create(key, value)
+                h.ok(op, rv=rv)
+            except (KeyExists, KeyNotFound, Conflict):
+                h.fail(op)  # definite non-occurrence
+            except Exception:
+                h.info(op)  # unavailable/timeout: outcome unknown
+            time.sleep(rng.uniform(0.002, 0.02))
+
+    def _reader(self, rid):
+        rng = random.Random(2000 + rid)
+        h = self.history
+        proc = f"r{rid}"
+        while not self.stop.is_set():
+            store = rng.choice(self.stores)
+            key = rng.choice(KEYS)
+            op = h.invoke(proc, "read", key)
+            try:
+                # get() returns the object's own mod-rv — the read's
+                # serialization point for its key
+                obj, rv = store.get(key)
+                h.ok(op, rv=rv, value=obj)
+            except KeyNotFound:
+                h.fail(op)  # negative reads stay out of the model
+            except Exception:
+                h.info(op)
+            time.sleep(rng.uniform(0.002, 0.02))
+
+
+def assert_chaos_gates(stores, history, live=None, fault=""):
+    """The three chaos acceptance gates: convergence + at most one
+    leader per term + a linearizable history with zero lost acks."""
+    live = [s for s in (live or stores)]
+    lead = wait_leader(live)
+    # quiesce: a final barrier so the leader's applied state is the
+    # full committed history
+    lead.read_index()
+    assert wait_until(
+        lambda: all(
+            s.node.status()["applied_index"]
+            >= lead.node.status()["commit_index"]
+            for s in live),
+        timeout=20), "members never converged after heal"
+    # gate: at most one leader per term, across every member that
+    # ever lived (killed members' claims count too)
+    claimed = {}
+    for s in stores:
+        for t in s.node.terms_led:
+            claimed.setdefault(t, []).append(s.node_id)
+    double = {t: who for t, who in claimed.items() if len(who) > 1}
+    assert not double, f"[{fault}] two leaders in one term: {double}"
+    # gate: linearizable history, zero lost acknowledged writes
+    with lead._lock:
+        final = {k: (v, rv) for k, (v, rv) in lead._data.items()
+                 if k.startswith("/reg/")}
+    res = linearize.check(history, final_state=final)
+    assert res.ok, (
+        f"[{fault}] linearizability violations "
+        f"({res.checked_writes} writes, {res.checked_reads} reads): "
+        + "; ".join(res.errors))
+    assert res.checked_writes > 0, "workload recorded no writes"
+
+
+def test_chaos_kill_leader(chaos_cluster):
+    """kill -9 the LEADER mid-traffic: a new leader takes over, no
+    acknowledged write is lost, history stays linearizable."""
+    stores, _nem = chaos_cluster
+    lead = wait_leader(stores)
+    w = Workload(stores).start()
+    try:
+        time.sleep(1.0)
+        lead.kill()
+        wait_leader(stores, exclude=(lead,))
+        time.sleep(1.5)
+    finally:
+        w.finish()
+    live = [s for s in stores if s is not lead]
+    assert_chaos_gates(stores, w.history, live=live,
+                       fault="kill-leader")
+
+
+def test_chaos_kill_follower(chaos_cluster):
+    """kill -9 a FOLLOWER: the majority keeps acking writes
+    throughout (no availability cliff), nothing is lost."""
+    stores, _nem = chaos_cluster
+    lead = wait_leader(stores)
+    victim = next(s for s in stores if s is not lead)
+    w = Workload(stores).start()
+    try:
+        time.sleep(0.8)
+        before = w.history.ops()
+        victim.kill()
+        time.sleep(1.5)
+        # liveness through the fault: acked writes kept flowing
+        after = [o for o in w.history.ops()[len(before):]
+                 if o.kind == "write" and o.status == linearize.OK]
+        assert len(after) > 0, "no write acked with one follower down"
+    finally:
+        w.finish()
+    live = [s for s in stores if s is not victim]
+    assert_chaos_gates(stores, w.history, live=live,
+                       fault="kill-follower")
+
+
+def test_chaos_symmetric_partition(chaos_cluster):
+    """Partition the leader away from both followers: the majority
+    side elects (one leader per term — the deposed leader can commit
+    nothing), heals, and the stitched history is linearizable."""
+    stores, nem = chaos_cluster
+    lead = wait_leader(stores)
+    others = [s.node_id for s in stores if s is not lead]
+    w = Workload(stores).start()
+    try:
+        time.sleep(0.8)
+        nem.partition([lead.node_id], others)
+        wait_leader(stores, exclude=(lead,))
+        time.sleep(1.5)
+        nem.heal()
+        # old leader rejoins as follower
+        assert wait_until(lambda: not lead.node.is_leader(),
+                          timeout=10)
+        time.sleep(1.0)
+    finally:
+        w.finish()
+    assert_chaos_gates(stores, w.history, fault="symmetric-partition")
+
+
+def test_chaos_asymmetric_delay_and_reorder(chaos_cluster):
+    """Asymmetric one-way delay (the leader's bytes reach one
+    follower late; the reverse path is fast) plus reordering jitter
+    on the other edge: terms may churn, but nothing acked is lost and
+    the history stays linearizable."""
+    stores, nem = chaos_cluster
+    lead = wait_leader(stores)
+    followers = [s for s in stores if s is not lead]
+    w = Workload(stores).start()
+    try:
+        time.sleep(0.8)
+        nem.one_way_delay(lead.node_id, followers[0].node_id, 0.4)
+        nem.jitter(followers[1].node_id, lead.node_id, 0.2)
+        time.sleep(2.0)
+        nem.heal()
+        time.sleep(1.0)
+    finally:
+        w.finish()
+    assert_chaos_gates(stores, w.history, fault="asymmetric-delay")
