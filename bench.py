@@ -255,12 +255,11 @@ def run_bench_matrix():
 
 def rss_mb():
     """This process's resident set in MB (the soak gates' flat-RSS
-    probe; both churn soaks sample it)."""
-    with open("/proc/self/status") as f:
-        for line in f:
-            if line.startswith("VmRSS:"):
-                return int(line.split()[1]) / 1024.0
-    return 0.0
+    probe; both churn soaks sample it). One parser for every gate:
+    the wire soak's copy in harness/soak.py is the canonical one."""
+    from kubernetes_tpu.harness.soak import rss_mb as _rss_mb
+
+    return _rss_mb()
 
 
 def run_soak(seconds: int):
@@ -451,494 +450,56 @@ def _assert_sanitizers_off():
 
 def run_wire_soak(seconds: int, num_nodes: int = 1000,
                   rate: float = 300.0, slo: float = 5.0,
-                  store_profile: str = "memory"):
-    """Sustained-traffic WIRE soak (ROADMAP scale-out item (b)):
-    Poisson continuous arrivals through the full wire path —
-    apiserver (TLV/HTTP) -> scheduler daemon -> batched bind ->
-    hollow-kubelet Running ack — against a `num_nodes` hollow-node
-    fleet heartbeating through /api/v1/batch, with balanced deletion
-    churn once the population fills. Gates, measured over the
-    steady-state window (after the warm ramp):
+                  store_profile: str = "memory", scenario: str = "",
+                  smoke: bool = False, ab: bool = False,
+                  explicit=()):
+    """Sustained-traffic WIRE soak, plus the named chaos scenarios
+    (noisy-neighbor / rack-failure / rolling-update / burst). The
+    machinery lives in kubernetes_tpu.harness.soak so the scenario
+    smokes also run inside tier-1; this wrapper owns the CLI contract:
+    print one JSON line, merge the record into BENCH_r08.json under its
+    scenario-qualified key, exit non-zero on a gate breach.
 
-      * p99 created->bound latency <= `slo` seconds
-      * zero XLA recompiles (CompileSentinel)
-      * flat RSS (+-10%)
-      * zero dropped watch events
-
-    Prints one JSON line, merges it under "wire_soak" in BENCH_r08.json
-    and exits non-zero on a gate breach. Protocol: 60s in CI
-    (`python bench.py --wire-soak 60`); the production-realism run is
-    the same command for hours (`--wire-soak 14400`), where the flat-RSS
-    and zero-recompile gates actually bite."""
-    import random
-    import threading
-    from collections import deque
-
+    Protocol: 60s in CI (`python bench.py --wire-soak 60`); the
+    production-realism run is the same command for hours
+    (`--wire-soak 14400 --wire-soak-scenario rack-failure`), where the
+    flat-RSS and zero-recompile gates actually bite. `explicit` names
+    the knobs the CLI user actually passed, so scenario defaults only
+    fill the rest."""
     _assert_sanitizers_off()
-    # continuous arrivals never give the daemon the 5s idle window the
-    # deferred scan warm waits for; compile everything up front
-    os.environ.setdefault("KUBERNETES_TPU_WARM_SCAN", "1")
-    # per-bind Events are the one store population that grows without
-    # bound under sustained traffic; expire them fast enough that the
-    # steady-state store — and therefore the flat-RSS gate — sees a
-    # flat population (the apiserver's --event-ttl analogue)
-    os.environ.setdefault("KUBERNETES_TPU_EVENT_TTL",
-                          str(min(3600, max(15, seconds // 4))))
-    from kubernetes_tpu.native.build import ensure_all
-
-    ensure_all()
-
-    from kubernetes_tpu.analysis.compile_guard import CompileSentinel
-    from kubernetes_tpu.api.types import (
-        Container,
-        ObjectMeta,
-        Pod,
-        PodSpec,
-    )
-    from kubernetes_tpu.apiserver.server import APIServer
-    from kubernetes_tpu.client.rest import RESTClient, batch_delete_item
-    from kubernetes_tpu.client.transport import HTTPTransport
-    from kubernetes_tpu.kubemark.fleet import FleetConfig, HollowFleet
-    from kubernetes_tpu.metrics import (
-        apiserver_requests_total,
-        apiserver_watch_cache_hits_total,
-        apiserver_watch_cache_misses_total,
-        apiserver_watch_coalesced_frame_bytes,
-        apiserver_watch_coalesced_frame_objects,
-        apiserver_watch_events_sent_total,
-        storage_watch_cache_ring_evictions_total,
-        storage_watch_events_dropped_total,
-        storage_watch_fanout_pruned_total,
-    )
-    from kubernetes_tpu.scheduler.server import (
-        SchedulerServer,
-        SchedulerServerOptions,
+    from kubernetes_tpu.harness.soak import (
+        SoakConfig,
+        run_wire_soak as _run_soak,
+        scenario_config,
     )
 
+    from kubernetes_tpu.apiserver.flowcontrol import enabled_in_env
 
-    quorum_stores = []
-    api2 = None
-    if store_profile == "quorum":
-        # multi-apiserver HA profile: a 3-member consensus store with
-        # TWO apiservers over it — one on the leader member (the hot
-        # path), one on a follower (every write it takes is forwarded
-        # to the leader; reads barrier through read-index). The
-        # creator drives the follower so the forwarding path carries
-        # the arrival stream; scheduler + fleet ride the leader.
-        import tempfile
-
-        from kubernetes_tpu.storage.quorum import build_cluster
-
-        qdir = tempfile.mkdtemp(prefix="quorum-soak-")
-        quorum_stores = build_cluster(qdir, 3)
-        deadline_q = time.time() + 30
-        leader_store = None
-        while time.time() < deadline_q and leader_store is None:
-            leader_store = next(
-                (s for s in quorum_stores if s.node.is_leader()), None)
-            time.sleep(0.05)
-        if leader_store is None:
-            raise RuntimeError("quorum never elected a leader")
-        follower_store = next(s for s in quorum_stores
-                              if s is not leader_store)
-        api = APIServer(store=leader_store)
-        api2 = APIServer(store=follower_store)
-        host, port = api.serve_http(enable_binary=True)
-        h2, p2 = api2.serve_http(enable_binary=True)
-        url = f"http://{host}:{port},http://{h2}:{p2}"
-        creator_url = f"http://{h2}:{p2},http://{host}:{port}"
-        print(f"# wire-soak: QUORUM store ({len(quorum_stores)} "
-              f"members, leader {leader_store.node_id}); apiservers "
-              f"at {url} (scheduler/fleet -> leader, creator -> "
-              "forwarding follower)", file=sys.stderr)
+    apf_on = enabled_in_env()
+    if scenario:
+        overrides = {
+            k: v for k, v in (("num_nodes", num_nodes), ("rate", rate),
+                              ("slo", slo))
+            if k in explicit
+        }
+        cfg = scenario_config(scenario, seconds, smoke=smoke,
+                              store_profile=store_profile, apf=apf_on,
+                              ab_compare=ab, **overrides)
     else:
-        api = APIServer()
-        host, port = api.serve_http(enable_binary=True)
-        url = f"http://{host}:{port}"
-        creator_url = url
-        print(f"# wire-soak: apiserver (in-process TLV/HTTP wire) at "
-              f"{url}", file=sys.stderr)
-    sentinel = CompileSentinel()
-    # fleet first: the scheduler's warmup compiles against the node
-    # count its informer sees, so the hollow nodes must be registered
-    # before the daemon starts or the real node-axis shape compiles
-    # against live traffic instead of in warmup
-    fleet_client = RESTClient(HTTPTransport(url, binary=True,
-                                            timeout=180.0))
-    fleet = HollowFleet(fleet_client, FleetConfig(num_nodes=num_nodes))
-    fleet.run()
-    print(f"# wire-soak: {num_nodes} hollow nodes registered, "
-          f"{len(fleet._threads)} fleet threads "
-          f"(shards of {fleet.config.shard_size} + the pacer)",
-          file=sys.stderr)
-    sched_client = RESTClient(HTTPTransport(url, binary=True,
-                                            timeout=180.0))
-    sched = SchedulerServer(
-        sched_client,
-        SchedulerServerOptions(algorithm_provider="TPUProvider",
-                               serve_port=None),
-    ).start()
-    if not sched.ready.wait(600):
-        raise RuntimeError("scheduler daemon never became ready")
-
-    client = RESTClient(HTTPTransport(creator_url, binary=True,
-                                      timeout=180.0))
-    stop = threading.Event()
-    lock = threading.Lock()
-    created: dict = {}          # name -> create time (unbound pods)
-    bound_order: deque = deque()  # names in bind order (churn victims)
-    latencies: list = []        # (observe time, created->bound seconds)
-    counts = {"created": 0, "bound": 0, "deleted": 0,
-              "driver_watch_events": 0, "driver_relists": 0}
-    rng = random.Random(1729)
-
-    def pod_template(name: str) -> Pod:
-        return Pod(
-            metadata=ObjectMeta(name=name,
-                                labels={"name": "sched-perf"}),
-            spec=PodSpec(containers=[Container(
-                requests={"cpu": "100m", "memory": "500Mi"})]),
-        )
-
-    churn_floor = max(2048, int(rate * 8))
-
-    def creator_loop():
-        """Poisson arrivals at `rate` pods/s: exponential inter-arrival
-        gaps accumulated per 100ms tick, the tick's due pods riding one
-        bulk-create request (an RC manager bursts its replica delta the
-        same way). Starts with a burst straight to the churn floor:
-        steady-state node occupancy — and the value-vocab program
-        shapes it compiles (the vocab width grows as churn diversifies
-        per-node free capacity) — must be reached INSIDE the warm ramp,
-        deterministically, not floor/rate seconds in where the last
-        cold compile straddles the gate boundary."""
-        serial = 0
-        for i in range(0, churn_floor, 1500):
-            if stop.is_set():
-                return
-            due = [f"soak-{serial + j:08d}"
-                   for j in range(min(1500, churn_floor - i))]
-            serial += len(due)
-            t0 = time.time()
-            with lock:
-                for nm in due:
-                    created[nm] = t0
-                counts["created"] += len(due)
-            try:
-                client.pods().create_many(
-                    [pod_template(nm) for nm in due])
-            except Exception as e:
-                print(f"# wire-soak prefill error: {e}", file=sys.stderr)
-                with lock:
-                    for nm in due:
-                        created.pop(nm, None)
-                    counts["created"] -= len(due)
-        next_arrival = time.monotonic()
-        while not stop.is_set():
-            tick_end = time.monotonic() + 0.1
-            due = []
-            while next_arrival <= tick_end:
-                due.append(f"soak-{serial:08d}")
-                serial += 1
-                next_arrival += rng.expovariate(rate)
-            if due:
-                t0 = time.time()
-                with lock:
-                    for nm in due:
-                        created[nm] = t0
-                    counts["created"] += len(due)
-                try:
-                    client.pods().create_many(
-                        [pod_template(nm) for nm in due])
-                except Exception as e:
-                    if not stop.is_set():
-                        print(f"# wire-soak creator error: {e}",
-                              file=sys.stderr)
-                    with lock:
-                        for nm in due:
-                            created.pop(nm, None)
-                        counts["created"] -= len(due)
-            delay = tick_end - time.monotonic()
-            if delay > 0:
-                stop.wait(delay)
-
-    observer_stream = [None]
-
-    def observer_loop():
-        """created->bound latency probe: one full pod watch (the
-        measurement apparatus, not the product path) records the first
-        time each soak pod shows up with a node assigned."""
-        pods = client.pods()
-        from_rv = "0"
-        first = True
-        while not stop.is_set():
-            try:
-                if not first:
-                    with lock:
-                        counts["driver_relists"] += 1
-                objs, rv = pods.list()
-                now = time.time()
-                with lock:
-                    for p in objs:
-                        if not p.spec.node_name:
-                            continue  # unbound: keep its create stamp
-                        t0 = created.pop(p.metadata.name, None)
-                        if t0 is not None:
-                            latencies.append((now, now - t0))
-                            bound_order.append(p.metadata.name)
-                            counts["bound"] += 1
-                first = False
-                stream = pods.watch(resource_version=rv)
-                observer_stream[0] = stream
-                for ev_type, obj in stream:
-                    if stop.is_set():
-                        return
-                    now = time.time()
-                    with lock:
-                        counts["driver_watch_events"] += 1
-                        if ev_type == "DELETED" or not obj.spec.node_name:
-                            continue
-                        t0 = created.pop(obj.metadata.name, None)
-                        if t0 is not None:
-                            latencies.append((now, now - t0))
-                            bound_order.append(obj.metadata.name)
-                            counts["bound"] += 1
-            except Exception as e:
-                if stop.is_set():
-                    return
-                print(f"# wire-soak observer error: {e}",
-                      file=sys.stderr)
-                stop.wait(0.5)
-
-    def churn_loop():
-        """Balanced deletion: once the bound population passes the
-        floor, delete oldest-first at arrival rate (through the batch
-        door), so steady-state population — and therefore honest RSS —
-        is flat and the fleet's deletion-observation path runs hot."""
-        while not stop.is_set():
-            victims = []
-            with lock:
-                while (len(bound_order) > churn_floor
-                       and len(victims) < 1024):
-                    victims.append(bound_order.popleft())
-            if victims:
-                try:
-                    client.commit_batch([
-                        batch_delete_item("pods", nm) for nm in victims
-                    ])
-                    with lock:
-                        counts["deleted"] += len(victims)
-                except Exception as e:
-                    if not stop.is_set():
-                        print(f"# wire-soak churn error: {e}",
-                              file=sys.stderr)
-            stop.wait(0.5)
-
-    threads = [
-        threading.Thread(target=creator_loop, name="soak-creator",
-                         daemon=True),
-        threading.Thread(target=observer_loop, name="soak-observer",
-                         daemon=True),
-        threading.Thread(target=churn_loop, name="soak-churn",
-                         daemon=True),
-    ]
-
-    def snap_counters():
-        if quorum_stores:
-            from kubernetes_tpu.metrics import (
-                quorum_leader_changes_total,
-                quorum_snapshot_installs_total,
-            )
-
-            quorum_extra = {
-                "leader_changes": quorum_leader_changes_total.total(),
-                "snapshot_installs":
-                    quorum_snapshot_installs_total.get(),
-            }
-        else:
-            quorum_extra = {}
-        return {
-            "quorum": quorum_extra,
-            "requests": apiserver_requests_total.total(),
-            "events_sent": apiserver_watch_events_sent_total.get(),
-            "cache_hits": apiserver_watch_cache_hits_total.get(),
-            "cache_misses": apiserver_watch_cache_misses_total.get(),
-            "dropped": storage_watch_events_dropped_total.get(),
-            "pruned": storage_watch_fanout_pruned_total.get(),
-            "ring_evictions":
-                storage_watch_cache_ring_evictions_total.get(),
-            "frames": apiserver_watch_coalesced_frame_objects.count,
-            "frame_objects":
-                apiserver_watch_coalesced_frame_objects.sum,
-            "frame_bytes": apiserver_watch_coalesced_frame_bytes.sum,
-            "compiles": sentinel.compile_count(),
-            "fleet": fleet.snapshot_stats(),
-        }
-
-    record = {"metric": "wire_soak", "seconds": seconds,
-              "hollow_nodes": num_nodes,
-              "arrival_rate_pods_per_sec": rate,
-              "slo_p99_seconds": slo,
-              "store_profile": store_profile}
-    try:
-        for th in threads:
-            th.start()
-        t_start = time.time()
-        # wide enough that the pre-fill binds, churn opens, and the
-        # vocab-growth compiles all land before the gates arm — but
-        # never more than half the run, so short smokes keep a
-        # non-empty steady window
-        warm_secs = min(max(15.0, 0.33 * seconds), 45.0,
-                        0.5 * seconds)
-        deadline = t_start + seconds
-        warm_end = t_start + warm_secs
-        # warm ramp: arrivals flow, compiles/caches settle, gates blind
-        while time.time() < warm_end:
-            time.sleep(0.25)
-        base = snap_counters()
-        rss_samples = [rss_mb()]
-        t_steady = time.time()
-        next_rss = t_steady + 1.0
-        while time.time() < deadline:
-            time.sleep(0.25)
-            if time.time() >= next_rss:
-                rss_samples.append(rss_mb())
-                next_rss += 1.0
-        end = snap_counters()
-        steady_secs = time.time() - t_steady
-        # diagnostics while the stack is still up: what the store
-        # holds (leak forensics) and what compiled mid-steady-state
-        from collections import Counter as _Counter
-
-        with api.store._lock:
-            store_counts = _Counter(
-                k.split("/")[1] for k in api.store._data)
-        record["store_objects_at_stop"] = dict(store_counts)
-        with sentinel._mu:
-            steady_compile_events = [
-                ev for ev, _dur in sentinel.events[int(base["compiles"]):]
-            ]
-        if steady_compile_events:
-            print("# steady-state compiles: "
-                  + ", ".join(steady_compile_events), file=sys.stderr)
-    finally:
-        stop.set()
-        if observer_stream[0] is not None:
-            try:
-                observer_stream[0].stop()
-            except Exception:
-                pass
-        for th in threads:
-            th.join(timeout=10)
-        fleet.stop()
-        sched.stop()
-        api.shutdown_http()
-        api.close_cachers()
-        if api2 is not None:
-            api2.shutdown_http()
-            api2.close_cachers()
-        for qs in quorum_stores:
-            try:
-                qs.close()
-            except Exception:
-                pass
-        for c in (sched_client, fleet_client, client):
-            try:
-                c.transport.close()
-            except Exception:
-                pass
-
-    with lock:
-        steady_lat = sorted(
-            dt for (t, dt) in latencies if t >= t_steady)
-        final_counts = dict(counts)
-        backlog = len(created)
-
-    def pct(q):
-        if not steady_lat:
-            return None  # renders as JSON null, not bare NaN
-        return round(steady_lat[min(len(steady_lat) - 1,
-                                    int(q * len(steady_lat)))], 4)
-
-    p50, p99 = pct(0.50), pct(0.99)
-    d = {k: end[k] - base[k] for k in end
-         if k not in ("fleet", "quorum")}
-    fleet_d = {k: end["fleet"][k] - base["fleet"][k]
-               for k in end["fleet"]}
-    rss_base = statistics.median(rss_samples[:5])
-    rss_end = statistics.median(rss_samples[-5:])
-    rss_drift = (rss_end - rss_base) / max(rss_base, 1.0)
-    record.update({
-        "steady_seconds": round(steady_secs, 1),
-        "pods_created": final_counts["created"],
-        "pods_bound": final_counts["bound"],
-        "pods_deleted": final_counts["deleted"],
-        "bind_backlog_at_stop": backlog,
-        "steady_bound_pods_per_sec": round(
-            len(steady_lat) / max(steady_secs, 1e-9), 1),
-        "p50_created_to_bound_seconds": p50,
-        "p99_created_to_bound_seconds": p99,
-        "steady_state_compiles": int(d["compiles"]),
-        "rss_start_mb": round(rss_base, 1),
-        "rss_end_mb": round(rss_end, 1),
-        "rss_drift_frac": round(rss_drift, 4),
-        "watch_events_dropped": int(d["dropped"]),
-        "driver_relists": final_counts["driver_relists"],
-        "steady_accounting": {
-            "apiserver_requests": int(d["requests"]),
-            "watch_events_sent": int(d["events_sent"]),
-            "watch_events_delivered_fleet": int(
-                fleet_d["watch_events"]),
-            "watch_events_delivered_driver": final_counts[
-                "driver_watch_events"],
-            "watch_cache_hits": int(d["cache_hits"]),
-            "watch_cache_misses": int(d["cache_misses"]),
-            "fanout_pruned": int(d["pruned"]),
-            "ring_evictions": int(d["ring_evictions"]),
-            "coalesced_frames": int(d["frames"]),
-            "coalesced_frame_objects": int(d["frame_objects"]),
-            "coalesced_frame_bytes": int(d["frame_bytes"]),
-            "fleet_heartbeats": int(fleet_d["heartbeats"]),
-            "fleet_transitions": int(fleet_d["transitions"]),
-            "fleet_deletions_observed": int(
-                fleet_d["deletions_observed"]),
-            "fleet_batch_requests": int(fleet_d["batch_requests"]),
-            "fleet_relists": int(fleet_d["relists"]),
-        },
-    })
-    if quorum_stores:
-        from kubernetes_tpu.metrics import quorum_append_rtt_seconds
-
-        record["quorum_accounting"] = {
-            "members": len(quorum_stores),
-            "steady_leader_changes": int(
-                end["quorum"]["leader_changes"]
-                - base["quorum"]["leader_changes"]),
-            "steady_snapshot_installs": int(
-                end["quorum"]["snapshot_installs"]
-                - base["quorum"]["snapshot_installs"]),
-            "append_rtt_p50_seconds":
-                quorum_append_rtt_seconds.percentile(0.50),
-            "append_rtt_p99_seconds":
-                quorum_append_rtt_seconds.percentile(0.99),
-            "statuses": [s.quorum_status() for s in quorum_stores],
-        }
-    gates = {
-        "p99_within_slo": bool(steady_lat) and p99 <= slo,
-        "zero_steady_state_compiles": d["compiles"] == 0,
-        "rss_flat": abs(rss_drift) <= 0.10,
-        "zero_dropped_watch_events": d["dropped"] == 0,
-    }
-    record["gates"] = gates
-    record["ok"] = all(gates.values())
+        cfg = SoakConfig(seconds=seconds, num_nodes=num_nodes,
+                         rate=rate, slo=slo,
+                         store_profile=store_profile, apf=apf_on)
+    record = _run_soak(cfg)
     print(json.dumps(record))
-    # each store profile owns its key: the quorum HA record must not
-    # clobber the single-store baseline (or vice versa)
+    # each store profile and scenario owns its key: a chaos-scenario
+    # record must not clobber the plain-soak baseline (or vice versa)
     soak_key = ("wire_soak" if store_profile == "memory"
                 else f"wire_soak_{store_profile}")
+    if scenario:
+        soak_key += "_" + scenario.replace("-", "_")
     _bench_merge({soak_key: record})
     if not record["ok"]:
-        breached = [k for k, v in gates.items() if not v]
+        breached = [k for k, v in record["gates"].items() if not v]
         print(f"# WIRE-SOAK GATE BREACH: {', '.join(breached)}",
               file=sys.stderr)
         sys.exit(1)
@@ -1222,17 +783,42 @@ def _cli():
              "realism protocol). Default off.",
     )
     ap.add_argument(
-        "--wire-soak-nodes", type=int, default=1000, metavar="N",
-        help="hollow-fleet size for --wire-soak (default 1000)",
+        "--wire-soak-nodes", type=int, default=None, metavar="N",
+        help="hollow-fleet size for --wire-soak (default 1000, or the "
+             "scenario's own default)",
     )
     ap.add_argument(
-        "--wire-soak-rate", type=float, default=300.0, metavar="PODS_S",
-        help="Poisson arrival rate for --wire-soak (default 300/s)",
+        "--wire-soak-rate", type=float, default=None, metavar="PODS_S",
+        help="Poisson arrival rate for --wire-soak (default 300/s, or "
+             "the scenario's own default)",
     )
     ap.add_argument(
-        "--wire-soak-slo", type=float, default=5.0, metavar="SECONDS",
+        "--wire-soak-slo", type=float, default=None, metavar="SECONDS",
         help="steady-state p99 created->bound SLO for --wire-soak "
              "(default 5.0s)",
+    )
+    ap.add_argument(
+        "--wire-soak-scenario", default="", metavar="NAME",
+        choices=["", "noisy-neighbor", "rack-failure", "rolling-update",
+                 "burst"],
+        help="named chaos scenario layered on the soak (each with its "
+             "own gates): noisy-neighbor (1 abusive flow vs N "
+             "well-behaved; APF sheds the abuser), rack-failure "
+             "(a rack of hollow nodes vanishes; eviction wave under "
+             "SLO), rolling-update (many-replica RC rolls v1->v2 "
+             "under SLO), burst (10x Poisson spike absorbed, p99 "
+             "recovers)",
+    )
+    ap.add_argument(
+        "--wire-soak-smoke", action="store_true",
+        help="use the scenario's small CI-smoke parameter set instead "
+             "of the production-realism one",
+    )
+    ap.add_argument(
+        "--wire-soak-ab", action="store_true",
+        help="noisy-neighbor only: also run the APF-off control arm "
+             "and gate on the protection delta (proves APF causes the "
+             "protection, not box luck)",
     )
     ap.add_argument(
         "--wire-soak-store", default="memory",
@@ -1244,9 +830,32 @@ def _cli():
     )
     args = ap.parse_args()
     if args.wire_soak:
-        run_wire_soak(args.wire_soak, num_nodes=args.wire_soak_nodes,
-                      rate=args.wire_soak_rate, slo=args.wire_soak_slo,
-                      store_profile=args.wire_soak_store)
+        if (args.wire_soak_smoke or args.wire_soak_ab) and (
+                not args.wire_soak_scenario):
+            raise SystemExit(
+                "--wire-soak-smoke/--wire-soak-ab require "
+                "--wire-soak-scenario (the plain soak has no "
+                "smoke/A-B parameter sets)")
+        explicit = {
+            name for name, val in (
+                ("num_nodes", args.wire_soak_nodes),
+                ("rate", args.wire_soak_rate),
+                ("slo", args.wire_soak_slo),
+            ) if val is not None
+        }
+        run_wire_soak(
+            args.wire_soak,
+            num_nodes=(args.wire_soak_nodes
+                       if args.wire_soak_nodes is not None else 1000),
+            rate=(args.wire_soak_rate
+                  if args.wire_soak_rate is not None else 300.0),
+            slo=(args.wire_soak_slo
+                 if args.wire_soak_slo is not None else 5.0),
+            store_profile=args.wire_soak_store,
+            scenario=args.wire_soak_scenario,
+            smoke=args.wire_soak_smoke,
+            ab=args.wire_soak_ab,
+            explicit=explicit)
         return
     if args.soak:
         # the mesh needs >=2 devices; re-exec once with the forced

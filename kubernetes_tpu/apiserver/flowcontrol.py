@@ -1,0 +1,480 @@
+"""API priority and fairness at the apiserver door (the reference's
+pkg/util/flowcontrol token buckets generalized into APF-shaped
+queue/dispatch machinery).
+
+Every resource request is classified by its authenticated identity into
+a **flow schema**, each schema maps to a **priority level** with a
+bounded concurrency share (seats), and within a level requests are
+**shuffle-sharded** into per-flow fair queues (flow key = user, or
+namespace for anonymous traffic) so N well-behaved flows are isolated
+from one noisy one: a hot flow can only ever occupy its own hand of
+``hand_size`` queues out of ``queues``, and round-robin dispatch across
+queues gives every active flow's queue equal service. When a flow's
+hand is full the request is shed with 429 + Retry-After instead of
+queueing unboundedly; a queued request that outlives ``queue_wait``
+seconds is shed the same way. The ``exempt`` level (system users:
+scheduler, kubelet/node fleet, controller-manager, loopback) never
+queues — control-plane traffic must not wait behind tenants.
+
+Default-on at the apiserver (server.handle is the single choke point
+both doors funnel through); ``KUBERNETES_TPU_APF=0`` is the kill
+switch. Per-level live state is served on ``/debug/flowcontrol`` and
+the ``apiserver_flowcontrol_*`` metric family tracks wait durations,
+queue depths, sheds, and dispatches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.analysis import races as _races
+from kubernetes_tpu.metrics import (
+    apiserver_flowcontrol_current_inqueue_requests,
+    apiserver_flowcontrol_dispatched_requests_total,
+    apiserver_flowcontrol_rejected_requests_total,
+    apiserver_flowcontrol_request_wait_duration_seconds,
+)
+
+#: identities whose traffic is the control plane itself — never queued
+#: behind tenants. "system:unsecured" is the in-process/loopback
+#: identity (integration-test masters and the insecure-port idiom both
+#: run as cluster-admin in the reference).
+EXEMPT_USERS = frozenset({
+    "system:kube-scheduler",
+    "system:kube-controller-manager",
+    "system:kube-proxy",
+    "system:apiserver",
+    "system:unsecured",
+})
+EXEMPT_USER_PREFIXES = ("system:node:",)
+EXEMPT_GROUPS = frozenset({"system:masters", "system:nodes"})
+
+
+class Rejected(Exception):
+    """Request shed at the apiserver door: the caller should answer 429
+    with Retry-After and the client should back off and retry."""
+
+    def __init__(self, level: str, reason: str, retry_after: int):
+        super().__init__(
+            f"too many requests for priority level {level!r} ({reason}); "
+            f"retry after {retry_after}s"
+        )
+        self.level = level
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+@dataclass(frozen=True)
+class FlowSchema:
+    """One row of the classification table: the first schema whose
+    ``match`` has an opinion wins (flowschema matchingPrecedence)."""
+
+    name: str
+    priority_level: str
+    #: (user, groups, verb, path) -> bool
+    match: Callable[[str, Sequence[str], str, str], bool]
+    #: flow distinguisher: "user" keys queues by caller identity,
+    #: "none" collapses the schema into a single flow
+    distinguisher: str = "user"
+
+    def flow_key(self, user: str) -> str:
+        if self.distinguisher == "user" and user:
+            return f"{self.name}:{user}"
+        return self.name
+
+
+class _Waiter:
+    """One queued request. ``dispatched`` is written by the dispatcher
+    and read back by the waiting thread — both under the level lock;
+    ``queue_index`` lets a timed-out waiter withdraw from its one queue
+    instead of scanning the whole bank."""
+
+    __slots__ = ("flow", "ready", "dispatched", "enqueued_at",
+                 "queue_index")
+
+    def __init__(self, flow: str, enqueued_at: float, queue_index: int):
+        self.flow = flow
+        self.ready = threading.Event()
+        self.dispatched = False
+        self.enqueued_at = enqueued_at
+        self.queue_index = queue_index
+
+
+class PriorityLevel:
+    """Seats + shuffle-sharded fair queues for one priority level.
+
+    Invariant: a request is queued only while every seat is busy, and
+    whenever a seat frees the longest-waiting queue (round-robin
+    cursor) dispatches first — so queues drain fairly across flows no
+    matter how deep one flow's queues are.
+    """
+
+    #: per-flow hand-memo entries retained (flow keys derive from
+    #: caller-controlled identity; the memo must not grow unboundedly)
+    HAND_MEMO_MAX = 1024
+
+    def __init__(
+        self,
+        name: str,
+        seats: int,
+        queues: int = 64,
+        queue_length: int = 128,
+        hand_size: int = 8,
+        exempt: bool = False,
+        queue_wait: float = 15.0,
+    ):
+        self.name = name
+        self.seats = max(1, int(seats))
+        self.exempt = exempt
+        self.queue_length = max(1, int(queue_length))
+        self.hand_size = max(1, min(int(hand_size), max(1, int(queues))))
+        self.queue_wait = queue_wait
+        self._mu = threading.Lock()
+        self._queues: List[deque] = [
+            deque() for _ in range(max(1, int(queues)))
+        ]  # guarded-by: self._mu
+        # flow -> dealt hand, memoized: the hand is a pure function of
+        # (level, flow) and flows are few and stable (one per tenant),
+        # so the blake2b deal runs once per flow, not per enqueue
+        self._hands: Dict[str, List[int]] = {}  # guarded-by: self._mu
+        self._seats_in_use = 0  # guarded-by: self._mu
+        self._waiting = 0  # guarded-by: self._mu
+        self._rr = 0  # guarded-by: self._mu  (round-robin dispatch cursor)
+        # pre-bound metric children (hot path: one dict op per event)
+        self._m_wait = (
+            apiserver_flowcontrol_request_wait_duration_seconds.labels(name)
+        )
+        self._m_inqueue = (
+            apiserver_flowcontrol_current_inqueue_requests.labels(name)
+        )
+        self._m_dispatched = (
+            apiserver_flowcontrol_dispatched_requests_total.child(
+                priority_level=name
+            )
+        )
+        _races.track(self, f"apiserver.flowcontrol.{name}")
+
+    # -- shuffle sharding ----------------------------------------------------
+
+    def hand_for(self, flow: str) -> List[int]:
+        """The flow's deterministic hand of queue indices: dealt without
+        replacement from a hash of (level, flow), so one hot flow can
+        never occupy more than ``hand_size`` of the level's queues.
+        acquire() memoizes the dealt hand per flow."""
+        n = len(self._queues)
+        h = int.from_bytes(
+            hashlib.blake2b(
+                f"{self.name}/{flow}".encode(), digest_size=16
+            ).digest(),
+            "big",
+        )
+        avail = list(range(n))
+        hand: List[int] = []
+        for _ in range(min(self.hand_size, n)):
+            i = h % len(avail)
+            h //= max(len(avail), 1)
+            hand.append(avail.pop(i))
+        return hand
+
+    # -- admission -----------------------------------------------------------
+
+    def acquire(self, flow: str) -> float:
+        """Take a seat (possibly after queueing); returns seconds
+        waited. Raises Rejected on queue-full or queue-wait timeout."""
+        if self.exempt:
+            # the system level never waits: unbounded immediate
+            # dispatch, by design (its wait histogram staying ~0 is the
+            # measurable contract)
+            with self._mu:
+                self._seats_in_use += 1
+            self._m_dispatched()
+            self._m_wait.observe(0.0)
+            return 0.0
+        w: Optional[_Waiter] = None
+        with self._mu:
+            if self._seats_in_use < self.seats and self._waiting == 0:
+                self._seats_in_use += 1
+                self._m_dispatched()
+                self._m_wait.observe(0.0)
+                return 0.0
+            hand = self._hands.get(flow)
+            if hand is None:
+                # dealt once per flow (memoized), so the blake2b deal
+                # is not a per-enqueue cost under the lock. BOUNDED:
+                # flow keys derive from caller-controlled identity
+                # (X-Remote-User), so an unbounded memo would be a
+                # remote memory leak — past the cap, deal per call
+                hand = self.hand_for(flow)
+                if len(self._hands) < self.HAND_MEMO_MAX:
+                    self._hands[flow] = hand
+            qi = min(hand, key=lambda i: len(self._queues[i]))
+            if len(self._queues[qi]) >= self.queue_length:
+                apiserver_flowcontrol_rejected_requests_total.inc(
+                    priority_level=self.name, reason="queue-full"
+                )
+                raise Rejected(self.name, "queue-full",
+                               self._retry_after_locked())
+            w = _Waiter(flow, time.monotonic(), qi)
+            self._queues[qi].append(w)
+            self._waiting += 1
+            self._m_inqueue.inc()
+        w.ready.wait(self.queue_wait)
+        with self._mu:
+            if w.dispatched:
+                waited = time.monotonic() - w.enqueued_at
+            else:
+                # timed out in queue: withdraw from the one queue it
+                # was appended to (the dispatcher can no longer pick
+                # this waiter once it leaves the deque)
+                self._queues[w.queue_index].remove(w)
+                self._waiting -= 1
+                self._m_inqueue.dec()
+                apiserver_flowcontrol_rejected_requests_total.inc(
+                    priority_level=self.name, reason="time-out"
+                )
+                raise Rejected(self.name, "time-out",
+                               self._retry_after_locked())
+        self._m_dispatched()
+        self._m_wait.observe(waited)
+        return waited
+
+    def release(self) -> None:
+        with self._mu:
+            self._seats_in_use -= 1
+            if not self.exempt:
+                self._dispatch_locked()
+
+    def _dispatch_locked(self) -> None:
+        """Fill freed seats round-robin across non-empty queues — each
+        active flow's queue gets equal service regardless of depth."""
+        n = len(self._queues)
+        while self._seats_in_use < self.seats:
+            for off in range(n):
+                qi = (self._rr + off) % n
+                if self._queues[qi]:
+                    self._rr = qi + 1
+                    w = self._queues[qi].popleft()
+                    break
+            else:
+                return
+            self._seats_in_use += 1
+            self._waiting -= 1
+            self._m_inqueue.dec()
+            w.dispatched = True
+            w.ready.set()
+
+    def _retry_after_locked(self) -> int:
+        """Congestion-scaled Retry-After: roughly how many dispatch
+        generations stand between the caller and a seat."""
+        return max(1, min(30, self._waiting // max(1, self.seats)))
+
+    # -- introspection (/debug/flowcontrol) ----------------------------------
+
+    def state(self) -> Dict[str, object]:
+        with self._mu:
+            depths = [len(q) for q in self._queues]
+            seats_in_use = self._seats_in_use
+            waiting = self._waiting
+        rejected = apiserver_flowcontrol_rejected_requests_total
+        return {
+            "exempt": self.exempt,
+            "seats": self.seats,
+            "seats_in_use": seats_in_use,
+            "waiting": waiting,
+            "queues": len(depths),
+            "queue_length_limit": self.queue_length,
+            "hand_size": self.hand_size,
+            "nonempty_queues": {
+                str(i): d for i, d in enumerate(depths) if d
+            },
+            "dispatched": self._m_dispatched_total(),
+            "rejected_queue_full": rejected.get(
+                priority_level=self.name, reason="queue-full"
+            ),
+            "rejected_time_out": rejected.get(
+                priority_level=self.name, reason="time-out"
+            ),
+        }
+
+    def _m_dispatched_total(self) -> float:
+        return apiserver_flowcontrol_dispatched_requests_total.get(
+            priority_level=self.name
+        )
+
+
+class _Ticket:
+    """Context manager holding one dispatched request's seat."""
+
+    __slots__ = ("level", "schema", "flow", "waited")
+
+    def __init__(self, level: PriorityLevel, schema: FlowSchema,
+                 flow: str, waited: float):
+        self.level = level
+        self.schema = schema
+        self.flow = flow
+        self.waited = waited
+
+    def __enter__(self) -> "_Ticket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.level.release()
+
+
+def is_exempt_identity(user: str, groups: Sequence[str]) -> bool:
+    if user in EXEMPT_USERS or user.startswith(EXEMPT_USER_PREFIXES):
+        return True
+    return any(g in EXEMPT_GROUPS for g in groups)
+
+
+def default_levels(
+    total_seats: int = 32, queue_wait: float = 15.0,
+    queues: int = 64, queue_length: int = 128, hand_size: int = 8,
+) -> Dict[str, PriorityLevel]:
+    """exempt + three shared-concurrency levels. Shares (6:3:1) carve
+    ``total_seats`` the way the reference's assuredConcurrencyShares
+    carve --max-requests-inflight."""
+    shares = {"workload-high": 6, "workload-low": 3, "catch-all": 1}
+    total_shares = sum(shares.values())
+    levels: Dict[str, PriorityLevel] = {
+        "exempt": PriorityLevel("exempt", seats=1, exempt=True),
+    }
+    for name, share in shares.items():
+        levels[name] = PriorityLevel(
+            name,
+            seats=max(1, round(total_seats * share / total_shares)),
+            queues=queues if name != "catch-all" else max(4, queues // 4),
+            queue_length=(queue_length if name != "catch-all"
+                          else max(4, queue_length // 2)),
+            hand_size=hand_size if name != "catch-all" else max(
+                1, hand_size // 2),
+            queue_wait=queue_wait,
+        )
+    return levels
+
+
+def default_schemas() -> List[FlowSchema]:
+    """The classification table, in matching order:
+
+    ========================  ==============  ===========================
+    flow schema               priority level  matches
+    ========================  ==============  ===========================
+    system                    exempt          system users (scheduler,
+                                              controller-manager, nodes,
+                                              loopback/unsecured) and
+                                              system:masters/nodes groups
+    workload-low              workload-low    callers in group
+                                              ``workload:low``
+    workload-high             workload-high   any other named caller
+                                              (per-user flows)
+    catch-all                 catch-all       everything else (anonymous)
+    ========================  ==============  ===========================
+    """
+    return [
+        FlowSchema(
+            "system", "exempt",
+            match=lambda u, g, v, p: is_exempt_identity(u, g),
+            distinguisher="none",
+        ),
+        FlowSchema(
+            "workload-low", "workload-low",
+            match=lambda u, g, v, p: "workload:low" in g,
+        ),
+        FlowSchema(
+            "workload-high", "workload-high",
+            match=lambda u, g, v, p: bool(u)
+            and u != "system:anonymous",
+        ),
+        FlowSchema(
+            "catch-all", "catch-all",
+            match=lambda u, g, v, p: True,
+            distinguisher="none",
+        ),
+    ]
+
+
+def enabled_in_env() -> bool:
+    """The one parse of the KUBERNETES_TPU_APF kill switch (bench and
+    from_env must agree on what counts as off)."""
+    return os.environ.get("KUBERNETES_TPU_APF", "1").lower() not in (
+        "0", "false", "off"
+    )
+
+
+class APFController:
+    """Classification + admission for one apiserver. ``admit`` returns
+    a context manager holding the seat; it raises Rejected when the
+    request should be shed with 429 + Retry-After."""
+
+    def __init__(
+        self,
+        levels: Optional[Dict[str, PriorityLevel]] = None,
+        schemas: Optional[List[FlowSchema]] = None,
+    ):
+        self.levels = levels or default_levels()
+        self.schemas = schemas or default_schemas()
+        for s in self.schemas:
+            if s.priority_level not in self.levels:
+                raise ValueError(
+                    f"flow schema {s.name!r} names unknown priority "
+                    f"level {s.priority_level!r}"
+                )
+        _races.track(self, "apiserver.APFController")
+
+    @classmethod
+    def from_env(cls) -> Optional["APFController"]:
+        """Default-on; ``KUBERNETES_TPU_APF=0`` disables (the kill
+        switch). ``KUBERNETES_TPU_APF_SEATS`` scales the shared seat
+        pool and ``KUBERNETES_TPU_APF_QUEUE_WAIT`` bounds queue time."""
+        if not enabled_in_env():
+            return None
+        try:
+            seats = int(os.environ.get("KUBERNETES_TPU_APF_SEATS", "32"))
+        except ValueError:
+            seats = 32
+        try:
+            wait = float(os.environ.get(
+                "KUBERNETES_TPU_APF_QUEUE_WAIT", "15"))
+        except ValueError:
+            wait = 15.0
+        return cls(levels=default_levels(seats, wait))
+
+    def classify(
+        self, user: str, groups: Sequence[str], verb: str, path: str
+    ) -> Tuple[FlowSchema, PriorityLevel, str]:
+        for s in self.schemas:
+            if s.match(user, groups, verb, path):
+                return s, self.levels[s.priority_level], s.flow_key(user)
+        # default_schemas ends in a match-all; a custom table without
+        # one falls through to the last level rather than crashing
+        s = self.schemas[-1]
+        return s, self.levels[s.priority_level], s.flow_key(user)
+
+    def admit(self, user: str, groups: Sequence[str], verb: str,
+              path: str) -> _Ticket:
+        schema, level, flow = self.classify(user, groups, verb, path)
+        waited = level.acquire(flow)  # may raise Rejected
+        return _Ticket(level, schema, flow, waited)
+
+    def state(self) -> Dict[str, object]:
+        """The /debug/flowcontrol payload."""
+        return {
+            "enabled": True,
+            "priority_levels": {
+                name: lvl.state() for name, lvl in self.levels.items()
+            },
+            "flow_schemas": [
+                {
+                    "name": s.name,
+                    "priority_level": s.priority_level,
+                    "distinguisher": s.distinguisher,
+                }
+                for s in self.schemas
+            ],
+        }

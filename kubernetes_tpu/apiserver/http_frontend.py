@@ -133,7 +133,7 @@ def start_http_server(api: APIServer, host: str, port: int,
                     "message": "too many requests, please try again later",
                     "reason": "TooManyRequests",
                     "code": 429,
-                })
+                }, headers={"Retry-After": "1"})
                 return
             # apiserver_request_latencies (pkg/apiserver/metrics.go):
             # non-long-running requests only — a watch holds its
@@ -159,7 +159,26 @@ def start_http_server(api: APIServer, host: str, port: int,
             # reused slot would mis-attribute the next request's trail
             ctx = api._audit_ctx
             ctx.user = ""
+            ctx.groups = ()
             ctx.request_id = self.headers.get("X-Request-Id", "")
+            if getattr(api, "authenticator", None) is None:
+                # no authenticator = the insecure-port idiom: requests
+                # are unauthenticated anyway, so the caller-declared
+                # X-Remote-User/-Group identity headers are trusted for
+                # flow classification and audit attribution (the
+                # front-proxy request-header authenticator shape).
+                # With an authenticator configured they are IGNORED —
+                # only authenticated identity classifies.
+                remote = self.headers.get("X-Remote-User", "")
+                if remote:
+                    ctx.user = remote
+                    ctx.groups = tuple(
+                        g.strip()
+                        for g in (
+                            self.headers.get("X-Remote-Group") or ""
+                        ).split(",")
+                        if g.strip()
+                    )
 
             def audit_denied(code: int, user_name: str = "") -> None:
                 # denied access IS the audit log's primary story (who
@@ -196,6 +215,7 @@ def start_http_server(api: APIServer, host: str, port: int,
                     self._send_json(401, {"message": "unauthorized"})
                     return
                 ctx.user = user.name
+                ctx.groups = tuple(user.groups)
                 authorizer = getattr(api, "authorizer", None)
                 if authorizer is not None:
                     ns, info, _name, _sub, _grp, _ver = api._route(
@@ -282,6 +302,16 @@ def start_http_server(api: APIServer, host: str, port: int,
             if isinstance(payload, WatchResponse):
                 self._stream_watch(payload)
                 return
+            # APF sheds carry their Retry-After hint in the Status
+            # details; surface it as the real HTTP header so clients
+            # back off by the server's estimate, not a guess
+            retry_after = ""
+            if code == 429 and isinstance(payload, dict):
+                details = payload.get("details")
+                if isinstance(details, dict):
+                    retry_after = str(
+                        details.get("retryAfterSeconds") or ""
+                    )
             if wants_binary:
                 # Raw payloads (watch-cache hits) splice the stored TLV
                 # bytes into the response verbatim — encode() is a byte
@@ -290,6 +320,8 @@ def start_http_server(api: APIServer, host: str, port: int,
                 self.send_response(code)
                 self.send_header("Content-Type", binary.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(data)))
+                if retry_after:
+                    self.send_header("Retry-After", retry_after)
                 self.end_headers()
                 self.wfile.write(data)
                 return
@@ -304,13 +336,19 @@ def start_http_server(api: APIServer, host: str, port: int,
                 self.end_headers()
                 self.wfile.write(raw_body)
                 return
-            self._send_json(code, payload)
+            self._send_json(
+                code, payload,
+                headers={"Retry-After": retry_after} if retry_after
+                else None,
+            )
 
-        def _send_json(self, code: int, payload) -> None:
+        def _send_json(self, code: int, payload, headers=None) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 

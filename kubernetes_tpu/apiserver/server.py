@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu.api import labels as labelpkg
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.apiserver import admission as adm
+from kubernetes_tpu.apiserver.flowcontrol import Rejected as _APFRejected
 from kubernetes_tpu.apiserver.fields import (
     matches_fields,
     matches_fields_wire,
@@ -314,6 +315,7 @@ class APIServer:
         authorizer=None,
         data_dir: Optional[str] = None,
         admission_control: str = "",
+        flowcontrol: object = "auto",
     ):
         """data_dir: persist the store (WAL + snapshot) so a restarted
         apiserver resumes with full state and RV continuity — the role
@@ -321,7 +323,13 @@ class APIServer:
 
         admission_control: comma-separated plugin names replacing the
         default chain (the --admission-control flag; names per
-        admission.PLUGIN_FACTORIES)."""
+        admission.PLUGIN_FACTORIES).
+
+        flowcontrol: API priority-and-fairness at the door. "auto"
+        (default) builds the APFController from the environment
+        (default-on; KUBERNETES_TPU_APF=0 kills it); pass an
+        APFController to override, or None to disable for this
+        server."""
         if store is None:
             if data_dir:
                 from kubernetes_tpu.storage.durable import FileStore
@@ -408,6 +416,17 @@ class APIServer:
         except ValueError:
             self._event_ttl = 3600.0
         self._event_gc_next = 0.0  # monotonic sweep deadline
+        # API priority and fairness (apiserver/flowcontrol.py): the
+        # handle() choke point classifies every resource request by
+        # caller identity and takes a bounded-concurrency seat (or
+        # sheds with 429 + Retry-After) before dispatch — both doors
+        # (HTTP frontend and in-process transports) funnel through it
+        if flowcontrol == "auto":
+            from kubernetes_tpu.apiserver.flowcontrol import APFController
+
+            self.flowcontrol = APFController.from_env()
+        else:
+            self.flowcontrol = flowcontrol or None
         # dynamic third-party resources (master.go:610-766); re-install
         # any persisted ThirdPartyResource objects on startup
         self.thirdparty = ThirdPartyInstaller(self)
@@ -485,6 +504,51 @@ class APIServer:
         raw_mode (binary HTTP frontend only): cache-served list/get
         responses may be binary.RawObject/RawList — the stored TLV
         bytes, spliced verbatim by the frontend with zero re-encode."""
+        apf = self.flowcontrol
+        if apf is not None and path.startswith(("/api/", "/apis/")):
+            # APF admission: identity deposited by the door (HTTP
+            # frontend or LocalTransport) in the per-thread context. A
+            # direct in-process handle() caller with no door is the
+            # loopback/integration idiom -> system:unsecured (exempt).
+            ctx = self._audit_ctx
+            user = getattr(ctx, "user", None)
+            if user is None:
+                user = "system:unsecured"
+            groups = getattr(ctx, "groups", None) or ()
+            try:
+                ticket = apf.admit(user, groups, method.upper(), path)
+            except _APFRejected as e:
+                return 429, {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "status": "Failure",
+                    "message": str(e),
+                    "reason": "TooManyRequests",
+                    "code": 429,
+                    "details": {"retryAfterSeconds": e.retry_after},
+                }
+            with ticket:
+                # the seat spans the synchronous dispatch only: a watch
+                # pays for its initialization, not its stream lifetime
+                # (long-running requests hold connections by design)
+                return self._handle_audited(
+                    method, path, query, body, obj_mode, body_owned,
+                    raw_mode,
+                )
+        return self._handle_audited(
+            method, path, query, body, obj_mode, body_owned, raw_mode
+        )
+
+    def _handle_audited(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+        obj_mode: bool = False,
+        body_owned: bool = False,
+        raw_mode: bool = False,
+    ):
         level = self.audit_policy.level_for(path)
         if level == "None":
             return self._handle_coded(
@@ -660,6 +724,12 @@ class APIServer:
             from kubernetes_tpu.trace.httpd import render_traces
 
             return 200, render_traces(query)
+        if path == "/debug/flowcontrol":
+            # live APF state: per-level seats/queues/shed counts plus
+            # the flow-schema table (apiserver/flowcontrol.py)
+            if self.flowcontrol is None:
+                return 200, {"enabled": False}
+            return 200, self.flowcontrol.state()
         if path == "/debug/audit":
             # the audit ring (audit/audit.py), newest first; ?limit=N
             # bounds it, ?user=/&verb=/&resource= filter

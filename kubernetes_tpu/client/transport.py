@@ -12,17 +12,27 @@ an event iterator for watches.
 from __future__ import annotations
 
 import json
+import random as _random
 import threading
+import time as _time
 
 from kubernetes_tpu.analysis import races as _races
+from kubernetes_tpu.metrics import (
+    client_rate_limited_requests_total,
+    client_request_retries_total,
+)
 from kubernetes_tpu.runtime import binary as bin_codec
 from kubernetes_tpu.trace.profile import phase_timer
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib import parse as urlparse
 
+_rate_limited = client_rate_limited_requests_total.child()
+_retries = client_request_retries_total.child()
+
 
 class LocalTransport:
-    def __init__(self, server, object_protocol: bool = True):
+    def __init__(self, server, object_protocol: bool = True,
+                 user: str = "", groups=()):
         # object protocol: bodies/responses are API objects (copied at
         # the server boundary), skipping the reflective wire codec — the
         # in-process analogue of the reference's protobuf content type
@@ -30,6 +40,28 @@ class LocalTransport:
         # hollow-node.go:65)
         self.server = server
         self.object_protocol = object_protocol
+        # flow identity: deposited in the server's per-thread context so
+        # APF classification and the audit trail see the real caller.
+        # Unnamed in-process callers are the loopback/integration-test
+        # idiom -> system:unsecured (exempt, cluster-admin shaped).
+        self.user = user or "system:unsecured"
+        self.groups = tuple(groups)
+
+    def _deposit_identity(self):
+        ctx = getattr(self.server, "_audit_ctx", None)
+        if ctx is not None:
+            ctx.user = self.user
+            ctx.groups = self.groups
+        return ctx
+
+    @staticmethod
+    def _clear_identity(ctx) -> None:
+        # restore the thread's virgin state: a LATER direct handle()
+        # call on this thread must classify as loopback/unsecured
+        # again, not as this transport's tenant
+        if ctx is not None:
+            ctx.user = None
+            ctx.groups = None
 
     def request(
         self,
@@ -38,18 +70,26 @@ class LocalTransport:
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
     ) -> Tuple[int, Any]:
-        return self.server.handle(
-            method, path, query, body, obj_mode=self.object_protocol
-        )
+        ctx = self._deposit_identity()
+        try:
+            return self.server.handle(
+                method, path, query, body, obj_mode=self.object_protocol
+            )
+        finally:
+            self._clear_identity(ctx)
 
     def watch(
         self, path: str, query: Optional[Dict[str, str]] = None
     ) -> Iterator[Dict[str, Any]]:
         query = dict(query or {})
         query["watch"] = "true"
-        code, resp = self.server.handle(
-            "GET", path, query, None, obj_mode=self.object_protocol
-        )
+        ctx = self._deposit_identity()
+        try:
+            code, resp = self.server.handle(
+                "GET", path, query, None, obj_mode=self.object_protocol
+            )
+        finally:
+            self._clear_identity(ctx)
         if code != 200:
             raise WatchError(code, resp)
         return _StoppableEvents(resp)
@@ -151,16 +191,33 @@ class HTTPTransport:
 
     #: idle keep-alive connections retained per base URL
     POOL_MAX = 32
+    #: ceiling on one 429 backoff sleep (Retry-After larger than this
+    #: is clamped; the server's hint is an estimate, not a contract)
+    BACKOFF_429_CAP = 8.0
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  tls_ca: str = "", insecure: bool = False,
-                 binary: bool = False, bearer_token: str = ""):
+                 binary: bool = False, bearer_token: str = "",
+                 user: str = "", groups=(), retry_429: int = 4):
         """binary=True negotiates the binary content type
         (runtime/binary.py) — the protobuf-at-scale analogue kubemark
         components default to. Implies the object protocol client-side
         (no reflective codec on either end). bearer_token attaches
         `Authorization: Bearer ...` to every request (the kubeconfig
         user.token idiom — restclient.Config.BearerToken).
+
+        user/groups declare the caller's flow identity via the
+        X-Remote-User/-Group headers (honored by an authenticator-less
+        apiserver — the insecure-port idiom — for APF classification
+        and audit attribution; an authenticator-backed server ignores
+        them in favor of the authenticated identity).
+
+        retry_429: a 429 response (the apiserver door shedding load)
+        is retried up to this many times with the server's Retry-After
+        hint (capped exponential backoff + jitter when absent) instead
+        of surfacing as a hard failure; 0 disables. 429 means the
+        request was shed BEFORE execution, so replay is safe for every
+        verb. Sheds/retries are counted in self.stats.
 
         base_url may be a COMMA-SEPARATED list of servers (the HA
         apiserver idiom — etcd clients take endpoint lists the same
@@ -178,6 +235,15 @@ class HTTPTransport:
         self._active_lock = threading.Lock()
         self.timeout = timeout
         self.bearer_token = bearer_token
+        self.user = user
+        self.groups = tuple(groups)
+        self.retry_429 = max(0, int(retry_429))
+        self._stats_lock = threading.Lock()
+        # sheds_429: 429 responses observed; retries_429: retries
+        # performed; giveups_429: 429s surfaced to the caller after
+        # retries ran out
+        self.stats = {"sheds_429": 0, "retries_429": 0,
+                      "giveups_429": 0}  # guarded-by: self._stats_lock
         self.binary = binary
         self.object_protocol = binary
         self._ssl_ctx = None
@@ -258,6 +324,10 @@ class HTTPTransport:
             h["Accept"] = bin_codec.CONTENT_TYPE
         if self.bearer_token:
             h["Authorization"] = f"Bearer {self.bearer_token}"
+        if self.user:
+            h["X-Remote-User"] = self.user
+            if self.groups:
+                h["X-Remote-Group"] = ",".join(self.groups)
         return h
 
     def _encode_body(self, body):
@@ -282,13 +352,49 @@ class HTTPTransport:
         headers = self._headers(data is not None)
         target = self._target(path, query)
         method = method.upper()
+        shed_attempt = 0
+        while True:
+            resp, decoded = self._request_once(method, target, data,
+                                               headers)
+            if resp.status != 429:
+                return resp.status, decoded
+            # 429 = shed at the apiserver door BEFORE execution (APF or
+            # the in-flight limit): replaying is safe for every verb.
+            # Honor the server's Retry-After estimate; fall back to
+            # capped exponential backoff, jittered either way so a
+            # synchronized thundering herd doesn't re-shed itself.
+            _rate_limited()
+            with self._stats_lock:
+                self.stats["sheds_429"] += 1
+            if shed_attempt >= self.retry_429:
+                with self._stats_lock:
+                    self.stats["giveups_429"] += 1
+                return resp.status, decoded
+            _retries()
+            with self._stats_lock:
+                self.stats["retries_429"] += 1
+            _time.sleep(self._backoff_429(resp, shed_attempt))
+            shed_attempt += 1
+
+    def _backoff_429(self, resp, attempt: int) -> float:
+        try:
+            hint = float(resp.headers.get("Retry-After", "") or 0.0)
+        except (ValueError, AttributeError):
+            hint = 0.0
+        base = hint if hint > 0 else 0.25 * (2 ** attempt)
+        base = min(base, self.BACKOFF_429_CAP)
+        return base * (0.5 + _random.random() * 0.5)
+
+    def _request_once(self, method, target, data, headers):
+        """One request with connection-failover rotation (pre-encoded
+        body + headers); -> (http response, decoded payload)."""
         for attempt in range(max(len(self.base_urls), 1)):
             base = self.base_url
             try:
                 resp, payload = self._roundtrip(
                     base, method, target, data, headers
                 )
-                return resp.status, self._decode_response(resp, payload)
+                return resp, self._decode_response(resp, payload)
             except Exception as e:
                 if not _is_conn_error(e):
                     raise

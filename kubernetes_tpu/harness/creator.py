@@ -164,8 +164,11 @@ def main(argv=None):
     ap.add_argument("--server", required=True)
     ap.add_argument("--pods", type=int, required=True)
     args = ap.parse_args(argv)
+    # a named tenant flow (workload-high), NOT control-plane exempt:
+    # the creator is the workload the apiserver is allowed to queue
     client = RESTClient(HTTPTransport(args.server, binary=True,
-                                      timeout=180.0))
+                                      timeout=180.0,
+                                      user="perf-creator"))
     make_pods(client, args.pods)
 
 

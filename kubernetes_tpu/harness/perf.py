@@ -258,8 +258,12 @@ def schedule_pods_separate(
         # answer a bulk request tens of seconds late; timing out loses
         # the reply (pods exist, client does not know) and forces the
         # serial top-up reconciliation
+        # control-plane identity: this client drives node setup, the
+        # scheduler daemon, and the measurement watch — exempt traffic
+        # that must never queue behind the creator storm's flows
         client = RESTClient(HTTPTransport(url, binary=True,
-                                          timeout=180.0))
+                                          timeout=180.0,
+                                          user="system:kube-scheduler"))
         deadline = time.time() + 15
         while not client.healthz():
             if time.time() > deadline:
@@ -383,7 +387,8 @@ def main(argv=None):
         from kubernetes_tpu.client.transport import HTTPTransport
 
         client = RESTClient(HTTPTransport(args.server, binary=True,
-                                          timeout=180.0))
+                                          timeout=180.0,
+                                          user="perf-creator"))
         make_pods(client, args.pods)
         return
     if args.separate:

@@ -18,18 +18,26 @@ import sys
 import time
 
 
-def _client(server: str, tls_ca: str = "", insecure: bool = False):
+def _client(server: str, tls_ca: str = "", insecure: bool = False,
+            user: str = "", groups=()):
     from kubernetes_tpu.client.rest import RESTClient
     from kubernetes_tpu.client.transport import HTTPTransport
 
-    return RESTClient(HTTPTransport(server, tls_ca=tls_ca, insecure=insecure))
+    return RESTClient(HTTPTransport(server, tls_ca=tls_ca,
+                                    insecure=insecure, user=user,
+                                    groups=groups))
 
 
-def _client_from(args):
+def _client_from(args, user: str = "", groups=()):
+    """Every daemon authenticates with its own system identity so APF
+    classification and the audit log see the real caller (the
+    reference's per-component kubeconfig users)."""
     return _client(
         args.server,
         tls_ca=getattr(args, "certificate_authority", ""),
         insecure=getattr(args, "insecure_skip_tls_verify", False),
+        user=user,
+        groups=groups,
     )
 
 
@@ -159,7 +167,9 @@ def run_scheduler(args) -> None:
         options = SchedulerServerOptions(
             algorithm_provider=args.algorithm_provider
         )
-    sched = SchedulerServer(_client_from(args), options).start()
+    sched = SchedulerServer(
+        _client_from(args, user="system:kube-scheduler"), options
+    ).start()
     print("kube-scheduler running", flush=True)
     _wait_forever()
     sched.stop()
@@ -168,7 +178,9 @@ def run_scheduler(args) -> None:
 def run_controller_manager(args) -> None:
     from kubernetes_tpu.controller.manager import ControllerManager
 
-    mgr = ControllerManager(_client_from(args)).start()
+    mgr = ControllerManager(
+        _client_from(args, user="system:kube-controller-manager")
+    ).start()
     print("kube-controller-manager running", flush=True)
     _wait_forever()
     mgr.stop()
@@ -225,7 +237,11 @@ def run_kubelet(args) -> None:
             file=sys.stderr,
         )
         raise SystemExit(2)
-    kl = Kubelet(_client_from(args), cfg, runtime).run()
+    kl = Kubelet(
+        _client_from(args, user=f"system:node:{cfg.node_name}",
+                     groups=("system:nodes",)),
+        cfg, runtime,
+    ).run()
     print(f"kubelet {args.node} running "
           f"({'fake' if args.fake_runtime else 'process'} runtime)",
           flush=True)
@@ -238,7 +254,8 @@ def run_kubelet(args) -> None:
 def run_proxy(args) -> None:
     from kubernetes_tpu.proxy import Proxier
 
-    p = Proxier(_client_from(args), args.node).run()
+    p = Proxier(_client_from(args, user="system:kube-proxy"),
+                args.node).run()
     print(f"kube-proxy {args.node} running", flush=True)
     _wait_forever()
     p.stop()
@@ -293,7 +310,15 @@ def run_local_up(args) -> None:
 
     server = APIServer(data_dir=args.data_dir or None)
     host, port = server.serve_http(port=args.port)
-    client = _client(f"http://{host}:{port}")
+    # per-component identities (APF classification + audit): the shared
+    # admin client covers setup and the hollow kubelets; scheduler and
+    # controller-manager authenticate as themselves
+    client = _client(f"http://{host}:{port}", user="system:admin",
+                     groups=("system:masters",))
+    sched_client = _client(f"http://{host}:{port}",
+                           user="system:kube-scheduler")
+    mgr_client = _client(f"http://{host}:{port}",
+                         user="system:kube-controller-manager")
     cluster = HollowCluster(client, args.nodes).run()
     # real nodes: kubelets on the PROCESS runtime — pods scheduled there
     # run as live OS processes (docker_manager.go's role, sandbox form)
@@ -338,9 +363,10 @@ def run_local_up(args) -> None:
             proxier = UserspaceProxier(client, node_name=node_name).run()
             proxiers.append(proxier)
             cloud.register_node(node_name, proxier)
-    mgr = ControllerManager(client, cloud=cloud).start()
+    mgr = ControllerManager(mgr_client, cloud=cloud).start()
     sched = SchedulerServer(
-        client, SchedulerServerOptions(algorithm_provider=args.algorithm_provider)
+        sched_client,
+        SchedulerServerOptions(algorithm_provider=args.algorithm_provider),
     ).start()
     # componentstatuses: the in-process analogue of the master probing
     # scheduler/controller-manager health ports

@@ -1485,6 +1485,16 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     parser.add_argument("--insecure-skip-tls-verify", action="store_true")
     parser.add_argument("--token", default="",
                         help="bearer token (e.g. a service-account JWT)")
+    parser.add_argument(
+        "--as", dest="as_user", default="system:admin",
+        help="flow identity declared to an authenticator-less "
+        "apiserver (X-Remote-User; APF classification + audit). The "
+        "default is the local-admin idiom — exempt, like kubectl on "
+        "the reference's insecure port. Ignored by servers with an "
+        "authenticator (the authenticated identity wins).")
+    parser.add_argument(
+        "--as-group", dest="as_groups", action="append", default=None,
+        help="flow-identity group (repeatable; default system:masters)")
     parser.add_argument("--namespace", "-n", default="default")
     # node-API credentials (kubelet TLS + bearer authn — logs/exec/top
     # dial the kubelet directly, so they carry their own trust)
@@ -1667,6 +1677,8 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
             tls_ca=args.certificate_authority,
             insecure=args.insecure_skip_tls_verify,
             bearer_token=args.token,
+            user=args.as_user,
+            groups=tuple(args.as_groups or ("system:masters",)),
         ))
     k = Kubectl(
         client, args.namespace,

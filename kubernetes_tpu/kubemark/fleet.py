@@ -94,6 +94,11 @@ class HollowFleet:
             "heartbeats": 0, "transitions": 0, "deletions_observed": 0,
             "relists": 0, "batch_requests": 0, "watch_events": 0,
         }  # guarded-by: self._lock
+        # rack-failure chaos: nodes in here have "vanished" — their
+        # heartbeats stop and their pods are never acked again (the
+        # kubelet process is gone), so the node-lifecycle controller
+        # sees a stale Ready heartbeat and runs its eviction wave
+        self._dead: set = set()  # guarded-by: self._lock
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # per-shard live watch stream, so stop() can unblock the shard
@@ -161,7 +166,9 @@ class HollowFleet:
         next_tick = time.monotonic()
         while not self._stop.is_set():
             next_tick += cfg.tick
-            due = wheel[cursor]
+            with self._lock:
+                due = [nm for nm in wheel[cursor]
+                       if nm not in self._dead]
             cursor = (cursor + 1) % slots
             if due:
                 items = [self._heartbeat_item(nm) for nm in due]
@@ -204,6 +211,9 @@ class HollowFleet:
         """Ack a newly-bound pod to Running (Pending->Running, the
         hollow kubelet's syncPod outcome) exactly once."""
         uid = pod.metadata.uid
+        with self._lock:
+            if pod.spec.node_name in self._dead:
+                return  # that kubelet is gone; nobody acks this pod
         if pod.status.phase not in ("", "Pending"):
             with self._lock:
                 # already Running from a previous incarnation of this
@@ -309,6 +319,23 @@ class HollowFleet:
                 pass
         for th in self._threads:
             th.join(timeout=5)
+
+    def fail_nodes(self, count_or_names) -> List[str]:
+        """Rack failure: the given nodes (or the LAST `count` nodes)
+        vanish mid-run — no more heartbeats, no more pod acks. Returns
+        the failed node names. The Node objects stay in the store with
+        a go-stale Ready heartbeat, exactly what a dead kubelet leaves
+        behind; detection and eviction are the node-lifecycle
+        controller's job, not the harness's."""
+        if isinstance(count_or_names, int):
+            if count_or_names <= 0:
+                return []  # [-0:] would slice the WHOLE fleet
+            names = list(self.node_names[-count_or_names:])
+        else:
+            names = list(count_or_names)
+        with self._lock:
+            self._dead.update(names)
+        return names
 
     def running_pods(self) -> int:
         with self._lock:

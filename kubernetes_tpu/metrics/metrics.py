@@ -484,6 +484,67 @@ apiserver_watch_coalesced_frame_bytes = registry.register(
     )
 )
 
+# -- API priority and fairness (apiserver/flowcontrol.py) ---------------------
+
+#: seconds a request waited in its priority level's fair queues before
+#: dispatch (0 observed for immediate dispatch and for the exempt
+#: level — the exempt histogram staying ~0 IS the system-traffic
+#: never-queues contract, checked by the noisy-neighbor gate)
+apiserver_flowcontrol_request_wait_duration_seconds = registry.register(
+    HistogramVec(
+        "apiserver_flowcontrol_request_wait_duration_seconds",
+        "Seconds requests waited in APF queues, labeled by priority level",
+        label="priority_level",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: requests currently sitting in a priority level's queues
+apiserver_flowcontrol_current_inqueue_requests = registry.register(
+    GaugeVec(
+        "apiserver_flowcontrol_current_inqueue_requests",
+        "Requests currently queued by APF, labeled by priority level",
+        label="priority_level",
+    )
+)
+
+#: requests shed at the apiserver door (429 + Retry-After), labeled by
+#: priority level and reason (queue-full | time-out)
+apiserver_flowcontrol_rejected_requests_total = registry.register(
+    Counter(
+        "apiserver_flowcontrol_rejected_requests_total",
+        "Requests rejected by APF, labeled by priority level and reason",
+    )
+)
+
+#: requests that acquired a seat and executed, labeled by priority level
+apiserver_flowcontrol_dispatched_requests_total = registry.register(
+    Counter(
+        "apiserver_flowcontrol_dispatched_requests_total",
+        "Requests dispatched by APF, labeled by priority level",
+    )
+)
+
+# -- client transport resilience (client/transport.py) ------------------------
+
+#: 429 responses the HTTP transport observed (one per shed response,
+#: whether or not a retry followed)
+client_rate_limited_requests_total = registry.register(
+    Counter(
+        "client_rate_limited_requests_total",
+        "429 responses observed by the client HTTP transport",
+    )
+)
+
+#: retries the transport performed after a 429 (Retry-After honored,
+#: capped exponential backoff with jitter)
+client_request_retries_total = registry.register(
+    Counter(
+        "client_request_retries_total",
+        "Request retries performed by the client transport after 429",
+    )
+)
+
 # -- kubemark hollow fleet (kubemark/fleet.py) --------------------------------
 
 #: node heartbeats the hollow fleet committed (batched onto

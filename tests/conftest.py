@@ -179,6 +179,15 @@ if os.environ.get("KUBERNETES_TPU_RACE_SANITIZER"):
         _races.assert_no_races("(suite-wide)")
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; the slow set is the hours-long
+    # production-realism forms (full chaos scenarios, A/B soaks)
+    config.addinivalue_line(
+        "markers",
+        "slow: production-realism long forms excluded from tier-1",
+    )
+
+
 def wait_until(cond, timeout=60.0, interval=0.01):
     """Poll `cond` until truthy or `timeout` elapses. The single shared
     copy (each test file used to carry its own, and the defaults

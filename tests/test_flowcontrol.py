@@ -1,0 +1,592 @@
+"""API priority-and-fairness unit suite (apiserver/flowcontrol.py).
+
+Covers the ISSUE-12 contract: classification by identity, exempt-level
+bypass, seat accounting under concurrency, shuffle-shard fairness (one
+hot flow cannot occupy all queues), queue-full shed with Retry-After
+(both in-process and through the HTTP door), the client transport's
+429 backoff, and the queue/dispatch machinery under the ARMED race
+witness + lock-order sanitizer.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.analysis import locks, races
+from kubernetes_tpu.apiserver.flowcontrol import (
+    APFController,
+    FlowSchema,
+    PriorityLevel,
+    Rejected,
+    default_levels,
+    default_schemas,
+    is_exempt_identity,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.transport import HTTPTransport, LocalTransport
+
+from conftest import wait_until
+
+
+def _tiny_controller(seats=1, queues=8, queue_length=2, hand_size=2,
+                     queue_wait=0.4):
+    levels = {
+        "exempt": PriorityLevel("exempt", seats=1, exempt=True),
+        "workload-high": PriorityLevel(
+            "workload-high", seats=seats, queues=queues,
+            queue_length=queue_length, hand_size=hand_size,
+            queue_wait=queue_wait),
+        "workload-low": PriorityLevel("workload-low", seats=seats),
+        "catch-all": PriorityLevel("catch-all", seats=seats),
+    }
+    return APFController(levels=levels)
+
+
+# -- classification -----------------------------------------------------------
+
+
+def test_classification_table():
+    c = APFController()
+    for user, groups, want in [
+        ("system:kube-scheduler", (), "exempt"),
+        ("system:kube-controller-manager", (), "exempt"),
+        ("system:node:hollow-0001", (), "exempt"),
+        ("system:unsecured", (), "exempt"),
+        ("anybody", ("system:masters",), "exempt"),
+        ("batch-bot", ("workload:low",), "workload-low"),
+        ("tenant-a", (), "workload-high"),
+        ("", (), "catch-all"),
+    ]:
+        _s, level, _f = c.classify(user, groups, "GET", "/api/v1/pods")
+        assert level.name == want, (user, groups, level.name)
+
+
+def test_flow_keys_are_per_user():
+    c = APFController()
+    _, _, fa = c.classify("tenant-a", (), "GET", "/api/v1/pods")
+    _, _, fb = c.classify("tenant-b", (), "GET", "/api/v1/pods")
+    assert fa != fb
+    # anonymous traffic collapses into one catch-all flow
+    _, _, f1 = c.classify("", (), "GET", "/api/v1/pods")
+    _, _, f2 = c.classify("", (), "POST", "/api/v1/pods")
+    assert f1 == f2
+
+
+def test_exempt_identity_helper():
+    assert is_exempt_identity("system:kube-proxy", ())
+    assert is_exempt_identity("system:node:n1", ())
+    assert is_exempt_identity("x", ("system:nodes",))
+    assert not is_exempt_identity("system:anonymous", ())
+    assert not is_exempt_identity("tenant", ("workload:high",))
+
+
+def test_custom_schema_table_validates_levels():
+    with pytest.raises(ValueError):
+        APFController(schemas=[FlowSchema(
+            "x", "no-such-level", match=lambda u, g, v, p: True)])
+
+
+# -- seats + queues ------------------------------------------------------------
+
+
+def test_exempt_level_never_queues():
+    """Saturate every shared level; the exempt level must still admit
+    immediately with zero recorded wait — the control-plane contract."""
+    c = _tiny_controller(seats=1)
+    holders = [c.admit("tenant-a", (), "GET", "/api/v1/pods")]
+    t0 = time.monotonic()
+    tk = c.admit("system:kube-scheduler", (), "POST", "/api/v1/batch")
+    assert time.monotonic() - t0 < 0.2
+    assert tk.level.name == "exempt" and tk.waited == 0.0
+    tk.__exit__()
+    for h in holders:
+        h.__exit__()
+
+
+def test_seat_accounting_bounds_concurrency():
+    lvl = PriorityLevel("acct", seats=3, queues=8, queue_length=64,
+                        hand_size=4, queue_wait=5.0)
+    in_flight = []
+    peak = [0]
+    mu = threading.Lock()
+
+    def worker(i):
+        lvl.acquire(f"flow-{i % 5}")
+        with mu:
+            in_flight.append(i)
+            peak[0] = max(peak[0], len(in_flight))
+        time.sleep(0.01)
+        with mu:
+            in_flight.remove(i)
+        lvl.release()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert peak[0] <= 3, f"seat limit violated: {peak[0]} in flight"
+    st = lvl.state()
+    assert st["seats_in_use"] == 0 and st["waiting"] == 0
+    assert st["dispatched"] >= 24
+
+
+def test_queue_full_sheds_with_retry_after():
+    lvl = PriorityLevel("shed", seats=1, queues=8, queue_length=1,
+                        hand_size=1, queue_wait=5.0)
+    lvl.acquire("hot")  # take the only seat
+    # hand_size=1 x queue_length=1: exactly one waiter fits
+    waiter = threading.Thread(
+        target=lambda: (lvl.acquire("hot"), lvl.release()))
+    waiter.start()
+    assert wait_until(lambda: lvl.state()["waiting"] == 1, 2.0)
+    with pytest.raises(Rejected) as exc:
+        lvl.acquire("hot")
+    assert exc.value.reason == "queue-full"
+    assert exc.value.retry_after >= 1
+    lvl.release()  # dispatches the queued waiter
+    waiter.join(timeout=2)
+    assert lvl.state()["rejected_queue_full"] >= 1
+    lvl.release()
+
+
+def test_queue_wait_timeout_sheds():
+    lvl = PriorityLevel("timeout", seats=1, queues=4, queue_length=8,
+                        hand_size=2, queue_wait=0.15)
+    lvl.acquire("holder")
+    t0 = time.monotonic()
+    with pytest.raises(Rejected) as exc:
+        lvl.acquire("victim")
+    assert exc.value.reason == "time-out"
+    assert 0.1 <= time.monotonic() - t0 < 2.0
+    st = lvl.state()
+    assert st["waiting"] == 0, "timed-out waiter must leave the queue"
+    lvl.release()
+
+
+def test_shuffle_shard_hot_flow_cannot_occupy_all_queues():
+    """The fairness core: a hot flow only ever reaches its own hand of
+    queues, so some queue always stays free for other flows."""
+    lvl = PriorityLevel("shard", seats=1, queues=16, queue_length=4,
+                        hand_size=4, queue_wait=3.0)
+    hand = lvl.hand_for("hot")
+    assert len(set(hand)) == 4
+    lvl.acquire("seat-holder")  # saturate the seat
+    # flood the hot flow until it sheds: its queues are full
+    flooded = []
+
+    def hot_waiter():
+        try:
+            lvl.acquire("hot")
+            lvl.release()
+        except Rejected:
+            pass
+
+    for _ in range(4 * 4):  # exactly fills the hand
+        th = threading.Thread(target=hot_waiter)
+        th.start()
+        flooded.append(th)
+    assert wait_until(lambda: lvl.state()["waiting"] == 16, 3.0), \
+        lvl.state()
+    with pytest.raises(Rejected):
+        lvl.acquire("hot")
+    # only the hot flow's hand is occupied...
+    st = lvl.state()
+    occupied = {int(i) for i in st["nonempty_queues"]}
+    assert occupied == set(hand)
+    assert len(occupied) < 16, "hot flow occupied every queue"
+    # ...so a well-behaved flow whose hand differs still enqueues
+    other = next(f"flow-{i}" for i in range(100)
+                 if set(lvl.hand_for(f"flow-{i}")) != set(hand))
+    ok = []
+
+    def good_waiter():
+        lvl.acquire(other)
+        ok.append(True)
+        lvl.release()
+
+    th = threading.Thread(target=good_waiter)
+    th.start()
+    assert wait_until(lambda: lvl.state()["waiting"] == 17, 2.0)
+    lvl.release()  # free the seat: round-robin dispatch drains
+    th.join(timeout=5)
+    for f in flooded:
+        f.join(timeout=5)
+    assert ok, "well-behaved flow starved behind the hot flow"
+    # drain bookkeeping: every dispatched waiter released its seat
+    assert wait_until(
+        lambda: lvl.state()["seats_in_use"] == 0
+        and lvl.state()["waiting"] == 0, 5.0), lvl.state()
+
+
+def test_round_robin_dispatch_is_fair_across_flows():
+    """10 queued requests from the hot flow, 1 from another flow: the
+    other flow's request must dispatch within the first two seat
+    grants, not after the hot backlog drains."""
+    lvl = PriorityLevel("rr", seats=1, queues=16, queue_length=16,
+                        hand_size=2, queue_wait=10.0)
+    lvl.acquire("holder")
+    order = []
+    mu = threading.Lock()
+
+    def waiter(flow):
+        lvl.acquire(flow)
+        with mu:
+            order.append(flow)
+        lvl.release()
+
+    hot = [threading.Thread(target=waiter, args=("hot",))
+           for _ in range(10)]
+    for th in hot:
+        th.start()
+    assert wait_until(lambda: lvl.state()["waiting"] == 10, 3.0)
+    good = threading.Thread(target=waiter, args=("good",))
+    good.start()
+    assert wait_until(lambda: lvl.state()["waiting"] == 11, 3.0)
+    lvl.release()  # seats free one by one as each waiter releases
+    good.join(timeout=5)
+    for th in hot:
+        th.join(timeout=5)
+    assert "good" in order[:2], order
+
+
+# -- the apiserver doors -------------------------------------------------------
+
+
+def test_http_door_sheds_with_retry_after_header():
+    api = APIServer(flowcontrol=_tiny_controller())
+    _h, _p = api.serve_http()
+    url = f"http://{_h}:{_p}"
+    try:
+        holder = api.flowcontrol.admit("tenant-x", (), "GET",
+                                       "/api/v1/pods")
+        # one waiter fills hand(1-2 queues x len 2)... flood until shed
+        tr = HTTPTransport(url, user="tenant-x", retry_429=0)
+        results = []
+
+        def req():
+            results.append(tr.request(
+                "GET", "/api/v1/namespaces/default/pods"))
+
+        threads = [threading.Thread(target=req) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=10)
+        holder.__exit__()
+        codes = sorted(c for c, _ in results)
+        assert 429 in codes, codes
+        shed = next(p for c, p in results if c == 429)
+        assert shed["reason"] == "TooManyRequests"
+        assert shed["details"]["retryAfterSeconds"] >= 1
+        # the real header rides the wire too
+        holder2 = api.flowcontrol.admit("tenant-x", (), "GET",
+                                        "/api/v1/pods")
+        import http.client as hc
+
+        conn = hc.HTTPConnection(_h, _p, timeout=10)
+        waiters = [threading.Thread(target=req) for _ in range(6)]
+        for th in waiters:
+            th.start()
+        deadline = time.time() + 5
+        retry_after = None
+        while time.time() < deadline and retry_after is None:
+            conn.request("GET", "/api/v1/namespaces/default/pods",
+                         headers={"X-Remote-User": "tenant-x"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 429:
+                retry_after = resp.headers.get("Retry-After")
+        holder2.__exit__()
+        for th in waiters:
+            th.join(timeout=10)
+        conn.close()
+        assert retry_after is not None and int(retry_after) >= 1
+        tr.close()
+    finally:
+        api.shutdown_http()
+
+
+def test_http_door_identity_headers_classify_and_audit():
+    api = APIServer(flowcontrol=APFController())
+    _h, _p = api.serve_http()
+    try:
+        from kubernetes_tpu.metrics import (
+            apiserver_flowcontrol_dispatched_requests_total as disp,
+        )
+
+        base_wh = disp.get(priority_level="workload-high")
+        base_ex = disp.get(priority_level="exempt")
+        tr = HTTPTransport(f"http://{_h}:{_p}", user="tenant-z")
+        assert tr.request("GET", "/api/v1/nodes")[0] == 200
+        assert disp.get(priority_level="workload-high") == base_wh + 1
+        trs = HTTPTransport(f"http://{_h}:{_p}",
+                            user="system:kube-scheduler")
+        assert trs.request("GET", "/api/v1/nodes")[0] == 200
+        assert disp.get(priority_level="exempt") == base_ex + 1
+        # the audit trail sees the declared caller, not anonymous
+        code, audit = tr.request("GET", "/debug/audit",
+                                 query={"user": "tenant-z"})
+        assert code == 200 and audit["items"], audit
+        tr.close()
+        trs.close()
+    finally:
+        api.shutdown_http()
+
+
+def test_local_transport_deposits_identity():
+    api = APIServer(flowcontrol=APFController())
+    from kubernetes_tpu.metrics import (
+        apiserver_flowcontrol_dispatched_requests_total as disp,
+    )
+
+    base_ex = disp.get(priority_level="exempt")
+    base_wl = disp.get(priority_level="workload-low")
+    lt = LocalTransport(api)  # unnamed in-process caller -> unsecured
+    assert lt.request("GET", "/api/v1/nodes")[0] == 200
+    assert disp.get(priority_level="exempt") == base_ex + 1
+    lt2 = LocalTransport(api, user="batcher", groups=("workload:low",))
+    assert lt2.request("GET", "/api/v1/nodes")[0] == 200
+    assert disp.get(priority_level="workload-low") == base_wl + 1
+
+
+def test_local_transport_identity_does_not_leak_to_direct_callers():
+    """After a LocalTransport(user=tenant) request, a DIRECT handle()
+    call on the same thread must classify as loopback/unsecured again
+    — a stale tenant identity would queue (or shed) exempt work."""
+    api = APIServer(flowcontrol=APFController())
+    from kubernetes_tpu.metrics import (
+        apiserver_flowcontrol_dispatched_requests_total as disp,
+    )
+
+    lt = LocalTransport(api, user="tenant-sticky")
+    assert lt.request("GET", "/api/v1/nodes")[0] == 200
+    base_ex = disp.get(priority_level="exempt")
+    base_wh = disp.get(priority_level="workload-high")
+    assert api.handle("GET", "/api/v1/nodes", {}, None)[0] == 200
+    assert disp.get(priority_level="exempt") == base_ex + 1
+    assert disp.get(priority_level="workload-high") == base_wh
+
+
+def test_hand_memo_is_bounded():
+    """Flow keys derive from caller-controlled identity: the per-flow
+    hand memo must cap, not grow one entry per spoofed user."""
+    lvl = PriorityLevel("memo", seats=1, queues=8, queue_length=4,
+                        hand_size=2, queue_wait=0.05)
+    lvl.HAND_MEMO_MAX = 16
+    lvl.acquire("holder")  # force every later acquire onto queues
+    for i in range(64):
+        try:
+            lvl.acquire(f"spoofed-{i}")
+        except Rejected:
+            pass
+    assert len(lvl._hands) <= 16
+    lvl.release()
+
+
+def test_fleet_fail_nodes_zero_is_a_noop():
+    from kubernetes_tpu.kubemark.fleet import HollowFleet
+
+    fleet = HollowFleet.__new__(HollowFleet)
+    fleet.node_names = [f"n{i}" for i in range(5)]
+    import threading as _t
+
+    fleet._lock = _t.Lock()
+    fleet._dead = set()
+    assert fleet.fail_nodes(0) == []
+    assert not fleet._dead
+    assert fleet.fail_nodes(2) == ["n3", "n4"]
+
+
+def test_debug_flowcontrol_endpoint_and_kill_switch(monkeypatch):
+    api = APIServer(flowcontrol=APFController())
+    code, state = api.handle("GET", "/debug/flowcontrol", {}, None)
+    assert code == 200 and state["enabled"]
+    assert set(state["priority_levels"]) == {
+        "exempt", "workload-high", "workload-low", "catch-all"}
+    assert [s["name"] for s in state["flow_schemas"]] == [
+        "system", "workload-low", "workload-high", "catch-all"]
+    # the kill switch: KUBERNETES_TPU_APF=0 disables classification
+    monkeypatch.setenv("KUBERNETES_TPU_APF", "0")
+    off = APIServer()
+    assert off.flowcontrol is None
+    code, state = off.handle("GET", "/debug/flowcontrol", {}, None)
+    assert code == 200 and state == {"enabled": False}
+    monkeypatch.delenv("KUBERNETES_TPU_APF")
+    on = APIServer()
+    assert on.flowcontrol is not None
+
+
+def test_default_levels_share_seats():
+    levels = default_levels(total_seats=32)
+    assert levels["exempt"].exempt
+    shared = [levels[n].seats for n in
+              ("workload-high", "workload-low", "catch-all")]
+    assert shared[0] > shared[1] > shared[2] >= 1
+    assert sum(shared) <= 34  # rounding slack over 32
+
+
+def test_default_schemas_order_is_first_match_wins():
+    c = APFController()
+    # a system user in workload:low still lands exempt (schema order)
+    _s, level, _f = c.classify(
+        "system:kube-scheduler", ("workload:low",), "GET", "/x")
+    assert level.name == "exempt"
+    assert [s.name for s in default_schemas()] == [
+        "system", "workload-low", "workload-high", "catch-all"]
+
+
+# -- client transport 429 resilience ------------------------------------------
+
+
+class _FakeResp:
+    def __init__(self, status, retry_after=None):
+        self.status = status
+        self.headers = (
+            {"Retry-After": str(retry_after)} if retry_after else {})
+
+
+def test_transport_retries_429_honoring_retry_after(monkeypatch):
+    tr = HTTPTransport("http://127.0.0.1:1", retry_429=3)
+    responses = [_FakeResp(429, retry_after=2), _FakeResp(429),
+                 _FakeResp(200)]
+    calls = []
+
+    def fake_once(method, target, data, headers):
+        calls.append(method)
+        return responses[len(calls) - 1], {"n": len(calls)}
+
+    sleeps = []
+    monkeypatch.setattr(tr, "_request_once", fake_once)
+    monkeypatch.setattr(
+        "kubernetes_tpu.client.transport._time",
+        type("T", (), {"sleep": staticmethod(sleeps.append)}),
+    )
+    code, payload = tr.request("GET", "/api/v1/pods")
+    assert code == 200 and payload == {"n": 3}
+    assert len(calls) == 3
+    assert tr.stats == {"sheds_429": 2, "retries_429": 2,
+                        "giveups_429": 0}
+    # first sleep honors (jittered) Retry-After: in [1, 2]s
+    assert 1.0 <= sleeps[0] <= 2.0, sleeps
+    # second has no hint: capped exponential backoff, well under cap
+    assert 0.0 < sleeps[1] <= tr.BACKOFF_429_CAP
+
+
+def test_transport_gives_up_after_retry_budget(monkeypatch):
+    tr = HTTPTransport("http://127.0.0.1:1", retry_429=2)
+    calls = []
+
+    def fake_once(method, target, data, headers):
+        calls.append(1)
+        return _FakeResp(429, retry_after=1), {"code": 429}
+
+    monkeypatch.setattr(tr, "_request_once", fake_once)
+    monkeypatch.setattr(
+        "kubernetes_tpu.client.transport._time",
+        type("T", (), {"sleep": staticmethod(lambda s: None)}),
+    )
+    code, _ = tr.request("POST", "/api/v1/pods")
+    assert code == 429
+    assert len(calls) == 3  # initial + 2 retries
+    assert tr.stats["giveups_429"] == 1
+
+
+def test_transport_retry_disabled(monkeypatch):
+    tr = HTTPTransport("http://127.0.0.1:1", retry_429=0)
+    monkeypatch.setattr(
+        tr, "_request_once",
+        lambda *a: (_FakeResp(429), {"code": 429}))
+    code, _ = tr.request("GET", "/x")
+    assert code == 429
+    assert tr.stats == {"sheds_429": 1, "retries_429": 0,
+                        "giveups_429": 1}
+
+
+def test_identity_headers_on_the_wire():
+    tr = HTTPTransport("http://127.0.0.1:1", user="tenant-q",
+                       groups=("workload:low", "g2"))
+    h = tr._headers(False)
+    assert h["X-Remote-User"] == "tenant-q"
+    assert h["X-Remote-Group"] == "workload:low,g2"
+    anon = HTTPTransport("http://127.0.0.1:1")
+    assert "X-Remote-User" not in anon._headers(False)
+
+
+def test_creator_shed_classification():
+    """The Poisson creator must count a post-retry 429 as a shed (and
+    keep going), not die: the classification rides APIStatusError."""
+    from kubernetes_tpu.client.rest import APIStatusError
+
+    shed = APIStatusError(429, {"reason": "TooManyRequests"})
+    other = APIStatusError(500, {"reason": "InternalError"})
+    assert shed.code == 429 and other.code != 429
+
+
+# -- the armed witnesses over the queue/dispatch machinery ---------------------
+
+
+def test_concurrent_dispatch_under_armed_race_witness():
+    """Hammer admit/release from many threads with the data-race
+    detector ARMED over the controller and its levels (track() runs in
+    their constructors, so building them inside the armed window
+    instruments every queue/seat attribute access)."""
+    with races.instrumented(reset=True):
+        c = _tiny_controller(seats=2, queues=8, queue_length=8,
+                             hand_size=2, queue_wait=1.0)
+        stats = {"ok": 0, "shed": 0}
+        mu = threading.Lock()
+
+        def worker(i):
+            for j in range(20):
+                user = ("system:kube-scheduler" if i % 4 == 0
+                        else f"tenant-{i % 3}")
+                try:
+                    with c.admit(user, (), "GET", "/api/v1/pods"):
+                        time.sleep(0.0005)
+                    with mu:
+                        stats["ok"] += 1
+                except Rejected:
+                    with mu:
+                        stats["shed"] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert stats["ok"] > 0
+        for lvl in c.levels.values():
+            st = lvl.state()
+            assert st["seats_in_use"] == 0 and st["waiting"] == 0
+    races.assert_no_races("(flowcontrol)")
+
+
+def test_lock_order_sanitizer_green_over_apf_doors():
+    """Drive the full handle() path (APF + audit + store + cacher
+    locks) under the lock-ORDER sanitizer; any inconsistent acquisition
+    order across those subsystems fails here."""
+    with locks.instrumented():
+        api = APIServer(flowcontrol=_tiny_controller(
+            seats=2, queue_wait=0.5))
+        lt = LocalTransport(api, user="tenant-lock")
+
+        def worker():
+            for _ in range(10):
+                lt.request("GET", "/api/v1/nodes")
+                lt.request(
+                    "POST", "/api/v1/namespaces/default/pods",
+                    body={"kind": "Pod", "apiVersion": "v1",
+                          "metadata": {"generateName": "fc-"},
+                          "spec": {"containers": [{"name": "c"}]}})
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        api.close_cachers()
+    locks.assert_no_cycles("(flowcontrol doors)")
