@@ -404,7 +404,7 @@ def run_soak(seconds: int):
         sys.exit(1)
 
 
-BENCH_FILE = "BENCH_r08.json"
+BENCH_FILE = "BENCH_r09.json"
 
 
 def _bench_merge(update: dict) -> None:
@@ -452,6 +452,7 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
                   rate: float = 300.0, slo: float = 5.0,
                   store_profile: str = "memory", scenario: str = "",
                   smoke: bool = False, ab: bool = False,
+                  procs: int = 0, ha_schedulers: int = 0,
                   explicit=()):
     """Sustained-traffic WIRE soak, plus the named chaos scenarios
     (noisy-neighbor / rack-failure / rolling-update / burst). The
@@ -479,7 +480,8 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     if scenario:
         overrides = {
             k: v for k, v in (("num_nodes", num_nodes), ("rate", rate),
-                              ("slo", slo))
+                              ("slo", slo), ("procs", procs),
+                              ("ha_schedulers", ha_schedulers))
             if k in explicit
         }
         cfg = scenario_config(scenario, seconds, smoke=smoke,
@@ -488,13 +490,18 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
     else:
         cfg = SoakConfig(seconds=seconds, num_nodes=num_nodes,
                          rate=rate, slo=slo,
-                         store_profile=store_profile, apf=apf_on)
+                         store_profile=store_profile, apf=apf_on,
+                         procs=procs, ha_schedulers=ha_schedulers)
     record = _run_soak(cfg)
     print(json.dumps(record))
     # each store profile and scenario owns its key: a chaos-scenario
     # record must not clobber the plain-soak baseline (or vice versa)
-    soak_key = ("wire_soak" if store_profile == "memory"
-                else f"wire_soak_{store_profile}")
+    if cfg.procs:
+        soak_key = f"wire_soak_procs{cfg.procs}"
+    elif store_profile == "memory":
+        soak_key = "wire_soak"
+    else:
+        soak_key = f"wire_soak_{store_profile}"
     if scenario:
         soak_key += "_" + scenario.replace("-", "_")
     _bench_merge({soak_key: record})
@@ -503,6 +510,76 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
         print(f"# WIRE-SOAK GATE BREACH: {', '.join(breached)}",
               file=sys.stderr)
         sys.exit(1)
+
+
+def run_proc_curve(seconds: int, procs_list, rates, num_nodes: int,
+                   slo: float):
+    """The multi-process scaling protocol: for each apiserver process
+    count, ratchet the Poisson arrival rate up the `rates` ladder
+    until a gate breaks; the last all-gates-green rung is that
+    topology's sustained ceiling. BENCH_r09.json gets the whole curve
+    (per-rung gate records included), so the aggregate-pods/s-vs-
+    process-count claim is a recorded measurement, not a headline."""
+    _assert_sanitizers_off()
+    from kubernetes_tpu.apiserver.flowcontrol import enabled_in_env
+    from kubernetes_tpu.harness.soak import SoakConfig
+    from kubernetes_tpu.harness.soak import run_wire_soak as _run_soak
+
+    apf_on = enabled_in_env()
+    curve = {}
+    for procs in procs_list:
+        label = f"{procs}-process" if procs else "in-process"
+        rungs = []
+        ceiling = None
+        for rate in rates:
+            print(f"# proc-curve: {label}, rate {rate:g} pods/s",
+                  file=sys.stderr)
+            cfg = SoakConfig(
+                seconds=seconds, num_nodes=num_nodes, rate=rate,
+                slo=slo, procs=procs, apf=apf_on)
+            try:
+                rec = _run_soak(cfg)
+            except Exception as e:
+                print(f"# proc-curve rung failed outright: {e}",
+                      file=sys.stderr)
+                rungs.append({"rate": rate, "error": str(e)})
+                break
+            rungs.append({
+                "rate": rate,
+                "ok": rec["ok"],
+                "gates": rec["gates"],
+                "steady_bound_pods_per_sec":
+                    rec["steady_bound_pods_per_sec"],
+                "p99_created_to_bound_seconds":
+                    rec["p99_created_to_bound_seconds"],
+                "creator_sheds": rec["creator_sheds"],
+                "apiserver_process_accounting": rec.get(
+                    "apiserver_process_accounting"),
+            })
+            if rec["ok"]:
+                ceiling = rec["steady_bound_pods_per_sec"]
+            else:
+                breached = [k for k, v in rec["gates"].items()
+                            if not v]
+                print(f"# proc-curve: {label} broke at rate {rate:g} "
+                      f"({', '.join(breached)})", file=sys.stderr)
+                break
+        curve[str(procs)] = {
+            "sustained_ceiling_pods_per_sec": ceiling,
+            "rungs": rungs,
+        }
+        print(f"# proc-curve: {label} sustained ceiling "
+              f"{ceiling}", file=sys.stderr)
+    _bench_merge({"multiproc_curve": {
+        "seconds_per_rung": seconds,
+        "hollow_nodes": num_nodes,
+        "slo_p99_seconds": slo,
+        "curve": curve,
+    }})
+    print(json.dumps({"metric": "multiproc_curve", "curve": {
+        k: v["sustained_ceiling_pods_per_sec"]
+        for k, v in curve.items()
+    }}))
 
 
 def main():
@@ -800,14 +877,17 @@ def _cli():
     ap.add_argument(
         "--wire-soak-scenario", default="", metavar="NAME",
         choices=["", "noisy-neighbor", "rack-failure", "rolling-update",
-                 "burst"],
+                 "burst", "process-kill"],
         help="named chaos scenario layered on the soak (each with its "
              "own gates): noisy-neighbor (1 abusive flow vs N "
              "well-behaved; APF sheds the abuser), rack-failure "
              "(a rack of hollow nodes vanishes; eviction wave under "
              "SLO), rolling-update (many-replica RC rolls v1->v2 "
              "under SLO), burst (10x Poisson spike absorbed, p99 "
-             "recovers)",
+             "recovers), process-kill (multi-process profile: kill -9 "
+             "the leader apiserver, a follower, and the active "
+             "scheduler mid-soak; each recovers inside kill_slo with "
+             "zero lost acked writes)",
     )
     ap.add_argument(
         "--wire-soak-smoke", action="store_true",
@@ -828,7 +908,51 @@ def _cli():
              "consensus store behind TWO apiservers — leader + "
              "forwarding follower; the multi-apiserver HA smoke)",
     )
+    ap.add_argument(
+        "--wire-soak-procs", type=int, default=0, metavar="N",
+        help="run the soak against N apiserver replicas as SEPARATE "
+             "OS processes over one quorum (crash-safe supervised: "
+             "atexit + SIGKILL sweep), driven through the "
+             "multi-endpoint spread/failover transport; per-process "
+             "request/CPU/RSS accounting lands in the BENCH record. "
+             "0 = the in-process profiles.",
+    )
+    ap.add_argument(
+        "--wire-soak-ha", type=int, default=0, metavar="N",
+        help="with --wire-soak-procs: also run N kube-scheduler OS "
+             "processes sharing the leader-election lease (scheduler "
+             "HA; the process-kill scenario kills the holder)",
+    )
+    ap.add_argument(
+        "--proc-curve", default="", metavar="PROCS:RATES",
+        help="multi-process scaling protocol instead of a single "
+             "soak: e.g. '0,3:300,600,1200' runs the in-process and "
+             "3-process topologies, ratcheting the arrival rate up "
+             "each ladder until a gate breaks; the per-process-count "
+             "sustained-ceiling curve lands in BENCH_r09.json. Uses "
+             "--wire-soak SECONDS per rung and --wire-soak-nodes/-slo.",
+    )
     args = ap.parse_args()
+    if args.proc_curve:
+        if not args.wire_soak:
+            raise SystemExit("--proc-curve needs --wire-soak SECONDS "
+                             "(the per-rung soak length)")
+        try:
+            procs_part, _, rates_part = args.proc_curve.partition(":")
+            procs_list = [int(x) for x in procs_part.split(",") if x]
+            rates = [float(x) for x in rates_part.split(",") if x]
+            assert procs_list and rates
+        except (ValueError, AssertionError):
+            raise SystemExit(
+                "--proc-curve wants 'P1,P2:R1,R2,...' e.g. "
+                "'0,3:300,600,1200'")
+        run_proc_curve(
+            args.wire_soak, procs_list, rates,
+            num_nodes=(args.wire_soak_nodes
+                       if args.wire_soak_nodes is not None else 1000),
+            slo=(args.wire_soak_slo
+                 if args.wire_soak_slo is not None else 5.0))
+        return
     if args.wire_soak:
         if (args.wire_soak_smoke or args.wire_soak_ab) and (
                 not args.wire_soak_scenario):
@@ -843,6 +967,10 @@ def _cli():
                 ("slo", args.wire_soak_slo),
             ) if val is not None
         }
+        if args.wire_soak_procs:
+            explicit.add("procs")
+        if args.wire_soak_ha:
+            explicit.add("ha_schedulers")
         run_wire_soak(
             args.wire_soak,
             num_nodes=(args.wire_soak_nodes
@@ -855,6 +983,8 @@ def _cli():
             scenario=args.wire_soak_scenario,
             smoke=args.wire_soak_smoke,
             ab=args.wire_soak_ab,
+            procs=args.wire_soak_procs,
+            ha_schedulers=args.wire_soak_ha,
             explicit=explicit)
         return
     if args.soak:

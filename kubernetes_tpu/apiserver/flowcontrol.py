@@ -93,17 +93,20 @@ class _Waiter:
     """One queued request. ``dispatched`` is written by the dispatcher
     and read back by the waiting thread — both under the level lock;
     ``queue_index`` lets a timed-out waiter withdraw from its one queue
-    instead of scanning the whole bank."""
+    instead of scanning the whole bank; ``width`` is the seats this
+    request occupies while dispatched."""
 
     __slots__ = ("flow", "ready", "dispatched", "enqueued_at",
-                 "queue_index")
+                 "queue_index", "width")
 
-    def __init__(self, flow: str, enqueued_at: float, queue_index: int):
+    def __init__(self, flow: str, enqueued_at: float, queue_index: int,
+                 width: int = 1):
         self.flow = flow
         self.ready = threading.Event()
         self.dispatched = False
         self.enqueued_at = enqueued_at
         self.queue_index = queue_index
+        self.width = width
 
 
 class PriorityLevel:
@@ -184,22 +187,29 @@ class PriorityLevel:
 
     # -- admission -----------------------------------------------------------
 
-    def acquire(self, flow: str) -> float:
-        """Take a seat (possibly after queueing); returns seconds
-        waited. Raises Rejected on queue-full or queue-wait timeout."""
+    def acquire(self, flow: str, width: int = 1) -> float:
+        """Take `width` seats (possibly after queueing); returns
+        seconds waited. Raises Rejected on queue-full or queue-wait
+        timeout. Width > 1 is the cost classification for expensive
+        requests (selector LISTs, bulk batch bodies): one heavy
+        request occupies several seats so a stream of them cannot
+        soak up the level's whole nominal concurrency while costing
+        like singletons."""
+        width = max(1, min(int(width), self.seats))
         if self.exempt:
             # the system level never waits: unbounded immediate
             # dispatch, by design (its wait histogram staying ~0 is the
             # measurable contract)
             with self._mu:
-                self._seats_in_use += 1
+                self._seats_in_use += width
             self._m_dispatched()
             self._m_wait.observe(0.0)
             return 0.0
         w: Optional[_Waiter] = None
         with self._mu:
-            if self._seats_in_use < self.seats and self._waiting == 0:
-                self._seats_in_use += 1
+            if (self._seats_in_use + width <= self.seats
+                    and self._waiting == 0):
+                self._seats_in_use += width
                 self._m_dispatched()
                 self._m_wait.observe(0.0)
                 return 0.0
@@ -220,7 +230,7 @@ class PriorityLevel:
                 )
                 raise Rejected(self.name, "queue-full",
                                self._retry_after_locked())
-            w = _Waiter(flow, time.monotonic(), qi)
+            w = _Waiter(flow, time.monotonic(), qi, width)
             self._queues[qi].append(w)
             self._waiting += 1
             self._m_inqueue.inc()
@@ -231,10 +241,14 @@ class PriorityLevel:
             else:
                 # timed out in queue: withdraw from the one queue it
                 # was appended to (the dispatcher can no longer pick
-                # this waiter once it leaves the deque)
+                # this waiter once it leaves the deque), then re-run
+                # dispatch — if THIS waiter was a wide head holding
+                # the dispatcher while seats accumulated for it, its
+                # departure may unblock narrower waiters behind it
                 self._queues[w.queue_index].remove(w)
                 self._waiting -= 1
                 self._m_inqueue.dec()
+                self._dispatch_locked()
                 apiserver_flowcontrol_rejected_requests_total.inc(
                     priority_level=self.name, reason="time-out"
                 )
@@ -244,26 +258,34 @@ class PriorityLevel:
         self._m_wait.observe(waited)
         return waited
 
-    def release(self) -> None:
+    def release(self, width: int = 1) -> None:
+        width = max(1, min(int(width), self.seats))
         with self._mu:
-            self._seats_in_use -= 1
+            self._seats_in_use -= width
             if not self.exempt:
                 self._dispatch_locked()
 
     def _dispatch_locked(self) -> None:
         """Fill freed seats round-robin across non-empty queues — each
-        active flow's queue gets equal service regardless of depth."""
+        active flow's queue gets equal service regardless of depth. A
+        wide head-of-queue request that does not fit yet HOLDS the
+        dispatcher (seats accumulate for it as they free) instead of
+        being skipped — jumping past it would starve wide requests
+        behind an endless stream of narrow ones."""
         n = len(self._queues)
-        while self._seats_in_use < self.seats:
+        while True:
             for off in range(n):
                 qi = (self._rr + off) % n
                 if self._queues[qi]:
-                    self._rr = qi + 1
-                    w = self._queues[qi].popleft()
                     break
             else:
                 return
-            self._seats_in_use += 1
+            w = self._queues[qi][0]
+            if self._seats_in_use + w.width > self.seats:
+                return  # not enough seats yet: wait for more releases
+            self._rr = qi + 1
+            self._queues[qi].popleft()
+            self._seats_in_use += w.width
             self._waiting -= 1
             self._m_inqueue.dec()
             w.dispatched = True
@@ -309,22 +331,64 @@ class PriorityLevel:
 
 
 class _Ticket:
-    """Context manager holding one dispatched request's seat."""
+    """Context manager holding one dispatched request's seats."""
 
-    __slots__ = ("level", "schema", "flow", "waited")
+    __slots__ = ("level", "schema", "flow", "waited", "width")
 
     def __init__(self, level: PriorityLevel, schema: FlowSchema,
-                 flow: str, waited: float):
+                 flow: str, waited: float, width: int = 1):
         self.level = level
         self.schema = schema
         self.flow = flow
         self.waited = waited
+        self.width = width
 
     def __enter__(self) -> "_Ticket":
         return self
 
     def __exit__(self, *exc) -> None:
-        self.level.release()
+        self.level.release(self.width)
+
+
+#: seats a selector LIST occupies: the label/field filter runs in-seat
+#: over the whole collection (the raw-splice fast path cannot serve it)
+WIDTH_SELECTOR_LIST = 2
+#: one extra seat per this many items in a bulk body (a 1000-item
+#: /api/v1/batch decodes+validates+commits every item inside its seat)
+WIDTH_ITEMS_PER_SEAT = 200
+#: widest any single request can be classified (further capped at the
+#: level's total seats at acquire time so it can always dispatch)
+WIDTH_MAX = 4
+
+
+def request_width(verb: str, path: str, query=None, body=None) -> int:
+    """Cost-classify one request into the seats it occupies — decided
+    AT CLASSIFY TIME from the request shape alone, so one heavy
+    request cannot masquerade as a singleton and starve a level that
+    nominally has free seats:
+
+      * selector LISTs (labelSelector/fieldSelector, non-watch) run
+        the filter in-seat over the whole collection -> 2 seats;
+      * bulk bodies (``/api/v1/batch``, bulk-create Lists) cost one
+        extra seat per WIDTH_ITEMS_PER_SEAT items, capped at
+        WIDTH_MAX;
+      * everything else is 1.
+    """
+    if verb in ("GET", "HEAD"):
+        # same watch detection as the router (`watch=false` is a LIST,
+        # not a watch — a truthy-string check would let selector LISTs
+        # masquerade as width-1 watches)
+        is_watch = query is not None and \
+            query.get("watch") in ("true", "1")
+        if query and not is_watch and (
+                query.get("labelSelector") or query.get("fieldSelector")):
+            return WIDTH_SELECTOR_LIST
+        return 1
+    items = body.get("items") if isinstance(body, dict) else None
+    if isinstance(items, (list, tuple)) and \
+            len(items) >= WIDTH_ITEMS_PER_SEAT:
+        return min(WIDTH_MAX, 1 + len(items) // WIDTH_ITEMS_PER_SEAT)
+    return 1
 
 
 def is_exempt_identity(user: str, groups: Sequence[str]) -> bool:
@@ -457,10 +521,11 @@ class APFController:
         return s, self.levels[s.priority_level], s.flow_key(user)
 
     def admit(self, user: str, groups: Sequence[str], verb: str,
-              path: str) -> _Ticket:
+              path: str, width: int = 1) -> _Ticket:
         schema, level, flow = self.classify(user, groups, verb, path)
-        waited = level.acquire(flow)  # may raise Rejected
-        return _Ticket(level, schema, flow, waited)
+        width = max(1, min(int(width), level.seats))
+        waited = level.acquire(flow, width)  # may raise Rejected
+        return _Ticket(level, schema, flow, waited, width)
 
     def state(self) -> Dict[str, object]:
         """The /debug/flowcontrol payload."""

@@ -25,6 +25,7 @@ from kubernetes_tpu.api import labels as labelpkg
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.apiserver import admission as adm
 from kubernetes_tpu.apiserver.flowcontrol import Rejected as _APFRejected
+from kubernetes_tpu.apiserver.flowcontrol import request_width as _apf_width
 from kubernetes_tpu.apiserver.fields import (
     matches_fields,
     matches_fields_wire,
@@ -516,7 +517,13 @@ class APIServer:
                 user = "system:unsecured"
             groups = getattr(ctx, "groups", None) or ()
             try:
-                ticket = apf.admit(user, groups, method.upper(), path)
+                # seat WIDTH classified from the request shape: a
+                # selector LIST or a bulk batch body occupies several
+                # seats, so heavy requests are charged what they cost
+                verb = method.upper()
+                ticket = apf.admit(
+                    user, groups, verb, path,
+                    width=_apf_width(verb, path, query, body))
             except _APFRejected as e:
                 return 429, {
                     "kind": "Status",
@@ -675,15 +682,24 @@ class APIServer:
         except Compacted as e:
             return 410, APIError(410, str(e), reason="Expired").status()
         except Exception as e:
-            # NotPrimary (a write reached an unpromoted standby) -> 503
-            # so clients retry through transport failover; imported
+            # NotPrimary (a write reached an unpromoted standby, or a
+            # quorum member that cannot prove/reach a leader) -> 503 so
+            # clients rotate through transport failover; imported
             # lazily to keep replication optional
             from kubernetes_tpu.storage.replicated import NotPrimary
 
             if isinstance(e, NotPrimary):
-                return 503, APIError(
+                status = APIError(
                     503, str(e), reason="ServiceUnavailable"
                 ).status()
+                # replay safety for the multi-endpoint transport: an
+                # indeterminate outcome (the write may have committed)
+                # must not be blind-retried on another replica
+                status["details"] = {
+                    "indeterminate": bool(
+                        getattr(e, "indeterminate", False)),
+                }
+                return 503, status
             raise
         finally:
             if body_owned:

@@ -18,6 +18,7 @@ import time as _time
 
 from kubernetes_tpu.analysis import races as _races
 from kubernetes_tpu.metrics import (
+    apiserver_endpoint_failovers_total,
     client_rate_limited_requests_total,
     client_request_retries_total,
 )
@@ -28,6 +29,7 @@ from urllib import parse as urlparse
 
 _rate_limited = client_rate_limited_requests_total.child()
 _retries = client_request_retries_total.child()
+_failovers = apiserver_endpoint_failovers_total.child()
 
 
 class LocalTransport:
@@ -198,7 +200,8 @@ class HTTPTransport:
     def __init__(self, base_url: str, timeout: float = 30.0,
                  tls_ca: str = "", insecure: bool = False,
                  binary: bool = False, bearer_token: str = "",
-                 user: str = "", groups=(), retry_429: int = 4):
+                 user: str = "", groups=(), retry_429: int = 4,
+                 spread: bool = False):
         """binary=True negotiates the binary content type
         (runtime/binary.py) — the protobuf-at-scale analogue kubemark
         components default to. Implies the object protocol client-side
@@ -221,12 +224,23 @@ class HTTPTransport:
 
         base_url may be a COMMA-SEPARATED list of servers (the HA
         apiserver idiom — etcd clients take endpoint lists the same
-        way): a connection-level failure rotates to the next server and
-        retries, so a primary/standby failover is invisible to callers
-        beyond the retried request."""
+        way): a connection-level failure OR a 503 (an unpromoted
+        standby; a quorum member that cannot reach its leader) rotates
+        to the next server and retries, so a replica failover is
+        invisible to callers beyond the retried request. A 503 whose
+        body marks the outcome ``indeterminate`` (the write may have
+        committed) still rotates but is NOT blind-replayed.
+
+        spread=True round-robins ordinary requests across the endpoint
+        list (each call picks the next server) instead of pinning one —
+        the load-spreading mode for a replicated apiserver front door.
+        Watches stay pinned to the connection they opened on either
+        way."""
         urls = [u.strip().rstrip("/") for u in base_url.split(",")
                 if u.strip()]
         self.base_urls = urls
+        self.spread = spread and len(urls) > 1
+        self._spread_i = 0  # guarded-by: self._active_lock
         self._active = 0  # guarded-by: self._active_lock
         # failover rotation races: watch threads and request threads
         # rotate concurrently, and torn read-modify-writes of _active
@@ -241,9 +255,15 @@ class HTTPTransport:
         self._stats_lock = threading.Lock()
         # sheds_429: 429 responses observed; retries_429: retries
         # performed; giveups_429: 429s surfaced to the caller after
-        # retries ran out
+        # retries ran out; failovers_503: endpoint rotations forced by
+        # a 503 reply (a member refusing because it is not / cannot
+        # reach the leader — treated like a dead socket)
+        # retries_503: full endpoint cycles re-run after every member
+        # answered a determinate 503 (a leader election in progress —
+        # all members briefly refuse; bounded by retry_429's budget)
         self.stats = {"sheds_429": 0, "retries_429": 0,
-                      "giveups_429": 0}  # guarded-by: self._stats_lock
+                      "giveups_429": 0, "failovers_503": 0,
+                      "retries_503": 0}  # guarded-by: self._stats_lock
         self.binary = binary
         self.object_protocol = binary
         self._ssl_ctx = None
@@ -261,6 +281,15 @@ class HTTPTransport:
         with self._active_lock:
             return self.base_urls[self._active]
 
+    def _pick_base(self) -> str:
+        """The server the NEXT request targets: the sticky active one,
+        or — in spread mode — the next in round-robin order."""
+        if not self.spread:
+            return self.base_url
+        with self._active_lock:
+            self._spread_i = (self._spread_i + 1) % len(self.base_urls)
+            return self.base_urls[self._spread_i]
+
     def _rotate(self) -> bool:
         """Advance to the next server; True while untried servers remain
         in this rotation cycle."""
@@ -269,6 +298,22 @@ class HTTPTransport:
         with self._active_lock:
             self._active = (self._active + 1) % len(self.base_urls)
         return True
+
+    def _count_failover(self) -> None:
+        _failovers()
+        with self._stats_lock:
+            self.stats["failovers_503"] += 1
+
+    @staticmethod
+    def _is_indeterminate_503(decoded) -> bool:
+        """A 503 whose body says the outcome is unknown (the write may
+        have committed on the quorum even though this member couldn't
+        confirm it) — rotating is fine, blind replay is not."""
+        if not isinstance(decoded, dict):
+            return False
+        details = decoded.get("details")
+        return bool(isinstance(details, dict)
+                    and details.get("indeterminate"))
 
     # -- connection pool -----------------------------------------------------
 
@@ -353,9 +398,22 @@ class HTTPTransport:
         target = self._target(path, query)
         method = method.upper()
         shed_attempt = 0
+        unavailable_attempt = 0
         while True:
             resp, decoded = self._request_once(method, target, data,
                                                headers)
+            if (resp.status == 503
+                    and unavailable_attempt < self.retry_429
+                    and not self._is_indeterminate_503(decoded)):
+                # every endpoint refused (leader election in flight):
+                # a short jittered backoff outlives most elections —
+                # bounded by the same retry budget as 429 sheds
+                unavailable_attempt += 1
+                with self._stats_lock:
+                    self.stats["retries_503"] += 1
+                _time.sleep(min(0.2 * (2 ** unavailable_attempt), 2.0)
+                            * (0.5 + _random.random() * 0.5))
+                continue
             if resp.status != 429:
                 return resp.status, decoded
             # 429 = shed at the apiserver door BEFORE execution (APF or
@@ -386,29 +444,52 @@ class HTTPTransport:
         return base * (0.5 + _random.random() * 0.5)
 
     def _request_once(self, method, target, data, headers):
-        """One request with connection-failover rotation (pre-encoded
-        body + headers); -> (http response, decoded payload)."""
+        """One request with endpoint-failover rotation (pre-encoded
+        body + headers); -> (http response, decoded payload). Two
+        failure classes rotate: connection-level errors (socket died)
+        and 503 replies (the member told us it cannot serve — an
+        unpromoted standby, or a quorum member with no reachable
+        leader). A 503 is an explicit refusal BEFORE execution unless
+        its body marks the outcome indeterminate, so unlike a dead
+        socket it is safe to replay on the next server for every
+        verb."""
         for attempt in range(max(len(self.base_urls), 1)):
-            base = self.base_url
+            base = self._pick_base()
             try:
                 resp, payload = self._roundtrip(
                     base, method, target, data, headers
                 )
-                return resp, self._decode_response(resp, payload)
+                decoded = self._decode_response(resp, payload)
             except Exception as e:
                 if not _is_conn_error(e):
                     raise
                 rotated = self._rotate()  # NEXT request targets a peer
-                if (method in ("GET", "HEAD") and rotated
+                # a REFUSED connect never put the request on the wire
+                # (the process is dead / not listening): replaying is
+                # safe for EVERY verb, exactly like a 503 refusal
+                refused = isinstance(e, ConnectionRefusedError)
+                if ((method in ("GET", "HEAD") or refused) and rotated
                         and attempt + 1 < len(self.base_urls)):
-                    continue  # idempotent: replay on the next server
-                # non-idempotent verbs must NOT auto-replay across
-                # servers: the dead server may have committed (and
-                # replicated) the write before the connection dropped —
-                # replaying would double-execute or 409 the caller's
-                # own success. The caller's retry/requeue logic
-                # re-issues against the already-rotated peer.
+                    if refused:
+                        self._count_failover()
+                    continue  # replay on the next server
+                # other mid-flight failures on non-idempotent verbs
+                # must NOT auto-replay across servers: the dead server
+                # may have committed (and replicated) the write before
+                # the connection dropped — replaying would
+                # double-execute or 409 the caller's own success. The
+                # caller's retry/requeue logic re-issues against the
+                # already-rotated peer.
                 raise
+            if (resp.status == 503 and len(self.base_urls) > 1
+                    and attempt + 1 < len(self.base_urls)):
+                self._rotate()
+                self._count_failover()
+                if not self._is_indeterminate_503(decoded):
+                    continue  # refused before execution: replay
+                # outcome unknown (the write may have committed):
+                # surface the 503 — the CALLER owns idempotency here
+            return resp, decoded
         raise AssertionError("unreachable")
 
     def _roundtrip(self, base, method, target, data, headers):
@@ -558,6 +639,13 @@ class HTTPTransport:
                     status = self._decode_response(resp, payload)
                 except Exception:
                     status = {"message": payload.decode(errors="replace")}
+                if (resp.status == 503
+                        and attempt + 1 < len(self.base_urls)
+                        and self._rotate()):
+                    # this member can't serve (unpromoted standby /
+                    # lost leader): open the stream on a peer instead
+                    self._count_failover()
+                    continue
                 raise WatchError(resp.status, status)
             if self.binary:
                 return _BinaryEvents(resp, conn)

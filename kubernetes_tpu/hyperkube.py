@@ -83,6 +83,7 @@ def run_apiserver(args) -> None:
             data_dir=args.data_dir,
             peers=peers,
             listen_port=args.quorum_listen,
+            election_timeout=args.quorum_election_timeout,
         )).start()
         print(f"quorum member {args.quorum_id} peering on "
               f"{store.address[0]}:{store.address[1]} "
@@ -167,10 +168,23 @@ def run_scheduler(args) -> None:
         options = SchedulerServerOptions(
             algorithm_provider=args.algorithm_provider
         )
+    if getattr(args, "leader_elect", False):
+        # scheduler HA (server.go:140-157): two+ scheduler processes
+        # share one lease; the holder schedules, standbys take over
+        # when the holder dies or releases
+        options.leader_elect = True
+        options.leader_elect_identity = args.leader_elect_identity
+        options.leader_elect_lease_duration = args.lease_duration
+        options.leader_elect_renew_deadline = args.renew_deadline
+        options.leader_elect_retry_period = args.retry_period
+    if getattr(args, "serve_port", None) is not None:
+        options.serve_port = args.serve_port
     sched = SchedulerServer(
         _client_from(args, user="system:kube-scheduler"), options
     ).start()
-    print("kube-scheduler running", flush=True)
+    print("kube-scheduler running"
+          + (" (leader-elect)" if options.leader_elect else ""),
+          flush=True)
     _wait_forever()
     sched.stop()
 
@@ -459,6 +473,14 @@ def main(argv=None):
         "q1=127.0.0.1:7001,q2=127.0.0.1:7002",
     )
     p.add_argument(
+        "--quorum-election-timeout", type=float, default=1.0,
+        metavar="SECONDS",
+        help="base raft election timeout (etcd-style 1s default; each "
+        "reset re-rolls uniform [T, 2T]). The leader-lease window is "
+        "a fraction of this, so smaller = faster failover AND shorter "
+        "lease reads between renewals",
+    )
+    p.add_argument(
         "--replicate-listen", type=int, default=None, metavar="PORT",
         help="serve a WAL-shipping replication listener for a standby "
         "(the etcd-cluster property at primary/standby scale; commits "
@@ -492,6 +514,23 @@ def main(argv=None):
                 "--config", default="",
                 help="versioned KubeSchedulerConfiguration file "
                 "(componentconfig/v1alpha1); wins over flags",
+            )
+            p.add_argument(
+                "--leader-elect", action="store_true",
+                help="participate in kube-scheduler leader election: "
+                "only the lease holder schedules; standbys take over "
+                "when the holder dies (scheduler HA)",
+            )
+            p.add_argument("--leader-elect-identity", default="",
+                           help="lease holder identity (defaults to a "
+                           "per-process id)")
+            p.add_argument("--lease-duration", type=float, default=15.0)
+            p.add_argument("--renew-deadline", type=float, default=10.0)
+            p.add_argument("--retry-period", type=float, default=2.0)
+            p.add_argument(
+                "--serve-port", type=int, default=None,
+                help="observability mux port (/healthz /metrics; "
+                "0 = ephemeral, unset = disabled for daemon use)",
             )
 
     p = sub.add_parser("kubelet")

@@ -545,6 +545,18 @@ client_request_retries_total = registry.register(
     )
 )
 
+#: endpoint rotations a multi-endpoint transport performed because one
+#: apiserver replica stopped answering — a dead socket OR a 503 (an
+#: unpromoted standby / a quorum member that lost its leader). Counted
+#: client-side but named for what it measures: apiserver failovers.
+apiserver_endpoint_failovers_total = registry.register(
+    Counter(
+        "apiserver_endpoint_failovers_total",
+        "Apiserver endpoint rotations performed by multi-endpoint "
+        "client transports (connection failure or 503)",
+    )
+)
+
 # -- kubemark hollow fleet (kubemark/fleet.py) --------------------------------
 
 #: node heartbeats the hollow fleet committed (batched onto
@@ -725,5 +737,37 @@ quorum_snapshot_installs_total = registry.register(
     Counter(
         "quorum_snapshot_installs_total",
         "Raft snapshots installed onto lagging or fresh quorum members",
+    )
+)
+
+#: linearizable reads served under a live leader lease — no heartbeat
+#: round paid (the etcd lease-read optimization). Under a healthy
+#: leader this grows while quorum_readindex_rounds_total stays flat.
+quorum_lease_reads_total = registry.register(
+    Counter(
+        "quorum_lease_reads_total",
+        "Linearizable reads served under a live leader lease "
+        "(zero-heartbeat fast path)",
+    )
+)
+
+#: read-index confirmation rounds actually executed (a heartbeat
+#: majority round per barrier) — the slow path a lease read avoids
+quorum_readindex_rounds_total = registry.register(
+    Counter(
+        "quorum_readindex_rounds_total",
+        "Read-index heartbeat confirmation rounds executed for "
+        "linearizable reads (the lease-miss slow path)",
+    )
+)
+
+#: pre-vote probe rounds started by a would-be candidate (electability
+#: is probed WITHOUT bumping the term, so a rejoining partitioned
+#: member cannot depose a healthy leader)
+quorum_prevote_rounds_total = registry.register(
+    Counter(
+        "quorum_prevote_rounds_total",
+        "Pre-vote electability probe rounds started before any real "
+        "term-bumping election",
     )
 )

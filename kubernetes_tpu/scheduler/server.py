@@ -60,6 +60,12 @@ class SchedulerServerOptions:
     leader_elect_identity: str = ""
     lock_object_namespace: str = "kube-system"
     lock_object_name: str = "kube-scheduler"
+    # lease timing (leaderelection.go defaults); the HA soak shrinks
+    # these so a killed holder's standby takes over inside a CI-sized
+    # SLO instead of the production 15s
+    leader_elect_lease_duration: float = 15.0
+    leader_elect_renew_deadline: float = 10.0
+    leader_elect_retry_period: float = 2.0
 
     @classmethod
     def from_component_config(cls, cfg) -> "SchedulerServerOptions":
@@ -292,6 +298,9 @@ class SchedulerServer:
             opts.lock_object_namespace,
             opts.lock_object_name,
             identity,
+            lease_duration=opts.leader_elect_lease_duration,
+            renew_deadline=opts.leader_elect_renew_deadline,
+            retry_period=opts.leader_elect_retry_period,
             on_started_leading=lambda: (
                 setattr(self, "_thread", self.scheduler.run()),
                 self.ready.set(),

@@ -13,9 +13,12 @@ majority-ack consensus store (Raft-shaped):
     snapshot, reusing the durable store's length+CRC+TLV record
     framing and torn-tail recovery contract.
   * ``QuorumNode`` (node.py): randomized-timeout leader election with
-    persisted votes, per-follower next/match replication with
+    persisted votes (pre-vote probes electability before any term
+    bump), per-follower next/match replication with
     commit-on-majority-ack, snapshot install for lagging or fresh
-    followers, and read-index leadership confirmation.
+    followers, leader-lease linearizable reads (read-index heartbeat
+    rounds only on lease miss), and dynamic membership through logged
+    config entries.
   * ``QuorumStore`` (store.py): the storage.Interface facade — slots
     in behind the MemoryStore contract so the apiserver, cacher,
     scheduler and kubectl run against it unchanged; any node takes
@@ -30,6 +33,7 @@ hyperkube --store=quorum profile and the bench wire-soak use.
 
 from kubernetes_tpu.storage.quorum.node import (
     NodeConfig,
+    NotLeader,
     QuorumNode,
     QuorumUnavailable,
 )
@@ -40,6 +44,7 @@ from kubernetes_tpu.storage.quorum.store import (
 
 __all__ = [
     "NodeConfig",
+    "NotLeader",
     "QuorumNode",
     "QuorumStore",
     "QuorumUnavailable",

@@ -62,19 +62,32 @@ def read_framed(raw: bytes, pos: int) -> Tuple[Optional[bytes], int]:
     return body, pos + _HDR + n
 
 
+#: Entry kinds: DATA payloads go to the state machine's apply_fn;
+#: CONFIG payloads are membership changes the NODE applies itself
+#: (add/remove a quorum member through the replicated log — the
+#: single-server membership-change form, applied at commit).
+KIND_DATA = 0
+KIND_CONFIG = 1
+
+
 class Entry:
-    """One log slot: (term, index, payload bytes). The payload is
-    opaque to the log — the node stores TLV-encoded record batches."""
+    """One log slot: (term, index, payload bytes, kind). The payload is
+    opaque to the log — the node stores TLV-encoded record batches
+    (KIND_DATA) or membership changes (KIND_CONFIG)."""
 
-    __slots__ = ("term", "index", "payload")
+    __slots__ = ("term", "index", "payload", "kind")
 
-    def __init__(self, term: int, index: int, payload: bytes):
+    def __init__(self, term: int, index: int, payload: bytes,
+                 kind: int = KIND_DATA):
         self.term = term
         self.index = index
         self.payload = payload
+        self.kind = kind
 
     def __repr__(self):  # debugging / assertion messages
-        return f"Entry(t={self.term}, i={self.index}, {len(self.payload)}B)"
+        return (f"Entry(t={self.term}, i={self.index}, "
+                f"{len(self.payload)}B"
+                + (", cfg)" if self.kind == KIND_CONFIG else ")"))
 
 
 class RaftLog:
@@ -182,7 +195,7 @@ class RaftLog:
             self._entries.extend(entries)
             if self._wal is not None:
                 self._wal.write(b"".join(
-                    frame(tlv.dumps([e.term, e.index, e.payload]))
+                    frame(tlv.dumps([e.term, e.index, e.payload, e.kind]))
                     for e in entries
                 ))
                 self._wal.flush()
@@ -263,7 +276,7 @@ class RaftLog:
         with open(tmp, "wb") as f:
             f.write(_LOG_MAGIC)
             f.write(b"".join(
-                frame(tlv.dumps([e.term, e.index, e.payload]))
+                frame(tlv.dumps([e.term, e.index, e.payload, e.kind]))
                 for e in self._entries
             ))
             f.flush()
@@ -343,8 +356,12 @@ class RaftLog:
                     break
                 try:
                     with tlv.allow_dynamic():
-                        term, index, payload = tlv.loads(body)
-                except tlv.TLVError:
+                        row = tlv.loads(body)
+                    # pre-membership logs framed [term, index, payload];
+                    # absent kind decodes as DATA
+                    term, index, payload = row[0], row[1], row[2]
+                    kind = row[3] if len(row) > 3 else KIND_DATA
+                except (tlv.TLVError, IndexError, ValueError):
                     break  # torn/overwritten tail record
                 if index > self.snap_index:
                     # drop any stale prefix the snapshot superseded;
@@ -352,7 +369,7 @@ class RaftLog:
                     if self._entries and \
                             index <= self._entries[-1].index:
                         del self._entries[index - self.snap_index - 1:]
-                    self._entries.append(Entry(term, index, payload))
+                    self._entries.append(Entry(term, index, payload, kind))
                 pos = nxt
             self._valid_end = pos
 

@@ -19,12 +19,40 @@ and four kinds of threads:
 
 Commit = majority match on an entry of the current term (the leader's
 own durable append counts). Linearizable reads ride ``read_barrier``:
-the leader captures commit_index as the read index, confirms it still
-leads with one round of heartbeats carrying a confirm sequence number,
-then waits until the read index is applied — a read served after the
-barrier can never be a deposed leader's stale view (the etcd3
-ReadIndex protocol). Followers forward the barrier and then wait for
-their own apply position to pass the returned index.
+the leader captures commit_index as the read index, proves it still
+leads, then waits until the read index is applied — a read served
+after the barrier can never be a deposed leader's stale view (the
+etcd3 ReadIndex protocol). Followers forward the barrier and then
+wait for their own apply position to pass the returned index.
+
+**Leader leases** (round 13): leadership proof is usually free. Every
+successful AppendEntries reply records the SEND time of the call that
+earned it; the lease extends to (majority-th most recent ack's send
+time) + ``lease_factor`` x ``election_timeout``. While the lease is
+live, a barrier serves WITHOUT the heartbeat confirm round (counted in
+``quorum_lease_reads_total``; the slow confirm path counts
+``quorum_readindex_rounds_total``). Safety: no member grants a
+pre-vote — and therefore no real election can begin — before
+``election_timeout`` of leader silence, and silence starts no earlier
+than the last append's send time, so a deposing election cannot
+complete while any correctly-measured lease (factor < 1) is live.
+
+**Pre-vote** (round 13, always on): a would-be candidate first probes
+electability with a term-UNCHANGED "prevote" round; peers grant only
+when their own leader has gone silent past the election timeout and
+the candidate's log is current. Only a majority of prevotes starts a
+real (term-bumping) election — a rejoining partitioned member, whose
+peers still hear a healthy leader, can no longer force the cluster
+through a term it cannot win (``quorum_prevote_rounds_total``).
+
+**Dynamic membership** (round 13): ``propose_config`` replicates an
+add/remove of one member as a KIND_CONFIG log entry; every member
+applies it to its own peer set at commit (single-server change — one
+membership delta in flight at a time), so majority math, replicators,
+and lease accounting all follow the logged configuration with no
+downtime. A joining member simply starts as a follower pointed at the
+cluster; pre-vote keeps its timeouts from disturbing anyone until the
+leader's replicator reaches it (snapshot install included).
 
 The node knows nothing about the storage.Interface: payloads are
 opaque bytes the store evaluates and applies. That keeps every
@@ -46,10 +74,18 @@ from kubernetes_tpu.metrics import (
     quorum_append_rtt_seconds,
     quorum_commit_index,
     quorum_leader_changes_total,
+    quorum_lease_reads_total,
+    quorum_prevote_rounds_total,
+    quorum_readindex_rounds_total,
     quorum_snapshot_installs_total,
     quorum_term,
 )
-from kubernetes_tpu.storage.quorum.log import Entry, RaftLog
+from kubernetes_tpu.storage.quorum.log import (
+    KIND_CONFIG,
+    KIND_DATA,
+    Entry,
+    RaftLog,
+)
 from kubernetes_tpu.storage.quorum.rpc import PeerClient, PeerServer, RPCError
 from kubernetes_tpu.storage.replicated import NotPrimary
 
@@ -64,7 +100,15 @@ class QuorumUnavailable(NotPrimary):
     """No leader reachable / no majority: the write or linearizable
     read cannot be served right now. Subclasses NotPrimary so the
     apiserver's existing 503 mapping applies — clients retry through
-    transport failover onto a node that can reach the leader."""
+    transport failover onto a node that can reach the leader.
+
+    ``indeterminate``: the operation may ALREADY have committed (a
+    propose that timed out mid-replication, a forwarded batch whose
+    reply was lost). The apiserver surfaces it in the 503 body so the
+    multi-endpoint transport knows a blind replay is NOT safe; the
+    default False means the request definitively did not execute."""
+
+    indeterminate = False
 
 
 class NotLeader(QuorumUnavailable):
@@ -95,6 +139,13 @@ class NodeConfig:
     #: applied entries between raft-log compactions
     snapshot_every: int = 4096
     fsync: bool = False
+    #: lease window as a fraction of the BASE election timeout. Must
+    #: stay < 1: a pre-vote needs election_timeout of silence, silence
+    #: is measured from append RECEIVE (>= the leader's send time the
+    #: lease is measured from), and the margin absorbs clock-rate
+    #: drift between members. 0 disables lease reads (every barrier
+    #: pays the confirm round).
+    lease_factor: float = 0.75
 
 
 class QuorumNode:
@@ -124,6 +175,25 @@ class QuorumNode:
         #: heartbeat carries the latest, replies record it per peer
         self._confirm_seq = 0  # guarded-by: self._mu
         self._confirm_acked: Dict[str, int] = {}  # guarded-by: self._mu
+        #: leader lease bookkeeping: per peer, the SEND time (monotonic)
+        #: of the most recent append/snapshot call whose reply arrived
+        #: at our current term — the conservative end of the window in
+        #: which that peer provably still followed us
+        self._ack_start: Dict[str, float] = {}  # guarded-by: self._mu
+        #: pre-vote probe round: id fences stale grants, set collects
+        #: the grants of the current round only. Rounds are paced by
+        #: _prevote_last, NOT by touching _last_contact — probing must
+        #: not reset anyone's leader-silence clock (two nodes probing
+        #: each other would deny each other forever)
+        self._prevote_round = 0  # guarded-by: self._mu
+        self._prevotes: set = set()  # guarded-by: self._mu
+        self._prevote_last = 0.0  # guarded-by: self._mu
+        #: one membership change in flight at a time (single-server
+        #: change rule); cleared at apply or on any role change
+        self._config_inflight = False  # guarded-by: self._mu
+        #: this member was removed from the cluster by a committed
+        #: config entry: stop standing for election, serve nothing
+        self._removed = False  # guarded-by: self._mu
         #: first index of the current leadership term (the no-op);
         #: read barriers wait for it to commit (Raft §8: a new leader
         #: may not know the commit frontier until its own term commits)
@@ -150,9 +220,13 @@ class QuorumNode:
         self._server = PeerServer(self._dispatch, host=config.listen_host,
                                   port=config.listen_port)
         self.address = self._server.address
-        self._repl_clients: Dict[str, PeerClient] = {}
-        self._vote_clients: Dict[str, PeerClient] = {}
+        self._repl_clients: Dict[str, PeerClient] = {}  # guarded-by: self._mu
+        self._vote_clients: Dict[str, PeerClient] = {}  # guarded-by: self._mu
+        # _threads is append-only bookkeeping (start()/apply thread);
+        # joins never iterate it concurrently with appends
         self._threads: List[threading.Thread] = []
+        self._started = False  # replicators for dynamically-added
+        # peers spawn at config apply only once start() has run
         _races.track(self, "quorum.QuorumNode")
 
     # -- lifecycle -----------------------------------------------------------
@@ -169,16 +243,18 @@ class QuorumNode:
 
     def start(self) -> "QuorumNode":
         to = self.config.rpc_timeout
-        self._repl_clients = {
-            pid: PeerClient(addr, timeout=to)
-            for pid, addr in self.config.peers.items()
-        }
-        # elections must not queue behind an in-flight replication
-        # call on the shared per-peer socket: separate ballot clients
-        self._vote_clients = {
-            pid: PeerClient(addr, timeout=to)
-            for pid, addr in self.config.peers.items()
-        }
+        with self._mu:
+            self._repl_clients = {
+                pid: PeerClient(addr, timeout=to)
+                for pid, addr in self.config.peers.items()
+            }
+            # elections must not queue behind an in-flight replication
+            # call on the shared per-peer socket: separate ballot
+            # clients
+            self._vote_clients = {
+                pid: PeerClient(addr, timeout=to)
+                for pid, addr in self.config.peers.items()
+            }
         # only now may peer/client messages arrive: every owner
         # (node AND the store wrapping it) finished construction
         self._server.serve()
@@ -188,13 +264,19 @@ class QuorumNode:
             threading.Thread(target=self._apply_loop, daemon=True,
                              name=f"quorum-apply-{self.node_id}"),
         ]
-        for pid in self.config.peers:
-            self._threads.append(threading.Thread(
-                target=self._replicator, args=(pid,), daemon=True,
-                name=f"quorum-repl-{self.node_id}-{pid}"))
         for t in self._threads:
             t.start()
+        for pid in self.config.peers:
+            self._spawn_replicator(pid)
+        self._started = True
         return self
+
+    def _spawn_replicator(self, pid: str) -> None:
+        th = threading.Thread(
+            target=self._replicator, args=(pid,), daemon=True,
+            name=f"quorum-repl-{self.node_id}-{pid}")
+        self._threads.append(th)
+        th.start()
 
     def kill(self) -> None:
         """Simulated kill -9: sever every socket and stop every thread
@@ -205,8 +287,10 @@ class QuorumNode:
             self._cv.notify_all()
         self._stopped.set()
         self._server.close()
-        for c in list(self._repl_clients.values()) + \
-                list(self._vote_clients.values()):
+        with self._mu:
+            clients = (list(self._repl_clients.values())
+                       + list(self._vote_clients.values()))
+        for c in clients:
             c.close()
         self.raft_log.close()
 
@@ -237,6 +321,11 @@ class QuorumNode:
                 "commit_index": self.commit_index,
                 "applied_index": self.applied_index,
                 "peers": len(self.config.peers),
+                "members": sorted([self.node_id]
+                                  + list(self.config.peers)),
+                "lease_valid": (self._lease_expiry_locked()
+                                > time.monotonic()),
+                "removed": self._removed,
             }
 
     def wait_applied(self, index: int, timeout: float) -> bool:
@@ -261,6 +350,45 @@ class QuorumNode:
         was truncated by a competing leader) within `timeout` — the
         outcome is then indeterminate and the caller must not treat
         the write as acknowledged."""
+        return self._propose_entry(payload, KIND_DATA, timeout)
+
+    def propose_config(self, change: List[Any],
+                       timeout: float = 5.0) -> int:
+        """Leader-only membership change: replicate ``["add", pid,
+        [host, port]]`` or ``["remove", pid]`` as ONE config entry;
+        every member applies it to its peer set at commit (majority
+        math, replicators, and lease accounting follow). Single-server
+        change rule: one membership delta in flight at a time."""
+        from kubernetes_tpu.runtime import tlv
+
+        kind = change[0]
+        if kind not in ("add", "remove"):
+            raise ValueError(f"unknown membership change {kind!r}")
+        if kind == "add" and len(change) != 3:
+            raise ValueError("add takes [\"add\", id, [host, port]]")
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(
+                    f"{self.node_id} is {self.role}", self.leader_id)
+            if self._config_inflight:
+                raise QuorumUnavailable(
+                    "a membership change is already in flight")
+            self._config_inflight = True
+        try:
+            return self._propose_entry(
+                tlv.dumps(list(change)), KIND_CONFIG, timeout)
+        except Exception as e:
+            # a DETERMINATE failure frees the slot; an indeterminate
+            # one (the entry is in our log and may still commit) must
+            # keep the single-change rule armed — the flag clears at
+            # apply, or on any role/term change
+            if not getattr(e, "indeterminate", False):
+                with self._mu:
+                    self._config_inflight = False
+            raise
+
+    def _propose_entry(self, payload: bytes, kind: int,
+                       timeout: float) -> int:
         deadline = time.monotonic() + timeout
         with self._mu:
             if self.role != LEADER:
@@ -268,7 +396,7 @@ class QuorumNode:
                     f"{self.node_id} is {self.role}", self.leader_id)
             term = self.raft_log.term
             index = self.raft_log.last_index + 1
-            self.raft_log.append([Entry(term, index, payload)])
+            self.raft_log.append([Entry(term, index, payload, kind)])
             self._maybe_commit_locked()  # single-node: majority of 1
             self._cv.notify_all()
             while self.applied_index < index:
@@ -279,10 +407,37 @@ class QuorumNode:
                         f"entry {index} (term {term}) superseded")
                 left = deadline - time.monotonic()
                 if left <= 0 or self._killed:
-                    raise QuorumUnavailable(
+                    err = QuorumUnavailable(
                         f"entry {index} not committed within {timeout}s "
                         "(no majority reachable?)")
+                    # the entry is in OUR log: a later majority may
+                    # still commit it — the caller must not blind-retry
+                    err.indeterminate = True
+                    raise err
                 self._cv.wait(left)
+            # the apply position passing `index` is NOT enough: a
+            # competing leader's overwriting entry advances it too.
+            # The ack is only honest when the slot still holds OUR
+            # entry (same term) — otherwise this proposal was
+            # truncated away and acking it would invent a commit the
+            # cluster never made (found by the partition chaos
+            # checker as a duplicate rv). Compaction may have folded
+            # the slot into the snapshot while we slept: if our term
+            # never moved, nothing could have overwritten it (only a
+            # higher-term leader truncates) and the compacted entry
+            # was ours; if the term DID move, whose entry got
+            # compacted is unknowable — indeterminate, not a clean
+            # failure.
+            if index > self.raft_log.snap_index:
+                if self.raft_log.term_at(index) != term:
+                    raise QuorumUnavailable(
+                        f"entry {index} (term {term}) superseded")
+            elif self.raft_log.term != term:
+                err = QuorumUnavailable(
+                    f"entry {index} compacted across a term change "
+                    f"(term {term} -> {self.raft_log.term})")
+                err.indeterminate = True
+                raise err
             return index
 
     def apply_barrier(self, timeout: float = 5.0) -> None:
@@ -307,9 +462,13 @@ class QuorumNode:
 
     def read_barrier(self, timeout: float = 2.0) -> int:
         """Linearizable read point (etcd ReadIndex): capture the
-        commit index, confirm leadership with a heartbeat round, wait
-        until it is applied, return it. Raises NotLeader/
-        QuorumUnavailable when this node cannot prove leadership."""
+        commit index, prove leadership, wait until it is applied,
+        return it. Proof is the leader LEASE when live (zero extra
+        messages — the hot-read fast path) and a heartbeat confirm
+        round otherwise. Raises NotLeader/QuorumUnavailable when this
+        node cannot prove leadership — a lease-holding leader that
+        loses its majority stops serving within the lease window by
+        construction (the lease simply runs out)."""
         deadline = time.monotonic() + timeout
         with self._mu:
             if self.role != LEADER:
@@ -323,7 +482,10 @@ class QuorumNode:
                     raise QuorumUnavailable("term-start entry never "
                                             "committed (no majority?)")
             read_index = self.commit_index
-            if self.config.peers:
+            if self._lease_expiry_locked() > time.monotonic():
+                quorum_lease_reads_total.inc()
+            elif self.config.peers:
+                quorum_readindex_rounds_total.inc()
                 self._confirm_seq += 1
                 seq = self._confirm_seq
                 self._cv.notify_all()  # wake replicators to carry it
@@ -332,6 +494,10 @@ class QuorumNode:
                         raise QuorumUnavailable(
                             "leadership not confirmed by a majority "
                             "(partitioned from the quorum?)")
+            else:
+                # single-node cluster with leases disabled: the local
+                # commit IS the majority
+                quorum_readindex_rounds_total.inc()
             while self.applied_index < read_index:
                 if not self._wait_leader_locked(term, deadline):
                     raise QuorumUnavailable("read index never applied")
@@ -354,6 +520,38 @@ class QuorumNode:
                         if v >= seq)
         return acked >= self._majority()
 
+    # -- leader lease --------------------------------------------------------
+
+    def _lease_ack_locked(self, pid: str, term: int, t_sent: float) -> None:
+        """Record leadership contact with `pid`: a same-term reply to a
+        call SENT at t_sent proves the peer still followed us at t_sent
+        or later (the conservative end)."""
+        if self.role != LEADER or self.raft_log.term != term:
+            return
+        if t_sent > self._ack_start.get(pid, 0.0):
+            self._ack_start[pid] = t_sent
+            self._cv.notify_all()  # a barrier may be lease-waiting
+
+    def _lease_expiry_locked(self) -> float:
+        """Monotonic time until which this leader's lease is provably
+        safe: the majority-th most recent contact time + the lease
+        window. No member can GRANT a pre-vote (the only road to a
+        term bump) before election_timeout of silence, and its silence
+        clock started no earlier than our send time — so with
+        lease_factor < 1 no deposing election completes inside the
+        window. 0.0 when not leading or leases are disabled."""
+        if self.role != LEADER or self.config.lease_factor <= 0:
+            return 0.0
+        times = sorted(
+            [time.monotonic()]
+            + [self._ack_start.get(p, 0.0) for p in self.config.peers],
+            reverse=True)
+        anchor = times[self._majority() - 1]
+        if anchor <= 0.0:
+            return 0.0
+        return anchor + (self.config.election_timeout
+                         * self.config.lease_factor)
+
     def compact_now(self) -> None:
         """Force a raft-log compaction at the current applied index
         (test hook for the snapshot-install path)."""
@@ -367,6 +565,8 @@ class QuorumNode:
         kind = msg[0]
         if kind == "vote":
             return self._on_vote(msg)
+        if kind == "prevote":
+            return self._on_prevote(msg)
         if kind == "append":
             return self._on_append(msg)
         if kind == "snap":
@@ -385,6 +585,30 @@ class QuorumNode:
                 return ["fwdrep", False, "no client handler", None]
             return self.client_fn(msg)
         return ["err", f"unknown message kind {kind!r}"]
+
+    def _on_prevote(self, msg: Any) -> Any:
+        """Electability probe: grant iff the candidate COULD win a real
+        election right now — its target term is ahead of ours, its log
+        is current, and OUR leader has been silent past the base
+        election timeout (the lease check: a member still hearing a
+        healthy leader refuses, so a flapping rejoiner can't stampede
+        the cluster into a new term). Grants change NO state — nothing
+        persists, no vote is spent, our term does not move."""
+        _, target_term, _cand, last_idx, last_term = msg
+        with self._mu:
+            if self._killed:
+                return ["prevoterep", self.raft_log.term, False]
+            cur = self.raft_log.term
+            granted = False
+            if target_term > cur:
+                mine = (self.raft_log.last_term, self.raft_log.last_index)
+                silent = (time.monotonic() - self._last_contact
+                          >= self.config.election_timeout)
+                if ((last_term, last_idx) >= mine
+                        and (silent or self.role == CANDIDATE)
+                        and self.role != LEADER):
+                    granted = True
+            return ["prevoterep", cur, granted]
 
     def _on_vote(self, msg: Any) -> Any:
         _, term, cand, last_idx, last_term = msg
@@ -429,23 +653,35 @@ class QuorumNode:
                             max(rl.snap_index, prev_idx - 1), seq]
             match = prev_idx + len(raw_entries)
             new: List[Entry] = []
-            for t, i, payload in raw_entries:
+            for row in raw_entries:
+                t, i, payload = row[0], row[1], row[2]
+                ekind = row[3] if len(row) > 3 else KIND_DATA
                 if i <= rl.snap_index:
                     continue  # already folded into our snapshot
                 have = rl.term_at(i)
                 if have is None and i > rl.last_index:
-                    new.append(Entry(t, i, payload))
+                    new.append(Entry(t, i, payload, ekind))
                 elif have != t:
                     rl.truncate_from(i)
-                    new.append(Entry(t, i, payload))
+                    new.append(Entry(t, i, payload, ekind))
                 # have == t: duplicate delivery of an entry we hold
             if new:
                 rl.append(new)
+            # commit bound: the VERIFIED match frontier of THIS append
+            # (prev_idx + delivered entries — Raft's "index of last new
+            # entry"), never the raw log end: a healed follower may
+            # still hold a stale conflicting suffix from its own old
+            # term beyond the frontier, and applying it against a
+            # leader_commit that ran ahead of the delivered batch
+            # would ack a write the cluster never committed (found by
+            # the partition chaos checker as a duplicate commit)
             if leader_commit > self.commit_index:
-                self.commit_index = min(leader_commit, rl.last_index)
-                quorum_commit_index.labels(self.node_id).set(
-                    self.commit_index)
-                self._cv.notify_all()
+                bound = min(leader_commit, match)
+                if bound > self.commit_index:
+                    self.commit_index = bound
+                    quorum_commit_index.labels(self.node_id).set(
+                        self.commit_index)
+                    self._cv.notify_all()
             return ["apprep", rl.term, True, match, seq]
 
     def _on_snapshot(self, msg: Any) -> Any:
@@ -486,6 +722,7 @@ class QuorumNode:
         was = self.role
         self.role = FOLLOWER
         self.leader_id = leader
+        self._config_inflight = False
         self._timeout = self._roll_timeout()
         self._touch_locked()
         if was != FOLLOWER:
@@ -498,35 +735,104 @@ class QuorumNode:
             with self._mu:
                 if self._killed:
                     return
-                if self.role == LEADER:
+                if self.role == LEADER or self._removed:
                     continue
-                if (time.monotonic() - self._last_contact
-                        < self._timeout):
+                now = time.monotonic()
+                if now - self._last_contact < self._timeout:
                     continue
-                # silence past the randomized timeout: stand for
-                # election in the next term
-                term = self.raft_log.term + 1
-                self.raft_log.save_hardstate(term, self.node_id)
-                quorum_term.labels(self.node_id).set(term)
-                self.role = CANDIDATE
-                self.leader_id = ""
-                self._votes = {self.node_id}
+                if now - self._prevote_last < self._timeout:
+                    continue  # a probe round is still maturing
                 self._timeout = self._roll_timeout()
-                self._touch_locked()
+                self._prevote_last = now
+                if not self.config.peers:
+                    # single-node cluster: no one to probe, elect now
+                    self._begin_election_locked()
+                    continue
+                # silence past the randomized timeout: probe
+                # electability WITHOUT touching the term (pre-vote) —
+                # the real election starts only on a majority of grants
+                self._prevote_round += 1
+                round_id = self._prevote_round
+                self._prevotes = {self.node_id}
+                target_term = self.raft_log.term + 1
                 last_idx = self.raft_log.last_index
                 last_term = self.raft_log.last_term
-                if self._votes_win_locked():
-                    continue  # single-node cluster: instant leader
-            msg = ["vote", term, self.node_id, last_idx, last_term]
-            for pid in list(self.config.peers):
+                peers = list(self.config.peers)
+            quorum_prevote_rounds_total.inc()
+            msg = ["prevote", target_term, self.node_id,
+                   last_idx, last_term]
+            for pid in peers:
                 threading.Thread(
-                    target=self._solicit_vote, args=(pid, term, msg),
+                    target=self._solicit_prevote,
+                    args=(pid, round_id, msg),
                     daemon=True,
-                    name=f"quorum-ballot-{self.node_id}-{pid}",
+                    name=f"quorum-preballot-{self.node_id}-{pid}",
                 ).start()
 
+    def _solicit_prevote(self, pid: str, round_id: int,
+                         msg: Any) -> None:
+        with self._mu:
+            client = self._vote_clients.get(pid)
+        if client is None:
+            return
+        try:
+            reply = client.call(
+                msg, timeout=min(self.config.rpc_timeout,
+                                 self.config.election_timeout))
+        except RPCError:
+            return
+        if not reply or reply[0] != "prevoterep":
+            return
+        _, rterm, granted = reply
+        begin = None
+        with self._mu:
+            if self._killed or self._removed:
+                return
+            if rterm > self.raft_log.term:
+                # someone is already ahead: adopt the term, no ballot
+                self._step_down_locked(rterm, "")
+                return
+            if (self._prevote_round != round_id or not granted
+                    or self.role == LEADER):
+                return
+            self._prevotes.add(pid)
+            if len(self._prevotes) >= self._majority():
+                self._prevote_round += 1  # fence the round's stragglers
+                begin = self._begin_election_locked()
+        if begin is not None:
+            term, last_idx, last_term = begin
+            vote_msg = ["vote", term, self.node_id, last_idx, last_term]
+            for peer in list(self.config.peers):
+                threading.Thread(
+                    target=self._solicit_vote,
+                    args=(peer, term, vote_msg),
+                    daemon=True,
+                    name=f"quorum-ballot-{self.node_id}-{peer}",
+                ).start()
+
+    def _begin_election_locked(self):
+        """Bump the term, persist the self-vote, become CANDIDATE.
+        Returns (term, last_idx, last_term) for the caller to solicit
+        real votes with, or None when the cluster is single-node (we
+        won on the spot)."""
+        term = self.raft_log.term + 1
+        self.raft_log.save_hardstate(term, self.node_id)
+        quorum_term.labels(self.node_id).set(term)
+        self.role = CANDIDATE
+        self.leader_id = ""
+        self._votes = {self.node_id}
+        self._config_inflight = False
+        self._timeout = self._roll_timeout()
+        self._touch_locked()
+        last_idx = self.raft_log.last_index
+        last_term = self.raft_log.last_term
+        if self._votes_win_locked():
+            return None  # single-node cluster: instant leader
+        return term, last_idx, last_term
+
     def _solicit_vote(self, pid: str, term: int, msg: Any) -> None:
-        client = self._vote_clients.get(pid)
+        with self._mu:
+            client = self._vote_clients.get(pid)
         if client is None:
             return
         try:
@@ -561,6 +867,10 @@ class QuorumNode:
         self._next_index = {p: last + 1 for p in self.config.peers}
         self._match_index = {p: 0 for p in self.config.peers}
         self._confirm_acked = {p: 0 for p in self.config.peers}
+        # lease accounting restarts at zero: a fresh leader holds no
+        # lease until a majority of appends have been acked
+        self._ack_start = {p: 0.0 for p in self.config.peers}
+        self._config_inflight = False
         # the term-start no-op: commits the new leader's view of the
         # log prefix and anchors read barriers (empty payload; the
         # apply loop skips it)
@@ -576,12 +886,17 @@ class QuorumNode:
     # -- replication (leader) ------------------------------------------------
 
     def _replicator(self, pid: str) -> None:
-        client = self._repl_clients[pid]
+        with self._mu:
+            client = self._repl_clients.get(pid)
+        if client is None:
+            return
         hb = self.config.heartbeat_interval
         while not self._stopped.is_set():
             with self._mu:
                 if self._killed:
                     return
+                if pid not in self.config.peers:
+                    return  # removed by a committed config entry
                 if self.role != LEADER:
                     self._cv.wait(0.1)
                     continue
@@ -602,6 +917,7 @@ class QuorumNode:
                 if blob is None:
                     time.sleep(hb)
                     continue
+                t0 = time.monotonic()
                 try:
                     reply = client.call(
                         ["snap", term, self.node_id, snap_idx,
@@ -619,12 +935,14 @@ class QuorumNode:
                         self._next_index[pid] = snap_idx + 1
                         self._match_index[pid] = max(
                             self._match_index.get(pid, 0), snap_idx)
+                        self._lease_ack_locked(pid, term, t0)
                         installed = True
                 if installed:
                     quorum_snapshot_installs_total.inc()
                 continue
             msg = ["append", term, self.node_id, prev, prev_term,
-                   [[e.term, e.index, e.payload] for e in entries],
+                   [[e.term, e.index, e.payload, e.kind]
+                    for e in entries],
                    commit, seq]
             t0 = time.monotonic()
             try:
@@ -646,6 +964,10 @@ class QuorumNode:
                     continue
                 if self.role != LEADER or self.raft_log.term != term:
                     continue
+                # lease contact: ANY same-term reply (success or
+                # conflict backoff) proves the peer followed us at
+                # some point AFTER this call's send time
+                self._lease_ack_locked(pid, term, t0)
                 if ok:
                     if match > self._match_index.get(pid, 0):
                         self._match_index[pid] = match
@@ -682,6 +1004,69 @@ class QuorumNode:
             self.commit_index = candidate
             quorum_commit_index.labels(self.node_id).set(candidate)
             self._cv.notify_all()
+
+    # -- membership (applied config entries) ---------------------------------
+
+    def _apply_config(self, payload: bytes) -> None:
+        """Apply ONE committed membership change to this member's view
+        of the cluster — identical on every member, so majority math
+        never diverges. Runs on the apply thread only."""
+        from kubernetes_tpu.runtime import tlv
+
+        with tlv.allow_dynamic():
+            change = tlv.loads(payload)
+        kind, pid = change[0], change[1]
+        spawn = None
+        with self._mu:
+            self._config_inflight = False
+            if kind == "add":
+                addr = (change[2][0], int(change[2][1]))
+                if pid == self.node_id:
+                    pass  # my own join commit: nothing to wire
+                elif pid in self.config.peers:
+                    self.config.peers[pid] = addr  # re-address
+                else:
+                    self.config.peers[pid] = addr
+                    to = self.config.rpc_timeout
+                    self._repl_clients[pid] = PeerClient(addr, timeout=to)
+                    self._vote_clients[pid] = PeerClient(addr, timeout=to)
+                    self._next_index[pid] = self.raft_log.last_index + 1
+                    self._match_index[pid] = 0
+                    self._confirm_acked[pid] = 0
+                    self._ack_start[pid] = 0.0
+                    if self._started:
+                        spawn = pid
+                    log.info("%s: member %s added at %s:%s",
+                             self.node_id, pid, addr[0], addr[1])
+            elif kind == "remove":
+                if pid == self.node_id:
+                    # I was removed: stop standing for election, stop
+                    # leading; the survivors' majority math no longer
+                    # counts me
+                    self._removed = True
+                    if self.role == LEADER:
+                        self.role = FOLLOWER
+                        self.leader_id = ""
+                    log.info("%s: removed from the cluster (idle)",
+                             self.node_id)
+                else:
+                    self.config.peers.pop(pid, None)
+                    rc = self._repl_clients.pop(pid, None)
+                    vc = self._vote_clients.pop(pid, None)
+                    self._next_index.pop(pid, None)
+                    self._match_index.pop(pid, None)
+                    self._confirm_acked.pop(pid, None)
+                    self._ack_start.pop(pid, None)
+                    for c in (rc, vc):
+                        if c is not None:
+                            c.close()
+                    # a shrunk cluster may already satisfy commit /
+                    # confirm majorities: re-evaluate both
+                    self._maybe_commit_locked()
+                    log.info("%s: member %s removed", self.node_id, pid)
+            self._cv.notify_all()
+        if spawn is not None:
+            self._spawn_replicator(spawn)
 
     # -- apply loop ----------------------------------------------------------
 
@@ -723,7 +1108,14 @@ class QuorumNode:
                     self._cv.notify_all()
                 continue
             for e in batch:
-                if e.payload:
+                if e.kind == KIND_CONFIG:
+                    try:
+                        self._apply_config(e.payload)
+                    except Exception:
+                        log.exception(
+                            "%s: membership change at entry %s failed",
+                            self.node_id, e.index)
+                elif e.payload:
                     try:
                         self.apply_fn(e.payload, e.index)
                     except Exception:

@@ -149,6 +149,26 @@ class QuorumStore(MemoryStore):
         """Leader identity / role / indices for /healthz."""
         return self.node.status()
 
+    # -- membership ----------------------------------------------------------
+
+    def add_member(self, node_id: str, address: Tuple[str, int],
+                   timeout: float = 10.0) -> int:
+        """Replicate an add of `node_id` @ `address` through the log
+        (leader-only; raises NotLeader elsewhere). The new member
+        should already be RUNNING as a follower pointed at the
+        cluster — pre-vote keeps its timeouts harmless until the
+        leader's replicator reaches it (snapshot install included)."""
+        return self.node.propose_config(
+            ["add", node_id, [address[0], int(address[1])]],
+            timeout=timeout)
+
+    def remove_member(self, node_id: str, timeout: float = 10.0) -> int:
+        """Replicate a removal of `node_id` (leader-only). The removed
+        member goes idle when it applies the entry; survivors shrink
+        their majority math at theirs."""
+        return self.node.propose_config(["remove", node_id],
+                                        timeout=timeout)
+
     def wait_leader(self, timeout: float = 10.0) -> bool:
         """Block until SOME member is known to lead (local role or a
         leader hint learned from appends) — a cluster-warmup hook."""
@@ -310,13 +330,15 @@ class QuorumStore(MemoryStore):
     def _handle_forward(self, msg: Any) -> Any:
         """Peer-RPC handler for ["fwd", ops] from a follower taking
         client traffic. Results are re-encoded wire-safe (exceptions
-        become tagged error lists)."""
+        become tagged error lists); the indeterminate flag rides the
+        reply so the follower's caller knows replay safety."""
         try:
             results = self._submit_local(msg[1])
         except NotLeader as e:
             return ["fwdrep", False, "notleader", e.leader_id]
         except QuorumUnavailable as e:
-            return ["fwdrep", False, "unavailable", str(e)]
+            return ["fwdrep", False, "unavailable", str(e),
+                    bool(e.indeterminate)]
         out = []
         for r in results:
             if isinstance(r, Exception):
@@ -369,7 +391,10 @@ class QuorumStore(MemoryStore):
                             return [_decode_result(r) for r in reply[2]]
                         if reply[0] == "fwdrep" and \
                                 reply[2] == "unavailable":
-                            raise QuorumUnavailable(reply[3])
+                            err = QuorumUnavailable(reply[3])
+                            err.indeterminate = bool(
+                                reply[4] if len(reply) > 4 else False)
+                            raise err
                         last_err = QuorumUnavailable(
                             f"leader moved (hint {reply[3]!r})")
                     except RPCConnectError as e:
@@ -380,8 +405,10 @@ class QuorumStore(MemoryStore):
                         # re-sending could double-apply (and report a
                         # committed create as KeyExists). Same
                         # indeterminate contract as the local path.
-                        raise QuorumUnavailable(
+                        err = QuorumUnavailable(
                             f"forwarded write outcome unknown: {e}")
+                        err.indeterminate = True
+                        raise err
                 else:
                     last_err = QuorumUnavailable("no known leader")
             time.sleep(0.03)

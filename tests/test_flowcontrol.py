@@ -252,6 +252,183 @@ def test_round_robin_dispatch_is_fair_across_flows():
     assert "good" in order[:2], order
 
 
+# -- per-request seat width (round 13) ----------------------------------------
+
+
+def test_request_width_classification():
+    """Cost classification at classify time: selector LISTs and bulk
+    batch bodies occupy more than one seat; everything else is 1."""
+    from kubernetes_tpu.apiserver.flowcontrol import (
+        WIDTH_MAX,
+        request_width,
+    )
+
+    assert request_width("GET", "/api/v1/pods") == 1
+    assert request_width(
+        "GET", "/api/v1/pods", {"labelSelector": "a=b"}) == 2
+    assert request_width(
+        "GET", "/api/v1/pods", {"fieldSelector": "spec.nodeName=n1"}
+    ) == 2
+    # a WATCH with a selector holds a connection, not a seat-width
+    assert request_width(
+        "GET", "/api/v1/pods",
+        {"labelSelector": "a=b", "watch": "true"}) == 1
+    assert request_width("POST", "/api/v1/pods",
+                         None, {"kind": "Pod"}) == 1
+    assert request_width("POST", "/api/v1/batch", None,
+                         {"items": [0] * 250}) == 2
+    assert request_width("POST", "/api/v1/batch", None,
+                         {"items": [0] * 10_000}) == WIDTH_MAX
+
+
+def test_wide_request_occupies_multiple_seats():
+    """One heavy request cannot masquerade as a singleton: a width-3
+    request in a 4-seat level leaves room for only ONE more singleton;
+    the next narrow request queues until the wide one releases."""
+    lvl = PriorityLevel("wide", seats=4, queues=8, queue_length=8,
+                        hand_size=2, queue_wait=5.0)
+    lvl.acquire("heavy", width=3)
+    lvl.acquire("light-a", width=1)  # the last free seat
+    got = []
+
+    def second():
+        lvl.acquire("light-b", width=1)
+        got.append(time.monotonic())
+
+    th = threading.Thread(target=second, daemon=True)
+    th.start()
+    time.sleep(0.15)
+    assert not got, "a narrow request dispatched past a full level"
+    lvl.release(3)  # the wide request leaves; the waiter dispatches
+    th.join(timeout=5)
+    assert got, "the queued request never dispatched after release"
+    lvl.release(1)
+    lvl.release(1)
+
+
+def test_wide_head_of_queue_accumulates_seats():
+    """A wide queued request HOLDS the dispatcher until enough seats
+    free (no skip — narrow traffic cannot starve it)."""
+    lvl = PriorityLevel("hol", seats=4, queues=4, queue_length=8,
+                        hand_size=2, queue_wait=5.0)
+    for _ in range(4):
+        lvl.acquire("filler", width=1)
+    done = []
+
+    def wide():
+        lvl.acquire("big", width=3)
+        done.append("wide")
+
+    th = threading.Thread(target=wide, daemon=True)
+    th.start()
+    time.sleep(0.1)
+    lvl.release(1)  # 1 free < 3: the wide head keeps waiting
+    time.sleep(0.1)
+    assert not done
+    lvl.release(1)
+    lvl.release(1)  # 3 free: dispatches
+    th.join(timeout=5)
+    assert done == ["wide"]
+    lvl.release(3)
+    lvl.release(1)
+
+
+def test_wide_head_timeout_releases_dispatcher():
+    """A wide head-of-queue waiter that TIMES OUT must re-run the
+    dispatcher on its way out: it was holding seats hostage for
+    itself, and the narrow waiters behind it are dispatchable the
+    moment it withdraws (review-found stall: 2 seats free, narrow
+    waiter spuriously 429'd)."""
+    lvl = PriorityLevel("wto", seats=4, queues=4, queue_length=8,
+                        hand_size=2, queue_wait=5.0)
+    for _ in range(4):
+        lvl.acquire("filler", width=1)
+    wide_rejected = []
+    narrow_got = []
+
+    def wide():
+        try:
+            lvl.acquire("big", width=3)
+        except Rejected:
+            wide_rejected.append(True)
+
+    def narrow():
+        lvl.acquire("small", width=1)
+        narrow_got.append(True)
+
+    # the wide request queues with a SHORT timeout; the narrow one
+    # queues behind it with a long one
+    lvl.queue_wait = 0.3
+    tw = threading.Thread(target=wide, daemon=True)
+    tw.start()
+    time.sleep(0.05)
+    lvl.queue_wait = 5.0
+    tn = threading.Thread(target=narrow, daemon=True)
+    tn.start()
+    time.sleep(0.05)
+    # free 2 seats: not enough for the wide head, which holds them
+    lvl.release(1)
+    lvl.release(1)
+    tw.join(timeout=5)
+    assert wide_rejected, "the wide waiter never timed out"
+    # its withdrawal must hand the accumulated seats to the narrow one
+    tn.join(timeout=5)
+    assert narrow_got, ("narrow waiter stalled with free seats after "
+                        "the wide head timed out")
+    lvl.release(1)
+    for _ in range(2):
+        lvl.release(1)
+
+
+def test_width_capped_at_level_seats():
+    """A request wider than the whole level is capped so it can still
+    dispatch (otherwise it could never be admitted at all)."""
+    c = _tiny_controller(seats=1)
+    tk = c.admit("tenant-a", (), "POST", "/api/v1/batch", width=64)
+    assert tk.width == 1
+    tk.__exit__()
+
+
+def test_wide_requests_through_the_apf_door():
+    """End-to-end: bulk batch bodies through server.handle() are
+    charged their width — two 2-wide requests cannot run concurrently
+    in a 3-seat level (the second queues), while singles still fit."""
+    levels = {
+        "exempt": PriorityLevel("exempt", seats=1, exempt=True),
+        "workload-high": PriorityLevel(
+            "workload-high", seats=3, queues=8, queue_length=8,
+            hand_size=2, queue_wait=2.0),
+        "workload-low": PriorityLevel("workload-low", seats=1),
+        "catch-all": PriorityLevel("catch-all", seats=1),
+    }
+    c = APFController(levels=levels)
+    t1 = c.admit("tenant-a", (), "POST", "/api/v1/batch", width=2)
+    assert t1.width == 2
+    lvl = levels["workload-high"]
+    with lvl._mu:
+        assert lvl._seats_in_use == 2
+    # one singleton still fits...
+    t2 = c.admit("tenant-b", (), "GET", "/api/v1/pods", width=1)
+    with lvl._mu:
+        assert lvl._seats_in_use == 3
+    # ...but another wide request must wait for the first to leave
+    woke = []
+
+    def wide2():
+        tk = c.admit("tenant-c", (), "POST", "/api/v1/batch", width=2)
+        woke.append(tk)
+
+    th = threading.Thread(target=wide2, daemon=True)
+    th.start()
+    time.sleep(0.15)
+    assert not woke
+    t1.__exit__()
+    th.join(timeout=5)
+    assert woke and woke[0].width == 2
+    woke[0].__exit__()
+    t2.__exit__()
+
+
 # -- the apiserver doors -------------------------------------------------------
 
 
@@ -468,7 +645,8 @@ def test_transport_retries_429_honoring_retry_after(monkeypatch):
     assert code == 200 and payload == {"n": 3}
     assert len(calls) == 3
     assert tr.stats == {"sheds_429": 2, "retries_429": 2,
-                        "giveups_429": 0}
+                        "giveups_429": 0, "failovers_503": 0,
+                        "retries_503": 0}
     # first sleep honors (jittered) Retry-After: in [1, 2]s
     assert 1.0 <= sleeps[0] <= 2.0, sleeps
     # second has no hint: capped exponential backoff, well under cap
@@ -502,7 +680,8 @@ def test_transport_retry_disabled(monkeypatch):
     code, _ = tr.request("GET", "/x")
     assert code == 429
     assert tr.stats == {"sheds_429": 1, "retries_429": 0,
-                        "giveups_429": 1}
+                        "giveups_429": 1, "failovers_503": 0,
+                        "retries_503": 0}
 
 
 def test_identity_headers_on_the_wire():

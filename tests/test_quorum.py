@@ -132,6 +132,26 @@ class TestRaftLog:
         assert rl2.last_index == 5
         rl2.close()
 
+    def test_entry_kind_survives_restart(self, tmp_path):
+        """Membership (KIND_CONFIG) entries keep their kind across a
+        kill -9 + recovery — a replayed config change that came back
+        as DATA would feed peer addresses into the state machine."""
+        from kubernetes_tpu.storage.quorum.log import (
+            KIND_CONFIG,
+            KIND_DATA,
+        )
+
+        d = str(tmp_path)
+        rl = RaftLog(d)
+        rl.append([Entry(1, 1, b"data")])
+        rl.append([Entry(1, 2, b"cfgchange", KIND_CONFIG)])
+        rl.close()
+        rl2 = RaftLog(d)
+        assert rl2.entry(1).kind == KIND_DATA
+        assert rl2.entry(2).kind == KIND_CONFIG
+        assert rl2.entry(2).payload == b"cfgchange"
+        rl2.close()
+
 
 # -- consensus basics ---------------------------------------------------------
 
@@ -182,6 +202,44 @@ class TestQuorumConsensus:
         # and the new leader takes writes with RV continuity
         rv_before = lead2.current_rv
         assert lead2.create("/pods/post", {"i": 99}) > rv_before
+
+    def test_lease_reads_skip_readindex_rounds(self, cluster3):
+        """Leader leases: once a majority of appends has acked, steady
+        linearizable reads ride the lease — quorum_lease_reads_total
+        grows while quorum_readindex_rounds_total stays flat (the
+        structural gate the soak holds at scale)."""
+        from kubernetes_tpu.metrics import (
+            quorum_lease_reads_total,
+            quorum_readindex_rounds_total,
+        )
+
+        lead = wait_leader(cluster3)
+        lead.create("/pods/lease", {"x": 1})
+        l0 = quorum_lease_reads_total.get()
+        r0 = quorum_readindex_rounds_total.get()
+        # each write's append round renews the lease milliseconds
+        # before the read (the fixture's 0.15s election timeout makes
+        # a purely heartbeat-renewed lease window too tight for a
+        # loaded 1-core CI box)
+        for i in range(20):
+            lead.create(f"/pods/lease-{i}", {"x": i})
+            lead.get("/pods/lease")
+        assert quorum_lease_reads_total.get() - l0 >= 18
+        assert quorum_readindex_rounds_total.get() - r0 <= 2
+
+    def test_single_membership_change_in_flight(self, cluster3):
+        """The single-server membership-change rule: a second config
+        proposal while one is uncommitted is refused outright."""
+        lead = wait_leader(cluster3)
+        with lead.node._mu:
+            lead.node._config_inflight = True
+        try:
+            with pytest.raises(QuorumUnavailable):
+                lead.node.propose_config(
+                    ["add", "q9", ["127.0.0.1", 1]], timeout=0.5)
+        finally:
+            with lead.node._mu:
+                lead.node._config_inflight = False
 
     def test_stale_leader_cannot_serve_linearizable_reads(
             self, tmp_path):

@@ -43,7 +43,8 @@ def _run(cfg):
 
 def test_scenario_table_is_complete():
     assert set(SCENARIOS) == {
-        "noisy-neighbor", "rack-failure", "rolling-update", "burst"}
+        "noisy-neighbor", "rack-failure", "rolling-update", "burst",
+        "process-kill"}
     for name, forms in SCENARIOS.items():
         assert set(forms) == {"full", "smoke"}, name
     with pytest.raises(ValueError):
