@@ -404,7 +404,7 @@ def run_soak(seconds: int):
         sys.exit(1)
 
 
-BENCH_FILE = "BENCH_r09.json"
+BENCH_FILE = "BENCH_r10.json"
 
 
 def _bench_merge(update: dict) -> None:
@@ -841,6 +841,265 @@ def run_baseline_configs():
            pods4, reps=2)
 
 
+def run_train_cluster(slo_bound_s: float = 30.0) -> dict:
+    """Training-cluster workload bench (round 14): mixed gang sizes
+    2-16 at two priority tiers over an accelerator-labeled cluster,
+    then a queued HIGH-priority gang burst over the filled cluster
+    that must preempt its way in. One in-process control plane + the
+    TPU scheduler daemon (the gang director's production wiring).
+
+    Gates (all recorded in BENCH_r10.json `train_cluster`):
+      * every fill gang and every burst gang fully bound
+        (`schedulable_gangs == gangs_total`),
+      * ZERO partial binds ever observed (all-or-nothing, sampled on
+        every poll),
+      * the burst preempted at least one lower-priority pod,
+      * p95 time-to-full-gang-bound <= slo_bound_s,
+      * one quota-denied create observed with a readable 403.
+    """
+    _assert_sanitizers_off()
+    from kubernetes_tpu.api.types import (
+        POD_GROUP_LABEL,
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodGroup,
+        PodGroupSpec,
+        PodSpec,
+        PriorityClass,
+    )
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client import LocalTransport, RESTClient
+    from kubernetes_tpu.metrics import (
+        apiserver_quota_denials_total,
+        scheduler_gangs_parked_total,
+        scheduler_gangs_scheduled_total,
+        scheduler_preemption_victims_total,
+    )
+    from kubernetes_tpu.scheduler import algorithmprovider
+    from kubernetes_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerOptions,
+    )
+
+    t_start = time.time()
+    server = APIServer()
+    client = RESTClient(LocalTransport(server, user="system:apiserver"))
+    N_NODES = 32
+    accels = ["v100", "a100"]
+    for i in range(N_NODES):
+        client.nodes().create(Node(
+            metadata=ObjectMeta(
+                name=f"tn-{i:03d}",
+                labels={"accelerator": accels[i % 2]},
+            ),
+            status=NodeStatus(
+                allocatable={"cpu": "8", "memory": "64Gi", "pods": "64"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    pgr = client.resource("podgroups", "default")
+    client.resource("priorityclasses").create(PriorityClass(
+        metadata=ObjectMeta(name="training-high"), value=100))
+
+    def mk_pod(name, group, cpu):
+        return Pod(
+            metadata=ObjectMeta(
+                name=name,
+                labels={"app": group, POD_GROUP_LABEL: group},
+            ),
+            spec=PodSpec(containers=[Container(
+                image="train", requests={"cpu": cpu})]),
+        )
+
+    # throughput matrix: resnet prefers a100 2:1 (the Gavel term)
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        json.dump({"resnet": {"a100": 2.0, "v100": 1.0}}, f)
+        matrix_file = f.name
+    options = SchedulerServerOptions(
+        algorithm_provider=algorithmprovider.TPU_PROVIDER_NAME,
+        throughput_matrix_file=matrix_file,
+    )
+    parked_before = scheduler_gangs_parked_total.total()
+    sched_before = scheduler_gangs_scheduled_total.total()
+    victims_before = scheduler_preemption_victims_total.total()
+    srv = SchedulerServer(client, options).start()
+    partial_binds = 0
+    bound_at: dict = {}
+
+    def poll_gangs(groups, deadline):
+        """Wait for every gang to fully bind; every sample also checks
+        the all-or-nothing invariant (a gang is observed at 0 or all
+        members bound — binds ride one batch commit)."""
+        nonlocal partial_binds
+        sizes = dict(groups)
+        while sizes and time.time() < deadline:
+            pods, _rv = client.pods().list()
+            by_group: dict = {}
+            for p in pods:
+                g = p.metadata.labels.get(POD_GROUP_LABEL)
+                if g in sizes or g in bound_at:
+                    b, t = by_group.get(g, (0, 0))
+                    by_group[g] = (b + (1 if p.spec.node_name else 0),
+                                   t + 1)
+            now = time.time()
+            for g, (b, t) in by_group.items():
+                if g in sizes and b and b < sizes[g]:
+                    partial_binds += 1
+                if g in sizes and b == sizes[g]:
+                    bound_at[g] = now
+                    del sizes[g]
+            time.sleep(0.25)
+        return sizes  # still-unbound gangs
+
+    try:
+        # ---- fill phase: mixed gang sizes 2-16, two tiers ------------------
+        fill_groups = {}
+        t_fill = time.time()
+        g = 0
+        for size in (2, 3, 4, 6, 8, 12, 16, 2, 4, 8, 16, 3, 6, 12):
+            name = f"fill-{g:02d}"
+            pgr.create(PodGroup(
+                metadata=ObjectMeta(name=name),
+                spec=PodGroupSpec(
+                    min_member=size,
+                    priority=10 if g % 3 else 0,
+                    workload_class="resnet",
+                ),
+            ))
+            for i in range(size):
+                client.pods().create(mk_pod(f"{name}-{i}", name, "500m"))
+            fill_groups[name] = size
+            g += 1
+        create_times = {n: t_fill for n in fill_groups}
+        missing = poll_gangs(dict(fill_groups), time.time() + 120)
+        fill_bound = len(fill_groups) - len(missing)
+        # ---- quota denial over the filled cluster --------------------------
+        denials_before = apiserver_quota_denials_total.total()
+        pgr.create(PodGroup(
+            metadata=ObjectMeta(name="capped"),
+            spec=PodGroupSpec(quota={"pods": "1"}),
+        ))
+        client.pods().create(mk_pod("capped-0", "capped", "100m"))
+        quota_message = ""
+        try:
+            client.pods().create(mk_pod("capped-1", "capped", "100m"))
+        except Exception as e:
+            quota_message = str(e)
+        quota_denied = (
+            apiserver_quota_denials_total.total() > denials_before
+            and "exceeded quota" in quota_message
+        )
+        # ---- burst phase: high-priority gangs over the filled cluster ------
+        # fill the remaining headroom with priority-0 singleton ballast
+        # (no pod group: the preemptible tier)
+        n_ballast = 2 * N_NODES
+        for i in range(n_ballast):
+            client.pods().create(Pod(
+                metadata=ObjectMeta(name=f"ballast-{i:03d}",
+                                    labels={"app": "ballast"}),
+                spec=PodSpec(containers=[Container(
+                    image="train", requests={"cpu": "3000m"})]),
+            ))
+        deadline = time.time() + 60
+
+        def ballast_bound():
+            pods, _rv = client.pods().list(label_selector="app=ballast")
+            return sum(1 for p in pods if p.spec.node_name)
+
+        while time.time() < deadline:
+            # the cluster is "filled" once ballast stops landing: bound
+            # count stable across a poll gap and most of it placed
+            b0 = ballast_bound()
+            time.sleep(1.0)
+            if b0 >= n_ballast // 2 and ballast_bound() == b0:
+                break
+        burst_groups = {}
+        t_burst = time.time()
+        for b in range(4):
+            name = f"burst-{b}"
+            pgr.create(PodGroup(
+                metadata=ObjectMeta(name=name),
+                spec=PodGroupSpec(
+                    min_member=8,
+                    priority_class_name="training-high",
+                    workload_class="resnet",
+                ),
+            ))
+            for i in range(8):
+                client.pods().create(mk_pod(f"{name}-{i}", name,
+                                            "2000m"))
+            burst_groups[name] = 8
+        for n in burst_groups:
+            create_times[n] = t_burst
+        missing_burst = poll_gangs(dict(burst_groups),
+                                   time.time() + 120)
+        burst_bound = len(burst_groups) - len(missing_burst)
+        victims = (scheduler_preemption_victims_total.total()
+                   - victims_before)
+    finally:
+        srv.stop()
+        os.unlink(matrix_file)
+    bound_lat = sorted(
+        bound_at[n] - create_times[n] for n in bound_at
+    )
+
+    def pct(p):
+        if not bound_lat:
+            return None
+        return round(bound_lat[min(len(bound_lat) - 1,
+                                   int(p * len(bound_lat)))], 2)
+
+    gangs_total = len(fill_groups) + len(burst_groups)
+    schedulable = fill_bound + burst_bound
+    p95 = pct(0.95)
+    gates = {
+        "all_gangs_bound": schedulable == gangs_total,
+        "zero_partial_binds": partial_binds == 0,
+        "preemption_exercised": victims >= 1,
+        "p95_time_to_full_gang_bound_under_slo": (
+            p95 is not None and p95 <= slo_bound_s),
+        "quota_denial_readable_403": quota_denied,
+    }
+    record = {
+        "train_cluster": {
+            "metric": "training_cluster_gang_workload",
+            "nodes": N_NODES,
+            "gangs_total": gangs_total,
+            "gang_sizes": "2-16 mixed",
+            "schedulable_gangs": schedulable,
+            "partial_binds_observed": partial_binds,
+            "preemption_victims": victims,
+            "gangs_scheduled_total": (
+                scheduler_gangs_scheduled_total.total() - sched_before),
+            "gangs_parked_total": (
+                scheduler_gangs_parked_total.total() - parked_before),
+            "quota_denials_total": apiserver_quota_denials_total.total(),
+            "time_to_full_gang_bound_s": {
+                "p50": pct(0.50), "p95": p95,
+                "max": round(bound_lat[-1], 2) if bound_lat else None,
+            },
+            "slo_bound_s": slo_bound_s,
+            "wall_s": round(time.time() - t_start, 1),
+            "gates": gates,
+            "all_gates_pass": all(gates.values()),
+        }
+    }
+    _bench_merge(record)
+    print(json.dumps(record["train_cluster"]))
+    if not all(gates.values()):
+        raise SystemExit(f"train-cluster gates failed: "
+                         f"{ {k: v for k, v in gates.items() if not v} }")
+    return record
+
+
 def _cli():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -932,7 +1191,27 @@ def _cli():
              "sustained-ceiling curve lands in BENCH_r09.json. Uses "
              "--wire-soak SECONDS per rung and --wire-soak-nodes/-slo.",
     )
+    ap.add_argument(
+        "--train-cluster", action="store_true",
+        help="run the training-cluster gang workload bench instead of "
+             "the headline: mixed gang sizes 2-16 at two priority "
+             "tiers over an accelerator-labeled cluster, then a "
+             "high-priority gang burst that must preempt its way into "
+             "the filled cluster. Gates: every gang fully bound, zero "
+             "partial binds, preemption exercised, p95 "
+             "time-to-full-gang-bound under SLO, readable quota 403. "
+             "Results land in BENCH_r10.json `train_cluster`.",
+    )
+    ap.add_argument(
+        "--train-cluster-slo", type=float, default=30.0,
+        metavar="SECONDS",
+        help="p95 time-to-full-gang-bound SLO for --train-cluster "
+             "(default 30s on the 1-core CI box)",
+    )
     args = ap.parse_args()
+    if args.train_cluster:
+        run_train_cluster(slo_bound_s=args.train_cluster_slo)
+        return
     if args.proc_curve:
         if not args.wire_soak:
             raise SystemExit("--proc-curve needs --wire-soak SECONDS "

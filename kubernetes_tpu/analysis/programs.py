@@ -200,7 +200,11 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
         notes="fold-own-commits + re-probe, one dispatch",
     ))
 
-    for G in (8, 32):
+    # G=16 is the GANG-shaped grouped probe: the gang driver routes a
+    # wave's all-or-nothing spans through this same builder (a gang is
+    # a run group), so the gang path's transfer contract is audited at
+    # its bench shape alongside the template shapes
+    for G in (8, 16, 32):
         reps = [0, 24] * (G // 2)  # alternate the two templates
         G_bucket, glayout, gbuf_host = group_buffer(batch, reps[:G])
         gbuf = jnp.asarray(gbuf_host)
@@ -296,6 +300,39 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
         carry_out_leaves=carry_leaves,
         expected_host_leaves=3,  # chosen[G,K], n_done[G], L
         notes="grouped zoned device replay: G runs, one dispatch",
+    ))
+
+    # gang preemption: the victim-selection scorer (ops/preempt.py) —
+    # per-node candidate sort by (priority asc, newest first), freed-
+    # resource prefix scan, shortest fitting prefix + cost. Integer-
+    # only (no f64, no dot_general); ships exactly 3 host-bound arrays
+    # (victims_needed, cost, eviction order) per dispatch.
+    from kubernetes_tpu.ops.preempt import (
+        INVALID_PRIO,
+        _victim_score_fn,
+        pack_candidates,
+    )
+
+    cand = [
+        (snap.node_names[i % 13], i % 3, i, (500, 1 << 20, 0, 1))
+        for i in range(9)
+    ]
+    vprio, vord, vres, _idx = pack_candidates(
+        [n for n in snap.node_names if n], cand,
+        floor_nodes=16, floor_cands=8,
+    )
+    vfree = np.zeros((vprio.shape[0], 4), np.int64)
+    vreq = np.array([1000, 2 << 20, 0, 1], np.int64)
+    specs.append(ProgramSpec(
+        name="victim_score",
+        fn=jax.jit(_victim_score_fn),
+        args=(jnp.asarray(vprio), jnp.asarray(vord),
+              jnp.asarray(vres), jnp.asarray(vfree),
+              jnp.asarray(vreq), jnp.int32(10)),
+        carry_out_leaves=0,
+        expected_host_leaves=3,
+        notes="gang preemption victim scorer (ops/preempt.py): "
+              "lowest-priority-first / fewest-victims / newest-first",
     ))
 
     if include_mesh:

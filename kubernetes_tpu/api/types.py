@@ -1420,6 +1420,78 @@ class ClusterRoleBinding:
     role_ref: RoleRef = field(default_factory=RoleRef)
 
 
+# --- AI-cluster workload API (scheduling group) ------------------------------
+
+#: pods join a gang by carrying this label; its value names a PodGroup
+#: in the pod's namespace
+POD_GROUP_LABEL = "scheduler.k8s.io/pod-group"
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io PriorityClass: a named priority tier. Higher
+    ``value`` preempts lower; equal-or-higher is never evicted (the
+    preemption invariant the gang scheduler enforces)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+
+
+@dataclass
+class PodGroupSpec:
+    """Gang semantics for a set of pods labeled
+    ``scheduler.k8s.io/pod-group: <name>`` (Kant/Volcano-style
+    all-or-nothing co-scheduling):
+
+    * ``min_member`` — the gang schedules only when at least this many
+      members can bind in one wave; fewer never partially bind.
+    * ``priority_class_name`` / ``priority`` — the gang's tier. The
+      admission plugin resolves the class name into ``priority`` at
+      create time so the scheduler never needs the class list.
+    * ``queue`` — the quota scope (tenant) this gang charges; defaults
+      to the namespace.
+    * ``quota`` — hard budget for the gang's members: ``pods`` (member
+      count) and ``devices`` (summed accelerator requests). Enforced at
+      apiserver admission (403 on exceed); usage is computed from live
+      store state, so deletes release it with no bookkeeping to leak.
+    * ``workload_class`` — row of the cluster's per-accelerator-type
+      throughput matrix (Gavel-style normalized throughput) used as a
+      placement score term for this gang's members.
+    """
+
+    min_member: int = 1
+    priority_class_name: str = ""
+    priority: int = 0
+    queue: str = ""
+    quota: Dict[str, object] = field(default_factory=dict)
+    workload_class: str = ""
+
+
+@dataclass
+class PodGroupStatus:
+    #: Pending | Scheduling | Scheduled | Parked | Preempting
+    phase: str = "Pending"
+    #: members currently bound to nodes
+    scheduled: int = 0
+    #: members observed (bound + queued)
+    members: int = 0
+    #: names of members that could not be placed in the last wave
+    unschedulable: List[str] = field(default_factory=list)
+    #: human-readable parking reason (missing members / resources)
+    message: str = ""
+    #: victims evicted on this gang's behalf, lifetime total
+    preempted: int = 0
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
 # --- Scale subresource (extensions/types.go Scale) ---------------------------
 
 

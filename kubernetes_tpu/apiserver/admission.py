@@ -155,14 +155,18 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         from kubernetes_tpu.api.resource import parse_quantity
         from kubernetes_tpu.api.types import pod_resource_request
 
+        from kubernetes_tpu.metrics import apiserver_quota_denials_total
+
         pods, _rv = self._server.store.list(f"/pods/{namespace}/")
         active = [p for p in pods if p.status.phase not in ("Succeeded", "Failed")]
-        new_cpu, new_mem, _ = pod_resource_request(obj)
+        new_cpu, new_mem, new_dev = pod_resource_request(obj)
         used_cpu = sum(pod_resource_request(p)[0] for p in active)
         used_mem = sum(pod_resource_request(p)[1] for p in active)
+        used_dev = sum(pod_resource_request(p)[2] for p in active)
         for q in quotas:
             hard = q.spec.hard
             if "pods" in hard and len(active) + 1 > int(parse_quantity(hard["pods"]).value()):
+                apiserver_quota_denials_total.inc(budget="pods")
                 raise AdmissionDenied(
                     f"exceeded quota: pods={hard['pods']}"
                 )
@@ -170,12 +174,122 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                 if key in hard:
                     limit = parse_quantity(hard[key]).milli_value()
                     if used_cpu + new_cpu > limit:
+                        apiserver_quota_denials_total.inc(budget="cpu")
                         raise AdmissionDenied(f"exceeded quota: {key}={hard[key]}")
             for key in ("memory", "requests.memory"):
                 if key in hard:
                     limit = parse_quantity(hard[key]).value()
                     if used_mem + new_mem > limit:
+                        apiserver_quota_denials_total.inc(budget="memory")
                         raise AdmissionDenied(f"exceeded quota: {key}={hard[key]}")
+            # per-tenant accelerator budget (the AI-cluster workload
+            # dimension: a namespace's summed device requests)
+            for key in ("devices", "requests.devices"):
+                if key in hard:
+                    limit = int(parse_quantity(str(hard[key])).value())
+                    if used_dev + new_dev > limit:
+                        apiserver_quota_denials_total.inc(budget="devices")
+                        raise AdmissionDenied(f"exceeded quota: {key}={hard[key]}")
+
+
+class PodGroupAdmission(AdmissionPlugin):
+    """Gang workload admission (the Kant-style unified quota/priority
+    door, PAPERS.md):
+
+    * PodGroup CREATE/UPDATE: resolve ``spec.priorityClassName`` into
+      ``spec.priority`` from the PriorityClass table (unknown class is
+      denied — a gang whose tier cannot be resolved must not race the
+      scheduler with priority 0), default ``spec.queue`` to the
+      namespace (the tenant scope).
+    * Pod CREATE carrying the ``scheduler.k8s.io/pod-group`` label: the
+      named PodGroup must exist, and the group's pod/device budgets
+      must hold AFTER this pod: active member count <= quota.pods and
+      summed accelerator requests <= quota.devices. Exceeding either is
+      an AdmissionDenied (HTTP 403) counted in
+      ``apiserver_quota_denials_total``. Usage is computed from live
+      store state, so pod DELETEs release budget with no ledger to
+      drift.
+    """
+
+    def __init__(self, server):
+        self._server = server
+
+    def _group(self, namespace: str, name: str):
+        try:
+            from kubernetes_tpu.storage.store import KeyNotFound
+
+            return self._server.store.get(
+                f"/podgroups/{namespace}/{name}")[0]
+        except Exception:
+            return None
+
+    def _resolve_priority(self, pg) -> None:
+        cls_name = pg.spec.priority_class_name
+        if not cls_name:
+            return
+        try:
+            pc = self._server.store.get(f"/priorityclasses/{cls_name}")[0]
+        except Exception:
+            raise AdmissionDenied(
+                f"podgroup {pg.metadata.name!r} names unknown priority "
+                f"class {cls_name!r}"
+            )
+        pg.spec.priority = int(pc.value)
+
+    def admit(self, operation, resource, namespace, obj) -> None:
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        if resource == "podgroups" and operation in (CREATE, UPDATE) \
+                and obj is not None:
+            self._resolve_priority(obj)
+            if not obj.spec.queue:
+                obj.spec.queue = namespace or obj.metadata.namespace
+            return
+        if operation != CREATE or resource != "pods" or obj is None:
+            return
+        group_name = (obj.metadata.labels or {}).get(POD_GROUP_LABEL, "")
+        if not group_name:
+            return
+        pg = self._group(namespace, group_name)
+        if pg is None:
+            raise AdmissionDenied(
+                f"pod {obj.metadata.name!r} joins pod group "
+                f"{group_name!r}, which does not exist in namespace "
+                f"{namespace!r}; create the PodGroup first"
+            )
+        quota = pg.spec.quota or {}
+        if not quota:
+            return
+        from kubernetes_tpu.api.types import pod_resource_request
+        from kubernetes_tpu.metrics import apiserver_quota_denials_total
+
+        pods, _rv = self._server.store.list(f"/pods/{namespace}/")
+        members = [
+            p for p in pods
+            if p.status.phase not in ("Succeeded", "Failed")
+            and (p.metadata.labels or {}).get(POD_GROUP_LABEL) == group_name
+        ]
+        if "pods" in quota:
+            budget = int(str(quota["pods"]))
+            if len(members) + 1 > budget:
+                apiserver_quota_denials_total.inc(budget="pods")
+                raise AdmissionDenied(
+                    f"pod group {group_name!r} (tenant "
+                    f"{pg.spec.queue!r}) exceeded quota: pods="
+                    f"{budget} (in use: {len(members)}, requested: 1)"
+                )
+        if "devices" in quota:
+            budget = int(str(quota["devices"]))
+            new_dev = pod_resource_request(obj)[2]
+            used_dev = sum(pod_resource_request(p)[2] for p in members)
+            if used_dev + new_dev > budget:
+                apiserver_quota_denials_total.inc(budget="devices")
+                raise AdmissionDenied(
+                    f"pod group {group_name!r} (tenant "
+                    f"{pg.spec.queue!r}) exceeded quota: devices="
+                    f"{budget} (in use: {used_dev}, requested: "
+                    f"{new_dev})"
+                )
 
 
 class ServiceAccountAdmission(AdmissionPlugin):
@@ -366,6 +480,7 @@ PLUGIN_FACTORIES = {
     "SecurityContextDeny": lambda server: SecurityContextDeny(),
     "LimitRanger": LimitRanger,
     "ResourceQuota": ResourceQuotaAdmission,
+    "PodGroup": PodGroupAdmission,
     "ServiceAccount": ServiceAccountAdmission,
     "InitialResources": InitialResources,
     "LimitPodHardAntiAffinityTopology":

@@ -122,6 +122,10 @@ class PriorityLevel:
     #: caller-controlled identity; the memo must not grow unboundedly)
     HAND_MEMO_MAX = 1024
 
+    #: fraction of a level's nominal seats it may lend to saturated
+    #: siblings while idle (the reference's lendablePercent)
+    LENDABLE_PCT = 0.5
+
     def __init__(
         self,
         name: str,
@@ -135,6 +139,23 @@ class PriorityLevel:
         self.name = name
         self.seats = max(1, int(seats))
         self.exempt = exempt
+        # seat borrowing between sibling levels (the reference's
+        # lendable/borrowing concurrency limits): `exchange` is wired
+        # by the controller; a level may lend up to LENDABLE_PCT of its
+        # seats while it has no waiters, and borrow up to its own seat
+        # count (2x nominal ceiling). Leases are per-request: every
+        # release returns borrowed seats first, so a lender under
+        # contention gets them back as fast as the borrower's requests
+        # complete.
+        self.exchange = None
+        self.borrow_limit = self.seats
+        self._lent_out = 0  # guarded-by: self._mu
+        self._borrowed_in = 0  # guarded-by: self._mu
+        # in-flight borrow reservations: counted against borrow_limit
+        # (so concurrent saturated acquires cannot overshoot the 2x
+        # ceiling) but NOT in capacity until a lender actually grants
+        self._borrow_pending = 0  # guarded-by: self._mu
+        self._borrow_ledger: Dict[str, int] = {}  # guarded-by: self._mu
         self.queue_length = max(1, int(queue_length))
         self.hand_size = max(1, min(int(hand_size), max(1, int(queues))))
         self.queue_wait = queue_wait
@@ -207,7 +228,35 @@ class PriorityLevel:
             return 0.0
         w: Optional[_Waiter] = None
         with self._mu:
-            if (self._seats_in_use + width <= self.seats
+            if (self._seats_in_use + width <= self._capacity_locked()
+                    and self._waiting == 0):
+                self._seats_in_use += width
+                self._m_dispatched()
+                self._m_wait.observe(0.0)
+                return 0.0
+        # saturated: try to borrow a sibling level's idle seats before
+        # queueing (outside our lock; the exchange locks one lender at
+        # a time, so there is no lock-order cycle)
+        if self.exchange is not None:
+            lender = self.exchange.borrow(self, width)
+            if lender is not None:
+                # the exchange already moved the lease into
+                # _borrowed_in under our lock; record who to repay
+                with self._mu:
+                    self._borrow_ledger[lender.name] = (
+                        self._borrow_ledger.get(lender.name, 0) + width
+                    )
+                    if (self._waiting == 0 and self._seats_in_use
+                            + width <= self._capacity_locked()):
+                        self._seats_in_use += width
+                        self._m_dispatched()
+                        self._m_wait.observe(0.0)
+                        return 0.0
+                    # waiters exist: the borrowed capacity serves the
+                    # queue head (FIFO fairness), this request queues
+                    self._dispatch_locked()
+        with self._mu:
+            if (self._seats_in_use + width <= self._capacity_locked()
                     and self._waiting == 0):
                 self._seats_in_use += width
                 self._m_dispatched()
@@ -260,10 +309,29 @@ class PriorityLevel:
 
     def release(self, width: int = 1) -> None:
         width = max(1, min(int(width), self.seats))
+        give = None
         with self._mu:
             self._seats_in_use -= width
+            if self._borrow_ledger:
+                # return borrowed seats FIRST: a lender that became
+                # contended while we held its seats gets them back as
+                # soon as any of our requests completes
+                name = next(iter(self._borrow_ledger))
+                back = min(width, self._borrow_ledger[name])
+                self._borrow_ledger[name] -= back
+                if not self._borrow_ledger[name]:
+                    del self._borrow_ledger[name]
+                self._borrowed_in -= back
+                give = (name, back)
             if not self.exempt:
                 self._dispatch_locked()
+        if give is not None and self.exchange is not None:
+            self.exchange.give_back(*give)
+
+    def _capacity_locked(self) -> int:
+        """Effective seats: nominal, plus leases borrowed in, minus
+        seats currently lent to sibling levels."""
+        return self.seats + self._borrowed_in - self._lent_out
 
     def _dispatch_locked(self) -> None:
         """Fill freed seats round-robin across non-empty queues — each
@@ -281,7 +349,7 @@ class PriorityLevel:
             else:
                 return
             w = self._queues[qi][0]
-            if self._seats_in_use + w.width > self.seats:
+            if self._seats_in_use + w.width > self._capacity_locked():
                 return  # not enough seats yet: wait for more releases
             self._rr = qi + 1
             self._queues[qi].popleft()
@@ -303,11 +371,16 @@ class PriorityLevel:
             depths = [len(q) for q in self._queues]
             seats_in_use = self._seats_in_use
             waiting = self._waiting
+        with self._mu:
+            borrowed_in = self._borrowed_in
+            lent_out = self._lent_out
         rejected = apiserver_flowcontrol_rejected_requests_total
         return {
             "exempt": self.exempt,
             "seats": self.seats,
             "seats_in_use": seats_in_use,
+            "borrowed_in": borrowed_in,
+            "lent_out": lent_out,
             "waiting": waiting,
             "queues": len(depths),
             "queue_length_limit": self.queue_length,
@@ -328,6 +401,66 @@ class PriorityLevel:
         return apiserver_flowcontrol_dispatched_requests_total.get(
             priority_level=self.name
         )
+
+
+class SeatExchange:
+    """Seat lending between sibling priority levels (the reference's
+    lendable/borrowing concurrency limits). A level may lend only while
+    it is IDLE (no waiters) and only up to LENDABLE_PCT of its nominal
+    seats; leases return on the borrower's next releases, so a lender
+    that becomes contended recovers its seats at the borrower's request
+    completion rate, never waiting on a timer.
+
+    Locking: borrow()/give_back() hold at most ONE level lock at a
+    time (never the borrower's and a lender's together), so there is
+    no lock-order cycle with acquire/release."""
+
+    def __init__(self, levels: Sequence[PriorityLevel]):
+        self._levels = sorted(
+            (l for l in levels if not l.exempt), key=lambda l: l.name
+        )
+        self._by_name = {l.name: l for l in self._levels}
+
+    def borrow(self, borrower: PriorityLevel,
+               width: int) -> Optional[PriorityLevel]:
+        # reserve against the borrow limit UNDER the borrower's lock
+        # (a check-then-act across lock drops would let concurrent
+        # saturated acquires overshoot the 2x ceiling); the
+        # reservation is excluded from capacity until a lender grants
+        with borrower._mu:
+            if (borrower._borrowed_in + borrower._borrow_pending
+                    + width > borrower.borrow_limit):
+                return None
+            borrower._borrow_pending += width
+        lender_found = None
+        for lender in self._levels:
+            if lender is borrower:
+                continue
+            with lender._mu:
+                idle = (lender.seats - lender._seats_in_use
+                        - lender._lent_out)
+                lendable_left = int(
+                    lender.seats * lender.LENDABLE_PCT
+                ) - lender._lent_out
+                if (lender._waiting == 0 and idle >= width
+                        and lendable_left >= width):
+                    lender._lent_out += width
+                    lender_found = lender
+                    break
+        with borrower._mu:
+            borrower._borrow_pending -= width
+            if lender_found is not None:
+                borrower._borrowed_in += width
+        return lender_found
+
+    def give_back(self, lender_name: str, width: int) -> None:
+        lender = self._by_name.get(lender_name)
+        if lender is None:
+            return
+        with lender._mu:
+            lender._lent_out -= width
+            # returned seats dispatch the lender's waiters immediately
+            lender._dispatch_locked()
 
 
 class _Ticket:
@@ -489,6 +622,14 @@ class APFController:
                     f"flow schema {s.name!r} names unknown priority "
                     f"level {s.priority_level!r}"
                 )
+        # seat borrowing between the shared-concurrency levels
+        # (KUBERNETES_TPU_APF_BORROW=0 disables)
+        if os.environ.get("KUBERNETES_TPU_APF_BORROW", "1").lower() \
+                not in ("0", "false", "off"):
+            exchange = SeatExchange(list(self.levels.values()))
+            for lvl in self.levels.values():
+                if not lvl.exempt:
+                    lvl.exchange = exchange
         _races.track(self, "apiserver.APFController")
 
     @classmethod

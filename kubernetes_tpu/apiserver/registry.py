@@ -116,6 +116,32 @@ _CRON_WORD = _re.compile(r"(?i)\b(JAN|FEB|MAR|APR|MAY|JUN|JUL|AUG|SEP|OCT|"
 _EVERY_DURATION = _re.compile(r"^@every ([0-9]+(\.[0-9]+)?(ns|us|µs|ms|s|m|h))+$")
 
 
+def validate_podgroup(pg: t.PodGroup) -> None:
+    """PodGroup invariants the apiserver rejects with 422: minMember
+    must be positive, quota keys must be known, budgets non-negative."""
+    if pg.spec.min_member < 1:
+        raise ValidationError("spec.minMember: must be >= 1")
+    if pg.spec.priority < 0:
+        raise ValidationError("spec.priority: must be >= 0")
+    for key, v in (pg.spec.quota or {}).items():
+        if key not in ("pods", "devices"):
+            raise ValidationError(
+                f"spec.quota: unknown budget {key!r} (pods, devices)"
+            )
+        try:
+            if int(str(v)) < 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"spec.quota.{key}: {v!r} is not a non-negative integer"
+            )
+
+
+def validate_priorityclass(pc: t.PriorityClass) -> None:
+    if pc.value < 0:
+        raise ValidationError("value: must be >= 0")
+
+
 def validate_scheduledjob(sj: t.ScheduledJob) -> None:
     """batch/validation ValidateScheduledJobSpec: the schedule must be
     a cron expression — @-descriptors (robfig/cron's @daily etc.,
@@ -287,6 +313,17 @@ def default_resources() -> Dict[str, ResourceInfo]:
             "clusterrolebindings", "ClusterRoleBinding",
             t.ClusterRoleBinding, "/clusterrolebindings",
             namespaced=False, group="rbac",
+        ),
+        # -- AI-cluster workload API (scheduling group) -----------------------
+        ResourceInfo(
+            "podgroups", "PodGroup", t.PodGroup, "/podgroups",
+            group="scheduling", has_status=True,
+            validate=validate_podgroup,
+        ),
+        ResourceInfo(
+            "priorityclasses", "PriorityClass", t.PriorityClass,
+            "/priorityclasses", namespaced=False, group="scheduling",
+            validate=validate_priorityclass,
         ),
         # virtual: GET/LIST probe live component health, nothing stored
         # (registry/componentstatus/rest.go)

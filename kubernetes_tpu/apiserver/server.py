@@ -341,7 +341,12 @@ class APIServer:
         self.store = store
         self.scheme = scheme or default_scheme
         self.resources = default_resources()
-        self.admission = adm.AdmissionChain([adm.NamespaceLifecycle(self)])
+        # PodGroup admission is default-on: priority-class resolution
+        # and gang quota enforcement cost one label-dict get for pods
+        # outside any gang
+        self.admission = adm.AdmissionChain([
+            adm.NamespaceLifecycle(self), adm.PodGroupAdmission(self),
+        ])
         if admission_control:
             self.admission = adm.AdmissionChain([
                 adm.make_plugin(name.strip(), self)

@@ -25,6 +25,8 @@ _GROUPS = {
     "podsecuritypolicies": "/apis/extensions/v1beta1",
     "poddisruptionbudgets": "/apis/policy/v1alpha1",
     "scheduledjobs": "/apis/batch/v2alpha1",
+    "podgroups": "/apis/scheduling/v1alpha1",
+    "priorityclasses": "/apis/scheduling/v1alpha1",
     "roles": "/apis/rbac/v1alpha1",
     "rolebindings": "/apis/rbac/v1alpha1",
     "clusterroles": "/apis/rbac/v1alpha1",

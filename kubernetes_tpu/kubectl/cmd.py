@@ -74,6 +74,8 @@ ALIASES = {
     "role": "roles", "rolebinding": "rolebindings",
     "clusterrole": "clusterroles",
     "clusterrolebinding": "clusterrolebindings",
+    "pg": "podgroups", "podgroup": "podgroups",
+    "pc": "priorityclasses", "priorityclass": "priorityclasses",
 }
 
 SCALABLE = {
@@ -103,6 +105,7 @@ _KIND_TO_RESOURCE = {
     "Role": "roles", "RoleBinding": "rolebindings",
     "ClusterRole": "clusterroles",
     "ClusterRoleBinding": "clusterrolebindings",
+    "PodGroup": "podgroups", "PriorityClass": "priorityclasses",
 }
 
 
@@ -260,6 +263,34 @@ class Kubectl:
                 if c.requests:
                     reqs = ", ".join(f"{k}={v}" for k, v in c.requests.items())
                     lines.append(f"    Requests:\t{reqs}")
+        elif resource == "podgroups":
+            lines += [
+                f"Min Member:\t{obj.spec.min_member}",
+                f"Priority:\t{obj.spec.priority}"
+                + (f" ({obj.spec.priority_class_name})"
+                   if obj.spec.priority_class_name else ""),
+                f"Tenant:\t{obj.spec.queue or obj.metadata.namespace}",
+            ]
+            if obj.spec.quota:
+                q = ", ".join(f"{k}={v}" for k, v in obj.spec.quota.items())
+                lines.append(f"Quota:\t{q}")
+            if obj.spec.workload_class:
+                lines.append(f"Workload Class:\t{obj.spec.workload_class}")
+            lines += [
+                f"Phase:\t{obj.status.phase}",
+                f"Members Bound:\t{obj.status.scheduled}/"
+                f"{max(obj.status.members, obj.status.scheduled)}",
+            ]
+            if obj.status.preempted:
+                lines.append(f"Preempted Victims:\t{obj.status.preempted}")
+            if obj.status.phase in ("Parked", "Preempting"):
+                # why the gang is parked: the unschedulable members and
+                # the scheduler's human-readable reason
+                lines.append(f"Parked:\t{obj.status.message or '<none>'}")
+                if obj.status.unschedulable:
+                    lines.append("Unschedulable Members:")
+                    for m in obj.status.unschedulable:
+                        lines.append(f"  {m}")
         elif resource == "nodes":
             lines.append("Conditions:")
             for c in obj.status.conditions:
@@ -317,7 +348,19 @@ class Kubectl:
         for obj in self._load_manifests(filename):
             resource = self._resource_for(obj)
             ns = obj.metadata.namespace or self.namespace
-            created = self.client.resource(resource, ns).create(obj)
+            try:
+                created = self.client.resource(resource, ns).create(obj)
+            except APIStatusError as e:
+                if e.code == 403:
+                    # admission denial (gang quota, security policy):
+                    # surface the server's readable message, the
+                    # reference's "Error from server (Forbidden)" shape
+                    out.append(
+                        f"Error from server (Forbidden): error when "
+                        f"creating {filename!r}: {e}"
+                    )
+                    continue
+                raise
             out.append(f"{resource}/{created.metadata.name} created")
         return "\n".join(out)
 

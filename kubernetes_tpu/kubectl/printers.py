@@ -149,6 +149,22 @@ def _componentstatus_row(cs) -> List[str]:
     ]
 
 
+def _podgroup_row(pg) -> List[str]:
+    return [
+        pg.metadata.name,
+        str(pg.spec.min_member),
+        f"{pg.status.scheduled}/{max(pg.status.members, pg.status.scheduled)}",
+        pg.status.phase or "Pending",
+        str(pg.spec.priority),
+        age(pg.metadata.creation_timestamp),
+    ]
+
+
+def _priorityclass_row(pc) -> List[str]:
+    return [pc.metadata.name, str(pc.value),
+            age(pc.metadata.creation_timestamp)]
+
+
 TABLES: Dict[str, Tuple[List[str], Callable[[Any], List[str]]]] = {
     "pods": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"], _pod_row),
     "nodes": (["NAME", "STATUS", "AGE"], _node_row),
@@ -175,6 +191,11 @@ TABLES: Dict[str, Tuple[List[str], Callable[[Any], List[str]]]] = {
     "componentstatuses": (
         ["NAME", "STATUS", "MESSAGE", "ERROR"], _componentstatus_row,
     ),
+    "podgroups": (
+        ["NAME", "MIN-MEMBER", "BOUND", "PHASE", "PRIORITY", "AGE"],
+        _podgroup_row,
+    ),
+    "priorityclasses": (["NAME", "VALUE", "AGE"], _priorityclass_row),
 }
 
 

@@ -370,6 +370,42 @@ scheduler_slo_breach_total = registry.register(
     )
 )
 
+# -- AI-cluster workload subsystem (gangs / preemption / quota) ---------------
+
+#: gangs fully bound (all-or-nothing success), per wave driver
+scheduler_gangs_scheduled_total = registry.register(
+    Counter(
+        "scheduler_gangs_scheduled_total",
+        "PodGroups whose whole gang bound in one wave",
+    )
+)
+
+#: gangs parked (insufficient members or no all-member placement),
+#: labeled by reason (members | resources | preempting)
+scheduler_gangs_parked_total = registry.register(
+    Counter(
+        "scheduler_gangs_parked_total",
+        "PodGroups parked instead of partially bound, by reason",
+    )
+)
+
+#: pods evicted by priority preemption on behalf of a parked gang
+scheduler_preemption_victims_total = registry.register(
+    Counter(
+        "scheduler_preemption_victims_total",
+        "Victim pods evicted by gang priority preemption",
+    )
+)
+
+#: pod/device budget rejections at apiserver admission (403s), labeled
+#: by budget (pods | devices)
+apiserver_quota_denials_total = registry.register(
+    Counter(
+        "apiserver_quota_denials_total",
+        "Workload quota admission denials, labeled by exceeded budget",
+    )
+)
+
 #: apiserver request latency (pkg/apiserver/metrics.go
 #: apiserver_request_latencies, microsecond units like the scheduler's)
 apiserver_request_latency = registry.register(

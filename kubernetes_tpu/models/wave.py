@@ -171,11 +171,21 @@ def group_buffer(batch: PodBatch, reps, floor: int = 8):
     return G_bucket, layout, buf
 
 
+def gang_score_add(tables: RunTables, add: np.ndarray) -> RunTables:
+    """Fold a per-node additive score row (the heterogeneity-aware
+    throughput term: weight x normalized throughput of the gang's
+    workload class on each node's accelerator type) into a run's
+    tables. static_add is the per-node static score sum the replay
+    reads per pick, so the adjustment is exact — the pick sequence
+    maximizes the combined score including the term."""
+    return dc_replace(tables, static_add=tables.static_add + add)
+
+
 def host_group_replay(config: SchedulerConfig, snap: ClusterSnapshot,
                       batch: PodBatch, group, headers: np.ndarray,
                       usage: np.ndarray, replay_fn, perm: np.ndarray,
                       L_host: int, out: np.ndarray, zoned: bool,
-                      max_j: int, num_zones: int):
+                      max_j: int, num_zones: int, gang_marks=None):
     """FIFO host replay of a group of runs from ONE grouped probe.
 
     group: list of (rep, start, length); headers: i64[G, N_STK_ROWS, N]
@@ -190,7 +200,15 @@ def host_group_replay(config: SchedulerConfig, snap: ClusterSnapshot,
     Returns (counts_mat i64[G, N] node-order commits per run, n_full
     runs completely replayed, partial_done picks of run n_full when it
     stopped early (0 otherwise), L_host). Shared by the single-chip and
-    mesh wave drivers."""
+    mesh wave drivers.
+
+    gang_marks (aligned with `group`; None entries are ordinary runs)
+    makes a run ALL-OR-NOTHING: unless every member gets a node, the
+    gang is parked — no member binds (out stays -1), no commit folds,
+    and the replay continues with the NEXT run against the same state,
+    so a parked gang can never pollute the runs behind it. A mark's
+    optional `score_add` (i64[N]) is the gang's heterogeneity-aware
+    throughput term, folded into the run's static score row."""
     G = len(group)
     N = usage.shape[1]
     usage = usage.astype(np.int64, copy=True)
@@ -234,8 +252,23 @@ def host_group_replay(config: SchedulerConfig, snap: ClusterSnapshot,
             has_selectors=bool(batch.has_selectors[rep]),
             zone_id=zone_arr,
         )
+        gang = gang_marks[r] if gang_marks is not None else None
+        if gang is not None and gang.get("score_add") is not None:
+            tables = gang_score_add(tables, gang["score_add"])
         res: ReplayResult = replay_fn(_permute_tables(tables, perm), K,
                                       L_host)
+        if gang is not None and (res.n_done == 0
+                                 or bool((res.chosen < 0).any())):
+            # unfit member: park — no binds, no folds, round-robin
+            # counter untouched; the NEXT run replays against the same
+            # usage/spread/port state a never-attempted gang leaves.
+            # (A gang TABLE-HORIZON partial — n_done < K with every
+            # pick valid — is NOT unfit: it falls through to the
+            # normal partial path below, so the caller re-probes and
+            # continues the gang through run_single, whose gang
+            # failure path erases the whole span before any bind.)
+            n_full += 1
+            continue
         if res.n_done == 0:
             break  # no progress through tables: caller re-probes
         ids = np.where(res.chosen >= 0, perm[res.chosen], -1)
@@ -417,16 +450,21 @@ def svc_run_context(config: SchedulerConfig, snap: ClusterSnapshot,
     return ctx
 
 
-def split_runs(rep_idx: np.ndarray) -> List[Tuple[int, int, int]]:
+def split_runs(rep_idx: np.ndarray,
+               boundaries: Sequence[int] = ()) -> List[Tuple[int, int, int]]:
     """Maximal runs of consecutive equal representative rows:
     -> [(rep, start, length)]. Shared by the single-chip and mesh
-    drivers."""
+    drivers. `boundaries` forces additional run breaks at those
+    backlog positions — a gang span must be ITS OWN run even when the
+    neighbouring pods share its template, so the all-or-nothing commit
+    decision covers exactly the gang's members."""
     runs: List[Tuple[int, int, int]] = []
+    cuts = frozenset(boundaries)
     i, P = 0, len(rep_idx)
     while i < P:
         r = rep_idx[i]
         s = i
-        while i < P and rep_idx[i] == r:
+        while i < P and rep_idx[i] == r and (i == s or i not in cuts):
             i += 1
         runs.append((int(r), s, i - s))
     return runs
@@ -434,8 +472,8 @@ def split_runs(rep_idx: np.ndarray) -> List[Tuple[int, int, int]]:
 
 def classify_runs(config: SchedulerConfig, snap: ClusterSnapshot,
                   batch: PodBatch, runs, num_values: int, min_run: int,
-                  *, device_zoned: bool = False,
-                  zoned: bool = False) -> List[dict]:
+                  *, device_zoned: bool = False, zoned: bool = False,
+                  gang_starts: frozenset = frozenset()) -> List[dict]:
     """Classify every run once: eligibility, the self-anti veto, the
     service context, the device-replay route, and commit purity
     (whether a grouped probe's host adjustments can cover its commits).
@@ -448,7 +486,10 @@ def classify_runs(config: SchedulerConfig, snap: ClusterSnapshot,
     infos: List[dict] = []
     for rep, start, length in runs:
         eligible, veto = (False, None)
-        if length >= min_run:
+        # a gang span takes the run machinery at ANY length (typical
+        # gangs are 2-16 pods, under the default min_run): the probe/
+        # replay path is where the all-or-nothing commit is enforced
+        if length >= min_run or start in gang_starts:
             eligible, veto = run_eligible(
                 config, batch, rep, snap, config_ok=config_ok,
             )
@@ -822,6 +863,7 @@ class WaveScheduler:
         last_node_index: int = 0,
         keep: frozenset = frozenset(),
         source: str = "full",
+        gangs: Optional[Sequence[dict]] = None,
     ) -> Tuple[np.ndarray, tuple, int]:
         """-> (chosen i32[P] node ids with -1 == unschedulable,
         final carry, final lastNodeIndex). snap may be node-padded;
@@ -830,7 +872,21 @@ class WaveScheduler:
         snapshot fields unchanged since the previous wave — their
         device copies are reused instead of re-shipped. `source`
         identifies the snapshot's producer; a producer change drops the
-        device cache (ids/bit positions are producer-relative)."""
+        device cache (ids/bit positions are producer-relative).
+
+        `gangs` marks all-or-nothing spans of the backlog:
+        [{"start", "length", "score_add": i64[N] | None}]. Each span
+        becomes its own run (split_runs boundaries) riding the SAME
+        grouped probe/replay machinery as any template run — a gang
+        costs no extra dispatches — but its commits fold only when
+        every member gets a node; otherwise the whole span stays -1
+        (parked) and later runs/singletons replay against untouched
+        state. Spans the run machinery cannot take atomically (mixed
+        member templates, ineligible features -> the serial scan)
+        schedule plainly; the caller (scheduler/gang.GangDirector)
+        applies an unconditional post-hoc all-or-nothing check over
+        the returned hosts before anything binds. None/[] = no gangs,
+        and the wave is bit-identical to the pre-gang driver."""
         if source != self._dev_source:
             self._dev.clear()
             self._dev_source = source
@@ -866,8 +922,16 @@ class WaveScheduler:
         perm = np.asarray(snap.name_desc_order).astype(np.int64)
         N = snap.num_nodes
 
-        # maximal runs of consecutive equal reps
-        runs = split_runs(rep_idx)
+        # maximal runs of consecutive equal reps; gang spans force
+        # their own run boundaries so all-or-nothing covers exactly
+        # the gang's members
+        gang_by_start: dict = {}
+        boundaries: List[int] = []
+        for g in (gangs or ()):
+            gang_by_start[int(g["start"])] = g
+            boundaries += [int(g["start"]),
+                           int(g["start"]) + int(g["length"])]
+        runs = split_runs(rep_idx, boundaries)
 
         pending: List[int] = []
         # lastNodeIndex is tracked host-side (the replay computes it
@@ -922,7 +986,22 @@ class WaveScheduler:
         infos = classify_runs(
             self.config, snap, batch, runs, num_values, self.min_run,
             device_zoned=self._device_zoned, zoned=zoned,
+            gang_starts=frozenset(gang_by_start),
         )
+        for info in infos:
+            g = gang_by_start.get(info["start"])
+            if g is not None and info["length"] == g["length"] \
+                    and info["eligible"]:
+                # atomic in-driver gang: host probe/replay path only
+                # (the device zoned replay folds commits in-program and
+                # cannot discard a partial gang)
+                info["gang"] = g
+                info["device"] = False
+            else:
+                # span the driver can't take atomically (mixed member
+                # templates or ineligible features): schedules plainly;
+                # the director's post-hoc check guards the binds
+                info["gang"] = None
 
         def run_single(carry, info, done0=0):
             """The per-run fast path: probe_fused (or the single-run
@@ -983,12 +1062,34 @@ class WaveScheduler:
                 if tables.sa_bail:
                     # ServiceAffinity dynamics the tables can't express
                     # (mid-run re-pin hazard): scan the rest of the run
+                    # (a gang here schedules via the scan; the
+                    # director's post-hoc check still guards its binds)
                     pending.extend(range(start + done, start + length))
                     break
+                if info["gang"] is not None and \
+                        info["gang"].get("score_add") is not None:
+                    tables = gang_score_add(tables,
+                                            info["gang"]["score_add"])
                 with phase_timer("replay"):
                     res: ReplayResult = self._replay(
                         _permute_tables(tables, perm), K, L_host
                     )
+                if info["gang"] is not None and (
+                        res.n_done == 0 or bool((res.chosen < 0).any())):
+                    # all-or-nothing: park the gang — no member binds
+                    # and THIS segment folds nothing. Erase the whole
+                    # span: earlier horizon segments (rare — the +2
+                    # table-depth rule makes resource-bounded runs fit-
+                    # bail inside the table) may have written picks and
+                    # folded counts; the picks are discarded here and
+                    # the folded counts remain only as conservative
+                    # in-wave phantom usage — no binds happen, so the
+                    # next wave starts from clean cluster state.
+                    out[start:start + length] = -1
+                    return carry
+                # a gang table-horizon partial (n_done < K, all picks
+                # valid) falls through: write + fold + re-probe, the
+                # same transactional continuation any run gets
                 if res.n_done == 0:
                     # no progress possible through tables; scan the rest
                     pending.extend(range(start + done, start + length))
@@ -1032,6 +1133,7 @@ class WaveScheduler:
                          for g in group],
                         headers[:G], usage, self._replay, perm, L_host,
                         out, zoned, self.max_j, num_zones,
+                        gang_marks=[g["gang"] for g in group],
                     )
             if counts_mat.any():
                 cm = np.zeros((G_bucket, counts_mat.shape[1]), np.int64)

@@ -1,0 +1,151 @@
+"""Device-side victim selection for gang priority preemption.
+
+When a high-priority gang parks because the cluster cannot place every
+member, the scheduler looks for eviction victims among STRICTLY
+lower-priority bound pods (the preemption invariant: equal-or-higher
+priority is never a candidate — enforced here by masking, not by caller
+discipline). The scoring runs on device as one program over per-node
+candidate tables:
+
+  1. each node's candidates sort by the eviction key
+     (priority ascending, creation ordinal descending — evict the
+     lowest tier first, the newest pod first within a tier),
+  2. freed resources prefix-sum along the sorted axis,
+  3. ``victims_needed[n]`` = the shortest prefix whose freed capacity
+     fits one gang member on node n (0 = fits already, -1 = impossible
+     even evicting every candidate), and
+  4. ``cost[n]`` = the summed victim priorities of that prefix
+     (fewest-victims first, then cheapest tiers — the host tiebreak).
+
+The host driver places the gang's members greedily over the returned
+scores and evicts the union of chosen prefixes through the batch door
+(scheduler/gang.py). Integer-only math: the i64 composite sort key and
+prefix sums have TPU lowerings; there is no dot_general and no float.
+Registered in analysis/programs.py (transfer contract: 3 host-bound
+arrays per dispatch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+#: priority slot marking an unused candidate column (pad); any real
+#: priority is below it, so padded slots sort last and never count
+INVALID_PRIO = (1 << 31) - 1
+
+#: resource rows of the candidate/free tables, in order
+RES_ROWS = 4  # mcpu, mem bytes, devices, pod slots
+
+
+def _victim_score_fn(prio, ord_, res, free, req, gang_prio):
+    """prio i32[N, C], ord i32[N, C], res i64[N, C, 4] (freed per
+    candidate), free i64[N, 4], req i64[4], gang_prio i32 scalar ->
+    (victims_needed i32[N], cost i64[N], order i32[N, C])."""
+    import jax.numpy as jnp
+
+    N, C = prio.shape
+    # the invariant lives HERE: only strictly-lower-priority candidates
+    # are ever sortable into a usable prefix
+    valid = prio < gang_prio
+    # composite eviction key: priority ascending, newest (highest
+    # ordinal) first within a priority; invalid slots sort to the end
+    key = prio.astype(jnp.int64) * (jnp.int64(1) << 32) + (
+        (jnp.int64(1) << 32) - 1 - ord_.astype(jnp.int64)
+    )
+    key = jnp.where(valid, key, jnp.int64(1) << 62)
+    order = jnp.argsort(key, axis=1)
+    sorted_valid = jnp.take_along_axis(valid, order, axis=1)
+    sorted_res = jnp.take_along_axis(res, order[:, :, None], axis=1)
+    sorted_res = jnp.where(sorted_valid[:, :, None], sorted_res, 0)
+    sorted_prio = jnp.take_along_axis(prio, order, axis=1)
+    cum = jnp.cumsum(sorted_res, axis=1)  # freed after c+1 evictions
+    # a prefix is usable only while every slot in it is a real victim
+    prefix_ok = jnp.cumsum(sorted_valid.astype(jnp.int32), axis=1) == (
+        jnp.arange(1, C + 1, dtype=jnp.int32)[None, :]
+    )
+    fits_after = jnp.all(
+        free[:, None, :] + cum >= req[None, None, :], axis=2
+    ) & prefix_ok
+    fits_now = jnp.all(free >= req[None, :], axis=1)
+    any_fit = jnp.any(fits_after, axis=1)
+    first = jnp.argmax(fits_after, axis=1)  # index of shortest prefix
+    victims_needed = jnp.where(
+        fits_now, 0,
+        jnp.where(any_fit, first.astype(jnp.int32) + 1, jnp.int32(-1)),
+    )
+    cum_prio = jnp.cumsum(
+        jnp.where(sorted_valid, sorted_prio.astype(jnp.int64), 0), axis=1
+    )
+    prefix_cost = jnp.take_along_axis(
+        cum_prio, first[:, None], axis=1
+    )[:, 0]
+    cost = jnp.where(
+        victims_needed > 0, prefix_cost,
+        jnp.where(victims_needed == 0, jnp.int64(0),
+                  jnp.int64(1) << 62),
+    )
+    return victims_needed, cost, order.astype(jnp.int32)
+
+
+class VictimScorer:
+    """Compile-cached dispatcher for the victim-selection program.
+
+    Tables arrive pow2-bucketed on both axes (compile reuse: one
+    program per (N, C) bucket, like every other wave program); the
+    gang's priority and member request are traced operands so a burst
+    of different-priority gangs shares one compiled program."""
+
+    def __init__(self):
+        self._jit: Dict[Tuple[int, int], object] = {}
+
+    def score(self, prio: np.ndarray, ord_: np.ndarray, res: np.ndarray,
+              free: np.ndarray, req: np.ndarray, gang_prio: int):
+        import jax
+        import jax.numpy as jnp
+
+        N, C = prio.shape
+        fn = self._jit.get((N, C))
+        if fn is None:
+            fn = jax.jit(_victim_score_fn)
+            self._jit[(N, C)] = fn
+        needed, cost, order = fn(
+            jnp.asarray(prio), jnp.asarray(ord_), jnp.asarray(res),
+            jnp.asarray(free), jnp.asarray(req),
+            jnp.int32(gang_prio),
+        )
+        return (np.asarray(needed), np.asarray(cost), np.asarray(order))
+
+
+def pack_candidates(node_names, candidates, floor_nodes: int = 64,
+                    floor_cands: int = 8):
+    """Host-side table build (the encode step): group victim candidates
+    by node into padded [N, C] arrays.
+
+    candidates: [(node_name, priority, ordinal, (mcpu, mem, dev, 1))].
+    Returns (prio i32[N, C], ord i32[N, C], res i64[N, C, 4],
+    node_index {name: row}) with both axes pow2-bucketed so repeated
+    preemption rounds reuse one compiled program."""
+    from kubernetes_tpu.snapshot.pad import next_pow2
+
+    node_index = {nm: i for i, nm in enumerate(node_names)}
+    per_node: Dict[int, list] = {}
+    for nm, pr, od, res in candidates:
+        i = node_index.get(nm)
+        if i is not None:
+            per_node.setdefault(i, []).append((pr, od, res))
+    N = next_pow2(max(len(node_names), 1), floor=floor_nodes)
+    C = next_pow2(
+        max(max((len(v) for v in per_node.values()), default=1), 1),
+        floor=floor_cands,
+    )
+    prio = np.full((N, C), INVALID_PRIO, np.int32)
+    ordn = np.zeros((N, C), np.int32)
+    res = np.zeros((N, C, RES_ROWS), np.int64)
+    for i, cands in per_node.items():
+        for c, (pr, od, rr) in enumerate(cands[:C]):
+            prio[i, c] = pr
+            ordn[i, c] = od
+            res[i, c] = rr
+    return prio, ordn, res, node_index

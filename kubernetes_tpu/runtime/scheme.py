@@ -297,6 +297,8 @@ def _default_scheme() -> Scheme:
         ("ClusterRole", t.ClusterRole),
         ("ClusterRoleBinding", t.ClusterRoleBinding),
         ("Scale", t.Scale),
+        ("PodGroup", t.PodGroup),
+        ("PriorityClass", t.PriorityClass),
     ]:
         s.register(kind, cls)
     return s

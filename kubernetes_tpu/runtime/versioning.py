@@ -183,7 +183,8 @@ for _g, _v in (("batch", "v1"), ("batch", "v2alpha1"),
                ("autoscaling", "v1"),
                ("apps", "v1alpha1"), ("componentconfig", "v1alpha1"),
                ("federation", "v1beta1"), ("policy", "v1alpha1"),
-               ("rbac", "v1alpha1"), ("authentication.k8s.io", "v1beta1"),
+               ("rbac", "v1alpha1"), ("scheduling", "v1alpha1"),
+               ("authentication.k8s.io", "v1beta1"),
                ("authorization.k8s.io", "v1beta1")):
     _REGISTRY[(_g, _v)] = GroupVersion(_g, _v)
 

@@ -162,6 +162,12 @@ class SchedulerConfig:
     node_lister: object = None
     error: Callable[[Pod, Exception], None] = None
     recorder: object = None  # EventRecorder
+    # gang workload semantics (scheduler/gang.GangDirector): wave
+    # planning for PodGroups — all-or-nothing parking, priority
+    # ordering, preemption, throughput-aware placement scores. None =
+    # plain reference behavior (the default profile; waves without
+    # gang-labeled pods are untouched either way).
+    gang_director: object = None
     snapshot_extras: Callable[[], dict] = None  # listers for ClusterState
     stop_everything: threading.Event = field(default_factory=threading.Event)
 
@@ -198,6 +204,11 @@ class Scheduler:
         # previous wave's algorithm wall seconds — the adaptive
         # wave-gather window scales off it
         self._last_wave_secs = 0.0
+        gd = config.gang_director
+        if gd is not None and getattr(gd, "recorder", None) is None:
+            # the recorder is assigned on the config after factory
+            # assembly; hand it to the director for Preempted events
+            gd.recorder = config.recorder
 
     def run(self) -> threading.Thread:
         """scheduler.go:89 Run — the loop in a daemon thread."""
@@ -317,15 +328,29 @@ class Scheduler:
         start = DEFAULT_CLOCK.now()
         wall_start = time.time() if trace_span.enabled() else 0.0
         state = self._snapshot()
+        gang_layout: List[dict] = []
+        if cfg.gang_director is not None:
+            # gang planning: park minMember-short gangs before they
+            # touch the backlog, order [singletons | gangs by priority]
+            # with members contiguous, attach throughput score rows.
+            # Waves without gang-labeled pods come back untouched.
+            wave, gang_layout, pre_parked = \
+                cfg.gang_director.plan_wave(wave, state)
+            if pre_parked:
+                self._handle_failures(pre_parked, reason="GangParked")
+            if not wave:
+                return
+            pod = wave[0]
         try:
             with trace_span.span("scheduler.wave", pods=len(wave)):
-                if len(wave) == 1:
+                if len(wave) == 1 and not gang_layout:
                     hosts: List[Optional[str]] = [
                         cfg.algorithm.schedule(wave[0], state)
                     ]
                     errors: Dict[int, Exception] = {}
                 else:
-                    hosts, errors = self._schedule_wave(wave, state)
+                    hosts, errors = self._schedule_wave(
+                        wave, state, gangs=gang_layout or None)
         except Exception as e:
             # histograms are microsecond-unit like the reference's
             # (metrics.go ExponentialBuckets(1000, 2, 15) over us)
@@ -338,6 +363,14 @@ class Scheduler:
         scheduler_algorithm_latency.observe(
             self._last_wave_secs * 1e6
         )
+        if cfg.gang_director is not None and gang_layout:
+            # all-or-nothing enforcement over the returned hosts (the
+            # wave driver already discarded eligible-run partials; this
+            # also covers scan/mesh fallbacks) + preemption planning
+            # for parked gangs with priority
+            hosts, gang_errors = cfg.gang_director.after_wave(
+                wave, list(hosts), gang_layout, state)
+            errors.update(gang_errors)
         if trace_span.enabled():
             # attribute the wave's algorithm window to every traced
             # pod's own trace (one wall-clock read, per-pod dict gets)
@@ -395,9 +428,20 @@ class Scheduler:
                                  update_condition=i in unbatched)
 
     def _schedule_wave(
-        self, wave: Sequence[Pod], state: ClusterState
+        self, wave: Sequence[Pod], state: ClusterState, gangs=None
     ) -> Tuple[List[Optional[str]], Dict[int, Exception]]:
-        hosts = self.config.algorithm.schedule_backlog(wave, state)
+        if gangs:
+            try:
+                hosts = self.config.algorithm.schedule_backlog(
+                    wave, state, gangs=gangs)
+            except TypeError:
+                # algorithm without gang support (oracle/extender
+                # shells): schedule plainly; the director's post-hoc
+                # all-or-nothing check still guards the binds
+                hosts = self.config.algorithm.schedule_backlog(wave,
+                                                               state)
+        else:
+            hosts = self.config.algorithm.schedule_backlog(wave, state)
         errors: Dict[int, Exception] = {}
         for i, (p, h) in enumerate(zip(wave, hosts)):
             if h is None:

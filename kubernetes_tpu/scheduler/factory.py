@@ -58,11 +58,19 @@ class ConfigFactory:
         hard_pod_affinity_weight: int = 1,
         failure_domains: Optional[List[str]] = None,
         cache_ttl: float = 30.0,
+        throughput_matrix: Optional[dict] = None,
+        accel_label_key: str = "accelerator",
     ):
+        """throughput_matrix: the Gavel-style per-accelerator-type
+        normalized-throughput table {workload_class: {accel_type:
+        throughput}} feeding the gang director's placement score term;
+        node types come from the ``accel_label_key`` node label."""
         self.client = client
         self.scheduler_name = scheduler_name
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.failure_domains = failure_domains or []
+        self.throughput_matrix = throughput_matrix
+        self.accel_label_key = accel_label_key
         self.scheduler_cache = SchedulerCache(ttl=cache_ttl).run()
         # named: the pod backlog renders as workqueue_depth{name=
         # "scheduler-pods"} beside the controller queues at /metrics
@@ -123,6 +131,12 @@ class ConfigFactory:
             client.resource("persistentvolumeclaims", ""), name="pvcs",
             direct=True,
         )
+        # PodGroups -> the gang director (all-or-nothing spans,
+        # priority tiers, quota-scoped workloads)
+        self.podgroup_informer = Informer(
+            client.resource("podgroups", ""), name="podgroups",
+            direct=True,
+        )
         self._components = [
             self.assigned_informer,
             self.node_informer,
@@ -131,6 +145,7 @@ class ConfigFactory:
             self.replica_set_informer,
             self.pv_informer,
             self.pvc_informer,
+            self.podgroup_informer,
         ]
 
         self.node_lister = StoreToNodeLister(
@@ -258,6 +273,15 @@ class ConfigFactory:
         return self._make_config(algorithm)
 
     def _make_config(self, algorithm) -> SchedulerConfig:
+        from kubernetes_tpu.scheduler.gang import GangDirector
+
+        director = GangDirector(
+            pod_group_lister=self.podgroup_informer.store.list,
+            status_updater=self._update_podgroup_status,
+            preemptor=self._preempt_many,
+            throughput=self.throughput_matrix,
+            accel_label_key=self.accel_label_key,
+        )
         return SchedulerConfig(
             scheduler_cache=self.scheduler_cache,
             algorithm=algorithm,
@@ -270,6 +294,7 @@ class ConfigFactory:
             error=self._make_error_handler(),
             snapshot_extras=self._snapshot_extras,
             node_lister=self.node_lister,
+            gang_director=director,
         )
 
     def create_scheduler(self, config: SchedulerConfig) -> Scheduler:
@@ -342,6 +367,26 @@ class ConfigFactory:
                 p.metadata.namespace or "default",
             )
             for p, status, reason in updates
+        )
+
+    def _update_podgroup_status(self, namespace: str, name: str,
+                                status: dict) -> None:
+        """PATCH podgroups/{name}/status — why a gang is parked, how
+        many members are bound (what kubectl describe surfaces)."""
+        self.client.resource("podgroups", namespace).patch(
+            name, {"status": status}, subresource="status",
+        )
+
+    def _preempt_many(self, victims) -> list:
+        """Evict preemption victims through the batch door: one
+        request, one store transaction, one watch burst — the same
+        amortization path the wave binder rides."""
+        from kubernetes_tpu.client.rest import batch_delete_item
+
+        return self.client.commit_batch(
+            batch_delete_item("pods", v.metadata.name,
+                              v.metadata.namespace or "default")
+            for v in victims
         )
 
     def _update_pod_condition(self, pod: Pod, status: str, reason: str) -> None:

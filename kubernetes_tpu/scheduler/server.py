@@ -66,6 +66,12 @@ class SchedulerServerOptions:
     leader_elect_lease_duration: float = 15.0
     leader_elect_renew_deadline: float = 10.0
     leader_elect_retry_period: float = 2.0
+    # AI-cluster workloads: path to a JSON throughput matrix
+    # {workload_class: {accel_type: normalized_throughput}} feeding the
+    # gang director's Gavel-style placement score; node accelerator
+    # types come from the `accel_label_key` node label
+    throughput_matrix_file: str = ""
+    accel_label_key: str = "accelerator"
 
     @classmethod
     def from_component_config(cls, cfg) -> "SchedulerServerOptions":
@@ -161,11 +167,24 @@ class SchedulerServer:
         threading.Thread(
             target=_init_backend, daemon=True, name="sched-backend-init"
         ).start()
+        matrix = None
+        if opts.throughput_matrix_file:
+            import json as _json
+
+            try:
+                with open(opts.throughput_matrix_file) as f:
+                    matrix = _json.load(f)
+            except (OSError, ValueError):
+                log.warning("unreadable throughput matrix %r; gangs "
+                            "schedule without the heterogeneity term",
+                            opts.throughput_matrix_file)
         self.factory = ConfigFactory(
             self.client,
             scheduler_name=opts.scheduler_name,
             hard_pod_affinity_weight=opts.hard_pod_affinity_symmetric_weight,
             failure_domains=opts.failure_domains,
+            throughput_matrix=matrix,
+            accel_label_key=opts.accel_label_key,
         )
         self.factory.run_components()
 

@@ -354,8 +354,15 @@ class TPUScheduleAlgorithm:
                 self._last_node_index = saved_last
 
     def schedule_backlog(
-        self, pods: Sequence[Pod], state: ClusterState
+        self, pods: Sequence[Pod], state: ClusterState,
+        gangs: Optional[Sequence[dict]] = None,
     ) -> List[Optional[str]]:
+        """`gangs` marks all-or-nothing spans of the backlog (the gang
+        director's layout): [{"start", "length", "score_by_name":
+        {node_name: int} | None}]. The single-chip wave driver enforces
+        them in-program (no partial binds, no carry pollution); the
+        mesh path schedules normally and relies on the caller's
+        post-hoc all-or-nothing check before binding."""
         if not pods:
             return []
         if self._mesh_sched is not None:
@@ -364,10 +371,12 @@ class TPUScheduleAlgorithm:
             with self._sched_lock:
                 return self._schedule_backlog_mesh(pods, state)
         with self._sched_lock:
-            return self._schedule_backlog_locked(pods, state)
+            return self._schedule_backlog_locked(pods, state,
+                                                 gangs=gangs)
 
     def _schedule_backlog_locked(
-        self, pods: Sequence[Pod], state: ClusterState
+        self, pods: Sequence[Pod], state: ClusterState,
+        gangs: Optional[Sequence[dict]] = None,
     ) -> List[Optional[str]]:
         from kubernetes_tpu.parallel.mesh import _pad_snapshot
         from kubernetes_tpu.snapshot.encode import SnapshotEncoder
@@ -407,9 +416,33 @@ class TPUScheduleAlgorithm:
                 n_bucket = next_pow2(n_real, 64)
                 if n_bucket > n_real:
                     snap = _pad_snapshot(snap, n_bucket)
+        wave_gangs = None
+        if gangs:
+            # resolve per-node-NAME score rows (the heterogeneity
+            # throughput term) into snapshot node order; padded nodes
+            # score 0 and can never be picked (fit_static is False)
+            name_to_id = {
+                nm: i for i, nm in enumerate(snap.node_names) if nm
+            }
+            wave_gangs = []
+            for g in gangs:
+                add = None
+                by_name = g.get("score_by_name")
+                if by_name:
+                    import numpy as _np
+
+                    add = _np.zeros(len(snap.node_names), _np.int64)
+                    for nm, v in by_name.items():
+                        i = name_to_id.get(nm)
+                        if i is not None:
+                            add[i] = int(v)
+                wave_gangs.append({
+                    "start": g["start"], "length": g["length"],
+                    "score_add": add,
+                })
         chosen, _final, last = self._wave.schedule_backlog(
             snap, batch, rep_idx, last_node_index=self._last_node_index,
-            keep=keep, source=source,
+            keep=keep, source=source, gangs=wave_gangs,
         )
         self._last_node_index = last
         names = snap.node_names

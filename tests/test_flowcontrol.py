@@ -769,3 +769,92 @@ def test_lock_order_sanitizer_green_over_apf_doors():
             t.join()
         api.close_cachers()
     locks.assert_no_cycles("(flowcontrol doors)")
+
+
+# -- seat borrowing between levels (lendable concurrency limits) --------------
+
+
+def test_saturated_level_borrows_from_idle_sibling():
+    """workload-high at capacity + workload-low idle: the next
+    workload-high request dispatches on a BORROWED seat (no queueing,
+    zero wait) and /debug state shows the lease on both sides."""
+    c = _tiny_controller(seats=2, queue_wait=2.0)
+    wh, wl = c.levels["workload-high"], c.levels["workload-low"]
+    holders = [c.admit("tenant-a", (), "GET", "/api/v1/pods")
+               for _ in range(2)]  # wh nominal seats exhausted
+    # occupy catch-all so workload-low is the only idle lender
+    holders += [c.admit("", (), "GET", "/api/v1/pods")
+                for _ in range(2)]
+    t0 = time.monotonic()
+    extra = c.admit("tenant-a", (), "GET", "/api/v1/pods")
+    assert time.monotonic() - t0 < 0.2
+    assert extra.waited == 0.0
+    assert wh.state()["borrowed_in"] == 1
+    assert wl.state()["lent_out"] == 1
+    extra.__exit__()
+    # the lease returns on release
+    assert wh.state()["borrowed_in"] == 0
+    assert wl.state()["lent_out"] == 0
+    for h in holders:
+        h.__exit__()
+
+
+def test_lender_under_contention_gets_seats_back():
+    """A lender that saturates while its seat is lent out recovers it
+    the moment the borrower releases: the lender's queued waiter
+    dispatches off the give-back, not off a timeout."""
+    c = _tiny_controller(seats=2, queue_wait=5.0)
+    wh, wl = c.levels["workload-high"], c.levels["workload-low"]
+    hold_wh = [c.admit("tenant-a", (), "GET", "/api/v1/pods")
+               for _ in range(2)]
+    hold_ca = [c.admit("", (), "GET", "/api/v1/pods")
+               for _ in range(2)]  # catch-all busy
+    borrowed = c.admit("tenant-a", (), "GET", "/api/v1/pods")
+    assert wl.state()["lent_out"] == 1  # wl lent its lendable seat
+    # wl now becomes contended: one caller takes its remaining seat,
+    # the next must queue behind the lease
+    hold_wl = c.admit("batch-bot", ("workload:low",), "GET",
+                      "/api/v1/pods")
+    got = []
+
+    def low_caller():
+        tk = c.admit("batch-bot", ("workload:low",), "GET",
+                     "/api/v1/pods")
+        got.append(time.monotonic())
+        tk.__exit__()
+
+    t = threading.Thread(target=low_caller)
+    t.start()
+    wait_until(lambda: wl.state()["waiting"] == 1, timeout=2.0)
+    # borrower completes -> seat returns -> wl waiter dispatches
+    t0 = time.monotonic()
+    borrowed.__exit__()
+    t.join(timeout=2.0)
+    assert got, "lender's waiter never dispatched after give-back"
+    assert got[0] - t0 < 1.0
+    assert wl.state()["lent_out"] == 0
+    hold_wl.__exit__()
+    for h in hold_wh + hold_ca:
+        h.__exit__()
+
+
+def test_borrowing_is_bounded_and_idle_only():
+    """A lender with waiters lends nothing, and a borrower can never
+    exceed its borrow limit (2x nominal): with every sibling
+    saturated, workload-high requests queue/shed exactly as before
+    borrowing existed."""
+    c = _tiny_controller(seats=1, queue_length=1, queue_wait=0.3)
+    # saturate EVERY shared level so no seats are lendable
+    holders = [
+        c.admit("tenant-a", (), "GET", "/api/v1/pods"),
+        c.admit("batch-bot", ("workload:low",), "GET", "/api/v1/pods"),
+        c.admit("", (), "GET", "/api/v1/pods"),
+    ]
+    wh = c.levels["workload-high"]
+    # one more wh request: borrow limit is 1 (seats=1) -> one borrowed
+    # seat max; but no sibling is idle, so it must time out in queue
+    with pytest.raises(Rejected):
+        c.admit("tenant-a", (), "GET", "/api/v1/pods")
+    assert wh.state()["borrowed_in"] == 0
+    for h in holders:
+        h.__exit__()

@@ -1,0 +1,705 @@
+"""AI-cluster workload subsystem (round 14): gang scheduling,
+priority preemption, and quota admission.
+
+Covers the ISSUE-14 contract:
+
+* PodGroup/PriorityClass API + admission: priority-class resolution,
+  per-group pod/device budgets (403 on exceed, usage released on
+  delete), readable denial messages, the quota-denial metric.
+* Wave-driver gang semantics: all-or-nothing (a parked gang NEVER
+  partially binds), no starvation of singletons behind a parked gang,
+  O(1) device dispatches per wave regardless of gang count (the
+  structural gate), and bit-identity to the serial oracle when the
+  gang features are off.
+* Preemption: the device victim scorer (lowest-priority-first,
+  fewest-victims, newest-first) against a numpy reference, the
+  never-evict-equal-or-higher invariant under randomized fuzz, and
+  the no-pointless-evictions rule.
+* End to end: a live control plane + TPU scheduler daemon binds a
+  gang atomically, parks an oversized gang with a readable status,
+  and preempts lower-priority pods for a high-priority gang.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    POD_GROUP_LABEL,
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodSpec,
+    PriorityClass,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client import LocalTransport, RESTClient
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.scheduler import algorithmprovider
+from kubernetes_tpu.scheduler.gang import GangDirector, GangParked
+from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+from kubernetes_tpu.ops.preempt import (
+    INVALID_PRIO,
+    VictimScorer,
+    pack_candidates,
+)
+
+from conftest import wait_until
+from tests.test_conformance import ORACLE_PREDICATES, ORACLE_PRIORITIES
+
+
+def node(name, cpu="4", mem="32Gi", pods="110", labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def pod(name, cpu="500m", labels=None, group=None, ts=None):
+    lbl = dict(labels or {"app": "x"})
+    if group:
+        lbl[POD_GROUP_LABEL] = group
+        lbl.setdefault("app", group)
+    p = Pod(
+        metadata=ObjectMeta(name=name, labels=lbl),
+        spec=PodSpec(containers=[
+            Container(image="t", requests={"cpu": cpu})
+        ]),
+    )
+    if ts:
+        p.metadata.creation_timestamp = ts
+    return p
+
+
+def make_control_plane():
+    server = APIServer()
+    return server, RESTClient(LocalTransport(server))
+
+
+# -- API + quota admission ----------------------------------------------------
+
+
+class TestPodGroupAPI:
+    def test_crud_and_validation(self):
+        _, client = make_control_plane()
+        rc = client.resource("podgroups", "default")
+        rc.create(PodGroup(
+            metadata=ObjectMeta(name="g1"),
+            spec=PodGroupSpec(min_member=4, quota={"pods": "8"}),
+        ))
+        got = rc.get("g1")
+        assert got.spec.min_member == 4
+        assert got.status.phase == "Pending"
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as ei:
+            rc.create(PodGroup(metadata=ObjectMeta(name="bad"),
+                               spec=PodGroupSpec(min_member=0)))
+        assert ei.value.code == 422
+        with pytest.raises(APIStatusError) as ei:
+            rc.create(PodGroup(
+                metadata=ObjectMeta(name="bad2"),
+                spec=PodGroupSpec(quota={"gpus": "1"}),
+            ))
+        assert "unknown budget" in str(ei.value)
+
+    def test_priority_class_resolved_at_admission(self):
+        _, client = make_control_plane()
+        client.resource("priorityclasses").create(PriorityClass(
+            metadata=ObjectMeta(name="training-high"), value=1000,
+        ))
+        rc = client.resource("podgroups", "default")
+        rc.create(PodGroup(
+            metadata=ObjectMeta(name="g1"),
+            spec=PodGroupSpec(priority_class_name="training-high"),
+        ))
+        assert rc.get("g1").spec.priority == 1000
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as ei:
+            rc.create(PodGroup(
+                metadata=ObjectMeta(name="g2"),
+                spec=PodGroupSpec(priority_class_name="nope"),
+            ))
+        assert ei.value.code == 403
+        assert "unknown priority class" in str(ei.value)
+
+    def test_pod_quota_denied_403_and_released_on_delete(self):
+        from kubernetes_tpu.metrics import apiserver_quota_denials_total
+
+        _, client = make_control_plane()
+        client.resource("podgroups", "default").create(PodGroup(
+            metadata=ObjectMeta(name="g1"),
+            spec=PodGroupSpec(quota={"pods": "2"}),
+        ))
+        client.pods().create(pod("p0", group="g1"))
+        client.pods().create(pod("p1", group="g1"))
+        before = apiserver_quota_denials_total.get(budget="pods")
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as ei:
+            client.pods().create(pod("p2", group="g1"))
+        assert ei.value.code == 403
+        # the readable message kubectl surfaces
+        assert "exceeded quota: pods=2" in str(ei.value)
+        assert "in use: 2" in str(ei.value)
+        assert apiserver_quota_denials_total.get(
+            budget="pods") == before + 1
+        # delete releases usage (computed from live store state)
+        client.pods().delete("p0")
+        client.pods().create(pod("p2", group="g1"))
+
+    def test_device_quota(self):
+        _, client = make_control_plane()
+        client.resource("podgroups", "default").create(PodGroup(
+            metadata=ObjectMeta(name="g1"),
+            spec=PodGroupSpec(quota={"devices": "2"}),
+        ))
+
+        def gpu_pod(name, n):
+            return Pod(
+                metadata=ObjectMeta(
+                    name=name, labels={POD_GROUP_LABEL: "g1"}),
+                spec=PodSpec(containers=[Container(
+                    image="t",
+                    requests={"cpu": "100m",
+                              "alpha.kubernetes.io/nvidia-gpu": str(n)},
+                )]),
+            )
+
+        client.pods().create(gpu_pod("d0", 2))
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as ei:
+            client.pods().create(gpu_pod("d1", 1))
+        assert ei.value.code == 403 and "devices=2" in str(ei.value)
+
+    def test_pod_without_group_object_denied(self):
+        _, client = make_control_plane()
+        from kubernetes_tpu.client.rest import APIStatusError
+
+        with pytest.raises(APIStatusError) as ei:
+            client.pods().create(pod("orphan", group="ghost"))
+        assert ei.value.code == 403
+        assert "does not exist" in str(ei.value)
+
+    def test_kubectl_get_and_describe_podgroups(self):
+        from kubernetes_tpu.kubectl.cmd import Kubectl
+
+        _, client = make_control_plane()
+        client.resource("podgroups", "default").create(PodGroup(
+            metadata=ObjectMeta(name="train"),
+            spec=PodGroupSpec(min_member=8, priority=100,
+                              quota={"pods": "16"}),
+        ))
+        client.resource("podgroups", "default").patch(
+            "train",
+            {"status": {"phase": "Parked", "scheduled": 0, "members": 8,
+                        "unschedulable": ["train-3", "train-7"],
+                        "message": "gang parked: 2 of 8 members "
+                                   "unschedulable (insufficient "
+                                   "resources); no partial binds"}},
+            subresource="status",
+        )
+        k = Kubectl(client)
+        table = k.get("podgroups")
+        assert "MIN-MEMBER" in table and "Parked" in table
+        assert "0/8" in table
+        desc = k.describe("pg", "train")
+        assert "Parked:" in desc and "insufficient resources" in desc
+        assert "train-3" in desc and "train-7" in desc
+
+
+# -- wave-driver gang semantics ----------------------------------------------
+
+
+def oracle_backlog(state, pending):
+    oracle = GenericScheduler(
+        predicates=ORACLE_PREDICATES, priorities=ORACLE_PRIORITIES
+    )
+    return oracle.schedule_backlog(pending, state.clone())
+
+
+class TestGangWaves:
+    def test_parked_gang_never_partially_binds(self):
+        # 4 nodes x 2cpu = 16 slots of 500m; a 20-pod gang cannot fit
+        state = ClusterState.build([node(f"n{i:02d}", cpu="2")
+                                    for i in range(4)])
+        gang = [pod(f"g{i}", group="g1") for i in range(20)]
+        singles = [pod(f"s{i}") for i in range(4)]
+        algo = TPUScheduleAlgorithm(min_run=16)
+        hosts = algo.schedule_backlog(
+            gang + singles, state,
+            gangs=[{"start": 0, "length": 20}],
+        )
+        assert set(hosts[:20]) == {None}
+        # singletons behind the parked gang are NOT starved
+        assert all(h is not None for h in hosts[20:])
+
+    def test_fitting_gang_binds_every_member(self):
+        state = ClusterState.build([node(f"n{i:02d}", cpu="2")
+                                    for i in range(4)])
+        gang = [pod(f"g{i}", group="g1") for i in range(8)]
+        algo = TPUScheduleAlgorithm(min_run=16)
+        hosts = algo.schedule_backlog(
+            gang, state, gangs=[{"start": 0, "length": 8}])
+        assert all(h is not None for h in hosts)
+
+    def test_gang_probe_commit_o1_dispatches(self):
+        """Structural gate (test_slo 24-template style): doubling the
+        gang count must not grow the per-wave device dispatch count —
+        gangs ride the grouped probe/replay machinery like any run."""
+        state = ClusterState.build([node(f"n{i:02d}", cpu="64",
+                                         pods="500")
+                                    for i in range(8)])
+
+        def wave_of(n_gangs):
+            backlog, gangs = [], []
+            for g in range(n_gangs):
+                members = [
+                    pod(f"w{g}-{i}", cpu=f"{100 + (g % 3) * 50}m",
+                        group=f"grp{g}")
+                    for i in range(8)
+                ]
+                gangs.append({"start": len(backlog), "length": 8})
+                backlog += members
+            return backlog, gangs
+
+        counts = {}
+        for n_gangs in (4, 8):
+            algo = TPUScheduleAlgorithm(min_run=16)
+            backlog, gangs = wave_of(n_gangs)
+            hosts = algo.schedule_backlog(backlog, state, gangs=gangs)
+            assert all(h is not None for h in hosts)
+            d = algo._wave.dispatches
+            counts[n_gangs] = sum(d.values())
+            # every gang must have ridden the run machinery (grouped
+            # probe or probe), never the serial scan
+            assert d.get("scan", 0) == 0, d
+        assert counts[8] <= counts[4] + 1, (
+            f"dispatches grew with gang count: {counts}"
+        )
+        assert counts[8] <= 6, counts
+
+    def test_no_gang_config_bit_identical_to_oracle(self):
+        """Gang-labeled pods with the gang features OFF (no layout):
+        decisions match the serial oracle exactly — the default
+        profile is untouched by this subsystem (mixed-arrival
+        regression)."""
+        rng = random.Random(1414)
+        for trial in range(4):
+            nodes = [
+                node(f"n{i:02d}", cpu=str(rng.choice([1, 2, 4])))
+                for i in range(rng.randint(2, 6))
+            ]
+            state = ClusterState.build(nodes)
+            backlog = []
+            for t in range(rng.randint(1, 4)):
+                kind = rng.random()
+                n = rng.randint(1, 20)
+                if kind < 0.5:
+                    backlog += [
+                        pod(f"t{trial}-g{t}-{i}", cpu="300m",
+                            group=f"grp-{t}")
+                        for i in range(n)
+                    ]
+                else:
+                    backlog += [
+                        pod(f"t{trial}-s{t}-{i}",
+                            cpu=f"{200 + 100 * (t % 3)}m")
+                        for i in range(n)
+                    ]
+            want = oracle_backlog(state, backlog)
+            algo = TPUScheduleAlgorithm(min_run=8)
+            got = algo.schedule_backlog(backlog, state)
+            assert got == want, f"trial {trial} diverged"
+
+    def test_randomized_gang_fuzz_no_partial_binds(self):
+        """Property (c): under randomized gang mixes and capacities, a
+        gang either binds EVERY member or none, and singleton
+        placements never regress vs scheduling the singletons alone."""
+        rng = random.Random(77)
+        for trial in range(6):
+            n_nodes = rng.randint(2, 6)
+            cap = rng.choice([1, 2, 3])
+            state = ClusterState.build(
+                [node(f"n{i:02d}", cpu=str(cap))
+                 for i in range(n_nodes)]
+            )
+            backlog, gangs = [], []
+            for g in range(rng.randint(1, 4)):
+                size = rng.randint(2, 12)
+                gangs.append({"start": len(backlog), "length": size})
+                backlog += [
+                    pod(f"t{trial}-g{g}-{i}", cpu="600m",
+                        group=f"grp-{g}")
+                    for i in range(size)
+                ]
+            singles = [pod(f"t{trial}-s{i}", cpu="600m")
+                       for i in range(rng.randint(0, 4))]
+            # singletons first, like the director orders them
+            offset = len(singles)
+            for gd in gangs:
+                gd["start"] += offset
+            backlog = singles + backlog
+            algo = TPUScheduleAlgorithm(min_run=16)
+            hosts = algo.schedule_backlog(backlog, state, gangs=gangs)
+            for gd in gangs:
+                span = hosts[gd["start"]:gd["start"] + gd["length"]]
+                assert (all(h is not None for h in span)
+                        or all(h is None for h in span)), (
+                    f"trial {trial} partial bind: {span}"
+                )
+            # singleton placements match scheduling them alone (a
+            # parked gang consumed nothing)
+            algo2 = TPUScheduleAlgorithm(min_run=16)
+            alone = algo2.schedule_backlog(singles, state)
+            assert hosts[:offset] == alone
+
+    def test_gang_table_horizon_partial_continues_not_parks(self):
+        """A gang whose replay stops at the TABLE HORIZON (n_done < K
+        with every pick valid — reachable when one node absorbs a
+        whole compiled table depth of members) is NOT unfit: the
+        driver re-probes and continues the gang transactionally
+        instead of parking it as 'insufficient resources'."""
+        from kubernetes_tpu.models.wave import WaveScheduler
+        from kubernetes_tpu.snapshot.encode import SnapshotEncoder
+
+        # ONE huge node, gang of 200, max_j clamped to 128: the first
+        # replay horizon-bails at 128 picks on the node with fit still
+        # true, which before the horizon/unfit distinction parked the
+        # (entirely schedulable) gang
+        state = ClusterState.build(
+            [node("n00", cpu="400", pods="300")])
+        gang = [pod(f"h{i}", cpu="1000m", group="g1")
+                for i in range(200)]
+        enc = SnapshotEncoder(state, [gang[0]])
+        snap = enc.encode_nodes()
+        batch = enc.encode_pods()
+        rep_idx = np.zeros(200, np.int64)
+        w = WaveScheduler(min_run=16, max_j=128)
+        out, _carry, _L = w.schedule_backlog(
+            snap, batch, rep_idx,
+            gangs=[{"start": 0, "length": 200, "score_add": None}],
+        )
+        assert (out >= 0).all(), (
+            f"horizon-partial gang parked: "
+            f"{int((out >= 0).sum())}/200 placed"
+        )
+        # and a genuinely oversized gang on the same shape still parks
+        # wholesale (no partial binds through the horizon path)
+        gang2 = [pod(f"u{i}", cpu="1000m", group="g2")
+                 for i in range(500)]
+        enc2 = SnapshotEncoder(state, [gang2[0]])
+        snap2 = enc2.encode_nodes()
+        batch2 = enc2.encode_pods()
+        w2 = WaveScheduler(min_run=16, max_j=128)
+        out2, _c, _l = w2.schedule_backlog(
+            snap2, batch2, np.zeros(500, np.int64),
+            gangs=[{"start": 0, "length": 500, "score_add": None}],
+        )
+        assert (out2 < 0).all(), "oversized gang partially bound"
+
+    def test_het_score_steers_gang_to_fast_accelerator(self):
+        state = ClusterState.build([
+            node("slow-0", cpu="8"), node("slow-1", cpu="8"),
+            node("fast-0", cpu="8"),
+        ])
+        gang = [pod(f"g{i}", group="g1") for i in range(4)]
+        algo = TPUScheduleAlgorithm(min_run=16)
+        hosts = algo.schedule_backlog(
+            gang, state,
+            gangs=[{"start": 0, "length": 4,
+                    "score_by_name": {"fast-0": 1000}}],
+        )
+        assert set(hosts) == {"fast-0"}
+
+
+# -- preemption ---------------------------------------------------------------
+
+
+def _ref_victims_needed(prio, ordn, res, free, req, gang_prio):
+    """Numpy reference of the device scorer (the differential spec)."""
+    N, C = prio.shape
+    needed = np.full(N, -1, np.int64)
+    for n in range(N):
+        cands = [
+            (int(prio[n, c]), -int(ordn[n, c]), c)
+            for c in range(C) if prio[n, c] < gang_prio
+        ]
+        cands.sort()
+        f = free[n].astype(np.int64).copy()
+        if np.all(f >= req):
+            needed[n] = 0
+            continue
+        for k, (_p, _o, c) in enumerate(cands):
+            f += res[n, c]
+            if np.all(f >= req):
+                needed[n] = k + 1
+                break
+    return needed
+
+
+class TestVictimScorer:
+    def test_device_matches_numpy_reference_fuzz(self):
+        rng = np.random.RandomState(99)
+        scorer = VictimScorer()
+        for _ in range(5):
+            N, C = 8, 8
+            prio = rng.randint(0, 5, (N, C)).astype(np.int32)
+            prio[rng.rand(N, C) < 0.3] = INVALID_PRIO
+            ordn = rng.permutation(N * C).reshape(N, C).astype(np.int32)
+            res = rng.randint(0, 4, (N, C, 4)).astype(np.int64) * 250
+            free = rng.randint(0, 4, (N, 4)).astype(np.int64) * 250
+            req = np.array([500, 250, 0, 1], np.int64)
+            gang_prio = int(rng.randint(1, 6))
+            needed, cost, order = scorer.score(
+                prio, ordn, res, free, req, gang_prio)
+            want = _ref_victims_needed(prio, ordn, res, free, req,
+                                       gang_prio)
+            assert np.array_equal(needed.astype(np.int64), want)
+
+    def test_invariant_no_equal_or_higher_priority_victims_fuzz(self):
+        """Property (b): randomized clusters and priority mixes — the
+        planned victim set NEVER contains an equal-or-higher-priority
+        pod, and evictions only happen when they seat the whole
+        gang."""
+        rng = random.Random(1337)
+        for trial in range(6):
+            n_nodes = rng.randint(2, 5)
+            nodes = [node(f"n{i:02d}", cpu="4") for i in range(n_nodes)]
+            prios = [0, 10, 50, 100, 200]
+            pgs, bound = [], []
+            for g, pr in enumerate(prios):
+                pgs.append(PodGroup(
+                    metadata=ObjectMeta(name=f"grp-{g}"),
+                    spec=PodGroupSpec(min_member=1, priority=pr),
+                ))
+            for i in range(rng.randint(2, 10)):
+                g = rng.randrange(len(prios))
+                b = pod(f"b{trial}-{i}",
+                        cpu=f"{rng.choice([500, 1000, 2000])}m",
+                        group=f"grp-{g}",
+                        ts=f"2026-08-04T00:00:{i:02d}Z")
+                b.spec.node_name = f"n{rng.randrange(n_nodes):02d}"
+                bound.append(b)
+            state = ClusterState.build(nodes, assigned_pods=bound)
+            gang_prio = rng.choice([10, 50, 100, 200])
+            evicted = []
+            d = GangDirector(
+                pod_group_lister=lambda pgs=pgs: pgs,
+                preemptor=lambda vs: evicted.extend(vs),
+            )
+            members = [
+                pod(f"m{trial}-{i}", cpu="2000m", group="grp-hi")
+                for i in range(rng.randint(1, 4))
+            ]
+            entry = {"start": 0, "length": len(members),
+                     "key": ("default", "grp-hi"),
+                     "group": PodGroup(
+                         metadata=ObjectMeta(name="grp-hi"),
+                         spec=PodGroupSpec(priority=gang_prio)),
+                     "priority": gang_prio, "score_by_name": None}
+            d.after_wave(members, [None] * len(members), [entry], state)
+            pg_map = {("default", p.metadata.name): p for p in pgs}
+            for v in evicted:
+                assert d._priority_of(v, pg_map) < gang_prio, (
+                    f"trial {trial}: evicted {v.metadata.name} at "
+                    f"priority {d._priority_of(v, pg_map)} for a "
+                    f"priority-{gang_prio} gang"
+                )
+
+    def test_newest_first_tiebreak(self):
+        """Among equal-priority victims on one node, the newest pod
+        evicts first."""
+        nodes = [node("n00", cpu="2")]
+        old = pod("old", cpu="900m", group="low",
+                  ts="2026-08-04T00:00:01Z")
+        new = pod("new", cpu="900m", group="low",
+                  ts="2026-08-04T00:00:59Z")
+        old.spec.node_name = new.spec.node_name = "n00"
+        state = ClusterState.build(nodes, assigned_pods=[old, new])
+        pgs = [PodGroup(metadata=ObjectMeta(name="low"),
+                        spec=PodGroupSpec(priority=0))]
+        evicted = []
+        d = GangDirector(pod_group_lister=lambda: pgs,
+                         preemptor=lambda vs: evicted.extend(vs))
+        member = pod("m0", cpu="900m", group="hi")
+        entry = {"start": 0, "length": 1, "key": ("default", "hi"),
+                 "group": PodGroup(metadata=ObjectMeta(name="hi"),
+                                   spec=PodGroupSpec(priority=100)),
+                 "priority": 100, "score_by_name": None}
+        d.after_wave([member], [None], [entry], state)
+        assert [v.metadata.name for v in evicted] == ["new"]
+
+
+# -- director planning --------------------------------------------------------
+
+
+class TestDirectorPlanning:
+    def _director(self, pgs, statuses=None, evicted=None):
+        return GangDirector(
+            pod_group_lister=lambda: pgs,
+            status_updater=(
+                None if statuses is None
+                else lambda ns, n, s: statuses.append((n, s))
+            ),
+            preemptor=(
+                None if evicted is None
+                else lambda vs: evicted.extend(vs)
+            ),
+        )
+
+    def test_min_member_short_gang_parks_before_the_wave(self):
+        pgs = [PodGroup(metadata=ObjectMeta(name="g1"),
+                        spec=PodGroupSpec(min_member=4))]
+        statuses = []
+        d = self._director(pgs, statuses)
+        state = ClusterState.build([node("n00")])
+        wave = [pod("s0"), pod("g-0", group="g1"), pod("g-1", group="g1")]
+        backlog, layout, parked = d.plan_wave(wave, state)
+        assert [p.metadata.name for p in backlog] == ["s0"]
+        assert layout == [] and len(parked) == 2
+        assert all(isinstance(e, GangParked) for _p, e in parked)
+        assert "have 2 of minMember 4" in str(parked[0][1])
+        assert statuses[-1][1]["phase"] == "Parked"
+
+    def test_priority_orders_gangs_singletons_first(self):
+        pgs = [
+            PodGroup(metadata=ObjectMeta(name="lo"),
+                     spec=PodGroupSpec(min_member=1, priority=10)),
+            PodGroup(metadata=ObjectMeta(name="hi"),
+                     spec=PodGroupSpec(min_member=1, priority=100)),
+        ]
+        d = self._director(pgs)
+        state = ClusterState.build([node("n00")])
+        wave = ([pod(f"lo-{i}", group="lo") for i in range(2)]
+                + [pod("s0")]
+                + [pod(f"hi-{i}", group="hi") for i in range(2)])
+        backlog, layout, parked = d.plan_wave(wave, state)
+        names = [p.metadata.name for p in backlog]
+        assert names[0] == "s0"
+        assert names[1:3] == ["hi-0", "hi-1"]  # priority desc
+        assert names[3:] == ["lo-0", "lo-1"]
+        assert [(g["start"], g["length"]) for g in layout] == [
+            (1, 2), (3, 2)
+        ]
+        assert not parked
+
+    def test_wave_without_gangs_is_untouched(self):
+        d = self._director([])
+        state = ClusterState.build([node("n00")])
+        wave = [pod("a"), pod("b")]
+        backlog, layout, parked = d.plan_wave(wave, state)
+        assert backlog == wave and layout == [] and parked == []
+
+
+# -- end to end ---------------------------------------------------------------
+
+
+class TestGangEndToEnd:
+    def test_gang_lifecycle_with_tpu_daemon(self):
+        """One live session covers: atomic gang bind, minMember
+        parking with a readable status, and priority preemption
+        unparking a high-priority gang."""
+        from kubernetes_tpu.scheduler.server import (
+            SchedulerServer,
+            SchedulerServerOptions,
+        )
+
+        server, client = make_control_plane()
+        for i in range(2):
+            client.nodes().create(node(f"n{i}", cpu="2", pods="8"))
+        pgr = client.resource("podgroups", "default")
+        pgr.create(PodGroup(metadata=ObjectMeta(name="fit"),
+                            spec=PodGroupSpec(min_member=4)))
+        pgr.create(PodGroup(metadata=ObjectMeta(name="waiting"),
+                            spec=PodGroupSpec(min_member=3)))
+        client.resource("priorityclasses").create(PriorityClass(
+            metadata=ObjectMeta(name="urgent"), value=100))
+        pgr.create(PodGroup(
+            metadata=ObjectMeta(name="burst"),
+            spec=PodGroupSpec(min_member=2,
+                              priority_class_name="urgent")))
+        options = SchedulerServerOptions(
+            algorithm_provider=algorithmprovider.TPU_PROVIDER_NAME
+        )
+        srv = SchedulerServer(client, options).start()
+        try:
+            # 1) a fitting gang binds atomically
+            for i in range(4):
+                client.pods().create(pod(f"fit-{i}", cpu="400m",
+                                         group="fit"))
+            assert wait_until(
+                lambda: all(p.spec.node_name for p in
+                            client.pods().list(
+                                label_selector="app=fit")[0]),
+                timeout=40.0,
+            )
+            assert wait_until(
+                lambda: pgr.get("fit").status.phase == "Scheduled",
+                timeout=10.0,
+            )
+            # 2) a minMember-short gang parks with a readable status
+            client.pods().create(pod("waiting-0", cpu="100m",
+                                     group="waiting"))
+            assert wait_until(
+                lambda: pgr.get("waiting").status.phase == "Parked",
+                timeout=20.0,
+            )
+            st = pgr.get("waiting").status
+            assert "minMember 3" in st.message
+            assert client.pods().get("waiting-0").spec.node_name == ""
+            from kubernetes_tpu.kubectl.cmd import Kubectl
+
+            desc = Kubectl(client).describe("podgroups", "waiting")
+            assert "Parked:" in desc and "minMember 3" in desc
+            # 3) fill the cluster with low-priority pods, then a
+            # priority gang preempts its way in
+            filler = []
+            for i in range(2):
+                f = pod(f"filler-{i}", cpu="1200m")
+                client.pods().create(f)
+                filler.append(f.metadata.name)
+            assert wait_until(
+                lambda: all(
+                    client.pods().get(n).spec.node_name
+                    for n in filler
+                ),
+                timeout=20.0,
+            )
+            for i in range(2):
+                client.pods().create(pod(f"burst-{i}", cpu="1200m",
+                                         group="burst"))
+            # the fillers (priority 0) are evicted for the gang
+            assert wait_until(
+                lambda: all(
+                    not any(p.metadata.name == n
+                            for p in client.pods().list()[0])
+                    for n in filler
+                ),
+                timeout=30.0,
+            ), "low-priority fillers were not preempted"
+            assert wait_until(
+                lambda: all(p.spec.node_name for p in
+                            client.pods().list(
+                                label_selector="app=burst")[0]),
+                timeout=30.0,
+            ), "priority gang never bound after preemption"
+            from kubernetes_tpu.metrics import (
+                scheduler_preemption_victims_total,
+            )
+
+            assert scheduler_preemption_victims_total.total() >= 2
+        finally:
+            srv.stop()

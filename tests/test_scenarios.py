@@ -64,11 +64,16 @@ def test_noisy_neighbor_smoke():
     assert rec["flowcontrol"]["rejected_requests_total"] > 0
 
 
+@pytest.mark.slow
 def test_rack_failure_smoke():
     """A rack of hollow nodes vanishes mid-soak: the node-lifecycle
     controller completes the eviction wave under the declared SLO, the
     pow2 node bucket holds (zero recompiles), and arrivals keep
-    binding to the survivors."""
+    binding to the survivors.
+
+    Slow-marked (round 14 tier-1 budget reclaim): the 45s soak rides
+    the slow lane with the full forms; tier-1 keeps the
+    noisy-neighbor + burst smokes for the APF/soak interplay."""
     cfg = scenario_config("rack-failure", 45, smoke=True)
     rec = _run(cfg)
     acct = rec["scenario_accounting"]
@@ -78,10 +83,15 @@ def test_rack_failure_smoke():
     assert rec["steady_state_compiles"] == 0
 
 
+@pytest.mark.slow
 def test_rolling_update_smoke():
     """A multi-step RC roll v1->v2 through the real ReplicationManager
     completes under its SLO with every v2 replica bound, while soak
-    traffic keeps meeting the p99 gate."""
+    traffic keeps meeting the p99 gate.
+
+    Slow-marked (round 14 tier-1 budget reclaim): the 60s soak was the
+    heaviest tier-1 smoke; it rides the slow lane with the full
+    forms."""
     cfg = scenario_config("rolling-update", 60, smoke=True)
     rec = _run(cfg)
     acct = rec["scenario_accounting"]
