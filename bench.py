@@ -405,15 +405,18 @@ def run_soak(seconds: int):
 
 
 BENCH_FILE = "BENCH_r10.json"
+#: round-11 record: the --pack packing gates (optimizing vs greedy)
+BENCH_FILE_R11 = "BENCH_r11.json"
 
 
-def _bench_merge(update: dict) -> None:
-    """Merge `update` into BENCH_FILE: the headline run and the
-    wire-soak run each own their keys and neither clobbers the other's
-    record when run separately."""
+def _bench_merge(update: dict, path: str = None) -> None:
+    """Merge `update` into the bench record file: the headline run and
+    the wire-soak run each own their keys and neither clobbers the
+    other's record when run separately."""
+    path = path or BENCH_FILE
     rec = {}
     try:
-        with open(BENCH_FILE) as f:
+        with open(path) as f:
             rec = json.load(f)
         if not isinstance(rec, dict):
             rec = {}
@@ -421,11 +424,11 @@ def _bench_merge(update: dict) -> None:
         rec = {}
     rec.update(update)
     try:
-        with open(BENCH_FILE, "w") as f:
+        with open(path, "w") as f:
             json.dump(rec, f, indent=1)
             f.write("\n")
     except OSError as e:
-        print(f"# {BENCH_FILE} write failed: {e}", file=sys.stderr)
+        print(f"# {path} write failed: {e}", file=sys.stderr)
 
 
 def _assert_sanitizers_off():
@@ -1100,6 +1103,182 @@ def run_train_cluster(slo_bound_s: float = 30.0) -> dict:
     return record
 
 
+def _pack_config2(smoke: bool):
+    """Packed heterogeneous-request config (the config-2 shape, filled
+    past stranding): complementary 1-CPU and 3-CPU templates arrive
+    interleaved, total demand == total capacity. Greedy FIFO +
+    LeastRequested spreads the small pods across every node until no
+    node keeps 3 CPUs contiguous and the big tail strands; joint
+    packing seats big-first and fills the gaps."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from kubernetes_tpu.models.batch import SchedulerConfig as DevCfg
+
+    n_nodes = 64 if smoke else 1000
+    state, _ = build(n_nodes, 1)
+
+    def het(name, cpu, mem):
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": cpu, "memory": mem})]),
+        )
+
+    pods = []
+    for i in range(n_nodes):
+        # two small-template variants keep the wave multi-template
+        pods.append(het(f"small-{i:05d}", "1000m",
+                        "1Gi" if i % 2 else "2Gi"))
+        pods.append(het(f"big-{i:05d}", "3000m", "3Gi"))
+    config = DevCfg(
+        predicates=("PodFitsResources",),
+        priorities=(("LeastRequestedPriority", 1),
+                    ("BalancedResourceAllocation", 1)),
+    )
+    return state, pods, config, n_nodes * 4000
+
+
+def _pack_config4(smoke: bool):
+    """Packed zoned-spread config (the config-4 shape): two RC
+    templates with complementary sizes over zoned nodes under the
+    default provider (SelectorSpread active). Same stranding mechanism
+    as pack_config2, with the spread term pulling greedy placement
+    even flatter."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        ReplicationController,
+        ReplicationControllerSpec,
+    )
+    from kubernetes_tpu.oracle import ClusterState
+
+    n_nodes = 48 if smoke else 999
+    zones = ("a", "b", "c")
+    nodes = [
+        Node(
+            metadata=ObjectMeta(
+                name=f"znode-{i:05d}",
+                labels={
+                    "kubernetes.io/hostname": f"znode-{i:05d}",
+                    "failure-domain.beta.kubernetes.io/zone":
+                    zones[i % 3],
+                },
+            ),
+            status=NodeStatus(
+                allocatable={"cpu": "4", "memory": "32Gi", "pods": "110"},
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        for i in range(n_nodes)
+    ]
+    rcs, pods = [], []
+    for tag, cpu, mem in (("small", "1000m", "1Gi"),
+                          ("big", "3000m", "3Gi")):
+        lbl = {"rc": f"rc-{tag}"}
+        rcs.append(ReplicationController(
+            metadata=ObjectMeta(name=f"rc-{tag}"),
+            spec=ReplicationControllerSpec(selector=dict(lbl)),
+        ))
+    for i in range(n_nodes):
+        pods.append(Pod(
+            metadata=ObjectMeta(name=f"rcs-{i:05d}",
+                                labels={"rc": "rc-small"}),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": "1000m", "memory": "1Gi"})]),
+        ))
+        pods.append(Pod(
+            metadata=ObjectMeta(name=f"rcb-{i:05d}",
+                                labels={"rc": "rc-big"}),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": "3000m", "memory": "3Gi"})]),
+        ))
+    state = ClusterState.build(nodes, controllers=rcs)
+    return state, pods, None, n_nodes * 4000
+
+
+def run_pack(smoke: bool = False, write: bool = True) -> dict:
+    """The --pack packing gates (round 15): on packed heterogeneous
+    configs 2/4, the optimizing profile
+    (KUBERNETES_TPU_PROFILE=optimizing) must STRICTLY improve both the
+    schedulable-pod count and the packed-cluster utilization vs the
+    default greedy profile, at the same O(1)-dispatches-per-wave
+    budget. Records land in BENCH_r11.json; exit non-zero on a gate
+    breach. The full form runs ~1k nodes (slow-marked in CI); the
+    smoke form is tier-1 sized."""
+    _assert_sanitizers_off()
+    from kubernetes_tpu.native.build import ensure_all
+    from kubernetes_tpu.scheduler.tpu_algorithm import (
+        TPUScheduleAlgorithm,
+    )
+
+    ensure_all()
+    record = {}
+    all_ok = True
+    for key, builder in (("pack_config2", _pack_config2),
+                         ("pack_config4", _pack_config4)):
+        arms = {}
+        for prof in ("greedy", "optimizing"):
+            state, pods, config, alloc_mcpu = builder(smoke)
+            algo = TPUScheduleAlgorithm(config=config, profile=prof)
+            t0 = time.time()
+            hosts = algo.schedule_backlog(pods, state)
+            dt = time.time() - t0
+            placed_mcpu = sum(
+                int(str(p.spec.containers[0].requests["cpu"]
+                        ).rstrip("m"))
+                for p, h in zip(pods, hosts) if h is not None
+            )
+            driver = algo._opt if prof == "optimizing" else algo._wave
+            arms[prof] = {
+                "scheduled": sum(1 for h in hosts if h is not None),
+                "pods": len(pods),
+                "utilization": round(placed_mcpu / alloc_mcpu, 4),
+                "wall_s": round(dt, 2),
+                "dispatches": dict(driver.dispatches),
+                "dispatches_total": sum(driver.dispatches.values()),
+            }
+        g, o = arms["greedy"], arms["optimizing"]
+        gates = {
+            "schedulable_count_strictly_improves":
+                o["scheduled"] > g["scheduled"],
+            "packed_utilization_strictly_improves":
+                o["utilization"] > g["utilization"],
+            # the O(1) budget: a constant dispatch count per wave for
+            # BOTH profiles, independent of template/pod count
+            "o1_dispatch_budget": (o["dispatches_total"] <= 6
+                                   and g["dispatches_total"] <= 6),
+        }
+        all_ok = all_ok and all(gates.values())
+        record[key] = {
+            "smoke": smoke,
+            "greedy": g,
+            "optimizing": o,
+            "gates": gates,
+        }
+        print(f"# {key}: greedy {g['scheduled']}/{g['pods']} pods "
+              f"util {g['utilization']:.3f} | optimizing "
+              f"{o['scheduled']}/{o['pods']} util "
+              f"{o['utilization']:.3f} | gates "
+              f"{'PASS' if all(gates.values()) else 'FAIL'}",
+              file=sys.stderr)
+    record["all_gates_pass"] = all_ok
+    if write:
+        _bench_merge({"pack": record}, path=BENCH_FILE_R11)
+    print(json.dumps({"metric": "pack_gates", **record}))
+    if not all_ok:
+        raise SystemExit("--pack gates failed")
+    return record
+
+
 def _cli():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -1208,7 +1387,25 @@ def _cli():
         help="p95 time-to-full-gang-bound SLO for --train-cluster "
              "(default 30s on the 1-core CI box)",
     )
+    ap.add_argument(
+        "--pack", action="store_true",
+        help="run the packing gates instead of the headline: on packed "
+             "heterogeneous configs 2/4 the optimizing profile "
+             "(KUBERNETES_TPU_PROFILE=optimizing) must strictly "
+             "improve schedulable-pod count AND packed utilization vs "
+             "the default greedy profile at the same O(1)-dispatches-"
+             "per-wave budget. Records land in BENCH_r11.json; exits "
+             "non-zero on a gate breach.",
+    )
+    ap.add_argument(
+        "--pack-smoke", action="store_true",
+        help="with --pack: the tier-1-sized parameter set instead of "
+             "the ~1k-node full form",
+    )
     args = ap.parse_args()
+    if args.pack or args.pack_smoke:
+        run_pack(smoke=args.pack_smoke)
+        return
     if args.train_cluster:
         run_train_cluster(slo_bound_s=args.train_cluster_slo)
         return

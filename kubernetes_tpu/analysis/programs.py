@@ -335,10 +335,85 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
               "lowest-priority-first / fewest-victims / newest-first",
     ))
 
+    # optimizing profile: the joint-assignment solvers
+    # (scheduler/optimizer/ops/assign.py). Integer-only, scatter-free
+    # by construction (the empty scatter_allowed set asserts it), and
+    # ONE host-bound array per dispatch — the O(1)-dispatches-per-wave
+    # budget the profile claims is this transfer contract.
+    specs.extend(_assign_programs(snap, N))
+
     if include_mesh:
         specs.extend(_mesh_programs(config, snap, batch, layout,
                                     buf_host, carry_leaves))
     return specs
+
+
+def _assign_args(N: int, P: int = 16):
+    """Representative solver operands over an N-node cluster: P slots,
+    two complementary request shapes (the packing case the profile
+    exists for)."""
+    rng = np.random.RandomState(7)
+    fit = np.ones((P, N), bool)
+    fit[:, N - 1] = False  # one unschedulable (padded-like) node
+    score = rng.randint(0, 20, size=(P, N)).astype(np.int64)
+    req = np.zeros((P, 4), np.int64)
+    req[:, 0] = np.where(np.arange(P) % 2 == 0, 1000, 3000)
+    req[:, 1] = np.int64(1) << 30
+    req[:, 3] = 1
+    commit = req.copy()
+    check = np.ones((P, 4), bool)
+    cap = np.zeros((N, 4), np.int64)
+    cap[:, 0] = 4000
+    cap[:, 1] = np.int64(32) << 30
+    cap[:, 3] = 110
+    prio = np.zeros(P, np.int32)
+    order = np.arange(P, dtype=np.int32)
+    return fit, score, req, commit, check, cap, prio, order
+
+
+def _assign_programs(snap, N: int) -> List[ProgramSpec]:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.scheduler.optimizer.ops.assign import (
+        _auction_assign_fn,
+        _beam_assign_fn,
+        auction_rounds,
+    )
+
+    P = 16
+    fit, score, req, commit, check, cap, prio, order = _assign_args(N, P)
+    rounds = auction_rounds(P, N)
+    return [
+        ProgramSpec(
+            name="assign_auction",
+            fn=jax.jit(functools.partial(_auction_assign_fn, rounds)),
+            args=(jnp.asarray(fit), jnp.asarray(score),
+                  jnp.asarray(req), jnp.asarray(commit),
+                  jnp.asarray(check), jnp.asarray(cap),
+                  jnp.asarray(prio), jnp.asarray(order),
+                  jnp.int64(8)),
+            carry_out_leaves=0,
+            expected_host_leaves=1,  # owner[P]
+            scatter_allowed=(),  # scatter-free: one-hot winner max
+            notes="optimizing-profile auction solver: epsilon-scaled "
+                  "bidding rounds as one lax.scan dispatch",
+        ),
+        ProgramSpec(
+            name="assign_beam",
+            fn=jax.jit(functools.partial(_beam_assign_fn, 4, 4)),
+            args=(jnp.asarray(fit), jnp.asarray(score),
+                  jnp.asarray(req), jnp.asarray(commit),
+                  jnp.asarray(check), jnp.asarray(cap)),
+            carry_out_leaves=0,
+            expected_host_leaves=1,  # owner[P]
+            scatter_allowed=(),
+            notes="optimizing-profile top-K beam solver (small waves): "
+                  "one lax.scan over slots in solve order",
+        ),
+    ]
 
 
 def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
@@ -480,6 +555,53 @@ def _mesh_programs(config, snap, batch, pod_layout, pod_buf_host,
                          ("scatter-add", (1,))),
         notes="sharded grouped commit fold, scatter-form counts, "
               "donated resident carry",
+    ))
+    # the optimizing profile's auction solver, pjit'd over the node
+    # axis: the [slots x nodes] tensors shard like every other node-
+    # axis program, slot-axis operands replicate, and the owner vector
+    # comes back replicated (ONE host-bound array — the same transfer
+    # contract as the single-chip form)
+    import functools
+
+    from kubernetes_tpu.scheduler.optimizer.ops.assign import (
+        _auction_assign_fn,
+        auction_rounds,
+    )
+
+    P_a = 16
+    (a_fit, a_score, a_req, a_commit, a_check, a_cap, a_prio,
+     a_order) = _assign_args(n, P_a)
+    a_rounds = auction_rounds(P_a, n)
+    assign_in = (
+        PSpec(None, M.AXIS),  # fit [P, N]
+        PSpec(None, M.AXIS),  # score [P, N]
+        PSpec(),              # req [P, 4]
+        PSpec(),              # commit [P, 4]
+        PSpec(),              # check [P, 4]
+        PSpec(M.AXIS, None),  # cap [N, 4]
+        PSpec(),              # prio [P]
+        PSpec(),              # order [P]
+        PSpec(),              # eps0 scalar
+    )
+    from jax.sharding import NamedSharding
+
+    mesh_assign = jax.jit(
+        functools.partial(_auction_assign_fn, a_rounds),
+        in_shardings=tuple(NamedSharding(mesh, s) for s in assign_in),
+        out_shardings=NamedSharding(mesh, PSpec()),
+    )
+    specs.append(ProgramSpec(
+        name="mesh_assign_auction",
+        fn=mesh_assign,
+        args=(a_fit, a_score, a_req, a_commit, a_check, a_cap, a_prio,
+              a_order, np.int64(8)),
+        carry_out_leaves=0,
+        expected_host_leaves=1,
+        arg_shardings=assign_in,
+        out_shardings_decl=PSpec(),
+        scatter_allowed=(),
+        notes="optimizing-profile auction solver, node-axis sharded "
+              "(mesh variant)",
     ))
     specs.append(_resident_scatter_program(mesh, config, snap_p, n,
                                            n_per_shard))

@@ -39,6 +39,7 @@ from kubernetes_tpu.controller.serviceaccount import (
     ServiceAccountsController,
     TokensController,
 )
+from kubernetes_tpu.controller.podgroup import PodGroupStatusController
 from kubernetes_tpu.controller.pv_binder import PersistentVolumeClaimBinder
 from kubernetes_tpu.controller.replication import (
     ReplicationManager,
@@ -76,6 +77,7 @@ class ControllerManagerOptions:
         "serviceaccount",
         "serviceaccount-token",
         "attachdetach",
+        "podgroup",
     )  # hpa omitted by default: it needs a metrics client
     # the --service-account-private-key-file analogue: the tokens
     # controller only runs with a signing key
@@ -131,6 +133,8 @@ class ControllerManager:
             client, self.informers))
         add("pv-binder", lambda: PersistentVolumeClaimBinder(
             client, self.informers))
+        add("podgroup", lambda: PodGroupStatusController(
+            client, self.informers, rec("podgroup-controller")))
         add("serviceaccount", lambda: ServiceAccountsController(
             client, self.informers))
         add("attachdetach", lambda: AttachDetachController(

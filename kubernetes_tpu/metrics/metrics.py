@@ -381,7 +381,7 @@ scheduler_gangs_scheduled_total = registry.register(
 )
 
 #: gangs parked (insufficient members or no all-member placement),
-#: labeled by reason (members | resources | preempting)
+#: labeled by reason (members | resources | preempting | backoff)
 scheduler_gangs_parked_total = registry.register(
     Counter(
         "scheduler_gangs_parked_total",
@@ -394,6 +394,55 @@ scheduler_preemption_victims_total = registry.register(
     Counter(
         "scheduler_preemption_victims_total",
         "Victim pods evicted by gang priority preemption",
+    )
+)
+
+#: optimizing-profile waves (KUBERNETES_TPU_PROFILE=optimizing),
+#: labeled by the solver that ran (auction | beam | none)
+scheduler_optimizer_waves_total = registry.register(
+    Counter(
+        "scheduler_optimizer_waves_total",
+        "Waves driven by the optimizing (joint-packing) profile, "
+        "by solver",
+    )
+)
+
+#: optimizer placements the host-side serial-predicate re-validation
+#: rejected (the pod fell back to the greedy scan), by reason
+#: (predicate | unassigned | gang)
+scheduler_optimizer_fallbacks_total = registry.register(
+    Counter(
+        "scheduler_optimizer_fallbacks_total",
+        "Optimizer placements rejected by host re-validation and "
+        "routed to the greedy fallback, by reason",
+    )
+)
+
+#: placements the optimizer committed (validated against the serial
+#: predicates before any bind)
+scheduler_optimizer_placements_total = registry.register(
+    Counter(
+        "scheduler_optimizer_placements_total",
+        "Pod placements committed by the joint assignment solver",
+    )
+)
+
+#: defragmentation migrations executed (evict through the batch door +
+#: assigned re-create), bounded per cycle by KUBERNETES_TPU_DEFRAG_BUDGET
+defrag_migrations_total = registry.register(
+    Counter(
+        "defrag_migrations_total",
+        "Pods migrated by the idle-cycle defragmentation controller",
+    )
+)
+
+#: last measured cluster fragmentation (stranded free capacity /
+#: total free capacity, 0..1)
+defrag_fragmentation_ratio = registry.register(
+    Gauge(
+        "defrag_fragmentation_ratio",
+        "Stranded fraction of free cluster capacity at the last "
+        "defrag measurement",
     )
 )
 

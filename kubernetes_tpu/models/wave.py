@@ -855,6 +855,44 @@ class WaveScheduler:
                 K: int) -> Tuple[int, int]:
         return pick_j(self.config, self.max_j, snap, batch, rep, K)
 
+    def _wave_setup(self, snap: ClusterSnapshot, keep: frozenset,
+                    source: str, last_node_index: int):
+        """Per-wave device placement shared by the greedy driver and
+        the optimizing profile (scheduler/optimizer/profile.py):
+        -> (static, carry, num_zones, num_values). Resets the per-wave
+        dispatch tally and the device field cache on a snapshot
+        producer change."""
+        if source != self._dev_source:
+            self._dev.clear()
+            self._dev_source = source
+        self.dispatches = {}
+        res_host = np.stack([
+            np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
+            np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
+            np.asarray(snap.nz_mem), np.asarray(snap.pod_count),
+        ])
+        dev = self._to_dev_many(
+            snap,
+            tuple(BatchScheduler.STATIC_FIELDS) + self._CARRY_FIELDS,
+            keep,
+            extra={"__res__": res_host,
+                   "__lidx__": np.int64(last_node_index)},
+        )
+        static = {f: dev[f] for f in BatchScheduler.STATIC_FIELDS}
+        # config-resolved node masks are HOST arrays: place them once
+        # per wave (a numpy leaf in `static` would re-upload at every
+        # per-run probe/apply dispatch)
+        static.update({
+            k: jnp.asarray(v)
+            for k, v in BatchScheduler.config_static(
+                self.config, snap).items()
+        })
+        num_zones = max(
+            int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
+        )
+        num_values = int(snap.svc_num_values)
+        return static, self._carry_from(dev), num_zones, num_values
+
     def schedule_backlog(
         self,
         snap: ClusterSnapshot,
@@ -887,37 +925,9 @@ class WaveScheduler:
         applies an unconditional post-hoc all-or-nothing check over
         the returned hosts before anything binds. None/[] = no gangs,
         and the wave is bit-identical to the pre-gang driver."""
-        if source != self._dev_source:
-            self._dev.clear()
-            self._dev_source = source
-        self.dispatches = {}
+        static, carry, num_zones, num_values = self._wave_setup(
+            snap, keep, source, last_node_index)
         P = len(rep_idx)
-        res_host = np.stack([
-            np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
-            np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
-            np.asarray(snap.nz_mem), np.asarray(snap.pod_count),
-        ])
-        dev = self._to_dev_many(
-            snap,
-            tuple(BatchScheduler.STATIC_FIELDS) + self._CARRY_FIELDS,
-            keep,
-            extra={"__res__": res_host,
-                   "__lidx__": np.int64(last_node_index)},
-        )
-        static = {f: dev[f] for f in BatchScheduler.STATIC_FIELDS}
-        # config-resolved node masks are HOST arrays: place them once
-        # per wave (a numpy leaf in `static` would re-upload at every
-        # per-run probe/apply dispatch)
-        static.update({
-            k: jnp.asarray(v)
-            for k, v in BatchScheduler.config_static(
-                self.config, snap).items()
-        })
-        num_zones = max(
-            int(snap.zone_id.max()) + 1 if snap.zone_id.size else 1, 1
-        )
-        num_values = int(snap.svc_num_values)
-        carry = self._carry_from(dev)
         out = np.full(P, -1, np.int32)
         perm = np.asarray(snap.name_desc_order).astype(np.int64)
         N = snap.num_nodes

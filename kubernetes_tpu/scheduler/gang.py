@@ -37,6 +37,7 @@ profile stays bit-identical to the serial oracle).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -78,13 +79,27 @@ class GangDirector:
         accel_label_key: str = "accelerator",
         het_weight: int = 1,
         recorder=None,
+        backoff_initial: float = 2.0,
+        backoff_max: float = 30.0,
+        clock=time.monotonic,
     ):
         """pod_group_lister() -> iterable[PodGroup];
         status_updater(namespace, name, status_dict) PATCHes the
         PodGroup status subresource; preemptor(victim_pods) evicts
         through the batch door; throughput is the per-accelerator-type
         matrix {workload_class: {accel_type: normalized_throughput}}
-        with node types read from the ``accel_label_key`` node label."""
+        with node types read from the ``accel_label_key`` node label.
+
+        backoff_initial/backoff_max: per-gang exponential re-probe
+        backoff after a resource park. A perpetually-unfit giant gang
+        used to re-enter every wave (one full probe/replay per wave —
+        cheap per gang, measurable at high gang counts); now it sits
+        out doubling windows, capped at ``backoff_max`` seconds — the
+        starvation cap: every gang re-probes at least that often, so a
+        freed-up cluster is noticed within one cap interval. A gang
+        parked for preemption retries NEXT wave (the evictions just
+        paid for that retry), and a successful schedule clears the
+        backoff."""
         self.pod_group_lister = pod_group_lister
         self.status_updater = status_updater
         self.preemptor = preemptor
@@ -92,6 +107,11 @@ class GangDirector:
         self.accel_label_key = accel_label_key
         self.het_weight = max(0, int(het_weight))
         self.recorder = recorder
+        self.backoff_initial = float(backoff_initial)
+        self.backoff_max = float(backoff_max)
+        self._clock = clock
+        #: (ns, gang) -> (current delay seconds, earliest next attempt)
+        self._backoff: Dict[Tuple[str, str], Tuple[float, float]] = {}
         self._scorer = VictimScorer()
 
     # -- wave planning --------------------------------------------------------
@@ -160,6 +180,13 @@ class GangDirector:
         if not groups:
             return list(wave), [], []
         pg_map = self._pg_map()
+        # prune backoff state for deleted PodGroups: a gang recreated
+        # under the same name must not inherit a stale delay, and the
+        # dict must not grow with gang churn
+        if pg_map:
+            for key in list(self._backoff):
+                if key not in pg_map:
+                    del self._backoff[key]
         parked: List[Tuple[Pod, Exception]] = []
         ready: List[Tuple[int, int, tuple, object, List[Pod]]] = []
         for key, members in groups.items():
@@ -182,6 +209,16 @@ class GangDirector:
                 scheduler_gangs_parked_total.inc(reason="members")
                 self._park_status(ns, gname, pg, members, msg,
                                   reason="members")
+                continue
+            ent = self._backoff.get(key)
+            if ent is not None and self._clock() < ent[1]:
+                # resource-parked recently: sit this wave out instead
+                # of re-probing (exponential, capped at backoff_max —
+                # the starvation cap)
+                msg = (f"gang backing off {ent[0]:.0f}s after a "
+                       "resource park; will re-probe by the cap")
+                parked += [(p, GangParked(msg)) for p in members]
+                scheduler_gangs_parked_total.inc(reason="backoff")
                 continue
             ready.append((int(pg.spec.priority), arrival[key], key, pg,
                           members))
@@ -222,6 +259,7 @@ class GangDirector:
             members = list(backlog[s:s + n])
             if all(h is not None for h in span):
                 scheduler_gangs_scheduled_total.inc()
+                self._backoff.pop(entry["key"], None)
                 total = self._bound_members(state, ns, gname) + n
                 self._update_status(ns, gname, {
                     "phase": "Scheduled",
@@ -244,11 +282,18 @@ class GangDirector:
                 msg = (f"preempting {preempted} lower-priority pods "
                        f"for gang {gname!r}; retrying next wave")
                 reason = "preempting"
+                # the evictions paid for an immediate retry
+                self._backoff.pop(entry["key"], None)
             else:
                 msg = (f"gang parked: {len(unsched)} of {n} members "
                        "unschedulable (insufficient resources); no "
                        "partial binds")
                 reason = "resources"
+                prev = self._backoff.get(entry["key"])
+                delay = self.backoff_initial if prev is None else min(
+                    prev[0] * 2, self.backoff_max)
+                self._backoff[entry["key"]] = (
+                    delay, self._clock() + delay)
             scheduler_gangs_parked_total.inc(reason=reason)
             self._park_status(ns, gname, pg, members, msg,
                               reason=reason, unschedulable=unsched,

@@ -1,0 +1,1 @@
+"""Controllers of the optimizing profile (defragmentation)."""

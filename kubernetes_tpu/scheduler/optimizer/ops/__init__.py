@@ -1,0 +1,1 @@
+"""Device programs of the optimizing profile (joint assignment)."""

@@ -46,17 +46,36 @@ def _ids_to_names(chosen, node_names, n_real) -> List[Optional[str]]:
 class TPUScheduleAlgorithm:
     def __init__(self, mesh=None, min_run: int = 16, cache=None,
                  service_lister=None, controller_lister=None,
-                 replica_set_lister=None, config=None, replay=None):
+                 replica_set_lister=None, config=None, replay=None,
+                 profile=None):
         """config: a models/batch SchedulerConfig overriding the default
         provider — the device end of a resolved Policy file
         (factory.go:266 CreateFromConfig). replay overrides the wave
-        replay engine (testing seam; also disables the device replay)."""
+        replay engine (testing seam; also disables the device replay).
+        profile picks the wave driver: "greedy" (default; bit-identical
+        to the serial oracle) or "optimizing" (the joint-packing
+        profile, scheduler/optimizer); None reads
+        KUBERNETES_TPU_PROFILE."""
         # compile-vs-execute attribution: listening before any program
         # compiles means the first jit of every shape lands in
         # scheduler_xla_compile_seconds, not in a phase histogram
         trace_profile.install_compile_listener()
+        from kubernetes_tpu.scheduler.optimizer import (
+            PROFILE_OPTIMIZING,
+            active_profile,
+        )
+
+        self._profile = active_profile(profile)
+        self._opt = None
         self._mesh_sched = None
         self._inc = None
+        if mesh is not None and self._profile == PROFILE_OPTIMIZING:
+            # the optimizing profile is single-chip for now; the mesh
+            # path keeps the greedy driver (its resident-state grouped
+            # machinery) rather than silently changing semantics
+            log.warning("KUBERNETES_TPU_PROFILE=optimizing is not "
+                        "supported on the mesh driver; using greedy")
+            self._profile = "greedy"
         if mesh is not None:
             from kubernetes_tpu.parallel.mesh import MeshWaveScheduler
 
@@ -440,7 +459,16 @@ class TPUScheduleAlgorithm:
                     "start": g["start"], "length": g["length"],
                     "score_add": add,
                 })
-        chosen, _final, last = self._wave.schedule_backlog(
+        driver = self._wave
+        if self._profile == "optimizing":
+            if self._opt is None:
+                from kubernetes_tpu.scheduler.optimizer.profile import (
+                    OptimizingWaveDriver,
+                )
+
+                self._opt = OptimizingWaveDriver(self._wave)
+            driver = self._opt
+        chosen, _final, last = driver.schedule_backlog(
             snap, batch, rep_idx, last_node_index=self._last_node_index,
             keep=keep, source=source, gangs=wave_gangs,
         )

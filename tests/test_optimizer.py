@@ -1,0 +1,768 @@
+"""Optimizing profile & defragmentation (round 15).
+
+Covers the ISSUE-15 contract:
+
+* Solver safety: the auction and beam programs never propose a slot
+  outside its fit mask or past per-node multi-resource capacity
+  (randomized fuzz against a numpy re-check).
+* Profile safety: every placement the optimizing profile commits
+  passes the serial oracle's predicates (randomized fuzz, >=8 seeds);
+  ineligible features (inter-pod terms, volumes, ports) route to the
+  serial-equivalent scan and never crash the profile.
+* Gang atomicity under the optimizer: an unfittable gang never
+  partially binds; a fittable one binds whole.
+* O(1) dispatches per wave regardless of template count.
+* Strict improvement: the --pack smoke gates (schedulable count AND
+  packed utilization vs greedy) pass at tier-1 size; the full ~1k-node
+  forms are slow-marked.
+* Defragmentation: proposal quality, the never-reduce-schedulability
+  invariant (fuzz), the equal-or-higher-priority protection, busy
+  backoff, and end-to-end evict+rebind through the batch door.
+* PodGroup status reconciliation while the scheduler is down.
+* Gang-level exponential backoff with the starvation cap.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    POD_GROUP_LABEL,
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodSpec,
+    shallow_copy,
+)
+from kubernetes_tpu.models.batch import SchedulerConfig as DevCfg
+from kubernetes_tpu.oracle import ClusterState, GenericScheduler
+from kubernetes_tpu.scheduler.optimizer import (
+    PROFILE_GREEDY,
+    PROFILE_OPTIMIZING,
+    active_profile,
+)
+from kubernetes_tpu.scheduler.optimizer.controller import defrag as D
+from kubernetes_tpu.scheduler.optimizer.ops.assign import (
+    AssignSolver,
+    auction_rounds,
+)
+from kubernetes_tpu.scheduler.tpu_algorithm import TPUScheduleAlgorithm
+
+from tests.test_conformance import (
+    ORACLE_PREDICATES,
+    ORACLE_PRIORITIES,
+    random_scenario,
+)
+
+_SANITIZED = bool(os.environ.get("KUBERNETES_TPU_RACE_SANITIZER"))
+
+
+def node(name, cpu="4", mem="32Gi", pods="110", labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": pods},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def pod(name, cpu, mem="1Gi", labels=None, node_name=None):
+    p = Pod(
+        metadata=ObjectMeta(name=name, labels=labels or {"app": "x"}),
+        spec=PodSpec(containers=[Container(
+            requests={"cpu": cpu, "memory": mem})]),
+    )
+    if node_name:
+        p.spec.node_name = node_name
+    return p
+
+
+LRBA = DevCfg(
+    predicates=("PodFitsResources",),
+    priorities=(("LeastRequestedPriority", 1),
+                ("BalancedResourceAllocation", 1)),
+)
+
+
+def interleaved_pack(n):
+    """The stranding workload: complementary 1-CPU / 3-CPU templates
+    arriving interleaved over n 4-CPU nodes (demand == capacity)."""
+    pods = []
+    for i in range(n):
+        pods.append(pod(f"small-{i:04d}", "1000m"))
+        pods.append(pod(f"big-{i:04d}", "3000m", "3Gi"))
+    return pods
+
+
+# -- profile flag -------------------------------------------------------------
+
+
+class TestProfileFlag:
+    def test_default_and_override(self, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_TPU_PROFILE", raising=False)
+        assert active_profile() == PROFILE_GREEDY
+        monkeypatch.setenv("KUBERNETES_TPU_PROFILE", "optimizing")
+        assert active_profile() == PROFILE_OPTIMIZING
+        assert active_profile("greedy") == PROFILE_GREEDY
+
+    def test_unknown_falls_back_to_greedy(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_TPU_PROFILE", "simulated-annealing")
+        assert active_profile() == PROFILE_GREEDY
+
+
+# -- solver fuzz --------------------------------------------------------------
+
+
+def _check_solution(owner, fit, req, check, cap):
+    """numpy re-check: every assignment inside the fit mask, cumulative
+    per-node usage inside capacity for every checked resource row."""
+    used = np.zeros_like(cap)
+    for s, n in enumerate(owner):
+        n = int(n)
+        if n < 0:
+            continue
+        assert fit[s, n], f"slot {s} assigned outside its fit mask"
+        lhs = used[n] + req[s]
+        ok = (lhs <= cap[n]) | ~check[s]
+        assert ok.all(), f"slot {s} overflows node {n}"
+        used[n] += req[s]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_solver_fuzz_respects_fit_and_capacity(seed):
+    rng = np.random.RandomState(seed)
+    P = int(rng.choice([8, 24, 48]))
+    N = int(rng.choice([8, 16]))
+    fit = rng.rand(P, N) > 0.2
+    score = rng.randint(0, 40, size=(P, N)).astype(np.int64)
+    req = np.zeros((P, 4), np.int64)
+    req[:, 0] = rng.choice([500, 1000, 2000, 3000], size=P)
+    req[:, 1] = rng.choice([1, 2, 3], size=P) * (1 << 30)
+    req[:, 3] = 1
+    commit = req.copy()
+    check = np.ones((P, 4), bool)
+    zero = rng.rand(P) < 0.1
+    check[zero, :3] = False
+    # the encoder's invariant: zero_req means the request vector IS
+    # zero (the flag only preserves the predicate's skip-order quirk)
+    req[zero, :3] = 0
+    commit = req.copy()
+    cap = np.zeros((N, 4), np.int64)
+    cap[:, 0] = rng.choice([2000, 4000, 8000], size=N)
+    cap[:, 1] = rng.choice([4, 8, 32], size=N) * (1 << 30)
+    cap[:, 3] = rng.choice([2, 5, 110], size=N)
+    prio = np.zeros(P, np.int32)
+    order = np.arange(P, dtype=np.int32)
+    solver = AssignSolver()
+    owner, name = solver.solve(fit, score, req, commit, check, cap,
+                               prio, order, P)
+    assert name in ("auction", "beam")
+    _check_solution(owner, fit, req, check, cap)
+
+
+def test_beam_packs_small_wave_optimally():
+    # 2 nodes of 4 CPU; two 3-CPU and two 1-CPU slots: only the
+    # big+small pairing seats all four
+    fit = np.ones((4, 2), bool)
+    req = np.zeros((4, 4), np.int64)
+    req[:, 0] = [3000, 3000, 1000, 1000]
+    req[:, 3] = 1
+    cap = np.zeros((2, 4), np.int64)
+    cap[:, 0] = 4000
+    cap[:, 3] = 110
+    score = np.zeros((4, 2), np.int64)
+    solver = AssignSolver()
+    owner, name = solver.solve(
+        fit, score, req, req.copy(), np.ones((4, 4), bool), cap,
+        np.zeros(4, np.int32), np.arange(4, dtype=np.int32), 4)
+    assert name == "beam"
+    assert (owner >= 0).all()
+    _check_solution(owner, fit, req, np.ones((4, 4), bool), cap)
+
+
+def test_auction_rounds_bounded():
+    assert auction_rounds(16, 1024) == 16
+    assert auction_rounds(2048, 64) == 2048 // 64 * 8
+    assert auction_rounds(4096, 4096) >= 16
+
+
+def test_auction_long_run_past_64_rounds_stays_sound():
+    # P >> N drives auction_rounds past 64: the epsilon shift must
+    # clamp (a >=64-bit int64 shift is implementation-defined and
+    # would reinflate eps mid-run), and the whole wave still seats
+    P, N = 256, 16
+    assert auction_rounds(P, N) > 64
+    fit = np.ones((P, N), bool)
+    score = np.zeros((P, N), np.int64)
+    req = np.zeros((P, 4), np.int64)
+    req[:, 0] = 250
+    req[:, 3] = 1
+    check = np.ones((P, 4), bool)
+    cap = np.zeros((N, 4), np.int64)
+    cap[:, 0] = 4000  # exactly 16 slots per node
+    cap[:, 3] = 110
+    solver = AssignSolver()
+    owner, name = solver.solve(fit, score, req, req.copy(), check, cap,
+                               np.zeros(P, np.int32),
+                               np.arange(P, dtype=np.int32), P)
+    assert name == "auction"
+    _check_solution(owner, fit, req, check, cap)
+    assert (owner >= 0).all()
+
+
+# -- profile: oracle validity fuzz -------------------------------------------
+
+
+def _assert_oracle_valid(state, pods, hosts):
+    """The packing must be SERIALLY feasible: some one-at-a-time order
+    exists in which every placement passes the serial oracle's
+    predicates at its own insertion (exactly the property the serial
+    scheduler guarantees — a final-state re-check would be stricter
+    than the oracle itself for init-container pods, whose fit request
+    exceeds their committed usage)."""
+    from kubernetes_tpu.api.types import pod_resource_request
+
+    oracle = GenericScheduler(predicates=ORACLE_PREDICATES,
+                              priorities=ORACLE_PRIORITIES)
+
+    def gap(p):
+        # fit request minus committed usage: init-container pods need
+        # headroom at insertion they never consume, so they must come
+        # first in any witness order (the exchange argument)
+        req_c, req_m, _g = pod_resource_request(p)
+        com_c = sum(int(str(c.requests.get("cpu", "0")).rstrip("m") or 0)
+                    for c in p.spec.containers)
+        return (req_c - com_c, req_c, req_m)
+
+    remaining = sorted(
+        ((p, h) for p, h in zip(pods, hosts) if h is not None),
+        key=lambda ph: gap(ph[0]), reverse=True)
+    while remaining:
+        progress = None
+        for idx, (p, h) in enumerate(remaining):
+            fits, failed = oracle.find_nodes_that_fit(p, state)
+            if h in fits:
+                progress = idx
+                q = shallow_copy(p)
+                q.spec = shallow_copy(p.spec)
+                q.spec.node_name = h
+                state.assign(q)
+                break
+        assert progress is not None, (
+            "no serial order admits the remaining placements: "
+            + ", ".join(f"{p.metadata.name}->{h}"
+                        for p, h in remaining[:5])
+        )
+        remaining.pop(progress)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_optimizer_placements_pass_serial_oracle_fuzz(seed):
+    rng = random.Random(1000 + seed)
+    state, pending = random_scenario(
+        rng, n_nodes=10, n_existing=8, n_pending=30)
+    algo = TPUScheduleAlgorithm(profile="optimizing")
+    hosts = algo.schedule_backlog(pending, state)
+    _assert_oracle_valid(state, pending, hosts)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_optimizer_mixed_features_route_and_stay_feasible(seed):
+    # inter-pod terms and volumes are optimizer-ineligible: they must
+    # route through the scan, and the combined packing must respect
+    # per-node resource capacity
+    rng = random.Random(2000 + seed)
+    state, pending = random_scenario(
+        rng, n_nodes=8, n_existing=6, n_pending=20,
+        interpod_p=0.3, volumes_p=0.3)
+    algo = TPUScheduleAlgorithm(profile="optimizing")
+    hosts = algo.schedule_backlog(pending, state)
+    for p, h in zip(pending, hosts):
+        if h is None:
+            continue
+        q = shallow_copy(p)
+        q.spec = shallow_copy(p.spec)
+        q.spec.node_name = h
+        state.assign(q)
+    from kubernetes_tpu.api.types import (
+        resource_list_cpu_milli,
+        resource_list_memory,
+    )
+
+    for nm, info in state.node_infos.items():
+        if info.node is None:
+            continue
+        alloc = info.node.status.allocatable or {}
+        assert info.requested_milli_cpu <= resource_list_cpu_milli(alloc)
+        assert info.requested_memory <= resource_list_memory(alloc)
+        assert len(info.pods) <= int(str(alloc.get("pods", 0) or 0))
+
+
+def test_optimizer_strictly_beats_greedy_on_stranding_mix():
+    n = 16
+    pods = interleaved_pack(n)
+    g = TPUScheduleAlgorithm(config=LRBA, profile="greedy")
+    hg = g.schedule_backlog(pods, ClusterState.build(
+        [node(f"n{i:03d}") for i in range(n)]))
+    o = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+    ho = o.schedule_backlog(pods, ClusterState.build(
+        [node(f"n{i:03d}") for i in range(n)]))
+    assert sum(1 for h in ho if h) > sum(1 for h in hg if h)
+    assert sum(1 for h in ho if h) == len(pods)
+
+
+def test_optimizer_o1_dispatches_per_wave():
+    # 12 distinct templates interleaved: the greedy grouped path and
+    # the optimizer must BOTH stay O(1) dispatches; the optimizer's
+    # budget is probe_group + assign + apply + scan = 4
+    n_nodes = 16
+    nodes = [node(f"n{i:03d}") for i in range(n_nodes)]
+    pods = []
+    for i in range(48):
+        t = i % 12
+        pods.append(pod(f"p-{i:03d}-t{t}", f"{200 + 100 * t}m"))
+    algo = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+    algo.schedule_backlog(pods, ClusterState.build(nodes))
+    total = sum(algo._opt.dispatches.values())
+    assert total <= 4, algo._opt.dispatches
+
+    # template count doubles; dispatch count must not
+    pods2 = []
+    for i in range(96):
+        t = i % 24
+        pods2.append(pod(f"q-{i:03d}-t{t}", f"{200 + 50 * t}m"))
+    algo2 = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+    algo2.schedule_backlog(pods2, ClusterState.build(nodes))
+    assert sum(algo2._opt.dispatches.values()) <= 4
+
+
+def test_greedy_profile_untouched_by_optimizer_import():
+    # the default profile takes the wave driver path and stays
+    # bit-identical to the serial oracle (the conformance suites gate
+    # this too; here: same decisions with the optimizer imported)
+    rng = random.Random(7)
+    state, pending = random_scenario(rng, n_nodes=8, n_pending=20)
+    oracle = GenericScheduler(predicates=ORACLE_PREDICATES,
+                              priorities=ORACLE_PRIORITIES)
+    import copy
+
+    expected = oracle.schedule_backlog(pending, copy.deepcopy(state))
+    algo = TPUScheduleAlgorithm(profile="greedy")
+    got = algo.schedule_backlog(pending, state)
+    assert got == expected
+
+
+# -- gangs under the optimizer ------------------------------------------------
+
+
+class TestOptimizerGangs:
+    def test_unfittable_gang_never_partially_binds(self):
+        nodes = [node(f"n{i}", cpu="4") for i in range(4)]
+        # gang of 6 x 3cpu: at most 4 members could seat, so the gang
+        # must come back entirely unplaced
+        members = [pod(f"g-{i}", "3000m") for i in range(6)]
+        singles = [pod(f"s-{i}", "1000m") for i in range(4)]
+        backlog = singles + members
+        algo = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+        hosts = algo.schedule_backlog(
+            backlog, ClusterState.build(nodes),
+            gangs=[{"start": 4, "length": 6, "score_by_name": None}])
+        assert all(h is None for h in hosts[4:]), hosts
+        assert all(h is not None for h in hosts[:4])
+
+    def test_fittable_gang_binds_whole(self):
+        nodes = [node(f"n{i}", cpu="4") for i in range(4)]
+        members = [pod(f"g-{i}", "3000m") for i in range(4)]
+        algo = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+        hosts = algo.schedule_backlog(
+            members, ClusterState.build(nodes),
+            gangs=[{"start": 0, "length": 4, "score_by_name": None}])
+        assert all(h is not None for h in hosts)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_gang_atomicity_fuzz(self, seed):
+        rng = random.Random(3000 + seed)
+        n = rng.choice([4, 6, 8])
+        nodes = [node(f"n{i}", cpu="4") for i in range(n)]
+        gang_len = rng.choice([2, 3, n + 2])
+        members = [pod(f"g-{i}", f"{rng.choice([2000, 3000])}m")
+                   for i in range(gang_len)]
+        singles = [pod(f"s-{i}", "500m")
+                   for i in range(rng.randint(0, 6))]
+        backlog = singles + members
+        algo = TPUScheduleAlgorithm(config=LRBA, profile="optimizing")
+        hosts = algo.schedule_backlog(
+            backlog, ClusterState.build(nodes),
+            gangs=[{"start": len(singles), "length": gang_len,
+                    "score_by_name": None}])
+        span = hosts[len(singles):]
+        placed = sum(1 for h in span if h is not None)
+        assert placed in (0, gang_len), (
+            f"partial gang bind: {placed}/{gang_len}")
+
+
+# -- --pack gates -------------------------------------------------------------
+
+
+@pytest.mark.skipif(_SANITIZED, reason="perf gates run unsanitized")
+def test_pack_smoke_gates_strict_improvement():
+    import bench
+
+    record = bench.run_pack(smoke=True, write=False)
+    assert record["all_gates_pass"]
+    for key in ("pack_config2", "pack_config4"):
+        gates = record[key]["gates"]
+        assert gates["schedulable_count_strictly_improves"]
+        assert gates["packed_utilization_strictly_improves"]
+        assert gates["o1_dispatch_budget"]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(_SANITIZED, reason="perf gates run unsanitized")
+def test_pack_full_gates():
+    import bench
+
+    record = bench.run_pack(smoke=False, write=False)
+    assert record["all_gates_pass"]
+
+
+# -- analysis registration ----------------------------------------------------
+
+
+def test_assign_programs_registered():
+    from kubernetes_tpu.analysis.programs import build_programs
+
+    names = {s.name for s in build_programs(include_mesh=False)}
+    assert {"assign_auction", "assign_beam"} <= names
+
+
+# -- defragmentation ----------------------------------------------------------
+
+
+def _strand_state(n=8, used_cpu="2000m"):
+    nodes = [node(f"n{i}") for i in range(n)]
+    assigned = [pod(f"p{i}", used_cpu, node_name=f"n{i}")
+                for i in range(n)]
+    return ClusterState.build(nodes, assigned_pods=assigned)
+
+
+class TestDefrag:
+    TARGET = np.array([3000, 3 << 30, 0, 1], np.int64)
+
+    def test_fragmentation_measure(self):
+        state = _strand_state()
+        assert D.fragmentation(state, self.TARGET) == 1.0
+        empty = ClusterState.build([node("e0"), node("e1")])
+        assert D.fragmentation(empty, self.TARGET) == 0.0
+
+    def test_proposal_pairs_and_unstrands(self):
+        state = _strand_state(8)
+        plan = D.propose_migrations(state, self.TARGET, budget=8)
+        assert 0 < len(plan) <= 8
+        D.apply_migrations_to_state(state, plan)
+        assert D.fragmentation(state, self.TARGET) == 0.0
+
+    def test_budget_caps_plan(self):
+        state = _strand_state(8)
+        plan = D.propose_migrations(state, self.TARGET, budget=2)
+        assert len(plan) <= 2
+
+    def test_priority_protection(self):
+        state = _strand_state(8)
+        # every pod belongs to a tier >= beneficiary: nothing may move
+        plan = D.propose_migrations(
+            state, self.TARGET, budget=8,
+            beneficiary_priority=1, priority_of=lambda p: 5)
+        assert plan == []
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_migrations_never_reduce_schedulability_fuzz(self, seed):
+        rng = random.Random(4000 + seed)
+        n = rng.choice([6, 8, 10])
+        nodes = [node(f"n{i}") for i in range(n)]
+        assigned = []
+        k = 0
+        for i in range(n):
+            for _ in range(rng.randint(0, 3)):
+                assigned.append(pod(
+                    f"a{k}", f"{rng.choice([500, 1000, 2000])}m",
+                    node_name=f"n{i}"))
+                k += 1
+        pending = [pod(f"w{i}", f"{rng.choice([1000, 3000])}m")
+                   for i in range(6)]
+        target = D.target_shape(
+            ClusterState.build(nodes, assigned_pods=assigned), pending)
+
+        def schedulable(state):
+            algo = TPUScheduleAlgorithm(config=LRBA, profile="greedy")
+            hosts = algo.schedule_backlog(list(pending), state)
+            return sum(1 for h in hosts if h is not None)
+
+        before_state = ClusterState.build(nodes,
+                                          assigned_pods=list(assigned))
+        before = schedulable(before_state)
+        after_state = ClusterState.build(nodes,
+                                         assigned_pods=list(assigned))
+        frag_before = D.fragmentation(after_state, target)
+        plan = D.propose_migrations(after_state, target, budget=6)
+        D.apply_migrations_to_state(after_state, plan)
+        assert D.fragmentation(after_state, target) <= frag_before
+        after = schedulable(after_state)
+        assert after >= before, (
+            f"defrag reduced schedulable count {before} -> {after} "
+            f"(plan: {[(p.metadata.name, s, d) for p, s, d in plan]})")
+
+    def test_busy_backoff(self):
+        clock = {"t": 0.0}
+        ctrl = D.DefragController(
+            lambda: _strand_state(), busy_fn=lambda: True,
+            clock=lambda: clock["t"])
+        assert ctrl.sync_once()["outcome"] == "busy"
+        first = ctrl._backoff
+        assert first > 0
+        ctrl.sync_once()
+        assert ctrl._backoff >= first  # doubling, capped
+        assert ctrl._backoff <= ctrl.backoff_max
+
+    def test_calm_below_threshold(self):
+        state = ClusterState.build([node("n0"), node("n1")])
+        ctrl = D.DefragController(lambda: state)
+        res = ctrl.sync_once()
+        assert res["outcome"] == "calm"
+        assert res["migrations"] == 0
+
+    def test_execute_evicts_and_rebinds_through_batch_door(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client import LocalTransport, RESTClient
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        n = 4
+        for i in range(n):
+            client.nodes().create(node(f"n{i}"))
+        for i in range(n):
+            client.pods().create(pod(f"p{i}", "2000m",
+                                     node_name=f"n{i}"))
+
+        def state_fn():
+            nodes_live, _ = client.nodes().list()
+            pods_live, _ = client.pods().list()
+            return ClusterState.build(
+                list(nodes_live),
+                assigned_pods=[p for p in pods_live
+                               if p.spec.node_name])
+
+        ctrl = D.DefragController(
+            state_fn, client=client,
+            pending_fn=lambda: [pod("want", "3000m")],
+            frag_threshold=0.1)
+        res = ctrl.sync_once()
+        assert res["outcome"] == "migrated"
+        assert res["migrations"] > 0
+        pods_live, _ = client.pods().list()
+        by_node = {}
+        for p in pods_live:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        assert len(pods_live) == n  # every evicted pod was re-created
+        # at least one node is now whole (empty), fragmentation fell
+        empties = [f"n{i}" for i in range(n)
+                   if f"n{i}" not in by_node]
+        assert empties, by_node
+        assert D.fragmentation(
+            state_fn(), np.array([3000, 3 << 30, 0, 1], np.int64)) < 1.0
+
+
+# -- PodGroup status reconciliation ------------------------------------------
+
+
+class TestPodGroupStatusController:
+    def _plane(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client import LocalTransport, RESTClient
+        from kubernetes_tpu.controller.framework import (
+            SharedInformerFactory,
+        )
+        from kubernetes_tpu.controller.podgroup import (
+            PodGroupStatusController,
+        )
+
+        server = APIServer()
+        client = RESTClient(LocalTransport(server))
+        informers = SharedInformerFactory(client)
+        ctrl = PodGroupStatusController(client, informers)
+        informers.start()
+        informers.wait_for_sync()
+        return client, informers, ctrl
+
+    def test_reconciles_terminal_drift(self):
+        client, informers, ctrl = self._plane()
+        try:
+            pgr = client.resource("podgroups", "default")
+            pgr.create(PodGroup(
+                metadata=ObjectMeta(name="train"),
+                spec=PodGroupSpec(min_member=2),
+            ))
+            for i in range(2):
+                client.pods().create(pod(
+                    f"m{i}", "100m",
+                    labels={POD_GROUP_LABEL: "train", "app": "train"},
+                    node_name=f"n{i}"))
+            # the scheduler recorded a fully bound gang, then died
+            pgr.patch("train", {"status": {
+                "phase": "Scheduled", "members": 2, "scheduled": 2,
+            }}, subresource="status")
+            # one member finishes while the scheduler is away
+            client.pods().patch("m0", {"status": {
+                "phase": "Succeeded"}}, subresource="status")
+            informers.wait_for_sync()
+            import time as _t
+
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                if ctrl.sync_once():
+                    break
+                _t.sleep(0.1)
+            got = pgr.get("train")
+            assert got.status.members == 1
+            assert got.status.scheduled == 1
+            assert got.status.phase == "Pending"  # below minMember now
+        finally:
+            informers.stop()
+
+    def test_no_patch_when_in_sync(self):
+        client, informers, ctrl = self._plane()
+        try:
+            pgr = client.resource("podgroups", "default")
+            pgr.create(PodGroup(
+                metadata=ObjectMeta(name="idle"),
+                spec=PodGroupSpec(min_member=1),
+            ))
+            import time as _t
+
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                ctrl.sync_once()
+                got = pgr.get("idle")
+                if got.status.phase == "Pending" \
+                        and got.status.members == 0:
+                    break
+                _t.sleep(0.1)
+            rv = pgr.get("idle").metadata.resource_version
+            assert ctrl.sync_once() == 0  # steady state: zero PATCHes
+            assert pgr.get("idle").metadata.resource_version == rv
+        finally:
+            informers.stop()
+
+
+# -- gang backoff fairness ----------------------------------------------------
+
+
+class TestGangBackoff:
+    def _director(self, clock, pg):
+        from kubernetes_tpu.scheduler.gang import GangDirector
+
+        return GangDirector(
+            pod_group_lister=lambda: [pg],
+            backoff_initial=2.0, backoff_max=8.0, clock=clock,
+        )
+
+    def _wave(self, n_members):
+        return [pod(f"g-{i}", "3000m",
+                    labels={POD_GROUP_LABEL: "giant", "app": "giant"})
+                for i in range(n_members)]
+
+    def test_resource_park_backs_off_and_caps(self):
+        clock = {"t": 0.0}
+        pg = PodGroup(metadata=ObjectMeta(name="giant",
+                                          namespace="default"),
+                      spec=PodGroupSpec(min_member=2))
+        d = self._director(lambda: clock["t"], pg)
+        state = ClusterState.build([node("n0", cpu="1")])
+        wave = self._wave(2)
+        backlog, layout, parked = d.plan_wave(wave, state)
+        assert layout and not parked  # members suffice: gang enters
+        hosts, errors = d.after_wave(
+            backlog, [None] * len(backlog), layout, state)
+        assert errors  # resource park
+        key = ("default", "giant")
+        delay0, _ = d._backoff[key]
+        assert delay0 == 2.0
+        # inside the window: the gang sits the wave out (no re-probe)
+        backlog2, layout2, parked2 = d.plan_wave(self._wave(2), state)
+        assert not layout2 and len(parked2) == 2
+        assert "backing off" in str(parked2[0][1])
+        # repeated parks double the delay up to the starvation cap
+        for _ in range(4):
+            clock["t"] += d._backoff[key][0] + 0.1
+            backlog3, layout3, _ = d.plan_wave(self._wave(2), state)
+            assert layout3  # cap reached or window expired: re-probes
+            d.after_wave(backlog3, [None] * len(backlog3), layout3,
+                         state)
+        assert d._backoff[key][0] == 8.0  # capped, never unbounded
+
+    def test_success_clears_backoff(self):
+        clock = {"t": 0.0}
+        pg = PodGroup(metadata=ObjectMeta(name="giant",
+                                          namespace="default"),
+                      spec=PodGroupSpec(min_member=1))
+        d = self._director(lambda: clock["t"], pg)
+        state = ClusterState.build([node("n0")])
+        wave = self._wave(1)
+        backlog, layout, _ = d.plan_wave(wave, state)
+        d.after_wave(backlog, [None], layout, state)
+        assert ("default", "giant") in d._backoff
+        clock["t"] += 100.0
+        backlog2, layout2, _ = d.plan_wave(self._wave(1), state)
+        d.after_wave(backlog2, ["n0"], layout2, state)
+        assert ("default", "giant") not in d._backoff
+
+    def test_backoff_pruned_when_podgroup_deleted(self):
+        from kubernetes_tpu.scheduler.gang import GangDirector
+
+        clock = {"t": 0.0}
+        pgs = {
+            n: PodGroup(metadata=ObjectMeta(name=n,
+                                            namespace="default"),
+                        spec=PodGroupSpec(min_member=1))
+            for n in ("keep", "gone")
+        }
+        d = GangDirector(pod_group_lister=lambda: list(pgs.values()),
+                         backoff_initial=2.0, backoff_max=8.0,
+                         clock=lambda: clock["t"])
+        state = ClusterState.build([node("n0", cpu="1")])
+        for name in ("keep", "gone"):
+            wave = [pod(f"{name}-0", "3000m",
+                        labels={POD_GROUP_LABEL: name, "app": name})]
+            backlog, layout, _ = d.plan_wave(wave, state)
+            d.after_wave(backlog, [None], layout, state)
+        assert set(d._backoff) == {("default", "keep"),
+                                   ("default", "gone")}
+        del pgs["gone"]  # PodGroup deleted: its backoff must not leak
+        d.plan_wave([pod("keep-1", "3000m",
+                         labels={POD_GROUP_LABEL: "keep",
+                                 "app": "keep"})], state)
+        assert ("default", "gone") not in d._backoff
+
+    def test_singletons_unaffected_by_parked_gang_backoff(self):
+        clock = {"t": 0.0}
+        pg = PodGroup(metadata=ObjectMeta(name="giant",
+                                          namespace="default"),
+                      spec=PodGroupSpec(min_member=2))
+        d = self._director(lambda: clock["t"], pg)
+        state = ClusterState.build([node("n0", cpu="1")])
+        wave = self._wave(2)
+        backlog, layout, _ = d.plan_wave(wave, state)
+        d.after_wave(backlog, [None] * len(backlog), layout, state)
+        single = pod("lonely", "100m")
+        backlog2, layout2, parked2 = d.plan_wave(
+            [single] + self._wave(2), state)
+        assert backlog2 == [single]  # the singleton still schedules
+        assert len(parked2) == 2
