@@ -407,6 +407,8 @@ def run_soak(seconds: int):
 BENCH_FILE = "BENCH_r10.json"
 #: round-11 record: the --pack packing gates (optimizing vs greedy)
 BENCH_FILE_R11 = "BENCH_r11.json"
+#: round-12 record: the telemetry-pipeline overhead A/B
+BENCH_FILE_R12 = "BENCH_r12.json"
 
 
 def _bench_merge(update: dict, path: str = None) -> None:
@@ -512,6 +514,63 @@ def run_wire_soak(seconds: int, num_nodes: int = 1000,
         breached = [k for k, v in record["gates"].items() if not v]
         print(f"# WIRE-SOAK GATE BREACH: {', '.join(breached)}",
               file=sys.stderr)
+        sys.exit(1)
+
+
+def run_telemetry_ab(seconds: int, num_nodes: int = 96,
+                     rate: float = 40.0, slo: float = 5.0):
+    """The telemetry pipeline's <=5% overhead budget, measured: the
+    same smoke-sized soak twice — collector ON, then the
+    KUBERNETES_TPU_TELEMETRY=0 control arm — comparing steady bound
+    pods/s. The record (both arms + the ratio) lands in BENCH_r12.json
+    under `telemetry_ab`; exits non-zero when the on-arm throughput
+    drops below 95% of the off-arm's."""
+    _assert_sanitizers_off()
+    from kubernetes_tpu.harness.soak import (
+        SoakConfig,
+        run_wire_soak as _run_soak,
+    )
+
+    prior = os.environ.get("KUBERNETES_TPU_TELEMETRY")
+    arms = {}
+    try:
+        for arm, env_val in (("telemetry_on", "1"),
+                             ("telemetry_off", "0")):
+            os.environ["KUBERNETES_TPU_TELEMETRY"] = env_val
+            cfg = SoakConfig(
+                seconds=seconds, num_nodes=num_nodes, rate=rate,
+                slo=slo, params={"churn_floor": 512})
+            rec = _run_soak(cfg)
+            arms[arm] = rec
+            print(f"# telemetry-ab {arm}: "
+                  f"{rec['steady_bound_pods_per_sec']} pods/s "
+                  f"(ok={rec['ok']})", file=sys.stderr)
+    finally:
+        if prior is None:
+            os.environ.pop("KUBERNETES_TPU_TELEMETRY", None)
+        else:
+            os.environ["KUBERNETES_TPU_TELEMETRY"] = prior
+    on_tp = arms["telemetry_on"]["steady_bound_pods_per_sec"]
+    off_tp = arms["telemetry_off"]["steady_bound_pods_per_sec"]
+    ratio = on_tp / max(off_tp, 1e-9)
+    record = {
+        "metric": "telemetry_ab",
+        "seconds": seconds,
+        "on_pods_per_sec": on_tp,
+        "off_pods_per_sec": off_tp,
+        "on_over_off_ratio": round(ratio, 4),
+        "overhead_budget_ratio": 0.95,
+        "on": arms["telemetry_on"],
+        "off": arms["telemetry_off"],
+        "ok": ratio >= 0.95,
+    }
+    print(json.dumps({k: record[k] for k in
+                      ("metric", "on_pods_per_sec", "off_pods_per_sec",
+                       "on_over_off_ratio", "ok")}))
+    _bench_merge({"telemetry_ab": record}, path=BENCH_FILE_R12)
+    if not record["ok"]:
+        print(f"# TELEMETRY OVERHEAD BREACH: on/off throughput ratio "
+              f"{ratio:.3f} < 0.95", file=sys.stderr)
         sys.exit(1)
 
 
@@ -1402,7 +1461,38 @@ def _cli():
         help="with --pack: the tier-1-sized parameter set instead of "
              "the ~1k-node full form",
     )
+    ap.add_argument(
+        "--no-telemetry", action="store_true",
+        help="run with the continuous-telemetry pipeline OFF (sets "
+             "KUBERNETES_TPU_TELEMETRY=0). Required acknowledgment "
+             "for a --wire-soak run when the environment already "
+             "force-disables telemetry: a soak without its telemetry "
+             "record is only valid as a deliberate control arm.",
+    )
+    ap.add_argument(
+        "--telemetry-ab", type=int, default=0, metavar="SECONDS",
+        help="measure the telemetry pipeline's overhead: the same "
+             "smoke soak with the collector on and off, gated on the "
+             "on-arm keeping >=95%% of the off-arm's bound pods/s. "
+             "Record lands in BENCH_r12.json `telemetry_ab`.",
+    )
     args = ap.parse_args()
+    if args.no_telemetry:
+        os.environ["KUBERNETES_TPU_TELEMETRY"] = "0"
+    if args.telemetry_ab:
+        run_telemetry_ab(args.telemetry_ab)
+        return
+    if args.wire_soak and not args.no_telemetry:
+        from kubernetes_tpu import telemetry as _telemetry
+
+        if not _telemetry.enabled():
+            raise SystemExit(
+                "KUBERNETES_TPU_TELEMETRY is force-disabled in the "
+                "environment but --no-telemetry was not passed: a "
+                "wire soak without its telemetry record is only "
+                "valid as an explicit control arm. Pass "
+                "--no-telemetry to acknowledge, or unset "
+                "KUBERNETES_TPU_TELEMETRY.")
     if args.pack or args.pack_smoke:
         run_pack(smoke=args.pack_smoke)
         return

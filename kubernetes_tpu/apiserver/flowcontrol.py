@@ -158,7 +158,7 @@ class PriorityLevel:
         self._borrow_ledger: Dict[str, int] = {}  # guarded-by: self._mu
         self.queue_length = max(1, int(queue_length))
         self.hand_size = max(1, min(int(hand_size), max(1, int(queues))))
-        self.queue_wait = queue_wait
+        self.queue_wait = queue_wait  # guarded-by: self._mu
         self._mu = threading.Lock()
         self._queues: List[deque] = [
             deque() for _ in range(max(1, int(queues)))
@@ -205,6 +205,15 @@ class PriorityLevel:
             h //= max(len(avail), 1)
             hand.append(avail.pop(i))
         return hand
+
+    def set_queue_wait(self, seconds: float) -> None:
+        """Change the queue-wait budget (tests, reconfiguration).
+        Locked: acquire() captures its budget under the same lock at
+        enqueue time, so a waiter honors either the old value or the
+        new one — never a torn read; already-parked waiters keep the
+        budget they enqueued under."""
+        with self._mu:
+            self.queue_wait = float(seconds)
 
     # -- admission -----------------------------------------------------------
 
@@ -283,7 +292,11 @@ class PriorityLevel:
             self._queues[qi].append(w)
             self._waiting += 1
             self._m_inqueue.inc()
-        w.ready.wait(self.queue_wait)
+            # the wait budget is captured under the lock: a concurrent
+            # set_queue_wait() must not race this read (the timeout a
+            # request enqueued under is the timeout it honors)
+            wait_budget = self.queue_wait
+        w.ready.wait(wait_budget)
         with self._mu:
             if w.dispatched:
                 waited = time.monotonic() - w.enqueued_at

@@ -757,6 +757,21 @@ class APIServer:
             from kubernetes_tpu.audit import render_audit
 
             return 200, render_audit(query)
+        if path == "/debug/telemetry/query":
+            # the process telemetry store (telemetry/tsdb.py):
+            # ?q=rate(...)/sum(...)/quantile(...)/selector, or the
+            # store index with no query
+            from kubernetes_tpu import telemetry
+
+            return telemetry.handle_query(query)
+        if path == "/debug/telemetry/alerts":
+            from kubernetes_tpu import telemetry
+
+            return telemetry.handle_alerts(query)
+        if path == "/debug/flightrecorder":
+            from kubernetes_tpu import telemetry
+
+            return telemetry.handle_flight(query)
         if path.startswith("/debug/pprof"):
             # net/http/pprof analogue (scheduler server.go:96-99 mounts
             # it on every daemon; here daemons share this mux)

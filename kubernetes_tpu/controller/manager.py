@@ -173,6 +173,19 @@ class ControllerManager:
             healthz=lambda: not getattr(self, "lost_lease", False),
             name="controller-manager",
         )
+        # continuous telemetry behind /debug/telemetry on this mux;
+        # idempotent — a co-located scheduler daemon may already own
+        # the process collector, in which case we just share it
+        from kubernetes_tpu import telemetry
+        from kubernetes_tpu.telemetry import scrape as telemetry_scrape
+
+        if telemetry.enabled():
+            self._telemetry_owned = telemetry_scrape.default() is None
+            self._telemetry = telemetry_scrape.ensure_default(
+                "controller-manager",
+                recorder=self._broadcaster.new_recorder(
+                    "controller-manager"),
+            )
         return bound
 
     def start(self) -> "ControllerManager":
@@ -247,6 +260,12 @@ class ControllerManager:
                 pass
         self.informers.stop()
         self._broadcaster.shutdown()
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None and getattr(self, "_telemetry_owned", False):
+            from kubernetes_tpu.telemetry import scrape as telemetry_scrape
+
+            telemetry_scrape.release_default(tel)
+            self._telemetry = None
         obs = getattr(self, "_obs_server", None)
         if obs is not None:
             obs.shutdown()

@@ -23,7 +23,6 @@ per-process request/CPU rows in the BENCH record.
 from __future__ import annotations
 
 import atexit
-import json
 import os
 import signal
 import socket
@@ -106,92 +105,18 @@ def proc_cpu_seconds(pid: int) -> float:
         return 0.0
 
 
-def _parse_series(line: str):
-    """'name{k="v",...} 12.0' -> (name, {k: v}, 12.0); None on junk."""
-    try:
-        series, value = line.rsplit(" ", 1)
-        v = float(value)
-    except ValueError:
-        return None
-    series = series.strip()
-    if "{" in series:
-        name, _, rest = series.partition("{")
-        labels: Dict[str, str] = {}
-        for pair in rest.rstrip("}").split(","):
-            if "=" not in pair:
-                continue
-            k, _, val = pair.partition("=")
-            labels[k.strip()] = val.strip().strip('"')
-        return name, labels, v
-    return series, {}, v
-
-
-def scrape_raw(url: str, timeout: float = 5.0):
-    """GET <url>/metrics -> [(name, labels, value)] exposition rows."""
-    import http.client as _hc
-    from urllib import parse as _up
-
-    parts = _up.urlsplit(url)
-    conn = _hc.HTTPConnection(parts.hostname, parts.port,
-                              timeout=timeout)
-    try:
-        conn.request("GET", "/metrics")
-        resp = conn.getresponse()
-        text = resp.read().decode(errors="replace")
-    finally:
-        conn.close()
-    rows = []
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        parsed = _parse_series(line)
-        if parsed is not None:
-            rows.append(parsed)
-    return rows
-
-
-def series_sum(rows, name: str, **labels: str) -> float:
-    """Sum every exposition row of `name` whose labels include the
-    given pairs (the label-filtered fold the soak's gate deltas use)."""
-    total = 0.0
-    for n, lbls, v in rows:
-        if n != name:
-            continue
-        if all(lbls.get(k) == val for k, val in labels.items()):
-            total += v
-    return total
-
-
-def scrape_metrics(url: str, timeout: float = 5.0) -> Dict[str, float]:
-    """GET <url>/metrics and fold the exposition text into
-    {metric_name: summed value across label sets} (enough for the
-    soak's delta accounting; per-label detail via scrape_raw)."""
-    out: Dict[str, float] = {}
-    for name, _labels, v in scrape_raw(url, timeout):
-        out[name] = out.get(name, 0.0) + v
-    return out
-
-
-def healthz(url: str, timeout: float = 3.0) -> Optional[dict]:
-    """GET <url>/healthz -> parsed dict, or None while unreachable."""
-    import http.client as _hc
-    from urllib import parse as _up
-
-    parts = _up.urlsplit(url)
-    try:
-        conn = _hc.HTTPConnection(parts.hostname, parts.port,
-                                  timeout=timeout)
-        try:
-            conn.request("GET", "/healthz")
-            resp = conn.getresponse()
-            body = resp.read()
-            if resp.status != 200:
-                return None
-            return json.loads(body)
-        finally:
-            conn.close()
-    except (OSError, ValueError):
-        return None
+# The label-aware exposition parser (and its sum/scrape helpers) used
+# to live here, private to the multi-process harness. The telemetry
+# collector (telemetry/scrape.py) scrapes the same fleet through the
+# same lines, so the shared implementation moved to telemetry/expo.py;
+# these re-exports keep every historical harness import path working.
+from kubernetes_tpu.telemetry.expo import (  # noqa: E402,F401
+    healthz,
+    parse_series as _parse_series,
+    scrape_metrics,
+    scrape_raw,
+    series_sum,
+)
 
 
 class ApiserverReplica:

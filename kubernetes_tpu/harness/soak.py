@@ -459,6 +459,59 @@ def run_wire_soak(cfg: SoakConfig) -> dict:
                                  spread=fleet_procs is not None))
         for i in range(n_flows)
     ]
+
+    # continuous telemetry (kubernetes_tpu/telemetry): the driver-side
+    # collector scrapes this process's registry — and every apiserver
+    # replica process — into a TSDB each second, runs the SLO engine
+    # over the history, and arms the flight recorder. A firing alert
+    # dumps a bundle immediately; a breached gate always dumps one at
+    # the end (even though the fleet is torn down by then — the http
+    # targets' /healthz + /debug/flowcontrol state is cached per tick
+    # exactly so a dead process can still testify). The
+    # KUBERNETES_TPU_TELEMETRY=0 kill switch (and the bench A/B
+    # control arm riding it) turns all of this off.
+    telemetry_ctx = None
+    from kubernetes_tpu import telemetry as _telemetry
+
+    if _telemetry.enabled():
+        import tempfile as _tempf
+
+        from kubernetes_tpu.telemetry import scrape as _tscrape
+        from kubernetes_tpu.telemetry.flight import FlightRecorder
+        from kubernetes_tpu.telemetry.slo import Engine, default_rules
+        from kubernetes_tpu.telemetry.tsdb import TSDB
+
+        _tdb = TSDB(interval=1.0,
+                    retention_samples=max(600, int(seconds) + 120))
+        _teng = Engine(_tdb, rules=default_rules(slo_seconds=slo))
+        _tdir = str(params.get("flight_dir", "")) or _tempf.mkdtemp(
+            prefix="flight-recorder-")
+        _tflight = FlightRecorder(
+            _tdb, _tdir, window=float(seconds) + 120.0, engine=_teng)
+        _teng.on_fire = lambda alert: _tflight.record(
+            "alert-" + alert["alert"])
+        _tcoll = _tscrape.Collector(
+            _tdb, interval=1.0, engine=_teng, flight=_tflight)
+        _tcoll.add_registry("driver")
+        if fleet_procs is not None:
+            _tcoll.attach_fleet(fleet_procs)
+            _tflight.add_state_source("fleet", _tcoll.proc_state)
+        for label, srv in (("apiserver", api), ("apiserver-2", api2)):
+            if srv is not None:
+                _tflight.add_state_source(
+                    label,
+                    (lambda s: lambda: (
+                        s.flowcontrol.state() if s.flowcontrol
+                        is not None else {"enabled": False}))(srv))
+        _tcoll.start()
+        owned_default = _tscrape.default() is None
+        if owned_default:
+            _tscrape.set_default(_tcoll)
+        telemetry_ctx = (_tcoll, _teng, _tflight, owned_default)
+        print(f"# wire-soak: telemetry collector on "
+              f"({len(_tcoll.jobs())} targets, flight bundles -> "
+              f"{_tdir})", file=sys.stderr)
+
     stop = threading.Event()
     lock = threading.Lock()
     created: dict = {}          # name -> create time (unbound pods)
@@ -1321,7 +1374,7 @@ def run_wire_soak(cfg: SoakConfig) -> dict:
             # counter is scraped from the replicas' /metrics and
             # summed (the driver's in-process registry only sees its
             # own client-side families)
-            from kubernetes_tpu.harness.procs import series_sum
+            from kubernetes_tpu.telemetry.expo import series_sum
 
             rows = fleet_procs.scrape_raw()
 
@@ -1527,6 +1580,16 @@ def run_wire_soak(cfg: SoakConfig) -> dict:
                   + ", ".join(steady_compile_events), file=sys.stderr)
     finally:
         stop.set()
+        if telemetry_ctx is not None:
+            # one deterministic final scrape while the replicas are
+            # still alive, then park the collector thread; the TSDB
+            # and the cached process state stay readable for the
+            # post-gate summary and any breach bundle below
+            try:
+                telemetry_ctx[0].tick()
+            except Exception:
+                pass
+            telemetry_ctx[0].stop()
         if observer_stream[0] is not None:
             try:
                 observer_stream[0].stop()
@@ -1743,6 +1806,47 @@ def run_wire_soak(cfg: SoakConfig) -> dict:
         hook(record, gates, steady_lat, t_steady)
     record["gates"] = gates
     record["ok"] = all(gates.values())
+
+    if telemetry_ctx is not None:
+        coll, eng, flight, owned_default = telemetry_ctx
+        db = coll.db
+        peak_bind = max(
+            (v for _t, v in db.rate_over_time(
+                "kubemark_fleet_pod_transitions_total")),
+            default=0.0)
+        peak_req = max(
+            (v for _t, v in db.rate_over_time(
+                "apiserver_requests_total")),
+            default=0.0)
+        record["telemetry"] = {
+            "ticks": coll.ticks(),
+            "jobs": coll.jobs(),
+            "series": db.series_count(),
+            "samples": db.sample_count(),
+            "series_dropped": db.dropped(),
+            "alert_timeline": eng.history(),
+            "alerts_at_stop": eng.active(),
+            "peak_bind_rate_pods_per_sec": round(peak_bind, 1),
+            "peak_apiserver_request_rate_per_sec": round(peak_req, 1),
+            "flight_dir": flight.out_dir,
+        }
+        if not record["ok"]:
+            # a failed gate ALWAYS leaves a bundle — debounce
+            # bypassed, because the alert-triggered dump seconds ago
+            # does not carry the gate verdicts this one does
+            bundle = flight.record(
+                "soak-gate-breach",
+                extra={"gates": gates,
+                       "failed": sorted(k for k, v in gates.items()
+                                        if not v)},
+                force=True)
+            record["flight_bundle"] = bundle
+            print(f"# wire-soak: gate breach -> flight bundle "
+                  f"{bundle}", file=sys.stderr)
+        if owned_default:
+            from kubernetes_tpu.telemetry import scrape as _tscrape
+
+            _tscrape.release_default(coll)
 
     # -- A/B control arm (noisy-neighbor): prove APF causes the
     # protection — same scenario, APF off, must demonstrably degrade

@@ -1250,6 +1250,92 @@ class Kubectl:
             ])
         return _tabulate(rows)
 
+    def metrics_query(self, expr: str = "", output: str = "") -> str:
+        """kubectl metrics query '<expr>': evaluate a telemetry
+        expression — rate(...), sum(...)/sum_by(...), quantile(...),
+        or a bare name{label="v"}[window] selector — against the
+        apiserver's /debug/telemetry/query. No expression prints the
+        store index (series/sample counts, scraped jobs)."""
+        query = {"q": expr} if expr else {}
+        code, payload = self.client.transport.request(
+            "GET", "/debug/telemetry/query", query, None
+        )
+        if code != 200:
+            raise APIStatusError(
+                code, payload if isinstance(payload, dict) else {}
+            )
+        if output == "json":
+            return json.dumps(payload, indent=2, sort_keys=True)
+        kind = payload.get("kind", "")
+        if kind == "TelemetryIndex":
+            rows = [["TICKS", "JOBS", "SERIES", "SAMPLES", "DROPPED"]]
+            rows.append([
+                str(payload.get("ticks", 0)),
+                ",".join(payload.get("jobs", [])),
+                str(payload.get("series", 0)),
+                str(payload.get("samples", 0)),
+                str(sum((payload.get("dropped") or {}).values())),
+            ])
+            return _tabulate(rows)
+        result = payload.get("result")
+        kind = payload.get("resultType", kind)
+        if kind == "scalar":
+            return _fmt_num(result)
+        if kind == "vector":
+            rows = [["LABELS", "VALUE"]]
+            for item in result or []:
+                labels = item.get("labels", {})
+                rows.append([
+                    ",".join(f"{k}={v}"
+                             for k, v in sorted(labels.items())) or "{}",
+                    _fmt_num(item.get("value")),
+                ])
+            return _tabulate(rows)
+        if kind == "matrix":
+            rows = [["LABELS", "SAMPLES", "LAST"]]
+            for item in result or []:
+                labels = item.get("labels", {})
+                samples = item.get("samples", [])
+                last = samples[-1][1] if samples else None
+                rows.append([
+                    ",".join(f"{k}={v}"
+                             for k, v in sorted(labels.items())) or "{}",
+                    str(len(samples)),
+                    _fmt_num(last),
+                ])
+            return _tabulate(rows)
+        return json.dumps(payload, indent=2, sort_keys=True)
+
+    def alerts_cmd(self, output: str = "",
+                   firing_only: bool = False) -> str:
+        """kubectl alerts: the SLO engine's rule states (and the
+        fire/resolve timeline) from /debug/telemetry/alerts."""
+        query = {"firing": "1"} if firing_only else {}
+        code, payload = self.client.transport.request(
+            "GET", "/debug/telemetry/alerts", query, None
+        )
+        if code != 200:
+            raise APIStatusError(
+                code, payload if isinstance(payload, dict) else {}
+            )
+        if output == "json":
+            return json.dumps(payload, indent=2, sort_keys=True)
+        rows = [["ALERT", "STATE", "SINCE", "VALUE", "DESCRIPTION"]]
+        for st in payload.get("items", []):
+            since = st.get("since")
+            when = (
+                time.strftime("%H:%M:%S", time.localtime(since))
+                if isinstance(since, (int, float)) else ""
+            )
+            rows.append([
+                st.get("alert", ""),
+                "FIRING" if st.get("firing") else "ok",
+                when,
+                _fmt_num(st.get("value")),
+                st.get("description", ""),
+            ])
+        return _tabulate(rows)
+
     def autoscale(self, resource: str, name: str, min_replicas: int,
                   max_replicas: int, cpu_percent: int = 80) -> str:
         """kubectl autoscale (cmd/autoscale.go): create an HPA targeting
@@ -1679,6 +1765,15 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
     p.add_argument("--verb", dest="verb_filter", default="")
     p.add_argument("--resource", default="")
 
+    p = sub.add_parser("metrics")
+    p.add_argument("subverb", choices=["query"])
+    p.add_argument("expr", nargs="?", default="")
+    p.add_argument("--output", "-o", default="")
+
+    p = sub.add_parser("alerts")
+    p.add_argument("--output", "-o", default="")
+    p.add_argument("--firing", action="store_true")
+
     p = sub.add_parser("autoscale")
     p.add_argument("target")  # resource/name
     p.add_argument("--min", type=int, required=True)
@@ -1887,6 +1982,11 @@ def main(argv: Optional[Sequence[str]] = None, client: Optional[RESTClient] = No
             limit=args.limit, output=args.output, user=args.user,
             verb=args.verb_filter, resource=args.resource,
         )
+    elif args.verb == "metrics":
+        out = k.metrics_query(args.expr, output=args.output)
+    elif args.verb == "alerts":
+        out = k.alerts_cmd(output=args.output,
+                           firing_only=args.firing)
     elif args.verb == "autoscale":
         resource, name = args.target.split("/", 1)
         out = k.autoscale(resource, name, args.min, args.max,

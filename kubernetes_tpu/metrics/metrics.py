@@ -19,9 +19,17 @@ def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
 
 
 class _Metric:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str,
+                 label_bound: Optional[int] = None):
         self.name = name
         self.help = help_
+        #: declared series-cardinality bound for metrics whose label
+        #: values are caller-controlled or otherwise unbounded (flow
+        #: keys, node names). tests/test_metrics_lint.py requires it
+        #: at every dynamic-label call site, and the telemetry TSDB
+        #: enforces the same cap at scrape time
+        #: (telemetry_series_dropped_total).
+        self.label_bound = label_bound
         self._lock = threading.Lock()
 
     def render(self) -> str:
@@ -29,8 +37,9 @@ class _Metric:
 
 
 class Counter(_Metric):
-    def __init__(self, name: str, help_: str = ""):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "",
+                 label_bound: Optional[int] = None):
+        super().__init__(name, help_, label_bound=label_bound)
         self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -115,8 +124,9 @@ class GaugeVec(_Metric):
     single-label schema — the per-queue depth case, where the label is
     the workqueue name)."""
 
-    def __init__(self, name: str, help_: str = "", label: str = "name"):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "", label: str = "name",
+                 label_bound: Optional[int] = None):
+        super().__init__(name, help_, label_bound=label_bound)
         self.label = label
         self._children: Dict[str, Gauge] = {}
 
@@ -155,8 +165,9 @@ class Histogram(_Metric):
         help_: str = "",
         buckets: Optional[Sequence[float]] = None,
         const_labels: Optional[Dict[str, str]] = None,
+        label_bound: Optional[int] = None,
     ):
-        super().__init__(name, help_)
+        super().__init__(name, help_, label_bound=label_bound)
         self.buckets = list(buckets or exponential_buckets(1000, 2, 15))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -248,8 +259,9 @@ class HistogramVec(_Metric):
         help_: str = "",
         label: str = "phase",
         buckets: Optional[Sequence[float]] = None,
+        label_bound: Optional[int] = None,
     ):
-        super().__init__(name, help_)
+        super().__init__(name, help_, label_bound=label_bound)
         self.label = label
         self._buckets = buckets
         self._children: Dict[str, Histogram] = {}
@@ -347,6 +359,7 @@ scheduler_wave_phase_seconds = registry.register(
         "Wire-path phase latency in seconds, labeled by phase",
         label="phase",
         buckets=_SECONDS_BUCKETS,
+        label_bound=8,
     )
 )
 
@@ -386,6 +399,7 @@ scheduler_gangs_parked_total = registry.register(
     Counter(
         "scheduler_gangs_parked_total",
         "PodGroups parked instead of partially bound, by reason",
+        label_bound=8,
     )
 )
 
@@ -404,6 +418,7 @@ scheduler_optimizer_waves_total = registry.register(
         "scheduler_optimizer_waves_total",
         "Waves driven by the optimizing (joint-packing) profile, "
         "by solver",
+        label_bound=8,
     )
 )
 
@@ -415,6 +430,7 @@ scheduler_optimizer_fallbacks_total = registry.register(
         "scheduler_optimizer_fallbacks_total",
         "Optimizer placements rejected by host re-validation and "
         "routed to the greedy fallback, by reason",
+        label_bound=8,
     )
 )
 
@@ -462,6 +478,7 @@ apiserver_request_latency = registry.register(
         "apiserver_request_latencies_microseconds",
         "apiserver request latency in microseconds, labeled by verb",
         label="verb",
+        label_bound=16,
     )
 )
 
@@ -473,6 +490,7 @@ apiserver_requests_total = registry.register(
     Counter(
         "apiserver_requests_total",
         "REST requests handled by the apiserver, labeled by verb",
+        label_bound=16,
     )
 )
 
@@ -581,6 +599,7 @@ apiserver_flowcontrol_request_wait_duration_seconds = registry.register(
         "Seconds requests waited in APF queues, labeled by priority level",
         label="priority_level",
         buckets=_SECONDS_BUCKETS,
+        label_bound=16,
     )
 )
 
@@ -590,6 +609,7 @@ apiserver_flowcontrol_current_inqueue_requests = registry.register(
         "apiserver_flowcontrol_current_inqueue_requests",
         "Requests currently queued by APF, labeled by priority level",
         label="priority_level",
+        label_bound=16,
     )
 )
 
@@ -599,6 +619,7 @@ apiserver_flowcontrol_rejected_requests_total = registry.register(
     Counter(
         "apiserver_flowcontrol_rejected_requests_total",
         "Requests rejected by APF, labeled by priority level and reason",
+        label_bound=32,
     )
 )
 
@@ -607,6 +628,7 @@ apiserver_flowcontrol_dispatched_requests_total = registry.register(
     Counter(
         "apiserver_flowcontrol_dispatched_requests_total",
         "Requests dispatched by APF, labeled by priority level",
+        label_bound=16,
     )
 )
 
@@ -670,6 +692,7 @@ apiserver_audit_event_total = registry.register(
     Counter(
         "apiserver_audit_event_total",
         "Audit events emitted by the apiserver, labeled by level and verb",
+        label_bound=64,
     )
 )
 
@@ -682,6 +705,7 @@ workqueue_depth = registry.register(
         "workqueue_depth",
         "Current depth of each named workqueue",
         label="name",
+        label_bound=32,
     )
 )
 
@@ -690,6 +714,7 @@ workqueue_adds_total = registry.register(
     Counter(
         "workqueue_adds_total",
         "Total adds handled by each named workqueue",
+        label_bound=32,
     )
 )
 
@@ -700,6 +725,7 @@ workqueue_queue_duration_seconds = registry.register(
         "Seconds an item waits in a named workqueue before processing",
         label="name",
         buckets=_SECONDS_BUCKETS,
+        label_bound=32,
     )
 )
 
@@ -710,6 +736,7 @@ workqueue_work_duration_seconds = registry.register(
         "Seconds spent processing one item from a named workqueue",
         label="name",
         buckets=_SECONDS_BUCKETS,
+        label_bound=32,
     )
 )
 
@@ -718,6 +745,7 @@ workqueue_retries_total = registry.register(
     Counter(
         "workqueue_retries_total",
         "Total rate-limited requeues per named workqueue",
+        label_bound=32,
     )
 )
 
@@ -726,6 +754,7 @@ reflector_lists_total = registry.register(
     Counter(
         "reflector_lists_total",
         "Total list operations performed by each named reflector",
+        label_bound=32,
     )
 )
 
@@ -736,6 +765,7 @@ reflector_list_duration_seconds = registry.register(
         "Seconds per reflector list operation, labeled by reflector",
         label="name",
         buckets=_SECONDS_BUCKETS,
+        label_bound=32,
     )
 )
 
@@ -746,6 +776,7 @@ reflector_watch_duration_seconds = registry.register(
         "Seconds one reflector watch session stayed open",
         label="name",
         buckets=_SECONDS_BUCKETS,
+        label_bound=32,
     )
 )
 
@@ -754,6 +785,7 @@ watch_events_total = registry.register(
     Counter(
         "watch_events_total",
         "Watch events applied by reflectors, labeled by name and type",
+        label_bound=128,
     )
 )
 
@@ -764,6 +796,7 @@ informer_sync_duration_seconds = registry.register(
         "Seconds from informer start until the initial sync completed",
         label="name",
         buckets=_SECONDS_BUCKETS,
+        label_bound=32,
     )
 )
 
@@ -773,6 +806,7 @@ client_events_discarded_total = registry.register(
     Counter(
         "client_events_discarded_total",
         "Events discarded by the client event spam filter",
+        label_bound=64,
     )
 )
 
@@ -785,6 +819,7 @@ quorum_term = registry.register(
         "quorum_term",
         "Current raft term of each quorum store member",
         label="node",
+        label_bound=16,
     )
 )
 
@@ -794,6 +829,7 @@ quorum_commit_index = registry.register(
         "quorum_commit_index",
         "Highest committed raft log index of each quorum store member",
         label="node",
+        label_bound=16,
     )
 )
 
@@ -804,6 +840,7 @@ quorum_leader_changes_total = registry.register(
     Counter(
         "quorum_leader_changes_total",
         "Quorum leader elections won, labeled by the winning node",
+        label_bound=16,
     )
 )
 
@@ -854,5 +891,52 @@ quorum_prevote_rounds_total = registry.register(
         "quorum_prevote_rounds_total",
         "Pre-vote electability probe rounds started before any real "
         "term-bumping election",
+    )
+)
+
+# -- continuous telemetry pipeline (kubernetes_tpu/telemetry) -----------------
+
+#: wall seconds of one full collector tick (every target scraped,
+#: parsed, and ingested) — the pipeline's own overhead, scraped into
+#: the very store it measures
+telemetry_scrape_duration_seconds = registry.register(
+    Histogram(
+        "telemetry_scrape_duration_seconds",
+        "Seconds per telemetry collector tick across all targets",
+        buckets=_SECONDS_BUCKETS,
+    )
+)
+
+#: scrape failures per target job (unreachable replica, parse error);
+#: a restarting fleet replica shows up here before it shows up dead
+telemetry_scrape_errors_total = registry.register(
+    Counter(
+        "telemetry_scrape_errors_total",
+        "Failed telemetry scrapes, labeled by target job",
+        label_bound=16,
+    )
+)
+
+#: 1 while an SLO alert rule is firing, 0 otherwise (one child per
+#: rule name) — the `kubectl alerts` signal and the thing dashboards
+#: would page on
+telemetry_alerts_firing = registry.register(
+    GaugeVec(
+        "telemetry_alerts_firing",
+        "Whether each telemetry SLO alert rule is currently firing",
+        label="alert",
+        label_bound=32,
+    )
+)
+
+#: series the TSDB refused to create because a metric blew through its
+#: declared label-cardinality bound — the store-side enforcement of
+#: the same `label_bound` the metrics lint demands at call sites
+telemetry_series_dropped_total = registry.register(
+    Counter(
+        "telemetry_series_dropped_total",
+        "Series rejected by the TSDB per-metric cardinality cap, "
+        "labeled by metric name",
+        label_bound=256,
     )
 )

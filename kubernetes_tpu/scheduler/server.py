@@ -117,6 +117,8 @@ class SchedulerServer:
         self._thread: Optional[threading.Thread] = None
         self._health_server = None
         self._slo = None
+        self._telemetry = None
+        self._telemetry_owned = False
         #: (host, port) of the daemon's observability mux once serving
         self.health_address: Optional[tuple] = None
         # set once the scheduling loop is open for business (informers
@@ -211,6 +213,21 @@ class SchedulerServer:
                 opts.slo_objective_seconds,
                 interval=opts.slo_check_interval,
             ).run()
+
+        # continuous telemetry (telemetry/): the process collector
+        # behind this mux's /debug/telemetry endpoints. ensure_default
+        # is idempotent — whoever attached first owns shutdown.
+        from kubernetes_tpu import telemetry
+        from kubernetes_tpu.telemetry import scrape as telemetry_scrape
+
+        if telemetry.enabled() and self._health_server is not None:
+            self._telemetry_owned = telemetry_scrape.default() is None
+            self._telemetry = telemetry_scrape.ensure_default(
+                "scheduler",
+                slo_seconds=(opts.slo_objective_seconds
+                             if opts.slo_objective_seconds > 0 else 5.0),
+                recorder=config.recorder,
+            )
 
         self.scheduler = Scheduler(config)
         if not opts.leader_elect:
@@ -343,6 +360,11 @@ class SchedulerServer:
         configz.delete("componentconfig")
         if self._slo is not None:
             self._slo.stop()
+        if self._telemetry is not None and self._telemetry_owned:
+            from kubernetes_tpu.telemetry import scrape as telemetry_scrape
+
+            telemetry_scrape.release_default(self._telemetry)
+            self._telemetry = None
         if self._health_server is not None:
             self._health_server.shutdown()
             self._health_server.server_close()

@@ -108,6 +108,21 @@ def start_component_server(
 
                     self._send(200, render_audit(query))
                     return
+                if path == "/debug/telemetry/query":
+                    from kubernetes_tpu import telemetry
+
+                    self._send(*telemetry.handle_query(query))
+                    return
+                if path == "/debug/telemetry/alerts":
+                    from kubernetes_tpu import telemetry
+
+                    self._send(*telemetry.handle_alerts(query))
+                    return
+                if path == "/debug/flightrecorder":
+                    from kubernetes_tpu import telemetry
+
+                    self._send(*telemetry.handle_flight(query))
+                    return
                 self._send(404, {"message": f"unknown path {parsed.path}"})
             except Exception as e:  # a broken probe must not kill the mux
                 try:

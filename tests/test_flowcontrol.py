@@ -357,12 +357,14 @@ def test_wide_head_timeout_releases_dispatcher():
         narrow_got.append(True)
 
     # the wide request queues with a SHORT timeout; the narrow one
-    # queues behind it with a long one
-    lvl.queue_wait = 0.3
+    # queues behind it with a long one (set_queue_wait: the budget is
+    # read under the level lock at enqueue, so flipping it between
+    # starts is race-free)
+    lvl.set_queue_wait(0.3)
     tw = threading.Thread(target=wide, daemon=True)
     tw.start()
     time.sleep(0.05)
-    lvl.queue_wait = 5.0
+    lvl.set_queue_wait(5.0)
     tn = threading.Thread(target=narrow, daemon=True)
     tn.start()
     time.sleep(0.05)

@@ -96,3 +96,117 @@ def test_rendered_exposition_parses():
         if not line or line.startswith("# "):
             continue
         assert sample.match(line), f"unparseable exposition line: {line!r}"
+
+
+# -- label-cardinality bounds (round 17) --------------------------------------
+#
+# A metric whose label VALUES come from runtime data (flow keys, node
+# ids, workqueue names) can mint unbounded series — each one a ring
+# buffer in the telemetry TSDB and a dict entry in the registry
+# forever. The rule: every call site that passes a non-literal label
+# value forces that metric to declare `label_bound=N` at registration;
+# the TSDB enforces the same bound at scrape time
+# (telemetry_series_dropped_total counts the overflow).
+
+_METRIC_MODULES = ("kubernetes_tpu.metrics", "kubernetes_tpu.metrics.metrics")
+_DYNAMIC_CALL_ATTRS = ("inc", "child", "labels")
+
+
+def _dynamic_label_call_sites():
+    """AST-walk the package for metric calls whose label values are
+    not literals: `m.inc(k=expr)`, `m.child(k=expr)`, `m.labels(expr)`
+    — resolving both `from kubernetes_tpu.metrics import x [as y]`
+    aliases and `metrics.x` / `_m.x` module-attribute access."""
+    import ast
+    import os
+
+    import kubernetes_tpu
+
+    pkg_root = os.path.dirname(kubernetes_tpu.__file__)
+    hits = {}  # metric variable name -> ["path:line", ...]
+    for root, dirs, files in os.walk(pkg_root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            aliases = {}       # local name -> metric variable name
+            mod_aliases = set()  # local names bound to a metrics module
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom):
+                    if node.module in _METRIC_MODULES:
+                        for a in node.names:
+                            aliases[a.asname or a.name] = a.name
+                    elif node.module == "kubernetes_tpu":
+                        for a in node.names:
+                            if a.name == "metrics":
+                                mod_aliases.add(a.asname or a.name)
+                elif isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name in _METRIC_MODULES:
+                            mod_aliases.add(
+                                a.asname or a.name.split(".")[0])
+            if not aliases and not mod_aliases:
+                continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fnode = node.func
+                if not isinstance(fnode, ast.Attribute) or \
+                        fnode.attr not in _DYNAMIC_CALL_ATTRS:
+                    continue
+                base = fnode.value
+                metric = None
+                if isinstance(base, ast.Name) and base.id in aliases:
+                    metric = aliases[base.id]
+                elif (isinstance(base, ast.Attribute)
+                      and isinstance(base.value, ast.Name)
+                      and base.value.id in mod_aliases):
+                    metric = base.attr
+                if metric is None:
+                    continue
+                if fnode.attr in ("inc", "child"):
+                    dynamic = any(
+                        not isinstance(kw.value, ast.Constant)
+                        for kw in node.keywords if kw.arg)
+                else:  # labels(x)
+                    dynamic = bool(node.args) and not isinstance(
+                        node.args[0], ast.Constant)
+                if dynamic:
+                    hits.setdefault(metric, []).append(
+                        f"{rel}:{node.lineno}")
+    return hits
+
+
+def test_caller_controlled_labels_declare_bounds():
+    import kubernetes_tpu.metrics.metrics as mm
+
+    hits = _dynamic_label_call_sites()
+    assert hits, "the call-site scan found nothing — scanner broken?"
+    missing = {}
+    for varname, sites in sorted(hits.items()):
+        metric = getattr(mm, varname, None)
+        if metric is None:
+            # a local alias the scan could not resolve to a registered
+            # metric (e.g. a test fixture); name-level rules above
+            # cover those
+            continue
+        if getattr(metric, "label_bound", None) is None:
+            missing[varname] = sites
+    assert not missing, (
+        "metrics take caller-controlled label values but declare no "
+        f"label_bound: {missing}"
+    )
+
+
+def test_label_bounds_are_positive_ints():
+    for m in _registered():
+        bound = getattr(m, "label_bound", None)
+        if bound is not None:
+            assert isinstance(bound, int) and bound > 0, (
+                f"metric {m.name!r} label_bound must be a positive "
+                f"int, got {bound!r}"
+            )
