@@ -45,6 +45,12 @@ enforcing:
   wrapper actually carries must match leaf-for-leaf.  A program whose
   carry drifts to a different PartitionSpec than the resident
   placement would silently reshard O(nodes) buffers on EVERY dispatch.
+* ``dtype-contract`` — the quantized-placement width contract
+  (parallel/quant): programs registered with ``narrow_dtypes`` must
+  receive each declared table AT its narrow dtype and must never widen
+  a node-axis narrow integer to int32/int64 in-program (gather/scatter
+  index feeds exempt) — a silent upcast reads the full-width bytes the
+  narrow placement exists to save.
 * ``scatter-contract`` — the scatter-form commit programs (PR 6's
   O(picks) shipment) are correct only because their updates commute:
   the registry declares the exact (primitive, scatter dims) forms each
@@ -433,6 +439,137 @@ def _scatter_findings(spec: ProgramSpec, jaxpr) -> List[Finding]:
     return findings
 
 
+#: operand positions that are INDEX feeds (exempt from the widening
+#: rule: jax converts index arrays to int32 internally, which is the
+#: one legitimate narrow->wide convert of a table-derived value)
+_INDEX_OPERANDS = {
+    "gather": (1,), "scatter": (1,), "scatter-add": (1,),
+    "scatter-mul": (1,), "scatter-min": (1,), "scatter-max": (1,),
+}
+
+#: prims index values legitimately flow THROUGH on their way to a
+#: gather/scatter operand (jax's index normalization: wrap negatives,
+#: reshape to the indices layout); outputs inherit the index-only
+#: obligation
+_INDEX_PLUMBING = {
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "slice",
+    "concatenate", "select_n", "add", "sub", "mul", "rem", "clamp",
+    "min", "max",
+}
+
+#: comparisons consume the value into a bool guard — one byte out, no
+#: widened table materialized
+_INDEX_GUARDS = {"lt", "le", "gt", "ge", "eq", "ne"}
+
+_NARROW_INTS = ("int8", "int16")
+_WIDE_INTS = ("int32", "int64")
+
+
+def _dtype_findings(spec: ProgramSpec, jaxpr) -> List[Finding]:
+    """The quantized-placement dtype contract: every declared-narrow
+    static table must ARRIVE at its narrow dtype, and no node-axis
+    narrow integer may be widened to int32/int64 inside the program
+    except to feed gather/scatter indices. A silent in-program upcast
+    reads the full-width bytes the narrow placement exists to avoid —
+    and on a mesh it materializes a widened copy of a sharded table
+    per dispatch."""
+    import jax
+    import numpy as np
+
+    if not spec.narrow_dtypes:
+        return []
+    decl = {name: np.dtype(dt) for name, dt in spec.narrow_dtypes}
+    findings: List[Finding] = []
+
+    # 1. arrival check: the program input leaf for each declared field
+    # (located by its pytree path key) carries the narrow dtype
+    leaves = jax.tree_util.tree_leaves_with_path(spec.args)
+    avals = list(jaxpr.in_avals)
+    node_dims = set()
+    for i, (path, _leaf) in enumerate(leaves):
+        name = None
+        for p in path:
+            k = getattr(p, "key", None)
+            if isinstance(k, str):
+                name = k
+        if name not in decl or i >= len(avals):
+            continue
+        aval = avals[i]
+        if np.dtype(aval.dtype) != decl[name]:
+            findings.append(Finding(
+                "jaxpr", "dtype-contract", spec.name,
+                f"input table {name!r} arrives as {aval.dtype}, "
+                f"declared narrow placement is {decl[name]} — the "
+                "driver stopped placing the quantized copy",
+            ))
+        shape = getattr(aval, "shape", ())
+        if shape:
+            node_dims.add(shape[0])
+
+    # 2. widening check: narrow-int -> wide-int converts of node-axis
+    # arrays, with the gather/scatter index exemption
+    from jax.core import Literal
+
+    def scan(jx):
+        uses: dict = {}
+        for eqn in jx.eqns:
+            for pos, v in enumerate(eqn.invars):
+                if not isinstance(v, Literal) and hasattr(v, "aval"):
+                    uses.setdefault(v, []).append((eqn, pos))
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                iv = eqn.invars[0]
+                aval = getattr(iv, "aval", None)
+                if aval is None:
+                    continue
+                in_dt = np.dtype(aval.dtype)
+                out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+                shape = getattr(aval, "shape", ())
+                if (in_dt.name in _NARROW_INTS
+                        and out_dt.name in _WIDE_INTS
+                        and shape and shape[0] in node_dims):
+                    # transitive index-feed walk: the converted value
+                    # may flow through jax's index normalization
+                    # (negative-wrap add/select, broadcast to the
+                    # indices layout) before the gather/scatter; every
+                    # terminal use must be an index operand or a bool
+                    # guard
+                    work = [eqn.outvars[0]]
+                    seen: set = set()
+                    index_only = bool(uses.get(eqn.outvars[0]))
+                    while work and index_only:
+                        v = work.pop()
+                        if v in seen:
+                            continue
+                        seen.add(v)
+                        for c, pos in uses.get(v, ()):
+                            cp = c.primitive.name
+                            if pos in _INDEX_OPERANDS.get(cp, ()):
+                                continue
+                            if cp in _INDEX_GUARDS:
+                                continue
+                            if cp in _INDEX_PLUMBING:
+                                work.extend(c.outvars)
+                                continue
+                            index_only = False
+                            break
+                    if not index_only:
+                        findings.append(Finding(
+                            "jaxpr", "dtype-contract", spec.name,
+                            f"{in_dt.name}->{out_dt.name} widening of "
+                            f"a node-axis array (shape {shape}) inside "
+                            "a quantized program — a declared-narrow "
+                            "table is being upcast in-program; consume "
+                            "it via quant.narrow_eq/narrow_matvec "
+                            "instead",
+                        ))
+            for sub in _subjaxprs(eqn):
+                scan(sub)
+
+    scan(jaxpr.jaxpr)
+    return findings
+
+
 def audit_program(spec: ProgramSpec) -> List[Finding]:
     import jax
 
@@ -442,6 +579,7 @@ def audit_program(spec: ProgramSpec) -> List[Finding]:
     findings.extend(_donation_findings(spec))
     findings.extend(_sharding_findings(spec, jaxpr))
     findings.extend(_scatter_findings(spec, jaxpr))
+    findings.extend(_dtype_findings(spec, jaxpr))
     return findings
 
 

@@ -62,6 +62,15 @@ class ProgramSpec:
     #: None = unaudited; anything outside the set — notably an
     #: overwrite `scatter` without unique indices — is a finding.
     scatter_allowed: Optional[Tuple[Tuple[str, Tuple[int, ...]], ...]] = None
+    #: DTYPE contract (dtype-contract audit): static table name ->
+    #: numpy dtype the program's matching input leaf must carry, for
+    #: tables the quantized placement (parallel/quant) declares narrow.
+    #: The auditor additionally rejects any widening
+    #: convert_element_type from a narrow int to int32/int64 on a
+    #: node-axis array inside the program (except pure gather/scatter
+    #: index feeds) — a declared-narrow table silently upcast in-program
+    #: pays the full-width bandwidth the shrink exists to save.
+    narrow_dtypes: Optional[Tuple[Tuple[str, str], ...]] = None
     notes: str = ""
 
 
@@ -187,6 +196,51 @@ def build_programs(include_mesh: bool = True) -> List[ProgramSpec]:
             notes="single-run packed probe (models/probe._probe_fn)",
         ),
     ]
+
+    # the Pallas probe build (KUBERNETES_TPU_KERNEL=pallas): same
+    # transfer contract as the lax build — ONE packed host-bound array
+    # — with the fused fit+score+top-of-table reduction as a pallas_call
+    # (ops/pallas_probe). The auditor recurses into the kernel jaxpr
+    # via the pallas_call params, so the callback/f64/denylist rules
+    # cover the kernel body too.
+    probe_pallas = WaveProbe(config, kernel="pallas")
+    specs.append(ProgramSpec(
+        name="probe_pallas",
+        fn=probe_pallas._compiled(num_zones, num_values, J),
+        args=(static, carry, pod),
+        carry_out_leaves=0,
+        expected_host_leaves=1,
+        notes="fused Pallas probe kernel (ops/pallas_probe): "
+              "bit-identical to the lax build by test contract",
+    ))
+
+    # quantized placements (parallel/quant): the probe traced against
+    # narrowed static node tables at BOTH narrow widths, with the dtype
+    # contract asserting the tables arrive narrow and are never widened
+    # in-program (the placement bandwidth win is real, not cosmetic)
+    from kubernetes_tpu.parallel import quant as _quant
+
+    for qdt in (np.int8, np.int16):
+        qstatic = dict(static)
+        decl = []
+        for f in _quant.NARROWABLE:
+            host_f = np.asarray(getattr(snap, f))
+            nat = _quant.narrow_dtype(f, host_f)
+            dt = np.dtype(qdt) if np.dtype(qdt).itemsize >= nat.itemsize \
+                else nat
+            qstatic[f] = jnp.asarray(host_f.astype(dt))
+            decl.append((f, dt.str))
+        specs.append(ProgramSpec(
+            name=f"probe_quant_{np.dtype(qdt).name}",
+            fn=probe._compiled(num_zones, num_values, J),
+            args=(qstatic, carry, pod),
+            carry_out_leaves=0,
+            expected_host_leaves=1,
+            narrow_dtypes=tuple(decl),
+            notes="probe against quantized node tables "
+                  f"({np.dtype(qdt).name} placement): decisions "
+                  "bit-identical, tables never widened in-program",
+        ))
 
     fused = probe._compiled_fused(num_zones, num_values, J, layout,
                                   wave._apply_fn)

@@ -63,6 +63,18 @@ from kubernetes_tpu.snapshot.encode import ClusterSnapshot, PodBatch
 from kubernetes_tpu.snapshot.pad import next_pow2, pad_batch
 from kubernetes_tpu.trace.profile import phase_timer
 
+#: KUBERNETES_TPU_PIPELINE=1: double-buffered run pipeline — stage the
+#: next run's pod buffer (pack + async upload) while the current probe
+#: is in flight on device (models/probe dispatch/collect split)
+ENV_PIPELINE = "KUBERNETES_TPU_PIPELINE"
+
+
+def _pipeline_enabled() -> bool:
+    import os
+
+    return os.environ.get(ENV_PIPELINE, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
 _WAVE_PRIORITIES = {
     LEAST_REQUESTED,
     BALANCED_ALLOCATION,
@@ -570,10 +582,26 @@ class WaveScheduler:
 
     def __init__(self, config: Optional[SchedulerConfig] = None,
                  min_run: int = 16, max_j: int = 1024, pod_floor: int = 64,
-                 replay=None):
+                 replay=None, kernel: Optional[str] = None,
+                 quant_mode: Optional[str] = None,
+                 pipeline: Optional[bool] = None):
+        from kubernetes_tpu.parallel import quant as _quant
+
         self.config = config or SchedulerConfig()
         self.scan = BatchScheduler(self.config)
-        self.probe = WaveProbe(self.config)
+        # kernel/quant_mode default from KUBERNETES_TPU_KERNEL /
+        # KUBERNETES_TPU_QUANT; explicit values let a shadow driver or
+        # an A/B bench force a specific build (parallel/quant)
+        self._quant_mode = _quant.mode() if quant_mode is None else quant_mode
+        self.probe = WaveProbe(
+            self.config, kernel=kernel,
+            score_mode=_quant.score_mode(self._quant_mode))
+        # double-buffered run pipeline (KUBERNETES_TPU_PIPELINE):
+        # decision-data compute order is unchanged — only HOST staging
+        # moves under the device's probe window — so decisions stay
+        # bit-identical to the serial loop (tests/test_kernel.py)
+        self.pipeline = (_pipeline_enabled() if pipeline is None
+                         else bool(pipeline))
         self.min_run = min_run
         self.max_j = max_j
         self.pod_floor = pod_floor
@@ -593,34 +621,129 @@ class WaveScheduler:
         from kubernetes_tpu.models.pack import Packer
 
         self._packer = Packer()
-        # device-resident snapshot fields across waves: field ->
-        # (shape, dtype, device array). The caller's `keep` set says which
-        # host fields are unchanged since the previous wave. `_dev_source`
-        # guards against reuse across snapshot provenances: arrays from a
-        # from-scratch encoder (fresh vocab bit/slot assignments) must
-        # never satisfy a `keep` computed by the incremental encoder.
+        # device-resident snapshot fields across waves (the mesh path's
+        # resident/mirror design, single-chip): field ->
+        # (host shape, host dtype, device array, full-width host MIRROR).
+        # The caller's `keep` set says which host fields are unchanged
+        # since the previous wave; fields NOT in keep are still reused
+        # when the mirror proves the content unchanged, scatter-updated
+        # when only a few rows moved, and re-shipped otherwise — so a
+        # quiet wave ships zero table bytes even without incremental
+        # provenance. `_dev_source` guards against reuse across snapshot
+        # provenances: arrays from a from-scratch encoder (fresh vocab
+        # bit/slot assignments) must never satisfy a `keep` computed by
+        # the incremental encoder.
         self._dev: dict = {}
         self._dev_source: Optional[str] = None
+        self._row_set_jit: dict = {}
+        # per-wave/total table-shipment accounting (bench --raw-curve)
+        self.stats = {
+            "waves": 0, "table_ships": 0, "table_reuses": 0,
+            "table_scatters": 0, "wave_table_bytes": 0,
+            "table_bytes_total": 0,
+            # bytes a reuse/scatter AVOIDED shipping (what the
+            # pre-resident driver re-shipped every wave) — the bench's
+            # steady-state byte-reduction numerator
+            "table_bytes_reused": 0,
+        }
+
+    # fraction of changed rows above which a scatter-row update loses
+    # to wholesale re-ship (mirrors parallel/resident.SCATTER_FRAC)
+    SCATTER_FRAC = 0.25
+
+    @staticmethod
+    def _rows_neq(mirror, host):
+        """Per-row changed mask, NaN-aware (numval uses NaN fills)."""
+        neq = mirror != host
+        if mirror.dtype.kind == "f":
+            neq &= ~(np.isnan(mirror) & np.isnan(host))
+        if neq.ndim == 1:
+            return neq
+        if neq.size == 0:
+            return np.zeros(neq.shape[0], bool)
+        return neq.reshape(neq.shape[0], -1).any(axis=1)
+
+    def _row_set(self, dtype, tail, bucket):
+        key = (np.dtype(dtype).str, tail, bucket)
+        fn = self._row_set_jit.get(key)
+        if fn is None:
+            fn = jax.jit(lambda a, r, v: a.at[r].set(v),
+                         donate_argnums=0)
+            self._row_set_jit[key] = fn
+        return fn
 
     def _to_dev_many(self, snap, fields, keep: frozenset, extra=None):
         """Device copies for `fields` (+ `extra` host arrays), shipping
         every miss in ONE batched device_put: on a tunneled chip each
         individual transfer costs a full dispatch round trip (~40ms
-        measured), so per-field puts dominate a cold wave."""
+        measured), so per-field puts dominate a cold wave. Placed
+        copies may ride a narrowed dtype (parallel/quant); mirrors
+        keep full width, and a narrow-range overflow changes the
+        placement dtype, which misses the cache and rebuilds wider."""
+        from kubernetes_tpu.parallel import quant as _quant
+
         out = {}
         missing = {}
+        scatters = []
         for f in fields:
             host = getattr(snap, f)
+            host_np = np.asarray(host)
+            place_dt = (_quant.narrow_dtype(f, host_np)
+                        if _quant.narrow_enabled(self._quant_mode)
+                        else host_np.dtype)
             ent = self._dev.get(f)
             if (
                 ent is not None
-                and f in keep
-                and ent[0] == host.shape
-                and ent[1] == host.dtype
+                and ent[2] is not None
+                and ent[0] == host_np.shape
+                and ent[1] == host_np.dtype
+                and ent[2].dtype == place_dt
             ):
-                out[f] = ent[2]
-            else:
-                missing[f] = np.asarray(host)
+                if f in keep:
+                    out[f] = ent[2]
+                    self.stats["table_reuses"] += 1
+                    self.stats["table_bytes_reused"] += ent[2].nbytes
+                    continue
+                neq = self._rows_neq(ent[3], host_np)
+                changed = np.nonzero(neq)[0]
+                if changed.size == 0:
+                    out[f] = ent[2]
+                    self.stats["table_reuses"] += 1
+                    self.stats["table_bytes_reused"] += ent[2].nbytes
+                    continue
+                if (host_np.ndim >= 1 and changed.size
+                        <= self.SCATTER_FRAC * host_np.shape[0]):
+                    scatters.append((f, host_np, place_dt, changed))
+                    continue
+            missing[f] = host_np.astype(place_dt) \
+                if place_dt != host_np.dtype else host_np
+            self._dev[f] = (host_np.shape, host_np.dtype, None,
+                            host_np.copy())
+        for f, host_np, place_dt, changed in scatters:
+            # pad the row count to a pow2 bucket (stable jit cache);
+            # duplicate rows re-set identical values, which is safe
+            bucket = 1
+            while bucket < changed.size:
+                bucket *= 2
+            rows = np.full(bucket, changed[0], np.int32)
+            rows[: changed.size] = changed
+            vals = np.ascontiguousarray(
+                host_np[rows].astype(place_dt, copy=False))
+            put = self._packer.ship(
+                {"__rows__": rows, "__vals__": vals})
+            ent = self._dev[f]
+            fn = self._row_set(place_dt, host_np.shape[1:], bucket)
+            arr = fn(ent[2], put["__rows__"], put["__vals__"])
+            mirror = ent[3]
+            mirror[changed] = host_np[changed]
+            self._dev[f] = (ent[0], ent[1], arr, mirror)
+            out[f] = arr
+            self.stats["table_scatters"] += 1
+            self.stats["wave_table_bytes"] += rows.nbytes + vals.nbytes
+            self.stats["table_bytes_total"] += rows.nbytes + vals.nbytes
+            self.stats["table_bytes_reused"] += max(
+                0, arr.nbytes - rows.nbytes - vals.nbytes)
+            self._count("table_scatter")
         if extra:
             missing.update(extra)
         if missing:
@@ -629,9 +752,12 @@ class WaveScheduler:
                 if extra and f in extra:
                     out[f] = arr
                     continue
-                host = missing[f]
-                self._dev[f] = (host.shape, host.dtype, arr)
+                ent = self._dev[f]
+                self._dev[f] = (ent[0], ent[1], arr, ent[3])
                 out[f] = arr
+                self.stats["table_ships"] += 1
+                self.stats["wave_table_bytes"] += missing[f].nbytes
+                self.stats["table_bytes_total"] += missing[f].nbytes
         return out
 
     # -- carry commit of a whole run -----------------------------------------
@@ -866,6 +992,8 @@ class WaveScheduler:
             self._dev.clear()
             self._dev_source = source
         self.dispatches = {}
+        self.stats["waves"] += 1
+        self.stats["wave_table_bytes"] = 0
         res_host = np.stack([
             np.asarray(snap.req_mcpu), np.asarray(snap.req_mem),
             np.asarray(snap.req_gpu), np.asarray(snap.nz_mcpu),
@@ -1013,18 +1141,60 @@ class WaveScheduler:
                 # the director's post-hoc check guards the binds
                 info["gang"] = None
 
-        def run_single(carry, info, done0=0):
+        # -- double-buffered staging (KUBERNETES_TPU_PIPELINE) --------
+        # rep -> (layout, device buf) packed + async-uploaded while an
+        # earlier run's probe was in flight. jax.device_put returns
+        # before the transfer completes, so the upload rides under the
+        # device's scoring window; run_single consumes the staged
+        # buffer instead of re-packing. Decision data is untouched —
+        # the staged buffer is bit-for-bit the buffer the serial loop
+        # would have packed at its later point.
+        staged: dict = {}
+
+        def _pack_run(rep):
+            ent = staged.pop(rep, None)
+            if ent is not None:
+                return ent
+            return pack_arrays({
+                f: np.asarray(getattr(batch, f)[rep])
+                for f in BatchScheduler.POD_FIELDS
+            })
+
+        def _stage_from(j):
+            """Stage the next host-path single run at or after infos[j]
+            (called between a probe's dispatch and collect). Runs that
+            will group pack their own fused group buffer, so staging
+            skips a pure run whose successor would group with it."""
+            while j < len(infos):
+                nxt = infos[j]
+                if not nxt["eligible"] or nxt["device"]:
+                    j += 1
+                    continue
+                if (nxt["pure"] and j + 1 < len(infos)
+                        and infos[j + 1]["pure"]
+                        and not infos[j + 1]["device"]):
+                    return  # will take the grouped header-probe path
+                if nxt["rep"] not in staged:
+                    with phase_timer("encode"):
+                        self._count("stage")
+                        l2, b2 = pack_arrays({
+                            f: np.asarray(getattr(batch, f)[nxt["rep"]])
+                            for f in BatchScheduler.POD_FIELDS
+                        })
+                        staged[nxt["rep"]] = (l2, jax.device_put(b2))
+                return
+
+        def run_single(carry, info, done0=0, next_idx=None):
             """The per-run fast path: probe_fused (or the single-run
             device replay) + host replay + deferred fold — one device
-            round trip per re-probe, exactly the pre-grouping shape."""
+            round trip per re-probe, exactly the pre-grouping shape.
+            Pipelined, the probe splits into dispatch + collect and the
+            NEXT run's buffer stages in the gap."""
             nonlocal L_host
             rep, start, length = info["rep"], info["start"], info["length"]
             self_anti_veto = info["veto"]
             svc_ctx = info["svc_ctx"]
-            layout, buf = pack_arrays({
-                f: np.asarray(getattr(batch, f)[rep])
-                for f in BatchScheduler.POD_FIELDS
-            })
+            layout, buf = _pack_run(rep)
             done = done0
             while done < length:
                 K = length - done
@@ -1057,18 +1227,45 @@ class WaveScheduler:
                     L_host = res.last_node_index
                     done += res.n_done
                     continue
-                with phase_timer("probe"):
-                    self._count("probe")
-                    carry, tables = self.probe.probe_fused(
-                        static, carry, prev_buf, prev_counts, buf,
-                        num_zones, num_values, J, rows, layout,
-                        self._apply_fn,
-                        has_selectors=bool(batch.has_selectors[rep]),
-                        zone_id=(np.asarray(snap.zone_id)
-                                 if zoned else None),
-                        self_anti_veto=self_anti_veto,
-                        svc_ctx=svc_ctx,
-                    )
+                if self.pipeline:
+                    # dispatch (async enqueue) .. stage .. collect:
+                    # the next run's pack + upload overlaps the
+                    # device's scoring of THIS probe. ONE probe timer
+                    # spans the whole device window with the staging
+                    # encode timer nested inside, so the trace
+                    # accountant's overlap_totals attributes exactly
+                    # the hidden staging seconds to the overlap.
+                    with phase_timer("probe"):
+                        self._count("probe")
+                        carry, raw = self.probe.probe_fused_dispatch(
+                            static, carry, prev_buf, prev_counts, buf,
+                            num_zones, num_values, J, layout,
+                            self._apply_fn,
+                        )
+                        if next_idx is not None:
+                            _stage_from(next_idx)
+                        tables = self.probe.probe_fused_collect(
+                            raw, num_zones, J, rows,
+                            has_selectors=bool(
+                                batch.has_selectors[rep]),
+                            zone_id=(np.asarray(snap.zone_id)
+                                     if zoned else None),
+                            self_anti_veto=self_anti_veto,
+                            svc_ctx=svc_ctx,
+                        )
+                else:
+                    with phase_timer("probe"):
+                        self._count("probe")
+                        carry, tables = self.probe.probe_fused(
+                            static, carry, prev_buf, prev_counts, buf,
+                            num_zones, num_values, J, rows, layout,
+                            self._apply_fn,
+                            has_selectors=bool(batch.has_selectors[rep]),
+                            zone_id=(np.asarray(snap.zone_id)
+                                     if zoned else None),
+                            self_anti_veto=self_anti_veto,
+                            svc_ctx=svc_ctx,
+                        )
                 if tables.sa_bail:
                     # ServiceAffinity dynamics the tables can't express
                     # (mid-run re-pin hazard): scan the rest of the run
@@ -1260,12 +1457,13 @@ class WaveScheduler:
                         carry, group)
                 if partial is not None:
                     g_idx, done = partial
-                    carry = run_single(carry, group[g_idx], done0=done)
+                    carry = run_single(carry, group[g_idx], done0=done,
+                                       next_idx=idx + g_idx + 1)
                     idx += g_idx + 1
                 else:
                     idx += consumed
                 continue
-            carry = run_single(carry, info)
+            carry = run_single(carry, info, next_idx=idx + 1)
             idx += 1
         carry = settle(carry)
         carry = flush(carry)

@@ -352,8 +352,8 @@ def _mesh_scan_fn(config, num_zones, n_per_shard, n_global, num_values,
             max_count = jax.lax.pmax(local_max, AXIS).astype(jnp.int64)
             s = R.normalize_counts_up(counts, max_count)
         elif name == "TaintTolerationPriority":
-            counts = (static["taint_count"] @ pod["intolerable_prefer"]).astype(
-                jnp.int64
+            counts = R.taint_intolerable_counts(
+                static["taint_count"], pod["intolerable_prefer"]
             )
             local_max = counts.max(where=fit, initial=0).astype(jnp.int32)
             max_count = jax.lax.pmax(local_max, AXIS).astype(jnp.int64)
@@ -609,9 +609,9 @@ def _mesh_probe_rows(config, num_zones, num_values, J, n_per_shard,
                 static["numval"], static["set_table"],
             )
         elif name == "TaintTolerationPriority":
-            stk_rows["tt_counts"] = (
-                static["taint_count"] @ pod["intolerable_prefer"]
-            ).astype(jnp.int64)
+            stk_rows["tt_counts"] = R.taint_intolerable_counts(
+                static["taint_count"], pod["intolerable_prefer"]
+            )
         elif name == INTER_POD_AFFINITY:
             stk_rows["ip_totals"] = IP.interpod_totals(
                 cnt_lt,

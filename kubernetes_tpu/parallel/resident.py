@@ -216,10 +216,19 @@ class ResidentClusterState:
     #: full table anyway)
     SCATTER_FRAC = 0.25
 
-    def __init__(self, mesh):
+    def __init__(self, mesh, quant_mode: Optional[str] = None):
         from kubernetes_tpu.analysis import races as _races
+        from kubernetes_tpu.parallel import quant as _quant
 
         self.mesh = mesh
+        # quantized placement (parallel/quant): declared-narrow STATIC
+        # node tables place at their audited width; carry leaves stay
+        # full width (the device folds accumulate into them). The
+        # placed dtype is part of the topology signature, so a value
+        # outgrowing its narrow range rebuilds the table wider.
+        self._quant = _quant
+        self._quant_mode = (_quant.mode() if quant_mode is None
+                            else quant_mode)
         self._key = None  # topology signature (shapes/dtypes/field set)
         self._static: Dict[str, object] = {}
         self._carry: Optional[tuple] = None
@@ -253,9 +262,22 @@ class ResidentClusterState:
 
     # -- sync ----------------------------------------------------------------
 
+    def _placed_dtype(self, f: str, arr: np.ndarray) -> np.dtype:
+        """Device-placement dtype for a field: the quant width audit
+        for declared-narrow static tables, the host dtype otherwise."""
+        if f in CARRY_FIELDS or not self._quant.narrow_enabled(
+                self._quant_mode):
+            return arr.dtype
+        return self._quant.narrow_dtype(f, arr)
+
+    def _placed(self, f: str, arr: np.ndarray) -> np.ndarray:
+        dt = self._placed_dtype(f, arr)
+        return arr.astype(dt, copy=False) if dt != arr.dtype else arr
+
     def _signature(self, hs: dict, hc: dict):
         return tuple(sorted(
-            (name, a.shape, a.dtype.str)
+            (name, a.shape, a.dtype.str,
+             self._placed_dtype(name, a).str)
             for name, a in list(hs.items()) + list(hc.items())
             if isinstance(a, np.ndarray)
         ))
@@ -311,7 +333,10 @@ class ResidentClusterState:
         self.stats["rebuilds"] += 1
         sspec, cspec = self._specs(hs.keys())
         names = list(hs.keys()) + list(CARRY_FIELDS)
-        arrays = [hs[n] for n in hs] + [hc[f] for f in CARRY_FIELDS]
+        # static tables place at their audited (possibly narrow) width;
+        # mirrors below keep the full-width host arrays
+        arrays = ([self._placed(n, hs[n]) for n in hs]
+                  + [hc[f] for f in CARRY_FIELDS])
         shard = self._shardings(sspec)
         shard.update(self._shardings(cspec))
         placed = jax.device_put(arrays, [shard[n] for n in names])
@@ -390,13 +415,14 @@ class ResidentClusterState:
             rows_union = None
         if replace:
             self.stats["replaces"] += 1
+            ships = [self._placed(f, h) for f, h, _s in replace]
             placed = jax.device_put(
-                [h for _f, h, _s in replace],
+                ships,
                 [self._shardings({f: s})[f] for f, _h, s in replace],
             )
-            for (f, host, _s), dev in zip(replace, placed):
+            for (f, host, _s), ship, dev in zip(replace, ships, placed):
                 self._store(f, dev, host)
-                self.count_h2d(host.nbytes, table=True)
+                self.count_h2d(ship.nbytes, table=True)
         if scatter:
             self._scatter(scatter, rows_union)
 
@@ -429,7 +455,13 @@ class ResidentClusterState:
         packed = {"__idx__": idx}
         names, axes, specs, arrays, hosts = [], [], [], [], []
         for f, host, spec, ax in fields:
+            # scatter rows ship at the resident array's placed dtype
+            # (identical to _placed_dtype(host) here — a width change
+            # changes the signature and rebuilds before _diff_sync)
             r = np.moveaxis(host, ax, 0)[rows]
+            pdt = self._placed_dtype(f, host)
+            if pdt != r.dtype:
+                r = r.astype(pdt)
             pad = np.zeros((M - len(rows),) + r.shape[1:], r.dtype)
             packed[f] = np.concatenate([r, pad]) if M > len(rows) else r
             names.append(f)

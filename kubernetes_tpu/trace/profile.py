@@ -165,6 +165,20 @@ def exclusive_totals() -> Dict[str, float]:
     return _ACCOUNTANT.snapshot()
 
 
+def overlap_totals() -> Dict[str, float]:
+    """Cumulative OVERLAPPED seconds per phase: occurrence wall
+    (phase_totals) minus the exclusive timeline's attribution — the
+    time a phase spent running concurrently under a higher-priority
+    phase. The double-buffered wave pipeline
+    (KUBERNETES_TPU_PIPELINE) shows up here as encode/transfer
+    seconds hidden under an in-flight probe window; a serial run
+    reads ~0 everywhere. Diff over a bench window like the other
+    totals."""
+    pt = phase_totals()
+    et = exclusive_totals()
+    return {p: max(0.0, pt[p] - et[p]) for p in PHASES}
+
+
 # -- XLA compile-vs-execute attribution ---------------------------------------
 
 _install_lock = threading.Lock()

@@ -360,9 +360,18 @@ def test_udp_echo_through_proxy():
         )
         addr = proxier.proxy_addr("default", "dns", "dns")
         c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # UDP: no delivery guarantee even on loopback — under full-suite
+        # load a 1-core box can starve the relay thread past a single
+        # receive window, so retry the datagram a few times
         c.settimeout(5)
-        c.sendto(b"hello", addr)
-        data, _ = c.recvfrom(4096)
+        data = None
+        for _ in range(4):
+            c.sendto(b"hello", addr)
+            try:
+                data, _ = c.recvfrom(4096)
+                break
+            except socket.timeout:
+                continue
         assert data == b"u:hello"
         c.close()
         usock.close()
