@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# CI entry points for the kubernetes_tpu tree. Three invocations, run
+# in this order — each is independently meaningful and independently
+# red/green:
+#
+#   build/ci.sh tier1      fast correctness suite (excludes slow marks)
+#   build/ci.sh analysis   static gate: AST lint + jaxpr audit + the
+#                          QUICK deterministic-simulation budget of
+#                          storage/quorum (clean-tree model check AND
+#                          the seeded-bug corpus must both pass;
+#                          exit 0 = clean tree)
+#   build/ci.sh race       armed race-witness run: the data-race
+#                          sanitizer instruments the chaos suites and
+#                          its JSONL findings merge back into the
+#                          analysis gate so one exit code carries the
+#                          whole verdict
+#
+# The DEEP simulation budget (widened BFS + long random-walk fault
+# schedules) rides inside the slow marks:
+#   python -m pytest tests/test_sim.py -m slow -q
+# Run it on the nightly lane, not per-commit: the quick budget already
+# replays every corpus trigger and a bounded exhaustive pass.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PYTEST_FLAGS=(-q -p no:cacheprovider -p no:xdist -p no:randomly)
+
+case "${1:-all}" in
+  tier1)
+    python -m pytest tests/ -m 'not slow' \
+        --continue-on-collection-errors "${PYTEST_FLAGS[@]}"
+    ;;
+  analysis)
+    python -m kubernetes_tpu.analysis
+    ;;
+  race)
+    report="$(mktemp -t race_witness.XXXXXX.jsonl)"
+    KUBERNETES_TPU_RACE_SANITIZER=1 \
+    KUBERNETES_TPU_RACE_REPORT="$report" \
+        python -m pytest tests/test_quorum.py \
+            tests/test_quorum_chaos.py tests/test_slo.py \
+            -m 'not slow' "${PYTEST_FLAGS[@]}"
+    python -m kubernetes_tpu.analysis --lint-only \
+        --race-report "$report"
+    ;;
+  all)
+    "$0" tier1 && "$0" analysis && "$0" race
+    ;;
+  *)
+    echo "usage: $0 {tier1|analysis|race|all}" >&2
+    exit 2
+    ;;
+esac

@@ -1,7 +1,7 @@
 """Static analysis & sanitizer suite — machine-checked contracts for
 the tensor-program scheduler.
 
-Four pass families, runnable standalone
+Five pass families, runnable standalone
 (``python -m kubernetes_tpu.analysis``, ``--json`` for the
 machine-readable CI artifact) and as tier-1 tests
 (tests/test_analysis.py):
@@ -43,6 +43,15 @@ machine-readable CI artifact) and as tier-1 tests
      (``KUBERNETES_TPU_RACE_SANITIZER=1``); findings dump as a JSONL
      artifact the CLI merges via ``--race-report``. Suppression:
      ``# race: allow[reason]`` at an access site.
+
+  5. **Deterministic simulation** (sim/): a FoundationDB-style model
+     checker for ``storage/quorum`` — virtual clock, in-memory net and
+     crash-faithful disk behind the node's injectable seams, bounded
+     exhaustive + seeded random schedule exploration, Raft safety
+     invariants checked after every event, violations emitted as
+     replayable schedule files. The quick budget runs under this CLI:
+     the clean tree must check quiet AND the seeded historical-bug
+     corpus (sim/corpus.py) must be re-found, or the gate is red.
 
 Each pass emits ``Finding`` rows; the CLI exits non-zero when any
 unsuppressed finding survives, which is the CI gate.
@@ -91,10 +100,12 @@ def render_report(findings: List[Finding], title: str = "") -> str:
 def run_static_passes(root: Optional[str] = None,
                       include_jaxpr: bool = True,
                       include_lint: bool = True,
-                      include_mesh: bool = True) -> List[Finding]:
-    """The CLI/CI body: lint the tree and audit the device programs.
-    (The lock-order and recompilation sanitizers are runtime passes;
-    they arm under the chaos/SLO tests instead.)"""
+                      include_mesh: bool = True,
+                      include_sim: bool = True) -> List[Finding]:
+    """The CLI/CI body: lint the tree, audit the device programs, and
+    model-check the consensus layer at the quick budget. (The
+    lock-order and recompilation sanitizers are runtime passes; they
+    arm under the chaos/SLO tests instead.)"""
     findings: List[Finding] = []
     if include_jaxpr:
         # the mesh shard_map variants need a multi-device host
@@ -135,6 +146,23 @@ def run_static_passes(root: Optional[str] = None,
                 "jaxpr", "trace-failure", "audit_all",
                 f"registered program failed to trace: {e!r}",
             ))
+    if include_sim:
+        # quick-budget deterministic simulation of storage/quorum:
+        # the clean tree must model-check quiet, AND the checker must
+        # still find every seeded historical bug (a blind checker is
+        # a gate failure, not a pass)
+        from kubernetes_tpu.analysis.sim import corpus
+
+        for v in corpus.check_clean():
+            findings.append(Finding(
+                "sim", "invariant-violation", "model-check", v))
+        for name, sched in sorted(corpus.find_seeded_bugs().items()):
+            if sched is None:
+                findings.append(Finding(
+                    "sim", "corpus-blind", name,
+                    "seeded historical bug not re-found within the "
+                    "quick model-check budget",
+                ))
     return findings
 
 

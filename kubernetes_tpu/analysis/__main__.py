@@ -15,6 +15,10 @@ Flags:
     --no-mesh       audit single-chip programs only (without it, an
                     unformable mesh is a `mesh-unavailable` finding,
                     never a silent coverage shrink)
+    --no-sim        skip the quick-budget deterministic simulation of
+                    storage/quorum (model check of the clean tree +
+                    the seeded-bug corpus gate); --lint-only and
+                    --jaxpr-only also skip it
     --json          machine-readable report: one JSON object per
                     finding on stdout (fields: pass, rule, where,
                     message, suppressed) — lint, jaxpr audit, and
@@ -76,6 +80,8 @@ def main(argv=None) -> int:
         include_jaxpr="--lint-only" not in argv,
         include_lint="--jaxpr-only" not in argv,
         include_mesh="--no-mesh" not in argv,
+        include_sim=not ({"--no-sim", "--lint-only", "--jaxpr-only"}
+                         & set(argv)),
     )
     for path in race_reports:
         try:

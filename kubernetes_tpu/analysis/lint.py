@@ -27,6 +27,15 @@ Two rule families:
                             outside metrics/metrics.py bypass the
                             duplicate-name registry
 
+* **Scoped rules** apply to named consensus-critical modules only:
+
+    - ``wall-clock-deadline``  ``time.time()`` feeding timeout / lease /
+                            deadline arithmetic in ``storage/quorum/``,
+                            ``client/transport.py``, or
+                            ``apiserver/flowcontrol.py`` — NTP steps
+                            the wall clock; election timers and leases
+                            must use ``time.monotonic()``
+
 * **Concurrency rules** (the static companion of analysis/races):
 
     - ``guarded-by``        a field annotated ``# guarded-by: self._lock``
@@ -470,6 +479,96 @@ def _check_module_wide(mod: _Module, findings: List[Finding]) -> None:
                         "exposition)")
 
 
+# -- wall-clock-deadline: monotonic-only timing in consensus paths -----------
+
+#: modules where EVERY timeout / lease / deadline computation must use
+#: the monotonic clock: election timers, leader leases, request
+#: deadlines, and flow-control queue timing all break when NTP steps
+#: the wall clock (a lease that "expires" early splits the brain; one
+#: that expires late serves stale reads)
+_WALL_CLOCK_SCOPE = (
+    "kubernetes_tpu/storage/quorum/",
+    "kubernetes_tpu/client/transport.py",
+    "kubernetes_tpu/apiserver/flowcontrol.py",
+)
+
+_DEADLINE_NAME_RE = re.compile(
+    r"deadline|expir|timeout|lease|until|cutoff", re.IGNORECASE)
+
+
+def _wall_clock_in_scope(relpath: str) -> bool:
+    return relpath.startswith(_WALL_CLOCK_SCOPE[0]) or \
+        relpath in _WALL_CLOCK_SCOPE[1:]
+
+
+def _is_wall_time_call(mod: _Module, node: ast.Call) -> bool:
+    dotted = _dotted(node.func) or ""
+    parts = dotted.split(".")
+    if len(parts) == 1:  # from time import time [as alias]
+        return mod.from_funcs.get(parts[0], ("", ""))[:2] == \
+            ("time", "time")
+    return parts[-1] == "time" and (
+        mod.mod_alias.get(parts[0], "") == "time"
+        or ".".join(parts[:-1]) == "time")
+
+
+def _check_wall_clock(mod: _Module, findings: List[Finding]) -> None:
+    """Flag ``time.time()`` feeding timeout/lease/deadline arithmetic
+    in the consensus-critical modules. Arithmetic participation (any
+    enclosing BinOp/AugAssign/Compare in the same statement), binding
+    to a deadline-ish name, or passing as a deadline-ish keyword all
+    count; a bare wall-clock read used for logging does not."""
+    parent: Dict[int, ast.AST] = {}
+    for n in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(n):
+            parent[id(child)] = n
+
+    def deadline_target(stmt: ast.AST) -> bool:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            name = _dotted(t) or ""
+            if _DEADLINE_NAME_RE.search(name):
+                return True
+        return False
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and _is_wall_time_call(mod, node)):
+            continue
+        reason = None
+        cur: ast.AST = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            up = parent.get(id(cur))
+            if isinstance(up, (ast.BinOp, ast.Compare, ast.AugAssign)):
+                reason = "in deadline arithmetic"
+                break
+            if isinstance(up, ast.keyword) and up.arg and \
+                    _DEADLINE_NAME_RE.search(up.arg):
+                reason = f"passed as {up.arg}="
+                break
+            cur = up
+        if reason is None:
+            stmt = cur
+            while stmt is not None and not isinstance(stmt, ast.stmt):
+                stmt = parent.get(id(stmt))
+            if stmt is not None and deadline_target(stmt):
+                reason = "bound to a deadline-valued name"
+        if reason is not None:
+            findings.append(Finding(
+                "lint", "wall-clock-deadline",
+                f"{mod.relpath}:{node.lineno}",
+                f"wall-clock time.time() {reason}: NTP steps break "
+                "election timers and leases here — use "
+                "time.monotonic()",
+                suppressed=mod.suppressed("wall-clock-deadline",
+                                          node.lineno),
+            ))
+
+
 # -- concurrency rules: guarded-by + thread-escape ----------------------------
 
 
@@ -680,6 +779,8 @@ def lint_sources(sources: Dict[str, str]) -> List[Finding]:
     for mod in mods.values():
         _check_module_wide(mod, findings)
         _check_concurrency(mod, findings)
+        if _wall_clock_in_scope(mod.relpath):
+            _check_wall_clock(mod, findings)
         if mod.modname.startswith(HOT_PREFIXES):
             seen: Set[int] = set()
             for modname, fname in traced:

@@ -241,6 +241,31 @@ class Nemesis:
         for st in self._states.values():
             st.set(dropped=False, delay=0.0, jitter=0.0)
 
+    def apply(self, spec) -> None:
+        """Apply one shared-vocabulary ``harness.faults.FaultSpec`` —
+        the same spec the sim checker's schedules are written in — by
+        dispatching to the verbs above. Sim-only discrete kinds
+        (DROP/DUPLICATE/CRASH/RECOVER act on one protocol message or
+        one process, which a byte-stream proxy cannot address) raise
+        ValueError rather than silently approximating."""
+        from kubernetes_tpu.harness.faults import FaultKind
+
+        if spec.kind is FaultKind.PARTITION:
+            self.partition(list(spec.a_side), list(spec.b_side))
+        elif spec.kind is FaultKind.ISOLATE:
+            self.isolate(spec.a_side[0])
+        elif spec.kind is FaultKind.ONE_WAY_DELAY:
+            self.one_way_delay(spec.a_side[0], spec.b_side[0],
+                               spec.magnitude)
+        elif spec.kind is FaultKind.JITTER:
+            self.jitter(spec.a_side[0], spec.b_side[0], spec.magnitude)
+        elif spec.kind is FaultKind.HEAL:
+            self.heal()
+        else:
+            raise ValueError(
+                f"fault kind {spec.kind.value!r} has no socket-level "
+                "interpretation (sim-only)")
+
     def close(self) -> None:
         for st in self._states.values():
             with st._cv:

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 from kubernetes_tpu.runtime import tlv
 from kubernetes_tpu.storage.durable import _CRC, _LEN, CorruptStoreError
+from kubernetes_tpu.storage.quorum.io import OS_DISK, Disk
 
 _HS_MAGIC = b"KTQHS001"
 _LOG_MAGIC = b"KTQLOG01"
@@ -96,9 +97,11 @@ class RaftLog:
     lock only so read-side helpers (replicator threads slicing entries)
     are safe against concurrent appends."""
 
-    def __init__(self, data_dir: str, fsync: bool = False):
+    def __init__(self, data_dir: str, fsync: bool = False,
+                 disk: Optional[Disk] = None):
         self._dir = data_dir
-        os.makedirs(data_dir, exist_ok=True)
+        self._disk = disk if disk is not None else OS_DISK
+        self._disk.makedirs(data_dir)
         self._hs_path = os.path.join(data_dir, "hardstate")
         self._log_path = os.path.join(data_dir, "raft.log")
         self._snap_path = os.path.join(data_dir, "raft.snap")
@@ -128,12 +131,12 @@ class RaftLog:
             self.voted_for = voted_for
             body = tlv.dumps([term, voted_for])
             tmp = self._hs_path + ".tmp"
-            with open(tmp, "wb") as f:
+            with self._disk.open(tmp, "wb") as f:
                 f.write(_HS_MAGIC)
                 f.write(frame(body))
                 f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._hs_path)
+                self._disk.fsync(f)
+            self._disk.replace(tmp, self._hs_path)
 
     # -- entries -------------------------------------------------------------
 
@@ -200,7 +203,7 @@ class RaftLog:
                 ))
                 self._wal.flush()
                 if self._fsync:
-                    os.fsync(self._wal.fileno())
+                    self._disk.fsync(self._wal)
 
     def truncate_from(self, index: int) -> None:
         """Drop every entry >= index (a follower discarding a suffix
@@ -262,51 +265,50 @@ class RaftLog:
                            state_blob: bytes) -> None:
         tmp = self._snap_path + ".tmp"
         body = tlv.dumps([last_index, last_term, state_blob])
-        with open(tmp, "wb") as f:
+        with self._disk.open(tmp, "wb") as f:
             f.write(_SNAP_MAGIC)
             f.write(frame(body))
             f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
+            self._disk.fsync(f)
+        self._disk.replace(tmp, self._snap_path)
 
     def _rewrite_log_locked(self) -> None:
         if self._wal is not None:
             self._wal.close()
         tmp = self._log_path + ".tmp"
-        with open(tmp, "wb") as f:
+        with self._disk.open(tmp, "wb") as f:
             f.write(_LOG_MAGIC)
             f.write(b"".join(
                 frame(tlv.dumps([e.term, e.index, e.payload, e.kind]))
                 for e in self._entries
             ))
             f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._log_path)
-        self._wal = open(self._log_path, "ab")
+            self._disk.fsync(f)
+        self._disk.replace(tmp, self._log_path)
+        self._wal = self._disk.open(self._log_path, "ab")
 
     def _open_wal_locked(self) -> None:
-        if not os.path.exists(self._log_path) or self._rewrite_header:
-            self._wal = open(self._log_path, "wb")
+        if not self._disk.exists(self._log_path) or self._rewrite_header:
+            self._wal = self._disk.open(self._log_path, "wb")
             self._wal.write(_LOG_MAGIC)
             self._wal.flush()
-            os.fsync(self._wal.fileno())
+            self._disk.fsync(self._wal)
             return
-        size = os.path.getsize(self._log_path)
+        size = self._disk.getsize(self._log_path)
         if self._valid_end < size:
             # truncate the torn tail recovery discarded: appending
             # behind torn bytes would lose the new records on replay
-            with open(self._log_path, "r+b") as f:
+            with self._disk.open(self._log_path, "r+b") as f:
                 f.truncate(self._valid_end)
                 f.flush()
-                os.fsync(f.fileno())
-        self._wal = open(self._log_path, "ab")
+                self._disk.fsync(f)
+        self._wal = self._disk.open(self._log_path, "ab")
 
     def _recover_locked(self) -> None:
         self._valid_end = 0
         self._rewrite_header = False
-        if os.path.exists(self._hs_path):
-            with open(self._hs_path, "rb") as f:
-                raw = f.read()
+        if self._disk.exists(self._hs_path):
+            raw = self._disk.read_bytes(self._hs_path)
             if not raw.startswith(_HS_MAGIC):
                 raise CorruptStoreError(
                     f"{self._hs_path}: bad hardstate magic")
@@ -316,9 +318,8 @@ class RaftLog:
                     f"{self._hs_path}: hardstate failed integrity check")
             with tlv.allow_dynamic():
                 self.term, self.voted_for = tlv.loads(body)
-        if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                raw = f.read()
+        if self._disk.exists(self._snap_path):
+            raw = self._disk.read_bytes(self._snap_path)
             if not raw.startswith(_SNAP_MAGIC):
                 raise CorruptStoreError(
                     f"{self._snap_path}: bad snapshot magic")
@@ -329,9 +330,8 @@ class RaftLog:
             with tlv.allow_dynamic():
                 self.snap_index, self.snap_term, self._snap_blob = \
                     tlv.loads(body)
-        if os.path.exists(self._log_path):
-            with open(self._log_path, "rb") as f:
-                raw = f.read()
+        if self._disk.exists(self._log_path):
+            raw = self._disk.read_bytes(self._log_path)
             if raw and not raw.startswith(_LOG_MAGIC):
                 if _LOG_MAGIC.startswith(raw[: len(_LOG_MAGIC)]):
                     raw = b""  # torn creation: magic never fully landed

@@ -65,7 +65,6 @@ from __future__ import annotations
 import logging
 import random
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -80,13 +79,14 @@ from kubernetes_tpu.metrics import (
     quorum_snapshot_installs_total,
     quorum_term,
 )
+from kubernetes_tpu.storage.quorum.io import WALL_CLOCK
 from kubernetes_tpu.storage.quorum.log import (
     KIND_CONFIG,
     KIND_DATA,
     Entry,
     RaftLog,
 )
-from kubernetes_tpu.storage.quorum.rpc import PeerClient, PeerServer, RPCError
+from kubernetes_tpu.storage.quorum.rpc import TCP_TRANSPORT, RPCError
 from kubernetes_tpu.storage.replicated import NotPrimary
 
 log = logging.getLogger(__name__)
@@ -94,6 +94,14 @@ log = logging.getLogger(__name__)
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
+
+#: _propose_status_locked outcomes: the proposal is still in flight,
+#: honestly committed+applied, definitively truncated by a competing
+#: leader, or unknowable (compacted across a term change).
+ACK_PENDING = "pending"
+ACK_ACKED = "acked"
+ACK_LOST = "lost"
+ACK_INDETERMINATE = "indeterminate"
 
 
 class QuorumUnavailable(NotPrimary):
@@ -146,6 +154,20 @@ class NodeConfig:
     #: drift between members. 0 disables lease reads (every barrier
     #: pays the confirm round).
     lease_factor: float = 0.75
+    #: max entries per AppendEntries batch. Replication of a long tail
+    #: happens across several round trips; the sim checker shrinks
+    #: this so multi-batch interleavings (the states where a follower's
+    #: log is shorter than leader_commit) are reachable in short
+    #: schedules.
+    replication_batch: int = 64
+    #: environment seams for the deterministic-simulation checker
+    #: (analysis/sim). None = production: the wall clock, framed TCP,
+    #: the real filesystem, and the process-global rng — exactly the
+    #: pre-seam code path.
+    clock: Optional[Any] = field(default=None, repr=False)
+    transport: Optional[Any] = field(default=None, repr=False)
+    disk: Optional[Any] = field(default=None, repr=False)
+    rng: Optional[Any] = field(default=None, repr=False)
 
 
 class QuorumNode:
@@ -161,7 +183,13 @@ class QuorumNode:
         self.state_fn = state_fn
         #: handler for forwarded client ops (set by QuorumStore)
         self.client_fn = client_fn
-        self.raft_log = RaftLog(config.data_dir, fsync=config.fsync)
+        self._clock = config.clock if config.clock is not None \
+            else WALL_CLOCK
+        self._transport = config.transport if config.transport is not None \
+            else TCP_TRANSPORT
+        self._rng = config.rng if config.rng is not None else random
+        self.raft_log = RaftLog(config.data_dir, fsync=config.fsync,
+                                disk=config.disk)
 
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
@@ -199,7 +227,7 @@ class QuorumNode:
         #: may not know the commit frontier until its own term commits)
         self._term_start_index = 0  # guarded-by: self._mu
         self._votes: set = set()  # guarded-by: self._mu
-        self._last_contact = time.monotonic()  # guarded-by: self._mu
+        self._last_contact = self._clock.monotonic()  # guarded-by: self._mu
         self._timeout = self._roll_timeout()  # guarded-by: self._mu
         self._force_compact = False  # guarded-by: self._mu
         self._pending_snap: Optional[Tuple[int, bytes]] = None  # guarded-by: self._mu
@@ -217,8 +245,8 @@ class QuorumNode:
         if blob is not None:
             self.install_fn(blob)
 
-        self._server = PeerServer(self._dispatch, host=config.listen_host,
-                                  port=config.listen_port)
+        self._server = self._transport.listen(
+            self._dispatch, config.listen_host, config.listen_port)
         self.address = self._server.address
         self._repl_clients: Dict[str, PeerClient] = {}  # guarded-by: self._mu
         self._vote_clients: Dict[str, PeerClient] = {}  # guarded-by: self._mu
@@ -245,14 +273,14 @@ class QuorumNode:
         to = self.config.rpc_timeout
         with self._mu:
             self._repl_clients = {
-                pid: PeerClient(addr, timeout=to)
+                pid: self._transport.connect(addr, to)
                 for pid, addr in self.config.peers.items()
             }
             # elections must not queue behind an in-flight replication
             # call on the shared per-peer socket: separate ballot
             # clients
             self._vote_clients = {
-                pid: PeerClient(addr, timeout=to)
+                pid: self._transport.connect(addr, to)
                 for pid, addr in self.config.peers.items()
             }
         # only now may peer/client messages arrive: every owner
@@ -324,17 +352,17 @@ class QuorumNode:
                 "members": sorted([self.node_id]
                                   + list(self.config.peers)),
                 "lease_valid": (self._lease_expiry_locked()
-                                > time.monotonic()),
+                                > self._clock.monotonic()),
                 "removed": self._removed,
             }
 
     def wait_applied(self, index: int, timeout: float) -> bool:
         """Block until the local apply position reaches `index` (the
         follower half of a read barrier)."""
-        deadline = time.monotonic() + timeout
+        deadline = self._clock.monotonic() + timeout
         with self._mu:
             while self.applied_index < index:
-                left = deadline - time.monotonic()
+                left = deadline - self._clock.monotonic()
                 if left <= 0 or self._killed:
                     return False
                 self._cv.wait(left)
@@ -389,23 +417,28 @@ class QuorumNode:
 
     def _propose_entry(self, payload: bytes, kind: int,
                        timeout: float) -> int:
-        deadline = time.monotonic() + timeout
+        deadline = self._clock.monotonic() + timeout
         with self._mu:
             if self.role != LEADER:
                 raise NotLeader(
                     f"{self.node_id} is {self.role}", self.leader_id)
-            term = self.raft_log.term
-            index = self.raft_log.last_index + 1
-            self.raft_log.append([Entry(term, index, payload, kind)])
-            self._maybe_commit_locked()  # single-node: majority of 1
-            self._cv.notify_all()
-            while self.applied_index < index:
-                if self.raft_log.term_at(index) != term:
+            term, index = self._leader_append_locked(payload, kind)
+            while True:
+                status = self._propose_status_locked(index, term)
+                if status == ACK_ACKED:
+                    return index
+                if status == ACK_LOST:
                     # a competing leader truncated our suffix: the
                     # entry is definitively lost, never acked
                     raise QuorumUnavailable(
                         f"entry {index} (term {term}) superseded")
-                left = deadline - time.monotonic()
+                if status == ACK_INDETERMINATE:
+                    err = QuorumUnavailable(
+                        f"entry {index} compacted across a term change "
+                        f"(term {term} -> {self.raft_log.term})")
+                    err.indeterminate = True
+                    raise err
+                left = deadline - self._clock.monotonic()
                 if left <= 0 or self._killed:
                     err = QuorumUnavailable(
                         f"entry {index} not committed within {timeout}s "
@@ -415,30 +448,43 @@ class QuorumNode:
                     err.indeterminate = True
                     raise err
                 self._cv.wait(left)
-            # the apply position passing `index` is NOT enough: a
-            # competing leader's overwriting entry advances it too.
-            # The ack is only honest when the slot still holds OUR
-            # entry (same term) — otherwise this proposal was
-            # truncated away and acking it would invent a commit the
-            # cluster never made (found by the partition chaos
-            # checker as a duplicate rv). Compaction may have folded
-            # the slot into the snapshot while we slept: if our term
-            # never moved, nothing could have overwritten it (only a
-            # higher-term leader truncates) and the compacted entry
-            # was ours; if the term DID move, whose entry got
-            # compacted is unknowable — indeterminate, not a clean
-            # failure.
-            if index > self.raft_log.snap_index:
-                if self.raft_log.term_at(index) != term:
-                    raise QuorumUnavailable(
-                        f"entry {index} (term {term}) superseded")
-            elif self.raft_log.term != term:
-                err = QuorumUnavailable(
-                    f"entry {index} compacted across a term change "
-                    f"(term {term} -> {self.raft_log.term})")
-                err.indeterminate = True
-                raise err
-            return index
+
+    def _leader_append_locked(self, payload: bytes,
+                              kind: int) -> Tuple[int, int]:
+        """Durably append one entry to the leader's own log ->
+        (term, index). The non-blocking half of propose: the
+        deterministic simulator appends here and then polls
+        ``_propose_status_locked`` between schedule events instead of
+        blocking on the condition variable."""
+        term = self.raft_log.term
+        index = self.raft_log.last_index + 1
+        self.raft_log.append([Entry(term, index, payload, kind)])
+        self._maybe_commit_locked()  # single-node: majority of 1
+        self._cv.notify_all()
+        return term, index
+
+    def _propose_status_locked(self, index: int, term: int) -> str:
+        """The honest-ack decision for a proposal appended at (term,
+        index). The apply position passing `index` is NOT enough: a
+        competing leader's overwriting entry advances it too. The ack
+        is only honest when the slot still holds OUR entry (same
+        term) — otherwise this proposal was truncated away and acking
+        it would invent a commit the cluster never made (found by the
+        partition chaos checker as a duplicate rv). Compaction may
+        have folded the slot into the snapshot while we waited: if
+        our term never moved, nothing could have overwritten it (only
+        a higher-term leader truncates) and the compacted entry was
+        ours; if the term DID move, whose entry got compacted is
+        unknowable — indeterminate, not a clean failure."""
+        rl = self.raft_log
+        if index > rl.snap_index:
+            if rl.term_at(index) != term:
+                return ACK_LOST
+        elif rl.term != term:
+            return ACK_INDETERMINATE
+        if self.applied_index < index:
+            return ACK_PENDING
+        return ACK_ACKED
 
     def apply_barrier(self, timeout: float = 5.0) -> None:
         """Leader-only: block until this term's start entry has
@@ -447,18 +493,26 @@ class QuorumNode:
         (election restriction) but may not have applied them yet —
         evaluating a proposal before this barrier would let a write
         land on a state missing its predecessors."""
-        deadline = time.monotonic() + timeout
+        deadline = self._clock.monotonic() + timeout
         with self._mu:
             if self.role != LEADER:
                 raise NotLeader(
                     f"{self.node_id} is {self.role}", self.leader_id)
             term = self.raft_log.term
-            while (self.commit_index < self._term_start_index
-                   or self.applied_index < self.commit_index):
+            while not self._barrier_ready_locked():
                 if not self._wait_leader_locked(term, deadline):
                     raise QuorumUnavailable(
                         "leader state never caught up to the commit "
                         "frontier (no majority reachable?)")
+
+    def _barrier_ready_locked(self) -> bool:
+        """True once this term's start entry has committed AND every
+        committed entry is applied locally — the gate a fresh leader
+        must pass before evaluating any proposal (the apply-barrier
+        rule; bypassing it lets a write land on a state missing its
+        predecessors)."""
+        return (self.commit_index >= self._term_start_index
+                and self.applied_index >= self.commit_index)
 
     def read_barrier(self, timeout: float = 2.0) -> int:
         """Linearizable read point (etcd ReadIndex): capture the
@@ -469,7 +523,7 @@ class QuorumNode:
         node cannot prove leadership — a lease-holding leader that
         loses its majority stops serving within the lease window by
         construction (the lease simply runs out)."""
-        deadline = time.monotonic() + timeout
+        deadline = self._clock.monotonic() + timeout
         with self._mu:
             if self.role != LEADER:
                 raise NotLeader(
@@ -482,7 +536,7 @@ class QuorumNode:
                     raise QuorumUnavailable("term-start entry never "
                                             "committed (no majority?)")
             read_index = self.commit_index
-            if self._lease_expiry_locked() > time.monotonic():
+            if self._lease_expiry_locked() > self._clock.monotonic():
                 quorum_lease_reads_total.inc()
             elif self.config.peers:
                 quorum_readindex_rounds_total.inc()
@@ -509,7 +563,7 @@ class QuorumNode:
         commit wait must never survive deposition."""
         if self.role != LEADER or self.raft_log.term != term:
             raise NotLeader(f"{self.node_id} deposed", self.leader_id)
-        left = deadline - time.monotonic()
+        left = deadline - self._clock.monotonic()
         if left <= 0 or self._killed:
             return False
         self._cv.wait(min(left, 0.05))
@@ -543,7 +597,7 @@ class QuorumNode:
         if self.role != LEADER or self.config.lease_factor <= 0:
             return 0.0
         times = sorted(
-            [time.monotonic()]
+            [self._clock.monotonic()]
             + [self._ack_start.get(p, 0.0) for p in self.config.peers],
             reverse=True)
         anchor = times[self._majority() - 1]
@@ -602,7 +656,7 @@ class QuorumNode:
             granted = False
             if target_term > cur:
                 mine = (self.raft_log.last_term, self.raft_log.last_index)
-                silent = (time.monotonic() - self._last_contact
+                silent = (self._clock.monotonic() - self._last_contact
                           >= self.config.election_timeout)
                 if ((last_term, last_idx) >= mine
                         and (silent or self.role == CANDIDATE)
@@ -667,22 +721,28 @@ class QuorumNode:
                 # have == t: duplicate delivery of an entry we hold
             if new:
                 rl.append(new)
-            # commit bound: the VERIFIED match frontier of THIS append
-            # (prev_idx + delivered entries — Raft's "index of last new
-            # entry"), never the raw log end: a healed follower may
-            # still hold a stale conflicting suffix from its own old
-            # term beyond the frontier, and applying it against a
-            # leader_commit that ran ahead of the delivered batch
-            # would ack a write the cluster never committed (found by
-            # the partition chaos checker as a duplicate commit)
-            if leader_commit > self.commit_index:
-                bound = min(leader_commit, match)
-                if bound > self.commit_index:
-                    self.commit_index = bound
-                    quorum_commit_index.labels(self.node_id).set(
-                        self.commit_index)
-                    self._cv.notify_all()
+            self._advance_commit_follower_locked(leader_commit, match)
             return ["apprep", rl.term, True, match, seq]
+
+    def _advance_commit_follower_locked(self, leader_commit: int,
+                                        match: int) -> None:
+        """Advance a follower's commit index from a successful append.
+        Commit bound: the VERIFIED match frontier of THIS append
+        (prev_idx + delivered entries — Raft's "index of last new
+        entry"), never the raw log end: a healed follower may still
+        hold a stale conflicting suffix from its own old term beyond
+        the frontier, and applying it against a leader_commit that ran
+        ahead of the delivered batch would ack a write the cluster
+        never committed (found by the partition chaos checker as a
+        duplicate commit; re-found by the sim corpus as mutation
+        ``commit-past-match``)."""
+        if leader_commit > self.commit_index:
+            bound = min(leader_commit, match)
+            if bound > self.commit_index:
+                self.commit_index = bound
+                quorum_commit_index.labels(self.node_id).set(
+                    self.commit_index)
+                self._cv.notify_all()
 
     def _on_snapshot(self, msg: Any) -> Any:
         _, term, leader, last_idx, last_term, blob = msg
@@ -707,10 +767,10 @@ class QuorumNode:
 
     def _roll_timeout(self) -> float:
         t = self.config.election_timeout
-        return random.uniform(t, 2 * t)
+        return self._rng.uniform(t, 2 * t)
 
     def _touch_locked(self) -> None:
-        self._last_contact = time.monotonic()
+        self._last_contact = self._clock.monotonic()
 
     def _majority(self) -> int:
         return (len(self.config.peers) + 1) // 2 + 1
@@ -735,32 +795,12 @@ class QuorumNode:
             with self._mu:
                 if self._killed:
                     return
-                if self.role == LEADER or self._removed:
-                    continue
-                now = time.monotonic()
-                if now - self._last_contact < self._timeout:
-                    continue
-                if now - self._prevote_last < self._timeout:
-                    continue  # a probe round is still maturing
-                self._timeout = self._roll_timeout()
-                self._prevote_last = now
-                if not self.config.peers:
-                    # single-node cluster: no one to probe, elect now
-                    self._begin_election_locked()
-                    continue
-                # silence past the randomized timeout: probe
-                # electability WITHOUT touching the term (pre-vote) —
-                # the real election starts only on a majority of grants
-                self._prevote_round += 1
-                round_id = self._prevote_round
-                self._prevotes = {self.node_id}
-                target_term = self.raft_log.term + 1
-                last_idx = self.raft_log.last_index
-                last_term = self.raft_log.last_term
-                peers = list(self.config.peers)
+                plan = self._election_tick_locked(
+                    self._clock.monotonic())
+            if plan is None:
+                continue
+            round_id, msg, peers = plan
             quorum_prevote_rounds_total.inc()
-            msg = ["prevote", target_term, self.node_id,
-                   last_idx, last_term]
             for pid in peers:
                 threading.Thread(
                     target=self._solicit_prevote,
@@ -768,6 +808,35 @@ class QuorumNode:
                     daemon=True,
                     name=f"quorum-preballot-{self.node_id}-{pid}",
                 ).start()
+
+    def _election_tick_locked(
+            self, now: float) -> Optional[Tuple[int, Any, List[str]]]:
+        """One election-timer check at `now`. Returns (round_id,
+        prevote_msg, peers) when a pre-vote round should be solicited
+        — the production ticker fans the solicitation out on threads,
+        the simulator enqueues the messages into SimNet. None when
+        the timer has not fired (or a single-node cluster elected
+        itself on the spot)."""
+        if self.role == LEADER or self._removed:
+            return None
+        if now - self._last_contact < self._timeout:
+            return None
+        if now - self._prevote_last < self._timeout:
+            return None  # a probe round is still maturing
+        self._timeout = self._roll_timeout()
+        self._prevote_last = now
+        if not self.config.peers:
+            # single-node cluster: no one to probe, elect now
+            self._begin_election_locked()
+            return None
+        # silence past the randomized timeout: probe electability
+        # WITHOUT touching the term (pre-vote) — the real election
+        # starts only on a majority of grants
+        self._prevote_round += 1
+        self._prevotes = {self.node_id}
+        msg = ["prevote", self.raft_log.term + 1, self.node_id,
+               self.raft_log.last_index, self.raft_log.last_term]
+        return self._prevote_round, msg, list(self.config.peers)
 
     def _solicit_prevote(self, pid: str, round_id: int,
                          msg: Any) -> None:
@@ -781,34 +850,46 @@ class QuorumNode:
                                  self.config.election_timeout))
         except RPCError:
             return
-        if not reply or reply[0] != "prevoterep":
-            return
-        _, rterm, granted = reply
-        begin = None
-        with self._mu:
-            if self._killed or self._removed:
-                return
-            if rterm > self.raft_log.term:
-                # someone is already ahead: adopt the term, no ballot
-                self._step_down_locked(rterm, "")
-                return
-            if (self._prevote_round != round_id or not granted
-                    or self.role == LEADER):
-                return
-            self._prevotes.add(pid)
-            if len(self._prevotes) >= self._majority():
-                self._prevote_round += 1  # fence the round's stragglers
-                begin = self._begin_election_locked()
+        begin = self._on_prevote_reply(pid, round_id, reply)
         if begin is not None:
-            term, last_idx, last_term = begin
-            vote_msg = ["vote", term, self.node_id, last_idx, last_term]
-            for peer in list(self.config.peers):
+            term, vote_msg, peers = begin
+            for peer in peers:
                 threading.Thread(
                     target=self._solicit_vote,
                     args=(peer, term, vote_msg),
                     daemon=True,
                     name=f"quorum-ballot-{self.node_id}-{peer}",
                 ).start()
+
+    def _on_prevote_reply(
+            self, pid: str, round_id: int,
+            reply: Any) -> Optional[Tuple[int, Any, List[str]]]:
+        """Count one pre-vote reply. Returns (term, vote_msg, peers)
+        the moment a majority of grants starts the real (term-bumping)
+        election — the caller solicits the actual ballots."""
+        if not reply or reply[0] != "prevoterep":
+            return None
+        _, rterm, granted = reply
+        with self._mu:
+            if self._killed or self._removed:
+                return None
+            if rterm > self.raft_log.term:
+                # someone is already ahead: adopt the term, no ballot
+                self._step_down_locked(rterm, "")
+                return None
+            if (self._prevote_round != round_id or not granted
+                    or self.role == LEADER):
+                return None
+            self._prevotes.add(pid)
+            if len(self._prevotes) < self._majority():
+                return None
+            self._prevote_round += 1  # fence the round's stragglers
+            begin = self._begin_election_locked()
+            if begin is None:
+                return None
+            term, last_idx, last_term = begin
+            vote_msg = ["vote", term, self.node_id, last_idx, last_term]
+            return term, vote_msg, list(self.config.peers)
 
     def _begin_election_locked(self):
         """Bump the term, persist the self-vote, become CANDIDATE.
@@ -841,6 +922,10 @@ class QuorumNode:
                                  self.config.election_timeout))
         except RPCError:
             return
+        self._on_vote_reply(pid, term, reply)
+
+    def _on_vote_reply(self, pid: str, term: int, reply: Any) -> None:
+        """Count one real-election ballot reply for `term`."""
         if not reply or reply[0] != "voterep":
             return
         _, rterm, granted = reply
@@ -901,50 +986,28 @@ class QuorumNode:
                     self._cv.wait(0.1)
                     continue
                 term = self.raft_log.term
-                nxt = self._next_index.get(pid, 1)
-                prev = nxt - 1
-                prev_term = self.raft_log.term_at(prev)
-                seq = self._confirm_seq
-                commit = self.commit_index
-                if prev_term is None:
-                    # the follower's next entry was compacted away:
-                    # ship the whole snapshot instead
-                    snap_idx, snap_term, blob = self.raft_log.snapshot()
-                    entries = None
-                else:
-                    entries = self.raft_log.entries_from(nxt)
-            if prev_term is None:
-                if blob is None:
-                    time.sleep(hb)
-                    continue
-                t0 = time.monotonic()
+                plan = self._build_replication_locked(pid)
+            if plan is None:
+                # snapshot needed but the blob is absent: wait it out
+                self._clock.sleep(hb)
+                continue
+            if plan[0] == "snap":
+                _, msg, snap_idx = plan
+                t0 = self._clock.monotonic()
                 try:
                     reply = client.call(
-                        ["snap", term, self.node_id, snap_idx,
-                         snap_term, blob],
-                        timeout=max(5.0, self.config.rpc_timeout))
+                        msg, timeout=max(5.0, self.config.rpc_timeout))
                 except RPCError:
-                    time.sleep(hb)
+                    self._clock.sleep(hb)
                     continue
-                installed = False
                 with self._mu:
-                    if reply[0] == "snaprep" and \
-                            reply[1] > self.raft_log.term:
-                        self._step_down_locked(reply[1], "")
-                    elif reply[0] == "snaprep" and reply[2]:
-                        self._next_index[pid] = snap_idx + 1
-                        self._match_index[pid] = max(
-                            self._match_index.get(pid, 0), snap_idx)
-                        self._lease_ack_locked(pid, term, t0)
-                        installed = True
+                    installed = self._on_snap_reply_locked(
+                        pid, term, t0, snap_idx, reply)
                 if installed:
                     quorum_snapshot_installs_total.inc()
                 continue
-            msg = ["append", term, self.node_id, prev, prev_term,
-                   [[e.term, e.index, e.payload, e.kind]
-                    for e in entries],
-                   commit, seq]
-            t0 = time.monotonic()
+            _, msg = plan
+            t0 = self._clock.monotonic()
             try:
                 reply = client.call(msg)
             except RPCError:
@@ -953,40 +1016,91 @@ class QuorumNode:
                 with self._mu:
                     self._cv.wait(hb)
                 continue
-            quorum_append_rtt_seconds.observe(time.monotonic() - t0)
+            quorum_append_rtt_seconds.observe(
+                self._clock.monotonic() - t0)
             if not reply or reply[0] != "apprep":
-                time.sleep(hb)
+                self._clock.sleep(hb)
                 continue
-            _, rterm, ok, match, rseq = reply
             with self._mu:
-                if rterm > self.raft_log.term:
-                    self._step_down_locked(rterm, "")
-                    continue
-                if self.role != LEADER or self.raft_log.term != term:
-                    continue
-                # lease contact: ANY same-term reply (success or
-                # conflict backoff) proves the peer followed us at
-                # some point AFTER this call's send time
-                self._lease_ack_locked(pid, term, t0)
-                if ok:
-                    if match > self._match_index.get(pid, 0):
-                        self._match_index[pid] = match
-                        self._maybe_commit_locked()
-                    self._next_index[pid] = match + 1
-                    if rseq > self._confirm_acked.get(pid, 0):
-                        self._confirm_acked[pid] = rseq
-                        self._cv.notify_all()  # barrier waiters
+                if self._on_append_reply_locked(pid, term, t0, reply):
                     # idle (nothing new, seq current): heartbeat pace;
                     # a fresh append or barrier notifies us awake
-                    if (self.raft_log.last_index < self._next_index[pid]
-                            and self._confirm_seq == rseq):
-                        self._cv.wait(hb)
-                else:
-                    # conflict hint: jump next_index straight to just
-                    # past the follower's usable log end
-                    self._next_index[pid] = max(
-                        1, min(self._next_index.get(pid, 1) - 1,
-                               match + 1))
+                    self._cv.wait(hb)
+
+    def _build_replication_locked(self, pid: str) -> Optional[Tuple]:
+        """Build the next replication message for `pid` from the
+        leader's bookkeeping: ("append", msg) for a log append (empty
+        entry list = heartbeat), ("snap", msg, snap_idx) when the
+        follower's next entry was compacted away, or None when the
+        needed snapshot blob is absent (nothing sendable yet)."""
+        term = self.raft_log.term
+        nxt = self._next_index.get(pid, 1)
+        prev = nxt - 1
+        prev_term = self.raft_log.term_at(prev)
+        if prev_term is None:
+            # the follower's next entry was compacted away: ship the
+            # whole snapshot instead
+            snap_idx, snap_term, blob = self.raft_log.snapshot()
+            if blob is None:
+                return None
+            return ("snap", ["snap", term, self.node_id, snap_idx,
+                             snap_term, blob], snap_idx)
+        entries = self.raft_log.entries_from(
+            nxt, self.config.replication_batch)
+        return ("append",
+                ["append", term, self.node_id, prev, prev_term,
+                 [[e.term, e.index, e.payload, e.kind]
+                  for e in entries],
+                 self.commit_index, self._confirm_seq])
+
+    def _on_snap_reply_locked(self, pid: str, term: int, t0: float,
+                              snap_idx: int, reply: Any) -> bool:
+        """Process a snapshot-install reply for a call sent at t0;
+        True when the follower accepted the install."""
+        if not reply or reply[0] != "snaprep":
+            return False
+        if reply[1] > self.raft_log.term:
+            self._step_down_locked(reply[1], "")
+            return False
+        if not reply[2]:
+            return False
+        self._next_index[pid] = snap_idx + 1
+        self._match_index[pid] = max(
+            self._match_index.get(pid, 0), snap_idx)
+        self._lease_ack_locked(pid, term, t0)
+        return True
+
+    def _on_append_reply_locked(self, pid: str, term: int, t0: float,
+                                reply: Any) -> bool:
+        """Process one AppendEntries reply for a call sent (at our
+        term `term`) at t0: advance match/next/commit/lease/confirm
+        bookkeeping. Returns True when the replicator may idle at
+        heartbeat pace (nothing new to send, confirm seq current)."""
+        _, rterm, ok, match, rseq = reply
+        if rterm > self.raft_log.term:
+            self._step_down_locked(rterm, "")
+            return False
+        if self.role != LEADER or self.raft_log.term != term:
+            return False
+        # lease contact: ANY same-term reply (success or conflict
+        # backoff) proves the peer followed us at some point AFTER
+        # this call's send time
+        self._lease_ack_locked(pid, term, t0)
+        if ok:
+            if match > self._match_index.get(pid, 0):
+                self._match_index[pid] = match
+                self._maybe_commit_locked()
+            self._next_index[pid] = match + 1
+            if rseq > self._confirm_acked.get(pid, 0):
+                self._confirm_acked[pid] = rseq
+                self._cv.notify_all()  # barrier waiters
+            return (self.raft_log.last_index < self._next_index[pid]
+                    and self._confirm_seq == rseq)
+        # conflict hint: jump next_index straight to just past the
+        # follower's usable log end
+        self._next_index[pid] = max(
+            1, min(self._next_index.get(pid, 1) - 1, match + 1))
+        return False
 
     def _maybe_commit_locked(self) -> None:
         """Advance commit_index to the highest index replicated on a
@@ -1028,8 +1142,10 @@ class QuorumNode:
                 else:
                     self.config.peers[pid] = addr
                     to = self.config.rpc_timeout
-                    self._repl_clients[pid] = PeerClient(addr, timeout=to)
-                    self._vote_clients[pid] = PeerClient(addr, timeout=to)
+                    self._repl_clients[pid] = self._transport.connect(
+                        addr, to)
+                    self._vote_clients[pid] = self._transport.connect(
+                        addr, to)
                     self._next_index[pid] = self.raft_log.last_index + 1
                     self._match_index[pid] = 0
                     self._confirm_acked[pid] = 0
@@ -1069,6 +1185,48 @@ class QuorumNode:
             self._spawn_replicator(spawn)
 
     # -- apply loop ----------------------------------------------------------
+
+    def _apply_next(self) -> bool:
+        """Apply exactly one pending item — a leader-installed
+        snapshot, or the next committed-but-unapplied entry. Returns
+        False when the state machine is current. The production apply
+        loop batches (below) for throughput; the deterministic
+        simulator steps one entry at a time through this so invariants
+        can be checked between applies."""
+        with self._mu:
+            snap = self._pending_snap
+            self._pending_snap = None
+            e: Optional[Entry] = None
+            if snap is None:
+                if self.applied_index < self.commit_index:
+                    e = self.raft_log.entry(self.applied_index + 1)
+                if e is None:
+                    return False
+        if snap is not None:
+            idx, blob = snap
+            self.install_fn(blob)
+            with self._mu:
+                if idx > self.applied_index:
+                    self.applied_index = idx
+                self._cv.notify_all()
+            return True
+        if e.kind == KIND_CONFIG:
+            try:
+                self._apply_config(e.payload)
+            except Exception:
+                log.exception(
+                    "%s: membership change at entry %s failed",
+                    self.node_id, e.index)
+        elif e.payload:
+            try:
+                self.apply_fn(e.payload, e.index)
+            except Exception:
+                log.exception("%s: apply of entry %s failed",
+                              self.node_id, e.index)
+        with self._mu:
+            self.applied_index = e.index
+            self._cv.notify_all()
+        return True
 
     def _apply_loop(self) -> None:
         while not self._stopped.is_set():

@@ -214,3 +214,37 @@ class PeerServer:
                 c.close()
             except OSError:
                 pass
+
+
+class Transport:
+    """Peer-communication seam: how a node listens and how it reaches
+    a peer. Production is framed TCP (below); the deterministic
+    simulator (``analysis/sim``) substitutes in-memory per-edge
+    queues so message delivery, loss, duplication, reorder, and
+    partitions happen exactly when a schedule says so."""
+
+    def listen(self, handler: Callable[[Any], Any], host: str,
+               port: int):
+        """Return a server object exposing ``.address``, ``.serve()``
+        and ``.close()`` (the PeerServer surface)."""
+        raise NotImplementedError
+
+    def connect(self, address: Tuple[Any, Any], timeout: float):
+        """Return a client object exposing ``.call(msg, timeout=None)``
+        and ``.close()`` (the PeerClient surface)."""
+        raise NotImplementedError
+
+
+class TCPTransport(Transport):
+    """Production transport: the framed TCP client/server above."""
+
+    def listen(self, handler: Callable[[Any], Any], host: str,
+               port: int) -> PeerServer:
+        return PeerServer(handler, host=host, port=port)
+
+    def connect(self, address: Tuple[Any, Any],
+                timeout: float) -> PeerClient:
+        return PeerClient(address, timeout=timeout)
+
+
+TCP_TRANSPORT = TCPTransport()

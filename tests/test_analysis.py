@@ -215,6 +215,63 @@ def test_lint_suppression_syntax():
     assert len(hs) == 1 and hs[0].suppressed, found
 
 
+_WALL_CLOCK_FIXTURE = '''\
+import time
+from time import time as walltime
+
+
+class Lease:
+    def renew(self, window):
+        self.expiry = time.time() + window        # arithmetic
+        return self.expiry
+
+    def valid(self):
+        return time.time() < self.expiry          # comparison
+
+    def wait_for(self, cond):
+        cond.wait(timeout=time.time())            # deadline keyword
+        self.deadline = walltime()                # deadline-ish bind
+
+    def stamp_event(self):
+        return time.time()                        # bare read: legal
+
+    def monotonic_path(self, window):
+        return time.monotonic() + window          # the correct form
+'''
+
+
+def test_seeded_wall_clock_deadline_is_flagged():
+    found = lint.lint_sources(
+        {"kubernetes_tpu/storage/quorum/_seeded_lease.py":
+         _WALL_CLOCK_FIXTURE})
+    wc = [f for f in found if f.rule == "wall-clock-deadline"]
+    assert len(wc) == 4 and not any(f.suppressed for f in wc), found
+    lines = sorted(int(f.where.rsplit(":", 1)[1]) for f in wc)
+    assert lines == [7, 11, 14, 15], wc
+
+
+def test_wall_clock_rule_covers_all_named_modules_and_no_others():
+    src = "import time\ndeadline = time.time() + 5.0\n"
+    for rel in ("kubernetes_tpu/storage/quorum/_seeded.py",
+                "kubernetes_tpu/client/transport.py",
+                "kubernetes_tpu/apiserver/flowcontrol.py"):
+        found = lint.lint_sources({rel: src})
+        assert any(f.rule == "wall-clock-deadline" for f in found), rel
+    # identical source outside the consensus-critical scope is exempt
+    found = lint.lint_sources(
+        {"kubernetes_tpu/scheduler/_seeded.py": src})
+    assert not any(f.rule == "wall-clock-deadline" for f in found)
+
+
+def test_wall_clock_suppression_syntax():
+    src = ("import time\n"
+           "t = time.time() + 5  # lint: allow[wall-clock-deadline]\n")
+    found = lint.lint_sources(
+        {"kubernetes_tpu/storage/quorum/_seeded.py": src})
+    wc = [f for f in found if f.rule == "wall-clock-deadline"]
+    assert len(wc) == 1 and wc[0].suppressed, found
+
+
 def test_lint_traced_scope_is_transitive_and_cold_code_is_exempt():
     src = '''\
 import jax
